@@ -1,0 +1,18 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Brand-new implementation of the capability surface of PaddlePaddle Fluid
+(reference at /root/reference, see SURVEY.md) on JAX/XLA/Pallas/pjit:
+programs are serializable IR built by a layer API, lowered whole to jitted
+XLA executables; autodiff and distribution are functional transforms;
+parallelism is mesh sharding with XLA collectives over ICI/DCN.
+"""
+
+from .core import (Program, Block, OpDesc, VarDesc, program_guard,
+                   default_main_program, default_startup_program,
+                   Scope, global_scope, scope_guard,
+                   Executor, Place, CPUPlace, TPUPlace, unique_name)
+from . import ops  # registers the op library
+from . import backward
+from .backward import append_backward, calc_gradient, grad_var_name
+
+__version__ = "0.1.0"

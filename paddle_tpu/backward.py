@@ -1,0 +1,80 @@
+"""Autodiff as a program transform.
+
+≙ reference python/paddle/fluid/backward.py:434 `append_backward`. The
+reference reverse-walks the op list appending one grad-OpDesc per forward op
+(via each op's C++ GradOpMaker), inserting `sum` ops for fan-out and pruning
+no-grad branches. On a JAX runtime the differentiation itself is the
+platform's reverse-mode transform, so `append_backward` here:
+
+1. decides the differentiable parameter set (trainable params minus
+   no_grad_set, minus anything behind stop_gradient vars — same pruning
+   semantics, enforced at trace time by lax.stop_gradient in the lowering),
+2. declares `@GRAD` variables for loss and parameters, and
+3. appends ONE `autodiff` pseudo-op that the lowering expands into
+   jax.value_and_grad over the block prefix (core/lowering.py).
+
+The observable contract is identical: after append_backward, `p@GRAD` vars
+exist and downstream (optimizer) ops can consume them; param_grads pairs are
+returned for Optimizer._create_optimization_pass.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .core.program import Program, VarDesc, default_main_program
+from .core.lowering import AUTODIFF_OP
+
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+def append_backward(loss: VarDesc, parameter_list: Optional[Sequence[str]] = None,
+                    no_grad_set: Optional[set] = None,
+                    callbacks=None) -> List[Tuple[VarDesc, VarDesc]]:
+    """Append the gradient boundary for `loss`; returns [(param, grad)] pairs."""
+    program = default_main_program()
+    block = program.global_block
+    no_grad_set = set(no_grad_set or ())
+
+    if parameter_list is not None:
+        param_names = list(parameter_list)
+    else:
+        param_names = [p.name for p in block.all_parameters() if p.trainable]
+    param_names = [p for p in param_names if p not in no_grad_set]
+
+    grad_names = []
+    pairs = []
+    for p in param_names:
+        pvar = block.var(p)
+        g = block.create_var(grad_var_name(p), shape=pvar.shape, dtype=pvar.dtype)
+        g.stop_gradient = True
+        grad_names.append(g.name)
+        pairs.append((pvar, g))
+
+    loss_grad = block.create_var(grad_var_name(loss.name), shape=loss.shape,
+                                 dtype=loss.dtype)
+    loss_grad.stop_gradient = True
+
+    block.append_op(
+        AUTODIFF_OP,
+        inputs={}, outputs={"Grads": grad_names},
+        attrs={"loss": loss.name, "params": param_names,
+               "grad_names": grad_names, "loss_scale": 1.0})
+    return pairs
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """≙ backward.py:604 calc_gradient — gradient of targets w.r.t. arbitrary
+    vars. Implemented as append_backward with an explicit parameter list."""
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    pairs = append_backward(targets[0], parameter_list=[v.name for v in inputs],
+                            no_grad_set=no_grad_set)
+    return [g for _, g in pairs]

@@ -1,0 +1,17 @@
+from .program import (Program, Block, OpDesc, VarDesc, program_guard,
+                      default_main_program, default_startup_program,
+                      switch_main_program, switch_startup_program,
+                      unique_name, reset_unique_names)
+from .scope import Scope, global_scope, scope_guard
+from .executor import Executor, Place, CPUPlace, TPUPlace
+from .registry import register_op, get_op, require_op, registered_ops
+from . import types
+
+__all__ = [
+    "Program", "Block", "OpDesc", "VarDesc", "program_guard",
+    "default_main_program", "default_startup_program", "switch_main_program",
+    "switch_startup_program", "unique_name", "reset_unique_names",
+    "Scope", "global_scope", "scope_guard",
+    "Executor", "Place", "CPUPlace", "TPUPlace",
+    "register_op", "get_op", "require_op", "registered_ops", "types",
+]

@@ -1,0 +1,144 @@
+"""Executor: compile-and-run programs against a Scope.
+
+≙ reference Executor (paddle/fluid/framework/executor.h:39, executor.cc:127)
+and its Python wrapper (python/paddle/fluid/executor.py:183). The reference
+interprets programs op-by-op per step; here `run` lowers the program ONCE per
+(program, feed-signature, fetch-list) to a jitted XLA executable
+(core/lowering.py) and replays it — the compile cache plays the role of the
+reference's program cache (executor.py:165) and `Executor::Prepare`
+(executor.cc:296).
+
+Feed/fetch: the reference injects feed/fetch ops that move data through
+holder variables (executor.cc:230-294). Under a functional runtime the feed
+dict simply becomes jit arguments and fetches become return values — no ops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .program import Program, VarDesc, default_main_program
+from .scope import Scope, global_scope
+from .types import np_dtype
+from . import lowering
+
+
+def _device_dtype(dtype: str) -> str:
+    """64-bit host dtypes narrow to 32-bit on device (TPU-native widths)."""
+    return {"int64": "int32", "float64": "float32", "uint8": "uint8"}.get(dtype, dtype)
+
+
+class Place:
+    """Device identity (≙ platform/place.h:25-57). On the JAX runtime the
+    actual placement is owned by XLA; Place survives as an API-parity tag."""
+
+    def __init__(self, kind: str = "tpu", index: int = 0):
+        self.kind, self.index = kind, index
+
+    def __repr__(self):
+        return f"{self.kind.upper()}Place({self.index})"
+
+
+def CPUPlace():
+    return Place("cpu")
+
+
+def TPUPlace(index: int = 0):
+    return Place("tpu", index)
+
+
+class _Compiled:
+    __slots__ = ("fn", "state_in", "state_out", "fetch_names")
+
+    def __init__(self, fn, state_in, state_out, fetch_names):
+        self.fn = fn
+        self.state_in = state_in
+        self.state_out = state_out
+        self.fetch_names = fetch_names
+
+
+class Executor:
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place or Place("tpu")
+        self._cache: Dict[tuple, _Compiled] = {}
+        self._run_counter = 0
+
+    # -- helpers ------------------------------------------------------------
+    @staticmethod
+    def _fetch_name(f) -> str:
+        return f.name if isinstance(f, VarDesc) else str(f)
+
+    def _prep_feed(self, program: Program, feed: Dict[str, object]):
+        out = {}
+        for name, val in feed.items():
+            arr = np.asarray(val)
+            try:
+                var = program.global_block.var(name)
+                want = np_dtype(_device_dtype(var.dtype))
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            except KeyError:
+                pass
+            out[name] = jnp.asarray(arr)
+        return out
+
+    def _state_for(self, program: Program, scope: Scope) -> Dict[str, object]:
+        """Persistable vars the program reads that already exist in the scope."""
+        state = {}
+        block = program.global_block
+        read = {n for op in block.ops for n in op.input_names()}
+        for name in sorted(read):
+            try:
+                var = block.var(name)
+            except KeyError:
+                continue
+            if var.persistable and scope.has_var(name):
+                v = scope.find_var(name)
+                if v is not None:
+                    state[name] = v
+        return state
+
+    # -- main entry ---------------------------------------------------------
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
+            return_numpy: bool = True, donate_state: bool = True):
+        program = program if program is not None else default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+
+        fetch_names = [self._fetch_name(f) for f in fetch_list]
+        feed_arrays = self._prep_feed(program, feed)
+        state = self._state_for(program, scope)
+
+        feed_sig = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feed_arrays.items()))
+        state_sig = tuple(sorted((k, jnp.shape(v), str(jnp.result_type(v)))
+                                 for k, v in state.items()))
+        key = (program.fingerprint(), feed_sig, tuple(fetch_names), state_sig)
+
+        compiled = self._cache.get(key)
+        if compiled is None:
+            step, state_out = lowering.build_step_fn(
+                program, list(feed_arrays), fetch_names, sorted(state))
+            fn = jax.jit(step, donate_argnums=(0,) if donate_state else ())
+            compiled = _Compiled(fn, sorted(state), state_out, fetch_names)
+            self._cache[key] = compiled
+
+        seed = program.random_seed if program.random_seed is not None else 0
+        self._run_counter += 1
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._run_counter)
+
+        fetches, new_state = compiled.fn(state, feed_arrays, rng)
+        for name, val in new_state.items():
+            scope.set_var(name, val)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def close(self):
+        self._cache.clear()

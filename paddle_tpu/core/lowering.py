@@ -1,0 +1,154 @@
+"""Program -> pure JAX step function.
+
+This replaces the reference's entire runtime execution stack — the op-by-op
+interpreting Executor (paddle/fluid/framework/executor.cc:322-345), the
+per-step InferShape + kernel dispatch (operator.cc:605-699), and the
+threaded SSA-graph scheduler (details/threaded_ssa_graph_executor.cc:38-124)
+— with ONE function: trace every op of a block through its registered JAX
+compute fn, producing a single XLA computation that the compiler schedules,
+fuses, and (under a sharded jit) partitions. The op graph's parallelism is
+discovered by XLA, not by a host thread pool.
+
+Semantics of the produced function:
+
+    step(state, feed, rng) -> (fetch_tuple, new_state)
+
+* `state`  — dict of persistable vars (params, optimizer accumulators).
+* `feed`   — dict of per-step inputs.
+* `rng`    — JAX PRNG key threaded to random ops (deterministic per op index,
+             so retracing cannot skew the stream).
+* ops execute in program order by rebinding names in an environment dict —
+  SSA by construction, matching details/ssa_graph.h's var-versioning without
+  building it explicitly.
+* an `autodiff` pseudo-op (backward.py) makes the prefix of the block run
+  inside jax.value_and_grad; gradients bind to the declared `@GRAD` names
+  and downstream (optimizer) ops consume them like any other var.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .program import Block, OpDesc, Program
+from .registry import ExecContext, require_op
+
+AUTODIFF_OP = "autodiff"
+
+
+def _apply_stop_gradient(block: Block, name: str, val):
+    try:
+        var = block.var(name)
+    except KeyError:
+        return val
+    if var.stop_gradient and jnp.issubdtype(jnp.asarray(val).dtype, jnp.floating):
+        return jax.lax.stop_gradient(val)
+    return val
+
+
+def run_op(op: OpDesc, env: Dict[str, object], ctx: ExecContext, block: Block):
+    """Execute one op by tracing its compute fn; rebind outputs in env."""
+    impl = require_op(op.type)
+    ins = {slot: [env[n] for n in names] for slot, names in op.inputs.items()}
+    outs = impl.compute(ctx, ins, op.attrs)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        if len(vals) != len(names):
+            raise RuntimeError(
+                f"op {op.type}: slot {slot} produced {len(vals)} values for "
+                f"{len(names)} names {names}")
+        for n, v in zip(names, vals):
+            env[n] = _apply_stop_gradient(block, n, v)
+
+
+def run_op_range(ops: Sequence[OpDesc], start: int, stop: int,
+                 env: Dict[str, object], ctx: ExecContext, block: Block):
+    for i in range(start, stop):
+        ctx.op_index = i
+        run_op(ops[i], env, ctx, block)
+    return env
+
+
+def _float_like(v):
+    return jnp.issubdtype(jnp.result_type(v), jnp.floating)
+
+
+def run_block_with_autodiff(block: Block, env: Dict[str, object], ctx: ExecContext):
+    """Execute a block that may contain one autodiff pseudo-op.
+
+    The prefix [0, bwd) is the forward program; it runs inside
+    jax.value_and_grad w.r.t. the declared parameters so that XLA compiles
+    forward+backward as one fused computation. ≙ the structural effect of
+    backward.append_backward (python/paddle/fluid/backward.py:434) without
+    materializing per-op grad ops.
+    """
+    ops = block.ops
+    bwd_idx = next((i for i, o in enumerate(ops) if o.type == AUTODIFF_OP), None)
+    if bwd_idx is None:
+        return run_op_range(ops, 0, len(ops), env, ctx, block)
+
+    bop = ops[bwd_idx]
+    loss_name = bop.attrs["loss"]
+    param_names = list(bop.attrs["params"])
+    grad_names = list(bop.attrs["grad_names"])
+    loss_scale = float(bop.attrs.get("loss_scale", 1.0))
+
+    param_vals = {p: env[p] for p in param_names}
+
+    def fwd(pvals):
+        e = dict(env)
+        e.update(pvals)
+        e = run_op_range(ops, 0, bwd_idx, e, ctx, block)
+        loss = jnp.sum(e[loss_name])
+        return loss * loss_scale, e
+
+    (_, env2), grads = jax.value_and_grad(fwd, has_aux=True)(param_vals)
+    env = env2
+    for p, g in zip(param_names, grad_names):
+        env[g] = grads[p]
+    return run_op_range(ops, bwd_idx + 1, len(ops), env, ctx, block)
+
+
+def build_step_fn(program: Program, feed_names: Sequence[str],
+                  fetch_names: Sequence[str], state_in_names: Sequence[str],
+                  is_test: bool = False):
+    """Build the pure step function for block 0 of `program`.
+
+    Returns (step, state_out_names): state_out_names is the set of
+    persistable vars the step returns as new state (inputs carried through +
+    any persistable var an op writes — e.g. param updates, accumulators).
+    """
+    block = program.global_block
+    ops = block.ops
+    state_in = list(state_in_names)
+
+    persist_written = []
+    seen = set(state_in)
+    for op in ops:
+        for n in op.output_names():
+            if n in seen:
+                continue
+            try:
+                v = block.var(n)
+            except KeyError:
+                continue
+            if v.persistable:
+                persist_written.append(n)
+                seen.add(n)
+    state_out_names = state_in + persist_written
+
+    def step(state: Dict[str, object], feed: Dict[str, object], rng):
+        ctx = ExecContext(rng, is_test=is_test)
+        env: Dict[str, object] = {}
+        env.update(state)
+        env.update(feed)
+        env = run_block_with_autodiff(block, env, ctx)
+        fetches = tuple(env[n] for n in fetch_names)
+        new_state = {n: env[n] for n in state_out_names if n in env}
+        return fetches, new_state
+
+    return step, state_out_names

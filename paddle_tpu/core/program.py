@@ -1,0 +1,414 @@
+"""The Program IR: the heart of the framework.
+
+TPU-native re-design of the reference's ProgramDesc/BlockDesc/OpDesc/VarDesc
+(reference: paddle/fluid/framework/framework.proto:15-80 and the Python graph
+builder python/paddle/fluid/framework.py:121-1272).
+
+Key differences from the reference, driven by the XLA compilation model:
+
+* The reference *interprets* the program op-by-op every step
+  (paddle/fluid/framework/executor.cc:322-345). Here the Program is a
+  compile-time artifact only: the Executor lowers an entire block to one
+  traced JAX function and jit-compiles it once (core/lowering.py). There is
+  no runtime op dispatch, no per-step InferShape.
+* Serialization is JSON instead of protobuf — the IR is small, host-side,
+  and never crosses a C ABI, so a schema compiler buys nothing.
+* Gradient structure: `append_backward` (backward.py) marks a functional
+  autodiff boundary in the op stream rather than appending hundreds of
+  per-op grad ops; XLA sees one fused forward+backward computation.
+
+The *capability surface* is preserved: programs are buildable from a layer
+API, serializable, clonable, prunable for inference, and introspectable.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+from .types import VarKind, normalize_dtype
+
+# ---------------------------------------------------------------------------
+# unique_name (reference: python/paddle/fluid/unique_name.py)
+# ---------------------------------------------------------------------------
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self.ids: Dict[str, int] = {}
+        self.prefix = ""
+
+    def __call__(self, key: str) -> str:
+        tmp = self.ids.get(key, 0)
+        self.ids[key] = tmp + 1
+        return f"{self.prefix}{key}_{tmp}"
+
+
+_name_gen = _UniqueNameGenerator()
+
+
+def unique_name(key: str) -> str:
+    return _name_gen(key)
+
+
+def reset_unique_names():
+    _name_gen.ids.clear()
+
+
+# ---------------------------------------------------------------------------
+# Descriptors
+# ---------------------------------------------------------------------------
+
+class VarDesc:
+    """A named, typed, shaped variable slot in a Block.
+
+    Mirrors VarDesc (framework.proto:60-80) + Python Variable
+    (python/paddle/fluid/framework.py:121). shape may contain -1 for the
+    batch dimension only; lowering binds it from the feed at compile time
+    (XLA requires static shapes — each distinct feed shape compiles its own
+    executable, which is the bucketing story for ragged data).
+    """
+
+    __slots__ = (
+        "name", "shape", "dtype", "kind", "persistable", "is_parameter",
+        "stop_gradient", "lod_level", "initializer", "trainable", "regularizer",
+        "need_clip",
+    )
+
+    def __init__(self, name: str, shape: Sequence[int] = (), dtype: str = "float32",
+                 kind: str = VarKind.DENSE, persistable: bool = False,
+                 is_parameter: bool = False, stop_gradient: bool = False,
+                 lod_level: int = 0):
+        self.name = name
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = normalize_dtype(dtype)
+        self.kind = kind
+        self.persistable = persistable
+        self.is_parameter = is_parameter
+        self.stop_gradient = stop_gradient
+        self.lod_level = lod_level
+        # attached by the layer/param machinery; not serialized ops, but
+        # serialized as metadata so checkpoints can re-init missing params.
+        self.initializer = None
+        self.trainable = True
+        self.regularizer = None
+        self.need_clip = True
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "shape": list(self.shape), "dtype": self.dtype,
+            "kind": self.kind, "persistable": self.persistable,
+            "is_parameter": self.is_parameter, "stop_gradient": self.stop_gradient,
+            "lod_level": self.lod_level, "trainable": self.trainable,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "VarDesc":
+        v = VarDesc(d["name"], d["shape"], d["dtype"], d.get("kind", VarKind.DENSE),
+                    d.get("persistable", False), d.get("is_parameter", False),
+                    d.get("stop_gradient", False), d.get("lod_level", 0))
+        v.trainable = d.get("trainable", True)
+        return v
+
+    def __repr__(self):
+        return (f"Var({self.name}: {self.dtype}{list(self.shape)}"
+                f"{' param' if self.is_parameter else ''}"
+                f"{' persist' if self.persistable else ''})")
+
+
+class OpDesc:
+    """One operation: named input/output slots -> variable names, plus attrs.
+
+    Mirrors OpDesc (framework.proto:30-58). Attrs must be JSON-serializable;
+    BLOCK attrs (control flow) are stored as integer block indices, exactly
+    like the reference's AttrType::BLOCK.
+    """
+
+    __slots__ = ("type", "inputs", "outputs", "attrs")
+
+    def __init__(self, type: str, inputs: Optional[Dict[str, List[str]]] = None,
+                 outputs: Optional[Dict[str, List[str]]] = None,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.type = type
+        self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
+        self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+
+    def input(self, slot: str) -> List[str]:
+        return self.inputs.get(slot, [])
+
+    def output(self, slot: str) -> List[str]:
+        return self.outputs.get(slot, [])
+
+    def input_names(self) -> List[str]:
+        return [n for ns in self.inputs.values() for n in ns]
+
+    def output_names(self) -> List[str]:
+        return [n for ns in self.outputs.values() for n in ns]
+
+    def to_dict(self) -> dict:
+        return {"type": self.type, "inputs": self.inputs, "outputs": self.outputs,
+                "attrs": self.attrs}
+
+    @staticmethod
+    def from_dict(d: dict) -> "OpDesc":
+        return OpDesc(d["type"], d["inputs"], d["outputs"], d["attrs"])
+
+    def __repr__(self):
+        ins = ", ".join(f"{k}={v}" for k, v in self.inputs.items())
+        outs = ", ".join(f"{k}={v}" for k, v in self.outputs.items())
+        return f"{{{outs}}} = {self.type}({ins})"
+
+
+class Block:
+    """Ordered op list + var table; nested via parent_idx for control flow.
+
+    Mirrors BlockDesc (framework.proto:15-28, block_desc.h:38).
+    """
+
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, VarDesc] = {}
+        self.ops: List[OpDesc] = []
+
+    # -- vars ---------------------------------------------------------------
+    def create_var(self, name: Optional[str] = None, **kwargs) -> VarDesc:
+        if name is None:
+            name = unique_name("tmp")
+        if name in self.vars:
+            return self.vars[name]
+        v = VarDesc(name, **kwargs)
+        self.vars[name] = v
+        return v
+
+    def var(self, name: str) -> VarDesc:
+        """Find var in this block or ancestors (scope.h:62 FindVar semantics)."""
+        b: Optional[Block] = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = self.program.blocks[b.parent_idx] if b.parent_idx >= 0 else None
+        raise KeyError(f"variable {name!r} not found in block {self.idx} or ancestors")
+
+    def has_var(self, name: str) -> bool:
+        try:
+            self.var(name)
+            return True
+        except KeyError:
+            return False
+
+    # -- ops ----------------------------------------------------------------
+    def append_op(self, type: str, inputs: Optional[Dict[str, Any]] = None,
+                  outputs: Optional[Dict[str, Any]] = None,
+                  attrs: Optional[Dict[str, Any]] = None) -> OpDesc:
+        """Append an op; slot values may be names, VarDescs, or lists thereof.
+
+        Runs compile-time shape inference immediately (the reference does the
+        same through OpDesc::InferShape at append time, op_desc.cc).
+        """
+        def canon(slots):
+            out = {}
+            for k, v in (slots or {}).items():
+                if not isinstance(v, (list, tuple)):
+                    v = [v]
+                out[k] = [x.name if isinstance(x, VarDesc) else x for x in v]
+            return out
+
+        op = OpDesc(type, canon(inputs), canon(outputs), attrs)
+        self.ops.append(op)
+        from .registry import get_op  # local import to avoid cycle
+        impl = get_op(type)
+        if impl is not None and impl.infer_shape is not None:
+            impl.infer_shape(op, self)
+        return op
+
+    def prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> OpDesc:
+        op = self.append_op(type, inputs, outputs, attrs)
+        self.ops.insert(0, self.ops.pop())
+        return op
+
+    def to_dict(self) -> dict:
+        return {"idx": self.idx, "parent_idx": self.parent_idx,
+                "vars": [v.to_dict() for v in self.vars.values()],
+                "ops": [o.to_dict() for o in self.ops]}
+
+    def all_parameters(self) -> List[VarDesc]:
+        return [v for v in self.vars.values() if v.is_parameter]
+
+
+class Program:
+    """A serializable, transformable computation description.
+
+    Mirrors ProgramDesc (program_desc.h:30) + Python Program
+    (python/paddle/fluid/framework.py:1036). Supports clone, prune (for
+    inference export, ≙ framework/prune.cc), JSON round-trip, and a content
+    fingerprint used as the jit-cache key.
+    """
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self._seed: Optional[int] = None
+
+    # -- structure ----------------------------------------------------------
+    @property
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def block(self, idx: int) -> Block:
+        return self.blocks[idx]
+
+    def create_block(self, parent_idx: int) -> Block:
+        b = Block(self, len(self.blocks), parent_idx)
+        self.blocks.append(b)
+        return b
+
+    def current_block(self) -> Block:
+        return self.blocks[_current_block_idx[-1]] if _current_block_idx else self.global_block
+
+    def all_parameters(self) -> List[VarDesc]:
+        return [v for b in self.blocks for v in b.all_parameters()]
+
+    def list_vars(self) -> Iterator[VarDesc]:
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    # -- transforms ---------------------------------------------------------
+    def clone(self, for_test: bool = False) -> "Program":
+        """Deep copy; for_test flips is_test attrs (framework.py Program.clone)."""
+        p = Program.from_dict(self.to_dict())
+        if for_test:
+            for b in p.blocks:
+                for op in b.ops:
+                    if "is_test" in op.attrs:
+                        op.attrs["is_test"] = True
+                    if op.type == "dropout":
+                        op.attrs["is_test"] = True
+        p._seed = self._seed
+        return p
+
+    def prune(self, targets: Sequence[str], feeds: Sequence[str] = ()) -> "Program":
+        """Dead-op elimination keeping only ops needed for `targets`.
+
+        ≙ framework/prune.cc + Program._prune. Works backward over block 0;
+        sub-blocks referenced by surviving control-flow ops are kept whole.
+        """
+        p = self.clone()
+        blk = p.global_block
+        needed = set(targets)
+        kept: List[OpDesc] = []
+        for op in reversed(blk.ops):
+            if op.type in ("feed", "fetch"):
+                continue
+            produces = set(op.output_names())
+            if produces & needed or op.attrs.get("__side_effect__", False):
+                kept.append(op)
+                needed |= set(op.input_names())
+        kept.reverse()
+        blk.ops = kept
+        used = set(feeds) | set(targets)
+        for op in kept:
+            used |= set(op.input_names()) | set(op.output_names())
+        blk.vars = {n: v for n, v in blk.vars.items() if n in used}
+        return p
+
+    # -- serialization ------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {"version": 1, "seed": self._seed,
+                "blocks": [b.to_dict() for b in self.blocks]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Program":
+        p = Program()
+        p._seed = d.get("seed")
+        p.blocks = []
+        for bd in d["blocks"]:
+            b = Block(p, bd["idx"], bd["parent_idx"])
+            for vd in bd["vars"]:
+                b.vars[vd["name"]] = VarDesc.from_dict(vd)
+            b.ops = [OpDesc.from_dict(od) for od in bd["ops"]]
+            p.blocks.append(b)
+        if not p.blocks:
+            p.blocks = [Block(p, 0)]
+        return p
+
+    def to_json(self, indent=None) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    @staticmethod
+    def from_json(s: str) -> "Program":
+        return Program.from_dict(json.loads(s))
+
+    def fingerprint(self) -> str:
+        return hashlib.sha256(self.to_json().encode()).hexdigest()[:16]
+
+    def __str__(self):
+        lines = []
+        for b in self.blocks:
+            lines.append(f"block {b.idx} (parent {b.parent_idx}):")
+            for v in b.vars.values():
+                lines.append(f"  {v!r}")
+            for op in b.ops:
+                lines.append(f"  {op!r}")
+        return "\n".join(lines)
+
+    # seed for in-program RNG ops (≙ Program.random_seed, framework.py)
+    @property
+    def random_seed(self):
+        return self._seed
+
+    @random_seed.setter
+    def random_seed(self, s):
+        self._seed = s
+
+
+# ---------------------------------------------------------------------------
+# Default-program machinery (framework.py:1332-1411)
+# ---------------------------------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+_current_block_idx: List[int] = []
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def switch_main_program(p: Program) -> Program:
+    global _main_program
+    prev, _main_program = _main_program, p
+    return prev
+
+
+def switch_startup_program(p: Program) -> Program:
+    global _startup_program
+    prev, _startup_program = _startup_program, p
+    return prev
+
+
+class program_guard:
+    """`with program_guard(main, startup):` — scoped default programs
+    (python/paddle/fluid/framework.py:1385)."""
+
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        self.prev_main = switch_main_program(self.main)
+        if self.startup is not None:
+            self.prev_startup = switch_startup_program(self.startup)
+        return self
+
+    def __exit__(self, *exc):
+        switch_main_program(self.prev_main)
+        if self.startup is not None:
+            switch_startup_program(self.prev_startup)
+        return False

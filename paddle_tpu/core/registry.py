@@ -1,0 +1,112 @@
+"""Op registry: name -> (compute fn, shape inference).
+
+TPU-native analogue of OpRegistry/OpInfoMap (reference:
+paddle/fluid/framework/op_registry.h:136-174, op_info.h:70). Differences:
+
+* One registration per op, not one per (device, dtype, layout) kernel —
+  `compute` is a JAX-traceable function; XLA owns device lowering, dtype
+  specialization, and fusion, so the reference's OpKernelType dispatch
+  (operator.cc:605-699) has no equivalent here.
+* No GradOpMaker registrations: gradients come from JAX's reverse-mode
+  transform over the lowered program (backward.py). Ops that need custom
+  VJPs (e.g. Pallas kernels) attach them with jax.custom_vjp inside their
+  compute fn.
+* Shape inference runs at program-build time only (the reference re-runs
+  InferShape every step, operator.cc:607 — that cost disappears under jit).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class OpImpl:
+    type: str
+    # compute(ctx, ins: Dict[str, List[Array]], attrs) -> Dict[str, List[Array]]
+    compute: Callable
+    infer_shape: Optional[Callable] = None
+    # host-side ops (feed/fetch/reader) are handled by the executor, not traced
+    is_host_op: bool = False
+
+
+_REGISTRY: Dict[str, OpImpl] = {}
+
+
+def register_op(type: str, infer_shape: Optional[Callable] = None,
+                is_host_op: bool = False):
+    """Decorator: @register_op("relu", infer_shape=same_shape("X", "Out"))."""
+
+    def deco(fn: Callable):
+        if type in _REGISTRY:
+            raise ValueError(f"op {type!r} registered twice")
+        _REGISTRY[type] = OpImpl(type, fn, infer_shape, is_host_op)
+        return fn
+
+    return deco
+
+
+def get_op(type: str) -> Optional[OpImpl]:
+    return _REGISTRY.get(type)
+
+
+def require_op(type: str) -> OpImpl:
+    impl = _REGISTRY.get(type)
+    if impl is None:
+        raise NotImplementedError(
+            f"op {type!r} is not registered (have {len(_REGISTRY)} ops)")
+    return impl
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Execution context passed to compute fns
+# ---------------------------------------------------------------------------
+
+class ExecContext:
+    """Per-trace context: PRNG stream + global flags.
+
+    Functional replacement for the reference's ExecutionContext +
+    DeviceContext (operator.h:348): no streams/handles — the only runtime
+    state an op may need is randomness, which must be threaded functionally
+    for jit purity.
+    """
+
+    def __init__(self, rng_key, is_test: bool = False):
+        self._rng_key = rng_key
+        self._rng_counter = 0
+        self.is_test = is_test
+
+    def next_rng_key(self):
+        import jax
+        self._rng_counter += 1
+        return jax.random.fold_in(self._rng_key, self._rng_counter)
+
+
+# ---------------------------------------------------------------------------
+# Common shape-inference helpers
+# ---------------------------------------------------------------------------
+
+def same_shape(in_slot: str = "X", out_slot: str = "Out"):
+    def infer(op, block):
+        x = block.var(op.input(in_slot)[0])
+        out = block.var(op.output(out_slot)[0])
+        out.shape, out.dtype = x.shape, x.dtype
+    return infer
+
+
+def elementwise_binary_shape(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape, out.dtype = x.shape, x.dtype
+
+
+def unary_compute(fn):
+    """Wrap a jnp unary fn into the (ctx, ins, attrs) protocol."""
+    def compute(ctx, ins, attrs):
+        return {"Out": [fn(ins["X"][0])]}
+    return compute

@@ -1,0 +1,88 @@
+"""Scope: name -> array state container.
+
+≙ reference Scope (paddle/fluid/framework/scope.h:39) but functional-runtime
+flavored: a Scope here is just the persistent state pytree (parameters,
+optimizer accumulators, RNG key) that lives *between* jitted step calls.
+Intermediate activations never touch the Scope — they are values inside the
+traced computation, which is exactly the per-step local scope the reference
+creates and drops (executor.cc:332, scope_buffered_ssa_graph_executor.cc),
+realized at zero cost.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self.parent = parent
+        self._kids = []
+
+    def new_scope(self) -> "Scope":
+        s = Scope(self)
+        self._kids.append(s)
+        return s
+
+    def var(self, name: str):
+        """Find-or-create slot (scope.h:47 Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return self._vars[name]
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def set_var(self, name: str, value):
+        self._vars[name] = value
+
+    def erase(self, name: str):
+        self._vars.pop(name, None)
+
+    def local_var_names(self) -> Iterator[str]:
+        return iter(list(self._vars))
+
+    def get_numpy(self, name: str) -> np.ndarray:
+        v = self.find_var(name)
+        if v is None:
+            raise KeyError(f"variable {name!r} not found in scope")
+        return np.asarray(v)
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    """`with scope_guard(scope):` (python/paddle/fluid/executor.py:27-39)."""
+
+    def __init__(self, scope: Scope):
+        self.scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self.scope)
+        return self.scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False

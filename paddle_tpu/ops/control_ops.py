@@ -1,0 +1,65 @@
+"""Comparison, logical, and scalar-control ops.
+
+≙ reference paddle/fluid/operators/{compare_op, logical_op, increment_op,
+is_empty_op}. Block-structured control flow (while/conditional_block) lives
+in ops/flow_ops.py since it needs sub-block lowering.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .math_ops import broadcast_y_to_x
+
+
+def _cmp_infer(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape, out.dtype = x.shape, "bool"
+
+
+def _register_compare(name, fn):
+    def compute(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        return {"Out": [fn(x, broadcast_y_to_x(x, y, attrs.get("axis", -1)))]}
+    register_op(name, infer_shape=_cmp_infer)(compute)
+
+
+_register_compare("less_than", jnp.less)
+_register_compare("less_equal", jnp.less_equal)
+_register_compare("greater_than", jnp.greater)
+_register_compare("greater_equal", jnp.greater_equal)
+_register_compare("equal", jnp.equal)
+_register_compare("not_equal", jnp.not_equal)
+
+
+def _logical_infer(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape, out.dtype = x.shape, "bool"
+
+
+def _register_logical(name, fn, unary=False):
+    def compute(ctx, ins, attrs):
+        if unary:
+            return {"Out": [fn(ins["X"][0])]}
+        return {"Out": [fn(ins["X"][0], ins["Y"][0])]}
+    register_op(name, infer_shape=_logical_infer)(compute)
+
+
+_register_logical("logical_and", jnp.logical_and)
+_register_logical("logical_or", jnp.logical_or)
+_register_logical("logical_xor", jnp.logical_xor)
+_register_logical("logical_not", jnp.logical_not, unary=True)
+
+
+@register_op("increment")
+def increment(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+
+
+@register_op("is_empty")
+def is_empty(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.asarray(x.size == 0)]}

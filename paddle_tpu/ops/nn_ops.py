@@ -1,0 +1,480 @@
+"""NN ops: activations, softmax, conv/pool/norm, dropout, losses.
+
+≙ reference paddle/fluid/operators/{activation_op.cc, softmax_op, conv_op.cc,
+conv_cudnn_op.cu.cc, pool_op, batch_norm_op, layer_norm_op, dropout_op,
+cross_entropy_op, softmax_with_cross_entropy_op.cu, ...}. The cuDNN-special
+kernels (conv/pool/BN) map to XLA's native convolution/reduce-window HLOs,
+which XLA tiles onto the MXU — no library dispatch attr (`use_cudnn`) is
+needed; it is accepted and ignored for API parity.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op, same_shape
+
+# ---------------------------------------------------------------------------
+# Activations (activation_op.cc registers ~20 via functor templates; here a
+# table of jnp lambdas serves the same role)
+# ---------------------------------------------------------------------------
+
+_ACTIVATIONS = {
+    "relu": lambda x, a: jnp.maximum(x, 0),
+    "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+    "logsigmoid": lambda x, a: jax.nn.log_sigmoid(x),
+    "tanh": lambda x, a: jnp.tanh(x),
+    "tanh_shrink": lambda x, a: x - jnp.tanh(x),
+    "exp": lambda x, a: jnp.exp(x),
+    "log": lambda x, a: jnp.log(x),
+    "sqrt": lambda x, a: jnp.sqrt(x),
+    "rsqrt": lambda x, a: jax.lax.rsqrt(x),
+    "abs": lambda x, a: jnp.abs(x),
+    "ceil": lambda x, a: jnp.ceil(x),
+    "floor": lambda x, a: jnp.floor(x),
+    "round": lambda x, a: jnp.round(x),
+    "reciprocal": lambda x, a: 1.0 / x,
+    "square": lambda x, a: jnp.square(x),
+    "softplus": lambda x, a: jax.nn.softplus(x),
+    "softsign": lambda x, a: x / (1 + jnp.abs(x)),
+    "sin": lambda x, a: jnp.sin(x),
+    "cos": lambda x, a: jnp.cos(x),
+    "relu6": lambda x, a: jnp.clip(x, 0, a.get("threshold", 6.0)),
+    "leaky_relu": lambda x, a: jnp.where(x >= 0, x, a.get("alpha", 0.02) * x),
+    "elu": lambda x, a: jnp.where(x >= 0, x, a.get("alpha", 1.0) * (jnp.exp(x) - 1)),
+    "brelu": lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)),
+    "soft_relu": lambda x, a: jnp.log1p(jnp.exp(jnp.clip(
+        x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))),
+    "hard_sigmoid": lambda x, a: jnp.clip(
+        a.get("slope", 0.2) * x + a.get("offset", 0.5), 0, 1),
+    "thresholded_relu": lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, 0.0),
+    "hard_shrink": lambda x, a: jnp.where(jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+    "softshrink": lambda x, a: jnp.sign(x) * jnp.maximum(
+        jnp.abs(x) - a.get("lambda", 0.5), 0.0),
+    "swish": lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
+    "gelu": lambda x, a: jax.nn.gelu(x, approximate=a.get("approximate", False)),
+    "pow": lambda x, a: jnp.power(x, a.get("factor", 1.0)),
+    "stanh": lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(a.get("scale_a", 0.67) * x),
+}
+
+
+def _make_activation(name, fn):
+    def compute(ctx, ins, attrs):
+        return {"Out": [fn(ins["X"][0], attrs)]}
+    register_op(name, infer_shape=same_shape())(compute)
+
+
+for _n, _f in _ACTIVATIONS.items():
+    _make_activation(_n, _f)
+
+
+@register_op("prelu", infer_shape=same_shape())
+def prelu(ctx, ins, attrs):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "element":
+        alpha = alpha.reshape((1,) + x.shape[1:])
+    return {"Out": [jnp.where(x >= 0, x, alpha * x)]}
+
+
+@register_op("softmax", infer_shape=same_shape())
+def softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+@register_op("log_softmax", infer_shape=same_shape())
+def log_softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+def _maxout_infer(op, block):
+    x = block.var(op.input("X")[0])
+    g = op.attrs["groups"]
+    out = block.var(op.output("Out")[0])
+    out.shape = (x.shape[0], x.shape[1] // g) + tuple(x.shape[2:])
+    out.dtype = x.dtype
+
+
+@register_op("maxout", infer_shape=_maxout_infer)
+def maxout(ctx, ins, attrs):
+    x = ins["X"][0]
+    g = attrs["groups"]
+    n, c = x.shape[0], x.shape[1]
+    return {"Out": [jnp.max(x.reshape((n, c // g, g) + x.shape[2:]), axis=2)]}
+
+
+@register_op("dropout", infer_shape=same_shape())
+def dropout(ctx, ins, attrs):
+    """dropout_op.cc (upscale-in-train OFF in this reference era: outputs are
+    scaled by (1-p) at test time? No — reference uses 'downgrade_in_infer':
+    train: mask only; infer: scale by (1-p))."""
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    if attrs.get("is_test", False):
+        return {"Out": [x * (1.0 - p)], "Mask": [jnp.ones_like(x)]}
+    key = ctx.next_rng_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    return {"Out": [x * mask], "Mask": [mask]}
+
+
+# ---------------------------------------------------------------------------
+# Convolution / pooling  (NCHW layout, matching the reference's default)
+# ---------------------------------------------------------------------------
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+def _conv_out_dim(size, k, pad, stride, dil=1):
+    return (size + 2 * pad - (dil * (k - 1) + 1)) // stride + 1
+
+
+def _conv2d_infer(op, block):
+    x = block.var(op.input("Input")[0])
+    w = block.var(op.input("Filter")[0])
+    out = block.var(op.output("Output")[0])
+    s, p, d = (_pair(op.attrs.get(k, v)) for k, v in
+               (("strides", 1), ("paddings", 0), ("dilations", 1)))
+    n, _, h, wd = x.shape
+    oc, _, kh, kw = w.shape
+    out.shape = (n, oc, _conv_out_dim(h, kh, p[0], s[0], d[0]),
+                 _conv_out_dim(wd, kw, p[1], s[1], d[1]))
+    out.dtype = x.dtype
+
+
+def _conv2d(x, w, attrs, feature_group_count=None):
+    s = _pair(attrs.get("strides", 1))
+    p = _pair(attrs.get("paddings", 0))
+    d = _pair(attrs.get("dilations", 1))
+    groups = feature_group_count or attrs.get("groups", 1) or 1
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=s, padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+
+
+@register_op("conv2d", infer_shape=_conv2d_infer)
+def conv2d(ctx, ins, attrs):
+    """conv_op.cc / conv_cudnn_op.cu.cc → XLA conv_general_dilated (MXU)."""
+    return {"Output": [_conv2d(ins["Input"][0], ins["Filter"][0], attrs)]}
+
+
+@register_op("depthwise_conv2d", infer_shape=_conv2d_infer)
+def depthwise_conv2d(ctx, ins, attrs):
+    """operators/math/depthwise_conv.cu → grouped XLA conv."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    return {"Output": [_conv2d(x, w, attrs, feature_group_count=x.shape[1])]}
+
+
+def _conv2d_transpose_infer(op, block):
+    x = block.var(op.input("Input")[0])
+    w = block.var(op.input("Filter")[0])
+    out = block.var(op.output("Output")[0])
+    s, p, d = (_pair(op.attrs.get(k, v)) for k, v in
+               (("strides", 1), ("paddings", 0), ("dilations", 1)))
+    n, _, h, wd = x.shape
+    _, oc, kh, kw = w.shape
+    oh = (h - 1) * s[0] - 2 * p[0] + d[0] * (kh - 1) + 1
+    ow = (wd - 1) * s[1] - 2 * p[1] + d[1] * (kw - 1) + 1
+    out.shape = (n, oc * (op.attrs.get("groups", 1) or 1), oh, ow)
+    out.dtype = x.dtype
+
+
+@register_op("conv2d_transpose", infer_shape=_conv2d_transpose_infer)
+def conv2d_transpose(ctx, ins, attrs):
+    """conv_transpose_op.cc → gradient-style dilated conv (IOHW filter)."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    s = _pair(attrs.get("strides", 1))
+    p = _pair(attrs.get("paddings", 0))
+    d = _pair(attrs.get("dilations", 1))
+    kh, kw = w.shape[2], w.shape[3]
+    pad_h = d[0] * (kh - 1) - p[0]
+    pad_w = d[1] * (kw - 1) - p[1]
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(w, (2, 3)), window_strides=(1, 1),
+        padding=[(pad_h, pad_h), (pad_w, pad_w)], lhs_dilation=s, rhs_dilation=d,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        feature_group_count=attrs.get("groups", 1) or 1)
+    return {"Output": [out]}
+
+
+def _pool2d_infer(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    if op.attrs.get("global_pooling", False):
+        out.shape = tuple(x.shape[:2]) + (1, 1)
+    else:
+        k = _pair(op.attrs["ksize"])
+        s = _pair(op.attrs.get("strides", 1))
+        p = _pair(op.attrs.get("paddings", 0))
+        n, c, h, w = x.shape
+        if op.attrs.get("ceil_mode", False):
+            oh = -(-(h + 2 * p[0] - k[0]) // s[0]) + 1
+            ow = -(-(w + 2 * p[1] - k[1]) // s[1]) + 1
+        else:
+            oh = (h + 2 * p[0] - k[0]) // s[0] + 1
+            ow = (w + 2 * p[1] - k[1]) // s[1] + 1
+        out.shape = (n, c, oh, ow)
+    out.dtype = x.dtype
+
+
+@register_op("pool2d", infer_shape=_pool2d_infer)
+def pool2d(ctx, ins, attrs):
+    """pool_op.cc → XLA reduce_window (max) / avg via sum+count."""
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        if ptype == "max":
+            return {"Out": [jnp.max(x, axis=(2, 3), keepdims=True)]}
+        return {"Out": [jnp.mean(x, axis=(2, 3), keepdims=True)]}
+    k = _pair(attrs["ksize"])
+    s = _pair(attrs.get("strides", 1))
+    p = _pair(attrs.get("paddings", 0))
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pads)
+    else:
+        ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+        if attrs.get("exclusive", True):
+            ones = jnp.ones(x.shape[2:], x.dtype)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, k, s,
+                                        ((p[0], p[0]), (p[1], p[1])))
+            out = ssum / cnt
+        else:
+            out = ssum / (k[0] * k[1])
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+def _bn_infer(op, block):
+    x = block.var(op.input("X")[0])
+    y = block.var(op.output("Y")[0])
+    y.shape, y.dtype = x.shape, x.dtype
+    c = x.shape[1] if len(x.shape) > 1 else x.shape[0]
+    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        if op.output(slot):
+            v = block.var(op.output(slot)[0])
+            v.shape, v.dtype = (c,), "float32"
+
+
+@register_op("batch_norm", infer_shape=_bn_infer)
+def batch_norm(ctx, ins, attrs):
+    """batch_norm_op.cc/.cu. NCHW; running stats are persistable state vars
+    threaded functionally (MeanOut/VarianceOut rebind the same names, exactly
+    like the reference's in-place variable reuse)."""
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean_in, var_in = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False)
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+
+    if is_test or attrs.get("use_global_stats", False):
+        mean, var = mean_in, var_in
+        new_mean, new_var = mean_in, var_in
+        saved_mean, saved_var = mean_in, var_in
+    else:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+        new_mean = momentum * mean_in + (1 - momentum) * mean
+        new_var = momentum * var_in + (1 - momentum) * var
+        saved_mean, saved_var = mean, var
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x - mean.reshape(bshape).astype(x.dtype)) * \
+        (inv * scale).reshape(bshape).astype(x.dtype) + bias.reshape(bshape).astype(x.dtype)
+    return {"Y": [y], "MeanOut": [new_mean], "VarianceOut": [new_var],
+            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+
+
+def _ln_infer(op, block):
+    x = block.var(op.input("X")[0])
+    y = block.var(op.output("Y")[0])
+    y.shape, y.dtype = x.shape, x.dtype
+    ba = op.attrs.get("begin_norm_axis", 1)
+    rows = int(np.prod(x.shape[:ba])) if x.shape else 1
+    for slot in ("Mean", "Variance"):
+        if op.output(slot):
+            v = block.var(op.output(slot)[0])
+            v.shape, v.dtype = (rows,), "float32"
+
+
+@register_op("layer_norm", infer_shape=_ln_infer)
+def layer_norm(ctx, ins, attrs):
+    """layer_norm_op.cc: normalize over dims >= begin_norm_axis."""
+    x = ins["X"][0]
+    ba = attrs.get("begin_norm_axis", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(ba, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape((1,) * ba + x.shape[ba:])
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape((1,) * ba + x.shape[ba:])
+    return {"Y": [y], "Mean": [mean.reshape(-1)], "Variance": [var.reshape(-1)]}
+
+
+@register_op("l2_normalize", infer_shape=same_shape())
+def l2_normalize(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    out = x / jnp.maximum(norm, eps)
+    return {"Out": [out], "Norm": [norm]}
+
+
+@register_op("lrn", infer_shape=same_shape())
+def lrn(ctx, ins, attrs):
+    """lrn_op.cc: local response normalization across channels (AlexNet)."""
+    x = ins["X"][0]
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    win = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * win
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+
+def _xent_infer(op, block):
+    in_slot = "X" if op.type == "cross_entropy" else "Logits"
+    x = block.var(op.input(in_slot)[0])
+    out = block.var(op.output("Y" if op.type == "cross_entropy" else "Loss")[0])
+    out.shape = tuple(x.shape[:-1]) + (1,)
+    out.dtype = x.dtype
+
+
+@register_op("cross_entropy", infer_shape=_xent_infer)
+def cross_entropy(ctx, ins, attrs):
+    """cross_entropy_op.cc: takes probabilities (post-softmax). Hard labels
+    (int index, soft_label=False) or soft distributions."""
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-8
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, eps)), axis=-1, keepdims=True)
+    else:
+        lbl = label.reshape(label.shape[:-1]) if label.shape[-1:] == (1,) else label
+        p = jnp.take_along_axis(x, lbl[..., None].astype(jnp.int32), axis=-1)
+        loss = -jnp.log(jnp.maximum(p, eps))
+    return {"Y": [loss]}
+
+
+@register_op("softmax_with_cross_entropy", infer_shape=_xent_infer)
+def softmax_with_cross_entropy(ctx, ins, attrs):
+    """softmax_with_cross_entropy_op.cu: numerically-stable fused version."""
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lbl = label.reshape(label.shape[:-1]) if label.shape[-1:] == (1,) else label
+        loss = -jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32), axis=-1)
+    return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", infer_shape=same_shape())
+def sigmoid_cross_entropy_with_logits(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": [loss]}
+
+
+@register_op("square_error_cost", infer_shape=same_shape())
+def square_error_cost(ctx, ins, attrs):
+    """squared_l2_distance flavor used by fit_a_line: (X - Y)^2."""
+    return {"Out": [jnp.square(ins["X"][0] - ins["Y"][0])]}
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    sigma2 = sigma * sigma
+    diff = x - y
+    if ins.get("InsideWeight"):
+        diff = diff * ins["InsideWeight"][0]
+    abs_diff = jnp.abs(diff)
+    val = jnp.where(abs_diff < 1.0 / sigma2, 0.5 * sigma2 * jnp.square(diff),
+                    abs_diff - 0.5 / sigma2)
+    if ins.get("OutsideWeight"):
+        val = val * ins["OutsideWeight"][0]
+    out = jnp.sum(val.reshape(val.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [out], "Diff": [diff]}
+
+
+@register_op("huber_loss")
+def huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    out = jnp.where(ar <= delta, 0.5 * jnp.square(r), delta * (ar - 0.5 * delta))
+    return {"Out": [out], "Residual": [r]}
+
+
+@register_op("hinge_loss", infer_shape=same_shape("Logits"))
+def hinge_loss(ctx, ins, attrs):
+    logits, labels = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0)]}
+
+
+@register_op("log_loss", infer_shape=same_shape("Predicted", "Loss"))
+def log_loss(ctx, ins, attrs):
+    p, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": [loss]}
+
+
+@register_op("rank_loss")
+def rank_loss(ctx, ins, attrs):
+    label, left, right = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": [jnp.log1p(jnp.exp(d)) - label * d]}
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.square(ins["X"][0])).reshape((1,))]}
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    out = 0.5 * jnp.sum(jnp.square(sub).reshape(sub.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [out], "sub_result": [sub]}
+
+
+@register_op("mse_loss", infer_shape=same_shape("X", "Out"))
+def mse_loss(ctx, ins, attrs):
+    return {"Out": [jnp.square(ins["X"][0] - ins["Label"][0])]}

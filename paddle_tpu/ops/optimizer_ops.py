@@ -1,0 +1,182 @@
+"""Optimizer update ops.
+
+≙ reference paddle/fluid/operators/{sgd_op, momentum_op, adam_op, adagrad_op,
+adamax_op, adadelta_op, rmsprop_op, ftrl_op, decayed_adagrad_op,
+proximal_gd_op, proximal_adagrad_op}.h/.cc/.cu. Each op consumes Param +
+Grad + LearningRate (+ accumulators) and emits the updated tensors; the
+lowering rebinds the persistable names so the new values become next step's
+state — the functional reading of the reference's in-place param update.
+Dense only: sparse (SelectedRows) gradients are handled upstream because JAX
+gradients of gather are already scatter-adds fused by XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _p(ins, slot):
+    return ins[slot][0]
+
+
+@register_op("sgd")
+def sgd(ctx, ins, attrs):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    return {"ParamOut": [p - lr * g]}
+
+
+@register_op("momentum")
+def momentum(ctx, ins, attrs):
+    p, g, v, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity"), _p(ins, "LearningRate")
+    mu = attrs["mu"]
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@register_op("adam")
+def adam(ctx, ins, attrs):
+    """adam_op.h: m/v moments + scalar beta-power accumulators."""
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    m, v = _p(ins, "Moment1"), _p(ins, "Moment2")
+    b1p, b2p = _p(ins, "Beta1Pow"), _p(ins, "Beta2Pow")
+    b1, b2, eps = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999), attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    return {"ParamOut": [p_new], "Moment1Out": [m_new], "Moment2Out": [v_new],
+            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+
+
+@register_op("adagrad")
+def adagrad(ctx, ins, attrs):
+    p, g, mom, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment"), _p(ins, "LearningRate")
+    eps = attrs.get("epsilon", 1e-6)
+    mom_new = mom + jnp.square(g)
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(mom_new) + eps)],
+            "MomentOut": [mom_new]}
+
+
+@register_op("adamax")
+def adamax(ctx, ins, attrs):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    m, inf = _p(ins, "Moment"), _p(ins, "InfNorm")
+    b1p = _p(ins, "Beta1Pow")
+    b1, b2, eps = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999), attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf, jnp.abs(g))
+    p_new = p - (lr / (1 - b1p)) * (m_new / (inf_new + eps))
+    return {"ParamOut": [p_new], "MomentOut": [m_new], "InfNormOut": [inf_new]}
+
+
+@register_op("adadelta")
+def adadelta(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    avg_sq_g, avg_sq_u = _p(ins, "AvgSquaredGrad"), _p(ins, "AvgSquaredUpdate")
+    rho, eps = attrs.get("rho", 0.95), attrs.get("epsilon", 1e-6)
+    g2 = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    upd = -jnp.sqrt((avg_sq_u + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_u + (1 - rho) * jnp.square(upd)
+    return {"ParamOut": [p + upd], "AvgSquaredGradOut": [g2], "AvgSquaredUpdateOut": [u2]}
+
+
+@register_op("rmsprop")
+def rmsprop(ctx, ins, attrs):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    ms, mom = _p(ins, "MeanSquare"), _p(ins, "Moment")
+    rho, eps, mu = attrs.get("decay", 0.95), attrs.get("epsilon", 1e-6), attrs.get("momentum", 0.0)
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    if attrs.get("centered", False):
+        mg = _p(ins, "MeanGrad")
+        mg_new = rho * mg + (1 - rho) * g
+        mom_new = mu * mom + lr * g / jnp.sqrt(ms_new - jnp.square(mg_new) + eps)
+        return {"ParamOut": [p - mom_new], "MeanSquareOut": [ms_new],
+                "MomentOut": [mom_new], "MeanGradOut": [mg_new]}
+    mom_new = mu * mom + lr * g / jnp.sqrt(ms_new + eps)
+    return {"ParamOut": [p - mom_new], "MeanSquareOut": [ms_new], "MomentOut": [mom_new]}
+
+
+@register_op("decayed_adagrad")
+def decayed_adagrad(ctx, ins, attrs):
+    p, g, mom, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment"), _p(ins, "LearningRate")
+    decay, eps = attrs.get("decay", 0.95), attrs.get("epsilon", 1e-6)
+    mom_new = decay * mom + (1 - decay) * jnp.square(g)
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(mom_new) + eps)], "MomentOut": [mom_new]}
+
+
+@register_op("ftrl")
+def ftrl(ctx, ins, attrs):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    sq, lin = _p(ins, "SquaredAccumulator"), _p(ins, "LinearAccumulator")
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    denom = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    return {"ParamOut": [pre / denom], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [new_lin]}
+
+
+@register_op("proximal_gd")
+def proximal_gd(ctx, ins, attrs):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    prox = p - lr * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0) / (1 + lr * l2)
+    return {"ParamOut": [p_new]}
+
+
+@register_op("proximal_adagrad")
+def proximal_adagrad(ctx, ins, attrs):
+    p, g, mom, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment"), _p(ins, "LearningRate")
+    l1, l2, eps = attrs.get("l1", 0.0), attrs.get("l2", 0.0), 1e-10
+    mom_new = mom + jnp.square(g)
+    lr_t = lr / (jnp.sqrt(mom_new) + eps)
+    prox = p - lr_t * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0) / (1 + lr_t * l2)
+    return {"ParamOut": [p_new], "MomentOut": [mom_new]}
+
+
+@register_op("average_accumulates")
+def average_accumulates(ctx, ins, attrs):
+    """average_accumulates_op.cc — the state machine behind ModelAverage.
+
+    Accumulates param sums in three windows; restore logic lives in
+    optimizer.ModelAverage (python side), as in the reference.
+    """
+    p = _p(ins, "param")
+    sum1, sum2, sum3 = _p(ins, "in_sum_1"), _p(ins, "in_sum_2"), _p(ins, "in_sum_3")
+    num_acc, old_num, num_upd = (_p(ins, "in_num_accumulates"),
+                                 _p(ins, "in_old_num_accumulates"),
+                                 _p(ins, "in_num_updates"))
+    avg_window = attrs.get("average_window", 0.0)
+    max_avg = attrs.get("max_average_window", 10000)
+    min_avg = attrs.get("min_average_window", 10000)
+    num_upd_new = num_upd + 1
+    num_acc_new = num_acc + 1
+    sum1_new = sum1 + p
+    window = jnp.maximum(jnp.minimum(avg_window * num_upd_new.astype(jnp.float32),
+                                     float(max_avg)), float(min_avg))
+    roll = num_acc_new.astype(jnp.float32) >= window
+    sum2_new = jnp.where(roll, sum2 + sum1_new, sum2)
+    sum1_new = jnp.where(roll, jnp.zeros_like(sum1), sum1_new)
+    old_num_new = jnp.where(roll, num_acc_new, old_num)
+    num_acc_new = jnp.where(roll, jnp.zeros_like(num_acc_new), num_acc_new)
+    big = old_num_new.astype(jnp.float32) + num_acc_new.astype(jnp.float32) >= float(max_avg)
+    sum3_new = jnp.where(big, sum1_new + sum2_new, sum3)
+    sum1_cl = jnp.where(big, jnp.zeros_like(sum1_new), sum1_new)
+    sum2_cl = jnp.where(big, jnp.zeros_like(sum2_new), sum2_new)
+    return {"out_sum_1": [sum1_cl], "out_sum_2": [sum2_cl], "out_sum_3": [sum3_new],
+            "out_num_accumulates": [num_acc_new],
+            "out_old_num_accumulates": [old_num_new],
+            "out_num_updates": [num_upd_new]}

@@ -1,0 +1,136 @@
+"""Program IR tests (≙ reference test_program.py / test_protobuf_descs.py /
+test_operator_desc.py — SURVEY.md §4.3)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def build_linear(prog, startup):
+    with pt.program_guard(prog, startup):
+        blk = prog.global_block
+        blk.create_var("x", shape=(4, 3), dtype="float32")
+        w = blk.create_var("w", shape=(3, 2), dtype="float32",
+                           persistable=True, is_parameter=True)
+        blk.create_var("y")
+        blk.append_op("mul", {"X": "x", "Y": "w"}, {"Out": "y"})
+        sb = startup.global_block
+        sb.create_var("w", shape=(3, 2), persistable=True)
+        sb.append_op("uniform_random", {}, {"Out": "w"},
+                     {"shape": [3, 2], "min": -1.0, "max": 1.0, "seed": 1})
+    return blk
+
+
+def test_program_build_and_shapes():
+    prog, startup = pt.Program(), pt.Program()
+    blk = build_linear(prog, startup)
+    assert blk.var("y").shape == (4, 2)
+    assert len(blk.ops) == 1
+    assert blk.ops[0].type == "mul"
+
+
+def test_json_round_trip():
+    prog, startup = pt.Program(), pt.Program()
+    build_linear(prog, startup)
+    p2 = pt.Program.from_json(prog.to_json())
+    assert p2.fingerprint() == prog.fingerprint()
+    assert p2.global_block.var("w").is_parameter
+
+
+def test_clone_independent():
+    prog, startup = pt.Program(), pt.Program()
+    build_linear(prog, startup)
+    c = prog.clone()
+    c.global_block.append_op("relu", {"X": "y"}, {"Out": c.global_block.create_var("z")})
+    assert len(prog.global_block.ops) == 1
+    assert len(c.global_block.ops) == 2
+
+
+def test_prune_drops_dead_ops():
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        blk = prog.global_block
+        blk.create_var("x", shape=(4, 3), dtype="float32")
+        blk.create_var("a")
+        blk.create_var("b")
+        blk.append_op("relu", {"X": "x"}, {"Out": "a"})
+        blk.append_op("tanh", {"X": "x"}, {"Out": "b"})  # dead w.r.t. 'a'
+    p = prog.prune(targets=["a"], feeds=["x"])
+    assert [op.type for op in p.global_block.ops] == ["relu"]
+    assert "b" not in p.global_block.vars
+
+
+def test_executor_runs_and_updates_scope():
+    prog, startup = pt.Program(), pt.Program()
+    build_linear(prog, startup)
+    exe = pt.Executor()
+    exe.run(startup)
+    assert pt.global_scope().get_numpy("w").shape == (3, 2)
+    x = np.ones((4, 3), np.float32)
+    (y,) = exe.run(prog, feed={"x": x}, fetch_list=["y"])
+    w = pt.global_scope().get_numpy("w")
+    np.testing.assert_allclose(y, x @ w, rtol=1e-5)
+
+
+def test_append_backward_and_sgd_reduces_loss():
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        blk = prog.global_block
+        blk.create_var("x", shape=(8, 3), dtype="float32")
+        blk.create_var("target", shape=(8, 1), dtype="float32")
+        blk.create_var("w", shape=(3, 1), dtype="float32", persistable=True,
+                       is_parameter=True)
+        blk.create_var("pred")
+        blk.append_op("mul", {"X": "x", "Y": "w"}, {"Out": "pred"})
+        blk.create_var("diff2")
+        blk.append_op("square_error_cost", {"X": "pred", "Y": "target"}, {"Out": "diff2"})
+        blk.create_var("loss")
+        blk.append_op("mean", {"X": "diff2"}, {"Out": "loss"})
+        pairs = pt.append_backward(blk.var("loss"))
+        blk.create_var("lr", shape=(1,), dtype="float32", persistable=True)
+        for p, g in pairs:
+            blk.append_op("sgd", {"Param": p, "Grad": g, "LearningRate": "lr"},
+                          {"ParamOut": p})
+        sb = startup.global_block
+        sb.create_var("w", shape=(3, 1), persistable=True)
+        sb.append_op("fill_constant", {}, {"Out": "w"}, {"shape": [3, 1], "value": 0.0})
+        sb.create_var("lr", shape=(1,), persistable=True)
+        sb.append_op("fill_constant", {}, {"Out": "lr"}, {"shape": [1], "value": 0.1})
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 3).astype(np.float32)
+    w_true = np.array([[1.0], [-2.0], [0.5]], np.float32)
+    t = x @ w_true
+    exe = pt.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(50):
+        (l,) = exe.run(prog, feed={"x": x, "target": t}, fetch_list=["loss"])
+        losses.append(float(np.asarray(l).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.05, losses[::10]
+    w = pt.global_scope().get_numpy("w")
+    np.testing.assert_allclose(w, w_true, atol=0.15)
+
+
+def test_stop_gradient_blocks_flow():
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        blk = prog.global_block
+        blk.create_var("x", shape=(2, 2), dtype="float32")
+        w = blk.create_var("w", shape=(2, 2), dtype="float32", persistable=True,
+                           is_parameter=True)
+        h = blk.create_var("h")
+        blk.append_op("mul", {"X": "x", "Y": "w"}, {"Out": "h"})
+        h.stop_gradient = True
+        blk.create_var("loss")
+        blk.append_op("mean", {"X": "h"}, {"Out": "loss"})
+        pairs = pt.append_backward(blk.var("loss"))
+        sb = startup.global_block
+        sb.create_var("w", shape=(2, 2), persistable=True)
+        sb.append_op("fill_constant", {}, {"Out": "w"}, {"shape": [2, 2], "value": 1.0})
+    exe = pt.Executor()
+    exe.run(startup)
+    g = exe.run(prog, feed={"x": np.ones((2, 2), np.float32)},
+                fetch_list=[pt.grad_var_name("w")])[0]
+    np.testing.assert_allclose(g, np.zeros((2, 2)), atol=1e-7)

@@ -1,0 +1,217 @@
+"""Per-op golden tests via the OpTest harness (≙ the reference's 161
+test_*op*.py files, SURVEY.md §4.1). Math/elementwise/reduction coverage."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    def setup(self, rng):
+        self.op_type = "elementwise_add"
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(3, 4).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+        self.attrs = {}
+
+    def test(self, rng):
+        self.setup(rng)
+        self.check_output()
+        self.check_grad(["in_X", "in_Y"], "Out")
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    def test(self, rng):
+        self.op_type = "elementwise_add"
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(3,).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.check_output()
+        self.check_grad(["in_X", "in_Y"], "Out")
+
+
+class TestElementwiseMulTrailing(OpTest):
+    def test(self, rng):
+        self.op_type = "elementwise_mul"
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(3, 4).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}  # axis=-1: trailing aligned
+        self.outputs = {"Out": x * y}
+        self.check_output()
+        self.check_grad(["in_X", "in_Y"], "Out")
+
+
+class TestMatmul(OpTest):
+    @pytest.mark.parametrize("tx,ty", [(False, False), (True, False),
+                                       (False, True), (True, True)])
+    def test(self, rng, tx, ty):
+        self.op_type = "matmul"
+        a = rng.rand(4, 5).astype(np.float32)
+        b = rng.rand(5, 3).astype(np.float32)
+        x = a.T if tx else a
+        y = b.T if ty else b
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": tx, "transpose_Y": ty}
+        self.outputs = {"Out": a @ b}
+        self.check_output()
+        self.check_grad(["in_X", "in_Y"], "Out")
+
+
+class TestBatchedMatmul(OpTest):
+    def test(self, rng):
+        self.op_type = "matmul"
+        x = rng.rand(2, 4, 5).astype(np.float32)
+        y = rng.rand(2, 5, 3).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.matmul(x, y)}
+        self.check_output()
+
+
+class TestMul(OpTest):
+    def test(self, rng):
+        self.op_type = "mul"
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(12, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": x.reshape(2, 12) @ y}
+        self.check_output()
+        self.check_grad(["in_X", "in_Y"], "Out")
+
+
+class TestReduceSum(OpTest):
+    def test(self, rng):
+        self.op_type = "reduce_sum"
+        x = rng.rand(3, 4, 5).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1]}
+        self.outputs = {"Out": x.sum(axis=1)}
+        self.check_output()
+        self.check_grad(["in_X"], "Out")
+
+
+class TestReduceMeanKeepdim(OpTest):
+    def test(self, rng):
+        self.op_type = "reduce_mean"
+        x = rng.rand(3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [-1], "keep_dim": True}
+        self.outputs = {"Out": x.mean(axis=-1, keepdims=True)}
+        self.check_output()
+        self.check_grad(["in_X"], "Out")
+
+
+class TestScale(OpTest):
+    def test(self, rng):
+        self.op_type = "scale"
+        x = rng.rand(4, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 1.0}
+        self.outputs = {"Out": 2.5 * x + 1.0}
+        self.check_output()
+        self.check_grad(["in_X"], "Out")
+
+
+class TestSumN(OpTest):
+    def test(self, rng):
+        self.op_type = "sum"
+        xs = [rng.rand(3, 4).astype(np.float32) for _ in range(3)]
+        self.inputs = {"X": [(f"x{i}", x) for i, x in enumerate(xs)]}
+        self.outputs = {"Out": xs[0] + xs[1] + xs[2]}
+        self.check_output()
+
+
+class TestClip(OpTest):
+    def test(self, rng):
+        self.op_type = "clip"
+        x = (rng.rand(4, 4).astype(np.float32) - 0.5) * 4
+        self.inputs = {"X": x}
+        self.attrs = {"min": -1.0, "max": 1.0}
+        self.outputs = {"Out": np.clip(x, -1, 1)}
+        self.check_output()
+
+
+class TestTopK(OpTest):
+    def test(self, rng):
+        self.op_type = "top_k"
+        x = rng.rand(3, 10).astype(np.float32)
+        k = 3
+        idx = np.argsort(-x, axis=1)[:, :k]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"k": k}
+        self.outputs = {"Out": vals, "Indices": idx.astype(np.int64)}
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    def test(self, rng):
+        self.op_type = "softmax"
+        x = rng.rand(3, 7).astype(np.float32)
+        e = np.exp(x - x.max(axis=-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(axis=-1, keepdims=True)}
+        self.check_output()
+        # no check_grad: d(sum(softmax))/dx == 0 identically, the numeric
+        # check would only compare rounding noise.
+
+
+class TestCrossEntropyHard(OpTest):
+    def test(self, rng):
+        self.op_type = "cross_entropy"
+        prob = rng.rand(4, 5).astype(np.float32) + 0.1
+        prob /= prob.sum(axis=1, keepdims=True)
+        label = rng.randint(0, 5, (4, 1)).astype(np.int64)
+        want = -np.log(prob[np.arange(4), label.ravel()]).reshape(4, 1)
+        self.inputs = {"X": prob, "Label": label}
+        self.outputs = {"Y": want}
+        self.check_output()
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    def test(self, rng):
+        self.op_type = "softmax_with_cross_entropy"
+        logits = rng.rand(4, 6).astype(np.float32) * 3
+        label = rng.randint(0, 6, (4, 1)).astype(np.int64)
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        sm = e / e.sum(axis=1, keepdims=True)
+        want = -np.log(sm[np.arange(4), label.ravel()]).reshape(4, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Loss": want, "Softmax": sm}
+        self.check_output(atol=1e-5)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name,fn", [
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("tanh", np.tanh),
+        ("square", np.square),
+        ("softplus", lambda x: np.log1p(np.exp(x))),
+        ("leaky_relu", lambda x: np.where(x >= 0, x, 0.02 * x)),
+    ])
+    def test(self, rng, name, fn):
+        t = OpTest()
+        t.op_type = name
+        x = (rng.rand(3, 4).astype(np.float32) - 0.5) * 4
+        t.inputs = {"X": x}
+        t.attrs = {}
+        t.outputs = {"Out": fn(x)}
+        t.check_output(atol=1e-5)
+        if name not in ("relu", "leaky_relu"):  # kink at 0 breaks numeric diff
+            t.check_grad(["in_X"], "Out")
+
+
+class TestAccuracy(OpTest):
+    def test(self, rng):
+        self.op_type = "accuracy"
+        idx = np.array([[0, 1], [2, 3], [4, 5]], np.int64)
+        label = np.array([[1], [0], [4]], np.int64)
+        self.inputs = {"Out": idx.astype(np.float32), "Indices": idx, "Label": label}
+        self.outputs = {"Accuracy": np.array([2 / 3], np.float32)}
+        self.check_output(no_check_set=("out_Correct", "out_Total"))
