@@ -14,5 +14,12 @@ from .core import (Program, Block, OpDesc, VarDesc, program_guard,
 from . import ops  # registers the op library
 from . import backward
 from .backward import append_backward, calc_gradient, grad_var_name
+from . import layers
+from . import initializer
+from . import optimizer
+from . import regularizer
+from . import clip
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .layer_helper import LayerHelper
 
 __version__ = "0.1.0"

@@ -73,7 +73,7 @@ class VarDesc:
     __slots__ = (
         "name", "shape", "dtype", "kind", "persistable", "is_parameter",
         "stop_gradient", "lod_level", "initializer", "trainable", "regularizer",
-        "need_clip",
+        "need_clip", "is_data", "optimize_attr", "gradient_clip_attr",
     )
 
     def __init__(self, name: str, shape: Sequence[int] = (), dtype: str = "float32",

@@ -1,0 +1,27 @@
+"""IO layers: the `data` feed declaration (+ reader plumbing lives in
+paddle_tpu/data/, host-side by design).
+
+≙ reference python/paddle/fluid/layers/io.py:31 `data`. The reader-op stack
+(open_files/double_buffer, layers/io.py:295-574) is host-side Python here
+(data/pipeline.py): on a functional runtime the device-side reader variables
+serve no purpose — prefetch overlap comes from jax's async dispatch +
+double-buffered host staging.
+"""
+
+from __future__ import annotations
+
+from ..core.program import default_main_program
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True):
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().global_block
+    var = block.create_var(name, shape=shape, dtype=dtype, lod_level=lod_level)
+    var.stop_gradient = stop_gradient
+    var.is_data = True
+    return var

@@ -1,0 +1,36 @@
+"""In-graph metric layers (≙ python/paddle/fluid/layers/metric.py:
+accuracy, auc)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from . import nn
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """accuracy_op: fraction of samples whose top-k predictions hit label."""
+    helper = LayerHelper("accuracy")
+    topk_out, topk_indices = nn.topk(input, k=k)
+    acc_out = helper.create_tmp_variable("float32")
+    correct = correct or helper.create_tmp_variable("int32")
+    total = total or helper.create_tmp_variable("int32")
+    for v in (acc_out, correct, total):
+        v.stop_gradient = True
+    helper.append_op("accuracy",
+                     {"Out": topk_out, "Indices": topk_indices, "Label": label},
+                     {"Accuracy": acc_out, "Correct": correct, "Total": total})
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1):
+    """auc_op: streaming AUC approximated over a threshold grid. Stateless
+    per-batch version (the reference accumulates in op state; here the
+    Python metrics.Auc accumulator owns the streaming part)."""
+    helper = LayerHelper("auc")
+    out = helper.create_tmp_variable("float32")
+    out.stop_gradient = True
+    helper.append_op("auc", {"Predict": input, "Label": label}, {"AUC": out},
+                     {"curve": curve, "num_thresholds": num_thresholds})
+    return out

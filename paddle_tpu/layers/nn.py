@@ -1,0 +1,633 @@
+"""Neural-network layer functions building program ops.
+
+≙ reference python/paddle/fluid/layers/nn.py (4.3k LoC, 60+ layers: fc:45,
+embedding:153, conv2d:1172, batch_norm:1551, layer_norm:1668, ...). Each
+function appends ops to the default main program via LayerHelper and returns
+the output VarDesc.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.program import VarDesc, default_main_program
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer, NormalInitializer
+
+__all__ = [
+    "fc", "embedding", "dropout", "cross_entropy", "square_error_cost",
+    "conv2d", "conv2d_transpose", "pool2d", "batch_norm", "layer_norm",
+    "softmax", "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "matmul", "topk", "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+    "reduce_prod", "mean", "mul", "dot_product", "l2_normalize", "one_hot",
+    "transpose", "reshape", "concat", "split", "stack", "unstack", "expand",
+    "squeeze", "unsqueeze", "flatten", "pad", "im2sequence", "lrn", "prelu",
+    "relu", "log", "crop", "elementwise_add", "elementwise_sub",
+    "elementwise_mul", "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "clip", "clip_by_norm", "scale", "cast", "gather",
+    "scatter", "slice", "shape", "maxout", "smooth_l1", "warpctc",
+    "label_smooth", "bilinear_interp", "resize_bilinear", "random_crop",
+]
+
+
+def _current_block():
+    return default_main_program().current_block()
+
+
+# ---------------------------------------------------------------------------
+# Core layers
+# ---------------------------------------------------------------------------
+
+def fc(input, size: int, num_flatten_dims: int = 1, param_attr=None,
+       bias_attr=None, act=None, is_test=False, name=None) -> VarDesc:
+    """Fully connected (layers/nn.py:45): per-input mul + sum + bias + act."""
+    helper = LayerHelper("fc", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+    mul_results = []
+    for input_var in helper.multiple_input():
+        input_shape = input_var.shape
+        param_shape = [int(np.prod(input_shape[num_flatten_dims:]))] + [size]
+        w = helper.create_parameter(ParamAttr_to(param_attr), param_shape, dtype)
+        tmp = helper.create_tmp_variable(dtype)
+        helper.append_op("mul", {"X": input_var, "Y": w}, {"Out": tmp},
+                         {"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_tmp_variable(dtype)
+        helper.append_op("sum", {"X": mul_results}, {"Out": pre_bias})
+    pre_act = helper.append_bias_op(pre_bias) if bias_attr is not False else pre_bias
+    return helper.append_activation(pre_act)
+
+
+def ParamAttr_to(attr):
+    from ..param_attr import ParamAttr
+    a = ParamAttr.to_attr(attr)
+    # each fc input needs a fresh weight: clone to avoid name reuse
+    from ..param_attr import ParamAttr as PA
+    return PA(name=a.name, initializer=a.initializer,
+              learning_rate=a.learning_rate, regularizer=a.regularizer,
+              trainable=a.trainable, gradient_clip=a.gradient_clip)
+
+
+def embedding(input, size: Sequence[int], is_sparse: bool = False,
+              is_distributed: bool = False, padding_idx: Optional[int] = None,
+              param_attr=None, dtype: str = "float32") -> VarDesc:
+    """layers/nn.py:153. is_sparse/is_distributed are accepted for parity —
+    sparse grads are an XLA concern; distributed tables use the sharded
+    embedding path (parallel/)."""
+    helper = LayerHelper("embedding", param_attr=param_attr)
+    w = helper.create_parameter(helper.param_attr, size, dtype)
+    tmp = helper.create_tmp_variable(dtype)
+    padding_idx = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    helper.append_op("lookup_table", {"Ids": input, "W": w}, {"Out": tmp},
+                     {"is_sparse": is_sparse, "is_distributed": is_distributed,
+                      "padding_idx": padding_idx})
+    return tmp
+
+
+def dropout(x, dropout_prob: float, is_test: bool = False, seed=None,
+            name=None) -> VarDesc:
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    mask = helper.create_tmp_variable(x.dtype)
+    mask.stop_gradient = True
+    helper.append_op("dropout", {"X": x}, {"Out": out, "Mask": mask},
+                     {"dropout_prob": dropout_prob, "is_test": is_test,
+                      "seed": seed if seed is not None else 0})
+    return out
+
+
+def cross_entropy(input, label, soft_label: bool = False) -> VarDesc:
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("cross_entropy", {"X": input, "Label": label}, {"Y": out},
+                     {"soft_label": soft_label})
+    return out
+
+
+def square_error_cost(input, label) -> VarDesc:
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("square_error_cost", {"X": input, "Y": label}, {"Out": out})
+    return out
+
+
+def conv2d(input, num_filters: int, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn: bool = True, use_mkldnn: bool = False, act=None,
+           name=None) -> VarDesc:
+    """layers/nn.py:1172 (NCHW). use_cudnn/use_mkldnn accepted+ignored: XLA
+    owns kernel selection on TPU."""
+    helper = LayerHelper("conv2d", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    groups = groups or 1
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    filter_shape = [num_filters, num_channels // groups] + list(filter_size)
+
+    def _std(shape):
+        fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
+        return (2.0 / fan_in) ** 0.5
+
+    w = helper.create_parameter(helper.param_attr, filter_shape, dtype,
+                                default_initializer=NormalInitializer(0.0, _std(filter_shape)))
+    pre_bias = helper.create_tmp_variable(dtype)
+    helper.append_op("conv2d", {"Input": input, "Filter": w}, {"Output": pre_bias},
+                     {"strides": _pair(stride), "paddings": _pair(padding),
+                      "dilations": _pair(dilation), "groups": groups,
+                      "use_cudnn": use_cudnn})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2) \
+        if bias_attr is not False else pre_bias
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters: int, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+                     name=None) -> VarDesc:
+    helper = LayerHelper("conv2d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    in_channels = input.shape[1]
+    groups = groups or 1
+    if filter_size is None:
+        raise ValueError("filter_size must be set (output_size inference TBD)")
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    filter_shape = [in_channels, num_filters // groups] + list(filter_size)
+    w = helper.create_parameter(helper.param_attr, filter_shape, dtype)
+    pre_bias = helper.create_tmp_variable(dtype)
+    helper.append_op("conv2d_transpose", {"Input": input, "Filter": w},
+                     {"Output": pre_bias},
+                     {"strides": _pair(stride), "paddings": _pair(padding),
+                      "dilations": _pair(dilation), "groups": groups})
+    pre_act = helper.append_bias_op(pre_bias, 1, 2) if bias_attr is not False else pre_bias
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=-1, pool_type: str = "max", pool_stride=1,
+           pool_padding=0, global_pooling: bool = False, use_cudnn=True,
+           ceil_mode: bool = False, name=None, exclusive=True) -> VarDesc:
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("pool2d", {"X": input}, {"Out": out},
+                     {"pooling_type": pool_type, "ksize": _pair(pool_size),
+                      "strides": _pair(pool_stride), "paddings": _pair(pool_padding),
+                      "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+                      "exclusive": exclusive})
+    return out
+
+
+def batch_norm(input, act=None, is_test: bool = False, momentum: float = 0.9,
+               epsilon: float = 1e-5, param_attr=None, bias_attr=None,
+               data_layout: str = "NCHW", in_place: bool = False, name=None,
+               moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=False) -> VarDesc:
+    """layers/nn.py:1551. Running mean/var are persistable state vars updated
+    functionally each step (MeanOut/VarianceOut rebind the same names)."""
+    helper = LayerHelper("batch_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    channels = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    pshape = [channels]
+    scale = helper.create_parameter(helper.param_attr, pshape, dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(helper.bias_attr, pshape, dtype, is_bias=True)
+    mean = helper.create_global_variable(
+        name=moving_mean_name, dtype="float32", shape=pshape, persistable=True)
+    mean.stop_gradient = True
+    helper.set_variable_initializer(mean, ConstantInitializer(0.0))
+    variance = helper.create_global_variable(
+        name=moving_variance_name, dtype="float32", shape=pshape, persistable=True)
+    variance.stop_gradient = True
+    helper.set_variable_initializer(variance, ConstantInitializer(1.0))
+
+    saved_mean = helper.create_tmp_variable("float32", stop_gradient=True)
+    saved_var = helper.create_tmp_variable("float32", stop_gradient=True)
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("batch_norm",
+                     {"X": input, "Scale": scale, "Bias": bias,
+                      "Mean": mean, "Variance": variance},
+                     {"Y": out, "MeanOut": mean, "VarianceOut": variance,
+                      "SavedMean": saved_mean, "SavedVariance": saved_var},
+                     {"momentum": momentum, "epsilon": epsilon,
+                      "is_test": is_test, "data_layout": data_layout})
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale: bool = True, shift: bool = True,
+               begin_norm_axis: int = 1, epsilon: float = 1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None) -> VarDesc:
+    helper = LayerHelper("layer_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    param_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": input}
+    if scale:
+        inputs["Scale"] = helper.create_parameter(
+            helper.param_attr, param_shape, dtype,
+            default_initializer=ConstantInitializer(1.0))
+    if shift:
+        inputs["Bias"] = helper.create_parameter(
+            helper.bias_attr, param_shape, dtype, is_bias=True)
+    mean_out = helper.create_tmp_variable("float32", stop_gradient=True)
+    var_out = helper.create_tmp_variable("float32", stop_gradient=True)
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("layer_norm", inputs,
+                     {"Y": out, "Mean": mean_out, "Variance": var_out},
+                     {"epsilon": epsilon, "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(out)
+
+
+# ---------------------------------------------------------------------------
+# Simple wrappers
+# ---------------------------------------------------------------------------
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+
+def _unary(op_type, x, attrs=None, out_dtype=None, extra_outputs=None):
+    helper = LayerHelper(op_type)
+    out = helper.create_tmp_variable(out_dtype or x.dtype)
+    outputs = {"Out": out}
+    for slot in (extra_outputs or []):
+        ev = helper.create_tmp_variable(x.dtype)
+        ev.stop_gradient = True
+        outputs[slot] = ev
+    helper.append_op(op_type, {"X": x}, outputs, attrs or {})
+    return out
+
+
+def _binary(op_type, x, y, attrs=None):
+    helper = LayerHelper(op_type)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(op_type, {"X": x, "Y": y}, {"Out": out}, attrs or {})
+    return out
+
+
+def softmax(input, use_cudnn=True, name=None):
+    return _unary("softmax", input)
+
+
+def relu(x, name=None):
+    return _unary("relu", x)
+
+
+def log(x, name=None):
+    return _unary("log", x)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax_out = helper.create_tmp_variable(logits.dtype)
+    loss = helper.create_tmp_variable(logits.dtype)
+    helper.append_op("softmax_with_cross_entropy",
+                     {"Logits": logits, "Label": label},
+                     {"Loss": loss, "Softmax": softmax_out},
+                     {"soft_label": soft_label})
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, name=None):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("sigmoid_cross_entropy_with_logits",
+                     {"X": x, "Label": label}, {"Out": out})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("matmul", {"X": x, "Y": y}, {"Out": out},
+                     {"transpose_X": transpose_x, "transpose_Y": transpose_y,
+                      "alpha": alpha})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    helper = LayerHelper("mul")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("mul", {"X": x, "Y": y}, {"Out": out},
+                     {"x_num_col_dims": x_num_col_dims,
+                      "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def dot_product(x, y):
+    return reduce_sum(elementwise_mul(x, y), dim=-1, keep_dim=True)
+
+
+def topk(input, k):
+    helper = LayerHelper("top_k")
+    values = helper.create_tmp_variable(input.dtype)
+    indices = helper.create_tmp_variable("int64")
+    indices.stop_gradient = True
+    helper.append_op("top_k", {"X": input}, {"Out": values, "Indices": indices},
+                     {"k": k})
+    return values, indices
+
+
+def _reduce(op_type, input, dim, keep_dim, name=None):
+    helper = LayerHelper(op_type)
+    out = helper.create_tmp_variable(input.dtype)
+    if dim is None:
+        attrs = {"dim": [0], "keep_dim": keep_dim, "reduce_all": True}
+    else:
+        attrs = {"dim": dim if isinstance(dim, list) else [dim],
+                 "keep_dim": keep_dim, "reduce_all": False}
+    helper.append_op(op_type, {"X": input}, {"Out": out}, attrs)
+    return out
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_sum", input, dim, keep_dim)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_mean", input, dim, keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_max", input, dim, keep_dim)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_min", input, dim, keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_prod", input, dim, keep_dim)
+
+
+def mean(x, name=None):
+    return _unary("mean", x)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize")
+    out = helper.create_tmp_variable(x.dtype)
+    norm = helper.create_tmp_variable(x.dtype)
+    norm.stop_gradient = True
+    helper.append_op("l2_normalize", {"X": x}, {"Out": out, "Norm": norm},
+                     {"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def one_hot(input, depth):
+    return _unary("one_hot", input, {"depth": depth}, out_dtype="float32")
+
+
+def transpose(x, perm, name=None):
+    return _unary("transpose", x, {"axis": list(perm)})
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=True, name=None):
+    out = _unary("reshape", x, {"shape": list(shape)})
+    if act:
+        return _unary(act, out)
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat")
+    out = helper.create_tmp_variable(helper.input_dtype() if False else input[0].dtype)
+    helper.append_op("concat", {"X": list(input)}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split")
+    dim = dim if dim >= 0 else dim + len(input.shape)
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        attrs = {"num": num, "sections": [], "axis": dim}
+    else:
+        num = len(num_or_sections)
+        attrs = {"num": 0, "sections": list(num_or_sections), "axis": dim}
+    outs = [helper.create_tmp_variable(input.dtype) for _ in range(num)]
+    helper.append_op("split", {"X": input}, {"Out": outs}, attrs)
+    return outs
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    out = helper.create_tmp_variable(x[0].dtype)
+    helper.append_op("stack", {"X": list(x)}, {"Y": out}, {"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack")
+    num = num if num is not None else x.shape[axis]
+    outs = [helper.create_tmp_variable(x.dtype) for _ in range(num)]
+    helper.append_op("unstack", {"X": x}, {"Y": outs}, {"axis": axis, "num": num})
+    return outs
+
+
+def expand(x, expand_times, name=None):
+    return _unary("expand", x, {"expand_times": list(expand_times)})
+
+
+def squeeze(input, axes, name=None):
+    return _unary("squeeze", input, {"axes": list(axes)})
+
+
+def unsqueeze(input, axes, name=None):
+    return _unary("unsqueeze", input, {"axes": list(axes)})
+
+
+def flatten(x, axis=1, name=None):
+    return _unary("flatten", x, {"axis": axis})
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _unary("pad", x, {"paddings": list(paddings), "pad_value": pad_value})
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("crop", {"X": x}, {"Out": out},
+                     {"shape": list(shape), "offsets": list(offsets or [0] * len(shape))})
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    helper = LayerHelper("im2sequence")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("im2sequence", {"X": input}, {"Out": out},
+                     {"kernels": _pair(filter_size), "strides": _pair(stride),
+                      "paddings": _pair(padding) + _pair(padding)})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn")
+    out = helper.create_tmp_variable(input.dtype)
+    mid = helper.create_tmp_variable(input.dtype)
+    mid.stop_gradient = True
+    helper.append_op("lrn", {"X": input}, {"Out": out, "MidOut": mid},
+                     {"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    helper = LayerHelper("prelu", param_attr=param_attr, name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = list(x.shape[1:])
+    alpha = helper.create_parameter(helper.param_attr, alpha_shape, x.dtype,
+                                    default_initializer=ConstantInitializer(0.25))
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("prelu", {"X": x, "Alpha": alpha}, {"Out": out}, {"mode": mode})
+    return out
+
+
+def maxout(x, groups, name=None):
+    return _unary("maxout", x, {"groups": groups})
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    out = _binary("elementwise_add", x, y, {"axis": axis})
+    return _unary(act, out) if act else out
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    out = _binary("elementwise_sub", x, y, {"axis": axis})
+    return _unary(act, out) if act else out
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    out = _binary("elementwise_mul", x, y, {"axis": axis})
+    return _unary(act, out) if act else out
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    out = _binary("elementwise_div", x, y, {"axis": axis})
+    return _unary(act, out) if act else out
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_max", x, y, {"axis": axis})
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_min", x, y, {"axis": axis})
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_pow", x, y, {"axis": axis})
+
+
+def clip(x, min, max, name=None):
+    return _unary("clip", x, {"min": float(min), "max": float(max)})
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _unary("clip_by_norm", x, {"max_norm": float(max_norm)})
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = _unary("scale", x, {"scale": float(scale), "bias": float(bias),
+                              "bias_after_scale": bias_after_scale})
+    return _unary(act, out) if act else out
+
+
+def cast(x, dtype):
+    return _unary("cast", x, {"in_dtype": x.dtype, "out_dtype": dtype},
+                  out_dtype=dtype)
+
+
+def gather(input, index):
+    helper = LayerHelper("gather")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("gather", {"X": input, "Index": index}, {"Out": out})
+    return out
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    helper = LayerHelper("scatter")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("scatter", {"X": input, "Ids": index, "Updates": updates},
+                     {"Out": out}, {"overwrite": overwrite})
+    return out
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("slice", {"Input": input}, {"Out": out},
+                     {"axes": list(axes), "starts": list(starts), "ends": list(ends)})
+    return out
+
+
+def shape(input):
+    helper = LayerHelper("shape")
+    out = helper.create_tmp_variable("int32")
+    out.stop_gradient = True
+    helper.append_op("shape", {"Input": input}, {"Out": out})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1_loss")
+    diff = helper.create_tmp_variable(x.dtype)
+    loss = helper.create_tmp_variable(x.dtype)
+    inputs = {"X": x, "Y": y}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = inside_weight
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = outside_weight
+    helper.append_op("smooth_l1_loss", inputs, {"Diff": diff, "Out": loss},
+                     {"sigma": sigma if sigma is not None else 1.0})
+    return loss
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    helper = LayerHelper("warpctc")
+    loss = helper.create_tmp_variable(input.dtype)
+    grad = helper.create_tmp_variable(input.dtype)
+    grad.stop_gradient = True
+    helper.append_op("warpctc", {"Logits": input, "Label": label},
+                     {"Loss": loss, "WarpCTCGrad": grad},
+                     {"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32", name=None):
+    helper = LayerHelper("label_smooth")
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("label_smooth", {"X": label}, {"Out": out},
+                     {"epsilon": float(epsilon)})
+    return out
+
+
+def bilinear_interp(input, out_h, out_w, name=None):
+    helper = LayerHelper("bilinear_interp")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("bilinear_interp", {"X": input}, {"Out": out},
+                     {"out_h": out_h, "out_w": out_w})
+    return out
+
+
+resize_bilinear = bilinear_interp
+
+
+def random_crop(x, shape, seed=None):
+    helper = LayerHelper("random_crop")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("random_crop", {"X": x}, {"Out": out}, {"shape": list(shape)})
+    return out

@@ -1,0 +1,125 @@
+"""Tensor-creation layer functions.
+
+≙ reference python/paddle/fluid/layers/tensor.py (create_tensor,
+create_parameter, create_global_var, fill_constant, ones, zeros, sums,
+assign, argmin/argmax, ...).
+"""
+
+from __future__ import annotations
+
+from ..core.program import VarDesc, default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "create_tensor", "create_parameter", "create_global_var", "fill_constant",
+    "fill_constant_batch_size_like", "ones", "zeros", "sums", "assign",
+    "argmin", "argmax", "reverse", "cast", "concat",
+]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_variable(name=helper.name, dtype=dtype,
+                                  persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    helper = LayerHelper("create_parameter", name=name)
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(name=name, dtype=dtype, shape=shape,
+                                        persistable=persistable)
+    helper.set_variable_initializer(var, ConstantInitializer(value))
+    return var
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    if out is None:
+        out = helper.create_tmp_variable(dtype)
+    out.stop_gradient = True
+    helper.append_op("fill_constant", {}, {"Out": out},
+                     {"shape": list(shape), "dtype": dtype, "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_tmp_variable(dtype)
+    out.stop_gradient = True
+    helper.append_op("fill_constant_batch_size_like", {"Input": input},
+                     {"Out": out},
+                     {"shape": list(shape), "dtype": dtype, "value": float(value),
+                      "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx})
+    return out
+
+
+def ones(shape, dtype, force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros(shape, dtype, force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    if out is None:
+        out = helper.create_tmp_variable(input[0].dtype)
+    helper.append_op("sum", {"X": list(input)}, {"Out": out})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if output is None:
+        output = helper.create_tmp_variable(
+            input.dtype if isinstance(input, VarDesc) else "float32")
+    if isinstance(input, VarDesc):
+        helper.append_op("assign", {"X": input}, {"Out": output})
+    else:
+        import numpy as np
+        arr = np.asarray(input)
+        helper.append_op("assign_value", {}, {"Out": output},
+                         {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                          "values": arr.ravel().tolist()})
+    return output
+
+
+def argmin(x, axis=0):
+    helper = LayerHelper("arg_min")
+    out = helper.create_tmp_variable("int64")
+    out.stop_gradient = True
+    helper.append_op("arg_min", {"X": x}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def argmax(x, axis=0):
+    helper = LayerHelper("arg_max")
+    out = helper.create_tmp_variable("int64")
+    out.stop_gradient = True
+    helper.append_op("arg_max", {"X": x}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("reverse", {"X": x}, {"Out": out},
+                     {"axis": [axis] if isinstance(axis, int) else list(axis)})
+    return out
+
+
+# re-export from nn to mirror fluid.layers flat namespace
+from .nn import cast, concat  # noqa: E402,F401

@@ -478,3 +478,34 @@ def squared_l2_distance(ctx, ins, attrs):
 @register_op("mse_loss", infer_shape=same_shape("X", "Out"))
 def mse_loss(ctx, ins, attrs):
     return {"Out": [jnp.square(ins["X"][0] - ins["Label"][0])]}
+
+
+@register_op("label_smooth", infer_shape=same_shape())
+def label_smooth(ctx, ins, attrs):
+    """label_smooth_op.cc: (1-eps)*label + eps/K."""
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.1)
+    k = x.shape[-1]
+    if ins.get("PriorDist"):
+        return {"Out": [(1 - eps) * x + eps * ins["PriorDist"][0]]}
+    return {"Out": [(1 - eps) * x + eps / k]}
+
+
+@register_op("auc")
+def auc(ctx, ins, attrs):
+    """auc_op.cc: trapezoidal AUC over a uniform threshold grid (per batch)."""
+    pred = ins["Predict"][0]
+    label = ins["Label"][0].reshape(-1)
+    n_th = attrs.get("num_thresholds", 200)
+    pos_score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] >= 2 else pred.reshape(-1)
+    th = jnp.linspace(0.0, 1.0, n_th)
+    is_pos = (label > 0)[None, :]
+    above = pos_score[None, :] >= th[:, None]
+    tp = jnp.sum(above & is_pos, axis=1).astype(jnp.float32)
+    fp = jnp.sum(above & ~is_pos, axis=1).astype(jnp.float32)
+    P = jnp.maximum(jnp.sum(is_pos), 1).astype(jnp.float32)
+    N = jnp.maximum(jnp.sum(~is_pos), 1).astype(jnp.float32)
+    tpr = tp / P
+    fpr = fp / N
+    auc_val = -jnp.trapezoid(tpr, fpr)
+    return {"AUC": [auc_val.reshape((1,))]}

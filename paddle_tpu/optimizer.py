@@ -1,0 +1,436 @@
+"""Optimizer library: minimize = append_backward + accumulators + update ops.
+
+≙ reference python/paddle/fluid/optimizer.py:36-970 (Optimizer base:36,
+SGD:257, Momentum:283, Adagrad:327, Adam:368, Adamax:473, DecayedAdagrad:557,
+Adadelta:601, RMSProp:683, Ftrl, ModelAverage:818). The structure is
+preserved exactly: `minimize` appends backward, regularization, clipping,
+then one update op per parameter; accumulators are persistable vars
+initialized via the startup program. All of it compiles into the single
+per-step XLA executable.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from .core.program import (VarDesc, default_main_program,
+                           default_startup_program, unique_name, program_guard)
+from .backward import append_backward
+from .layer_helper import LayerHelper
+from .initializer import ConstantInitializer
+from .regularizer import append_regularization_ops
+from .clip import append_gradient_clip_ops, error_clip_callback
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None):
+        if not isinstance(learning_rate, (float, VarDesc)):
+            raise TypeError("learning_rate must be float or Variable")
+        self.regularization = regularization
+        self._name = name
+        self._learning_rate = learning_rate
+        self._learning_rate_map: Dict[int, VarDesc] = {}
+        self._accumulators: Dict[str, Dict[str, VarDesc]] = defaultdict(dict)
+        self.helper: Optional[LayerHelper] = None
+
+    # -- learning rate ------------------------------------------------------
+    def _create_global_learning_rate(self):
+        prog = default_main_program()
+        if id(prog) in self._learning_rate_map:
+            return
+        if isinstance(self._learning_rate, VarDesc):
+            self._learning_rate_map[id(prog)] = self._learning_rate
+            return
+        helper = LayerHelper("learning_rate")
+        lr = helper.create_global_variable(
+            name=unique_name("learning_rate"), dtype="float32", shape=(1,),
+            persistable=True)
+        lr.stop_gradient = True
+        helper.set_variable_initializer(
+            lr, ConstantInitializer(float(self._learning_rate)))
+        self._learning_rate_map[id(prog)] = lr
+
+    def _global_learning_rate(self) -> VarDesc:
+        return self._learning_rate_map[id(default_main_program())]
+
+    def _create_param_lr(self, param_and_grad):
+        """Per-param LR multiplier (ParamAttr.learning_rate, optimizer.py)."""
+        param = param_and_grad[0]
+        base = self._global_learning_rate()
+        mult = getattr(param, "optimize_attr", {}).get("learning_rate", 1.0)
+        if mult == 1.0:
+            return base
+        helper = LayerHelper("param_lr")
+        out = helper.create_tmp_variable("float32")
+        out.stop_gradient = True
+        helper.append_op("scale", {"X": base}, {"Out": out}, {"scale": float(mult)})
+        return out
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name: str, param: VarDesc, dtype=None,
+                         fill_value: float = 0.0, shape=None) -> VarDesc:
+        if param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        helper = self.helper or LayerHelper("optimizer")
+        var = helper.create_global_variable(
+            name=unique_name(f"{param.name}_{name}"),
+            dtype=dtype or param.dtype,
+            shape=tuple(shape) if shape is not None else param.shape,
+            persistable=True)
+        var.stop_gradient = True
+        helper.set_variable_initializer(var, ConstantInitializer(fill_value))
+        self._accumulators[name][param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- hooks for subclasses ----------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block):
+        pass
+
+    # -- driver -------------------------------------------------------------
+    def _create_optimization_pass(self, parameters_and_grads, loss,
+                                  startup_program=None):
+        self.helper = LayerHelper(self.__class__.__name__)
+        self._create_global_learning_rate()
+        block = default_main_program().global_block
+        self._create_accumulators(
+            block, [p for p, g in parameters_and_grads if g is not None])
+        optimize_ops = []
+        for param_and_grad in parameters_and_grads:
+            if param_and_grad[1] is None:
+                continue
+            if param_and_grad[0].trainable:
+                optimize_ops.append(
+                    self._append_optimize_op(block, param_and_grad))
+        self._finish_update(block)
+        return optimize_ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = append_backward(loss, parameter_list, no_grad_set,
+                                       [error_clip_callback])
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        optimize_ops = self._create_optimization_pass(params_grads, loss,
+                                                      startup_program)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        return block.append_op(
+            "sgd",
+            {"Param": param_and_grad[0], "Grad": param_and_grad[1],
+             "LearningRate": self._create_param_lr(param_and_grad)},
+            {"ParamOut": param_and_grad[0]})
+
+
+class MomentumOptimizer(Optimizer):
+    _velocity_acc_str = "velocity"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "momentum"
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._velocity_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        velocity = self._get_accumulator(self._velocity_acc_str, param_and_grad[0])
+        return block.append_op(
+            "momentum",
+            {"Param": param_and_grad[0], "Grad": param_and_grad[1],
+             "Velocity": velocity,
+             "LearningRate": self._create_param_lr(param_and_grad)},
+            {"ParamOut": param_and_grad[0], "VelocityOut": velocity},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class AdagradOptimizer(Optimizer):
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "adagrad"
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator(self._moment_acc_str, param_and_grad[0])
+        return block.append_op(
+            "adagrad",
+            {"Param": param_and_grad[0], "Grad": param_and_grad[1],
+             "Moment": moment,
+             "LearningRate": self._create_param_lr(param_and_grad)},
+            {"ParamOut": param_and_grad[0], "MomentOut": moment},
+            {"epsilon": self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    _moment1_acc_str = "moment1"
+    _moment2_acc_str = "moment2"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "adam"
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment1_acc_str, p)
+            self._add_accumulator(self._moment2_acc_str, p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p = param_and_grad[0]
+        m1 = self._get_accumulator(self._moment1_acc_str, p)
+        m2 = self._get_accumulator(self._moment2_acc_str, p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            "adam",
+            {"Param": p, "Grad": param_and_grad[1],
+             "LearningRate": self._create_param_lr(param_and_grad),
+             "Moment1": m1, "Moment2": m2, "Beta1Pow": b1p, "Beta2Pow": b2p},
+            {"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+             "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon})
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "adamax"
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p = param_and_grad[0]
+        moment = self._get_accumulator("moment", p)
+        inf_norm = self._get_accumulator("inf_norm", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        op = block.append_op(
+            "adamax",
+            {"Param": p, "Grad": param_and_grad[1],
+             "LearningRate": self._create_param_lr(param_and_grad),
+             "Moment": moment, "InfNorm": inf_norm, "Beta1Pow": b1p},
+            {"ParamOut": p, "MomentOut": moment, "InfNormOut": inf_norm},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon})
+        # beta1_pow update (reference appends a scale op in _finish_update)
+        block.append_op("scale", {"X": b1p}, {"Out": b1p},
+                        {"scale": self._beta1})
+        return op
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "decayed_adagrad"
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator("moment", param_and_grad[0])
+        return block.append_op(
+            "decayed_adagrad",
+            {"Param": param_and_grad[0], "Grad": param_and_grad[1],
+             "Moment": moment,
+             "LearningRate": self._create_param_lr(param_and_grad)},
+            {"ParamOut": param_and_grad[0], "MomentOut": moment},
+            {"decay": self._decay, "epsilon": self._epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "adadelta"
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("_avg_squared_grad", p)
+            self._add_accumulator("_avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        g = self._get_accumulator("_avg_squared_grad", param_and_grad[0])
+        u = self._get_accumulator("_avg_squared_update", param_and_grad[0])
+        return block.append_op(
+            "adadelta",
+            {"Param": param_and_grad[0], "Grad": param_and_grad[1],
+             "AvgSquaredGrad": g, "AvgSquaredUpdate": u},
+            {"ParamOut": param_and_grad[0], "AvgSquaredGradOut": g,
+             "AvgSquaredUpdateOut": u},
+            {"epsilon": self._epsilon, "rho": self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "rmsprop"
+        self._rho, self._epsilon, self._momentum = rho, epsilon, momentum
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        momentum_acc = self._get_accumulator("momentum", param_and_grad[0])
+        mean_square_acc = self._get_accumulator("mean_square", param_and_grad[0])
+        return block.append_op(
+            "rmsprop",
+            {"Param": param_and_grad[0], "Grad": param_and_grad[1],
+             "Moment": momentum_acc, "MeanSquare": mean_square_acc,
+             "LearningRate": self._create_param_lr(param_and_grad)},
+            {"ParamOut": param_and_grad[0], "MomentOut": momentum_acc,
+             "MeanSquareOut": mean_square_acc},
+            {"epsilon": self._epsilon, "decay": self._rho,
+             "momentum": self._momentum})
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "ftrl"
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        sq = self._get_accumulator("squared", param_and_grad[0])
+        lin = self._get_accumulator("linear", param_and_grad[0])
+        return block.append_op(
+            "ftrl",
+            {"Param": param_and_grad[0], "Grad": param_and_grad[1],
+             "SquaredAccumulator": sq, "LinearAccumulator": lin,
+             "LearningRate": self._create_param_lr(param_and_grad)},
+            {"ParamOut": param_and_grad[0], "SquaredAccumOut": sq,
+             "LinearAccumOut": lin},
+            {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power})
+
+
+class ModelAverage(Optimizer):
+    """≙ optimizer.py:818 — maintains sliding-window parameter averages via
+    average_accumulates ops; apply()/restore() swap averaged params in/out."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, **kwargs):
+        super().__init__(0.0, **kwargs)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self.params_grads: List[Tuple[VarDesc, VarDesc]] = []
+        main = default_main_program()
+        for param in main.global_block.all_parameters():
+            if param.trainable:
+                grad_name = param.name + "@GRAD"
+                if grad_name in main.global_block.vars:
+                    self.params_grads.append(
+                        (param, main.global_block.vars[grad_name]))
+        self.helper = LayerHelper("model_average")
+        for param, grad in self.params_grads:
+            self._append_average_accumulate_op(param)
+
+    def _append_average_accumulate_op(self, param):
+        self.helper = self.helper or LayerHelper("model_average")
+        sum_1 = self._add_accumulator("sum_1", param)
+        sum_2 = self._add_accumulator("sum_2", param)
+        sum_3 = self._add_accumulator("sum_3", param)
+        num_accumulates = self._add_accumulator("num_accumulates", param,
+                                                dtype="int64", shape=[1])
+        old_num_accumulates = self._add_accumulator("old_num_accumulates",
+                                                    param, dtype="int64",
+                                                    shape=[1])
+        num_updates = self._add_accumulator("num_updates", param,
+                                            dtype="int64", shape=[1])
+        block = default_main_program().global_block
+        block.append_op(
+            "average_accumulates",
+            {"param": param, "in_sum_1": sum_1, "in_sum_2": sum_2,
+             "in_sum_3": sum_3, "in_num_accumulates": num_accumulates,
+             "in_old_num_accumulates": old_num_accumulates,
+             "in_num_updates": num_updates},
+            {"out_sum_1": sum_1, "out_sum_2": sum_2, "out_sum_3": sum_3,
+             "out_num_accumulates": num_accumulates,
+             "out_old_num_accumulates": old_num_accumulates,
+             "out_num_updates": num_updates},
+            {"average_window": self.average_window,
+             "min_average_window": self.min_average_window,
+             "max_average_window": self.max_average_window})
+
+    def apply(self, executor, scope=None):
+        """Swap params to their window averages (host-side, functional)."""
+        import numpy as np
+        from .core.scope import global_scope
+        scope = scope or global_scope()
+        self._backup = {}
+        for param, _ in self.params_grads:
+            s1 = np.asarray(scope.find_var(self._get_accumulator("sum_1", param).name))
+            s2 = np.asarray(scope.find_var(self._get_accumulator("sum_2", param).name))
+            s3 = np.asarray(scope.find_var(self._get_accumulator("sum_3", param).name))
+            na = int(np.asarray(scope.find_var(
+                self._get_accumulator("num_accumulates", param).name)).ravel()[0])
+            ona = int(np.asarray(scope.find_var(
+                self._get_accumulator("old_num_accumulates", param).name)).ravel()[0])
+            total = max(na + ona, 1)
+            self._backup[param.name] = np.asarray(scope.find_var(param.name))
+            scope.set_var(param.name, (s1 + s2 + s3) / float(total))
+
+    def restore(self, executor, scope=None):
+        from .core.scope import global_scope
+        scope = scope or global_scope()
+        for name, val in getattr(self, "_backup", {}).items():
+            scope.set_var(name, val)
+        self._backup = {}
+
+
+# public aliases matching fluid
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer

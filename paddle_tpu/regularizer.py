@@ -1,0 +1,69 @@
+"""Weight-decay regularizers appended as gradient ops.
+
+≙ reference python/paddle/fluid/regularizer.py: L1/L2 decay terms are
+appended to each parameter's gradient before the optimizer op consumes it.
+"""
+
+from __future__ import annotations
+
+from .core.program import default_main_program
+
+
+class WeightDecayRegularizer:
+    def append_regularization_op(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff: float = 0.0):
+        self._regularization_coeff = regularization_coeff
+
+    def append_regularization_op(self, param, grad, block):
+        decay = block.create_var(grad.name + "@L2DECAY", shape=param.shape,
+                                 dtype=param.dtype)
+        decay.stop_gradient = True
+        block.append_op("scale", {"X": param}, {"Out": decay},
+                        {"scale": self._regularization_coeff})
+        block.append_op("elementwise_add", {"X": grad, "Y": decay},
+                        {"Out": grad})
+        return grad
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff: float = 0.0):
+        self._regularization_coeff = regularization_coeff
+
+    def append_regularization_op(self, param, grad, block):
+        sign = block.create_var(grad.name + "@L1SIGN", shape=param.shape,
+                                dtype=param.dtype)
+        sign.stop_gradient = True
+        decay = block.create_var(grad.name + "@L1DECAY", shape=param.shape,
+                                 dtype=param.dtype)
+        decay.stop_gradient = True
+        block.append_op("sign", {"X": param}, {"Out": sign})
+        block.append_op("scale", {"X": sign}, {"Out": decay},
+                        {"scale": self._regularization_coeff})
+        block.append_op("elementwise_add", {"X": grad, "Y": decay},
+                        {"Out": grad})
+        return grad
+
+
+def append_regularization_ops(parameters_and_grads, regularization=None):
+    """Per-param regularizer (ParamAttr) overrides the optimizer-level one
+    (regularizer.py append_regularization_ops)."""
+    params_and_grads = []
+    block = default_main_program().global_block
+    for param, grad in parameters_and_grads:
+        regularization_term = None
+        reg = getattr(param, "regularizer", None) or regularization
+        if grad is None or reg is None:
+            params_and_grads.append((param, grad))
+            continue
+        reg.append_regularization_op(param, grad, block)
+        params_and_grads.append((param, grad))
+    return params_and_grads
+
+
+# fluid-compatible aliases
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

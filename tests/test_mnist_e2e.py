@@ -1,0 +1,127 @@
+"""End-to-end model tests (≙ the reference's tests/book/, SURVEY.md §4.4):
+build model with layers API → optimizer.minimize → train to falling loss.
+
+Models mirror benchmark/fluid/models/mnist.py (LeNet-ish cnn_model) and
+tests/book/test_fit_a_line.py on synthetic data (no network in CI).
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def synthetic_mnist_batch(rng, batch_size):
+    imgs = rng.rand(batch_size, 1, 28, 28).astype(np.float32)
+    # labels correlated with the mean of a quadrant so learning is possible
+    labels = (imgs[:, 0, :14, :14].mean(axis=(1, 2)) * 20).astype(np.int64) % 10
+    return imgs, labels.reshape(-1, 1)
+
+
+def build_lenet(img, label):
+    """≙ benchmark/fluid/models/mnist.py cnn_model (conv-pool ×2 + fc)."""
+    conv1 = layers.conv2d(img, num_filters=20, filter_size=5, act="relu")
+    pool1 = layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = layers.conv2d(pool1, num_filters=50, filter_size=5, act="relu")
+    pool2 = layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    prediction = layers.fc(pool2, size=10, act="softmax")
+    cost = layers.cross_entropy(prediction, label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(prediction, label)
+    return prediction, avg_cost, acc
+
+
+def test_mnist_lenet_trains(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [1, 28, 28])
+        label = layers.data("label", [1], dtype="int64")
+        _, avg_cost, acc = build_lenet(img, label)
+        opt = pt.optimizer.AdamOptimizer(learning_rate=1e-3)
+        opt.minimize(avg_cost)
+
+    exe = pt.Executor()
+    exe.run(startup)
+    first = None
+    for i in range(30):
+        imgs, labels = synthetic_mnist_batch(rng, 32)
+        loss, a = exe.run(main, feed={"img": imgs, "label": labels},
+                          fetch_list=[avg_cost, acc])
+        if first is None:
+            first = float(loss.ravel()[0])
+    last = float(loss.ravel()[0])
+    assert last < first * 0.8, (first, last)
+
+
+def test_fit_a_line_sgd(rng):
+    """≙ tests/book/test_fit_a_line.py on synthetic data."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [13])
+        y = layers.data("y", [1])
+        y_predict = layers.fc(input=x, size=1, act=None)
+        cost = layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = layers.mean(cost)
+        opt = pt.optimizer.SGDOptimizer(learning_rate=0.01)
+        opt.minimize(avg_cost)
+
+    exe = pt.Executor()
+    exe.run(startup)
+    w_true = rng.randn(13, 1).astype(np.float32)
+    losses = []
+    for i in range(100):
+        xb = rng.randn(64, 13).astype(np.float32)
+        yb = xb @ w_true + 0.01 * rng.randn(64, 1).astype(np.float32)
+        (l,) = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[avg_cost])
+        losses.append(float(np.asarray(l).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+
+
+def test_recognize_digits_mlp_momentum(rng):
+    """≙ tests/book/recognize_digits MLP variant + Momentum + L2 decay."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [784])
+        label = layers.data("label", [1], dtype="int64")
+        hidden = layers.fc(img, size=64, act="relu")
+        prediction = layers.fc(hidden, size=10, act="softmax")
+        cost = layers.cross_entropy(prediction, label)
+        avg_cost = layers.mean(cost)
+        opt = pt.optimizer.MomentumOptimizer(
+            learning_rate=0.05, momentum=0.9,
+            regularization=pt.regularizer.L2Decay(1e-4))
+        opt.minimize(avg_cost)
+    exe = pt.Executor()
+    exe.run(startup)
+    first = last = None
+    for i in range(40):
+        x = rng.rand(64, 784).astype(np.float32)
+        yl = (x[:, :100].sum(axis=1) * 2).astype(np.int64).reshape(-1, 1) % 10
+        (l,) = exe.run(main, feed={"img": x, "label": yl}, fetch_list=[avg_cost])
+        if first is None:
+            first = float(l.ravel()[0])
+        last = float(l.ravel()[0])
+    assert last < first, (first, last)
+
+
+def test_lr_scheduler_and_global_norm_clip(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        lr = layers.exponential_decay(learning_rate=0.1, decay_steps=10,
+                                      decay_rate=0.5, staircase=True)
+        pt.clip.set_gradient_clip(pt.clip.GradientClipByGlobalNorm(1.0))
+        opt = pt.optimizer.SGDOptimizer(learning_rate=lr)
+        opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    for i in range(25):
+        xb = rng.randn(16, 4).astype(np.float32)
+        yb = xb.sum(axis=1, keepdims=True).astype(np.float32)
+        out = exe.run(main, feed={"x": xb, "y": yb},
+                      fetch_list=[loss, "@LR_DECAY_COUNTER@"])
+    # counter advanced once per run
+    assert int(np.asarray(out[1]).ravel()[0]) == 25
