@@ -74,6 +74,7 @@ class VarDesc:
         "name", "shape", "dtype", "kind", "persistable", "is_parameter",
         "stop_gradient", "lod_level", "initializer", "trainable", "regularizer",
         "need_clip", "is_data", "optimize_attr", "gradient_clip_attr",
+        "sharding",
     )
 
     def __init__(self, name: str, shape: Sequence[int] = (), dtype: str = "float32",
@@ -94,6 +95,10 @@ class VarDesc:
         self.trainable = True
         self.regularizer = None
         self.need_clip = True
+        # partition spec: tuple of mesh-axis names (or None) per dim, set by
+        # the sharding pass (parallel/transpiler.py) — the pjit-native
+        # reading of the reference's DistributeTranspiler var slicing.
+        self.sharding = None
 
     def to_dict(self) -> dict:
         return {
@@ -101,6 +106,7 @@ class VarDesc:
             "kind": self.kind, "persistable": self.persistable,
             "is_parameter": self.is_parameter, "stop_gradient": self.stop_gradient,
             "lod_level": self.lod_level, "trainable": self.trainable,
+            "sharding": list(self.sharding) if self.sharding is not None else None,
         }
 
     @staticmethod
@@ -109,6 +115,8 @@ class VarDesc:
                     d.get("persistable", False), d.get("is_parameter", False),
                     d.get("stop_gradient", False), d.get("lod_level", 0))
         v.trainable = d.get("trainable", True)
+        sh = d.get("sharding")
+        v.sharding = tuple(sh) if sh is not None else None
         return v
 
     def __repr__(self):

@@ -1,0 +1,11 @@
+"""Parallelism: device meshes, SPMD executors, sharding passes, collectives.
+
+≙ reference ParallelExecutor + framework/details/ + transpiler/ + the three
+communication backends of SURVEY.md §2.3, all re-realized as XLA collectives
+over a jax.sharding.Mesh.
+"""
+
+from .mesh import (make_mesh, default_mesh, set_default_mesh, spec_for, named,
+                   DP, TP, PP, SP, EP)
+from .parallel_executor import (ParallelExecutor, BuildStrategy,
+                                ExecutionStrategy, ReduceStrategy)

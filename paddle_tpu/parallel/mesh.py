@@ -1,0 +1,82 @@
+"""Device mesh construction.
+
+TPU-native replacement for the reference's device topology machinery
+(platform/nccl_helper.h NCCLContextMap, gen_nccl_id_op rendezvous): a
+jax.sharding.Mesh over local or multi-host devices. Multi-host bootstrap
+(the gen_nccl_id equivalent) is jax.distributed.initialize — see
+parallel/distributed.py.
+
+Axis convention (used across the framework):
+  dp — data parallel (batch)        sp — sequence/context parallel
+  tp — tensor/model parallel        ep — expert parallel
+  pp — pipeline stages
+Any subset may be present; size-1 axes are free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_default_mesh: Optional[Mesh] = None
+
+DP, TP, PP, SP, EP = "dp", "tp", "pp", "sp", "ep"
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh. axes maps axis name -> size; one size may be -1 to
+    absorb the remaining devices (like a reshape)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axes:
+        axes = {DP: n}
+    names = list(axes)
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(f"mesh axes {dict(zip(names, sizes))} need {total} "
+                         f"devices, have {n}")
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def default_mesh() -> Mesh:
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
+def set_default_mesh(mesh: Mesh):
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def spec_for(var_sharding: Optional[Tuple], mesh: Mesh) -> PartitionSpec:
+    """VarDesc.sharding tuple -> PartitionSpec, dropping axes the mesh lacks."""
+    if not var_sharding:
+        return PartitionSpec()
+    dims = []
+    for d in var_sharding:
+        if d is None:
+            dims.append(None)
+        elif isinstance(d, (list, tuple)):
+            kept = tuple(a for a in d if a in mesh.axis_names)
+            dims.append(kept if kept else None)
+        else:
+            dims.append(d if d in mesh.axis_names else None)
+    while dims and dims[-1] is None:
+        dims.pop()
+    return PartitionSpec(*dims)
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -1,0 +1,173 @@
+"""ParallelExecutor: SPMD data-parallel (and mesh-parallel) training.
+
+≙ reference ParallelExecutor (paddle/fluid/framework/parallel_executor.cc:54,
+python/paddle/fluid/parallel_executor.py:29) + the SSA-graph machinery in
+framework/details/. The reference replicates the program per GPU, inserts
+NCCL allreduce op-handles per gradient, and drives the DAG with a host
+thread pool. Here the SAME lowered step function is jit-compiled over a
+jax.sharding.Mesh: feeds are batch-sharded (≙ SplitLoDTensor feed split,
+parallel_executor.cc:216), parameters replicated (or sharded per
+BuildStrategy), and XLA GSPMD inserts the gradient all-reduces that
+AllReduceOpHandle (details/all_reduce_op_handle.cc:42) hand-codes — riding
+ICI instead of NCCL rings.
+
+BuildStrategy parity (details/build_strategy.h:24-33):
+  * ReduceStrategy.AllReduce — params+optimizer state replicated, grad psum.
+  * ReduceStrategy.Reduce    — optimizer state sharded over dp (the modern
+    ZeRO-1 reading of the reference's reduce+broadcast round-robin placement,
+    multi_devices_graph_builder.cc:234-259).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.program import Program, VarDesc, default_main_program
+from ..core.scope import Scope, global_scope
+from ..core.executor import Executor, _Compiled
+from ..core import lowering
+from .mesh import default_mesh, spec_for, DP
+
+
+class ReduceStrategy:
+    AllReduce = 0
+    Reduce = 1
+
+
+class BuildStrategy:
+    """≙ details/build_strategy.h. gradient_scale_ and debug fields kept."""
+
+    ReduceStrategy = ReduceStrategy
+
+    def __init__(self):
+        self.reduce_strategy = ReduceStrategy.AllReduce
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    """≙ details/execution_strategy.h — scheduling knobs. XLA owns
+    scheduling, so these are accepted and recorded only."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.allow_op_delay = False
+        self.num_iteration_per_drop_scope = 100
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda: bool = False, loss_name: Optional[str] = None,
+                 main_program: Optional[Program] = None,
+                 share_vars_from: Optional["ParallelExecutor"] = None,
+                 exec_strategy: Optional[ExecutionStrategy] = None,
+                 build_strategy: Optional[BuildStrategy] = None,
+                 num_trainers: int = 1, trainer_id: int = 0,
+                 scope: Optional[Scope] = None, mesh: Optional[Mesh] = None):
+        self._program = main_program if main_program is not None else default_main_program()
+        self._scope = scope or global_scope()
+        self._mesh = mesh or default_mesh()
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._loss_name = loss_name
+        self._cache: Dict[tuple, _Compiled] = {}
+        self._run_counter = 0
+        if share_vars_from is not None:
+            self._scope = share_vars_from._scope
+
+    # -- sharding decisions -------------------------------------------------
+    def _state_spec(self, var: VarDesc, value) -> PartitionSpec:
+        if var is not None and var.sharding:
+            return spec_for(var.sharding, self._mesh)
+        if (self._build_strategy.reduce_strategy == ReduceStrategy.Reduce
+                and var is not None and not var.is_parameter):
+            # optimizer accumulators sharded over dp when cleanly divisible
+            shape = jnp.shape(value)
+            dp_size = self._mesh.shape.get(DP, 1)
+            if shape and shape[0] % max(dp_size, 1) == 0 and shape[0] >= dp_size > 1:
+                return PartitionSpec(DP)
+        return PartitionSpec()
+
+    def _feed_spec(self, var: Optional[VarDesc], value) -> PartitionSpec:
+        if var is not None and var.sharding:
+            return spec_for(var.sharding, self._mesh)
+        shape = jnp.shape(value)
+        dp_size = self._mesh.shape.get(DP, 1)
+        if shape and dp_size > 1 and shape[0] % dp_size == 0:
+            return PartitionSpec(DP)  # batch split ≙ SplitLoDTensor
+        return PartitionSpec()
+
+    # -- run ----------------------------------------------------------------
+    def run(self, fetch_list: Sequence, feed: Optional[dict] = None,
+            feed_dict: Optional[dict] = None, return_numpy: bool = True):
+        feed = feed if feed is not None else (feed_dict or {})
+        program = self._program
+        block = program.global_block
+        exe_helper = Executor()
+        fetch_names = [exe_helper._fetch_name(f) for f in fetch_list]
+        feed_arrays = exe_helper._prep_feed(program, feed)
+        state = exe_helper._state_for(program, self._scope)
+
+        feed_sig = tuple(sorted((k, v.shape, str(v.dtype))
+                                for k, v in feed_arrays.items()))
+        state_sig = tuple(sorted((k, jnp.shape(v), str(jnp.result_type(v)))
+                                 for k, v in state.items()))
+        key = (program.fingerprint(), feed_sig, tuple(fetch_names), state_sig,
+               id(self._mesh), self._build_strategy.reduce_strategy)
+
+        compiled = self._cache.get(key)
+        if compiled is None:
+            step, state_out = lowering.build_step_fn(
+                program, list(feed_arrays), fetch_names, sorted(state))
+
+            def var_of(name):
+                try:
+                    return block.var(name)
+                except KeyError:
+                    return None
+
+            mesh = self._mesh
+            state_shardings = {
+                n: NamedSharding(mesh, self._state_spec(var_of(n), v))
+                for n, v in state.items()}
+            feed_shardings = {
+                n: NamedSharding(mesh, self._feed_spec(var_of(n), v))
+                for n, v in feed_arrays.items()}
+            rng_sharding = NamedSharding(mesh, PartitionSpec())
+            out_state_shardings = {
+                n: state_shardings.get(n, NamedSharding(mesh, self._state_spec(var_of(n), state.get(n))))
+                for n in state_out}
+            fetch_shardings = tuple(NamedSharding(mesh, PartitionSpec())
+                                    for _ in fetch_names)
+            fn = jax.jit(step,
+                         in_shardings=(state_shardings, feed_shardings,
+                                       rng_sharding),
+                         out_shardings=(fetch_shardings, out_state_shardings),
+                         donate_argnums=(0,))
+            compiled = _Compiled(fn, sorted(state), state_out, fetch_names)
+            self._cache[key] = compiled
+
+        seed = program.random_seed if program.random_seed is not None else 0
+        self._run_counter += 1
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._run_counter)
+        with self._mesh:
+            fetches, new_state = compiled.fn(state, feed_arrays, rng)
+        for name, val in new_state.items():
+            self._scope.set_var(name, val)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    @property
+    def device_count(self) -> int:
+        return int(np.prod(list(self._mesh.shape.values())))
+
+    def bcast_params(self):
+        """≙ ParallelExecutor::BCastParamsToGPUs (parallel_executor.cc:134).
+        Under GSPMD replication is a sharding property, so this is a no-op
+        kept for API parity."""
+        return None
