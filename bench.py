@@ -1,0 +1,106 @@
+"""Benchmark: ResNet-50 training throughput + MFU on the available device.
+
+≙ reference benchmark/fluid/fluid_benchmark.py (print_train_time :297) for
+the resnet config. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline is measured MFU / 0.45 (the BASELINE.json north-star target of
+45% MFU for ResNet-50).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip(device) -> float:
+    """bf16 peak FLOP/s for the benchmarked chip."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        "tpu v5 lite": 197e12, "tpu v5e": 197e12, "tpu v5": 459e12,
+        "tpu v4": 275e12, "tpu v6": 918e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return 197e12 if "tpu" in kind else 1e12  # cpu fallback keeps math sane
+
+
+def main():
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.models import resnet as resnet_model
+
+    on_tpu = any("tpu" in d.platform.lower() or "TPU" in d.device_kind
+                 for d in jax.devices())
+    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 4))
+    image = int(os.environ.get("BENCH_IMAGE", 224 if on_tpu else 32))
+    depth = int(os.environ.get("BENCH_DEPTH", 50))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 2))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16" if on_tpu else "float32")
+
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        img = layers.data("data", [3, image, image], dtype=dtype)
+        label = layers.data("label", [1], dtype="int64")
+        logits = resnet_model.resnet_imagenet(img, class_dim=1000,
+                                              depth=depth, head_act=None)
+        cost = layers.softmax_with_cross_entropy(logits, label)
+        avg_cost = layers.mean(cost)
+        opt = pt.optimizer.MomentumOptimizer(learning_rate=0.001, momentum=0.9)
+        opt.minimize(avg_cost)
+
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+
+        rng = np.random.RandomState(0)
+        data = rng.rand(batch, 3, image, image).astype("float32")
+        if dtype == "bfloat16":
+            import ml_dtypes
+            data = data.astype(ml_dtypes.bfloat16)
+        lbl = rng.randint(0, 1000, (batch, 1)).astype("int64")
+        feed = {"data": data, "label": lbl}
+
+        # warmup + compile
+        t0 = time.time()
+        exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+        compile_s = time.time() - t0
+        exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+
+        t0 = time.time()
+        for _ in range(steps):
+            (loss,) = exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+        elapsed = (time.time() - t0) / steps
+
+    # analytic train FLOPs: fwd conv+fc ≈ resnet50 4.09 GFLOP/img at 224²,
+    # scaled by (image/224)², bwd ≈ 2× fwd
+    fwd_flops_img = 4.089e9 * (image / 224.0) ** 2 * (
+        1.0 if depth == 50 else depth / 50.0)
+    train_flops = 3.0 * fwd_flops_img * batch
+    ips = batch / elapsed
+    import jax
+    peak = peak_flops_per_chip(jax.devices()[0])
+    mfu = train_flops / elapsed / peak
+
+    result = {
+        "metric": f"resnet{depth}_bs{batch}_{image}px_{dtype}_train_mfu",
+        "value": round(mfu * 100, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "images_per_sec": round(ips, 2),
+        "ms_per_batch": round(elapsed * 1000, 2),
+        "compile_s": round(compile_s, 1),
+        "loss": float(np.ravel(loss)[0]),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -75,14 +75,35 @@ class Executor:
     def _prep_feed(self, program: Program, feed: Dict[str, object]):
         out = {}
         for name, val in feed.items():
-            arr = np.asarray(val)
             try:
                 var = program.global_block.var(name)
+            except KeyError:
+                var = None
+
+            # ragged feeds: LoDTensor / list of sequences -> padded + lengths
+            # (≙ DataFeeder LoD handling, data_feeder.py:73)
+            seq_len_name = getattr(var, "seq_len_var", None) if var else None
+            from ..lod import LoDTensor, pad_sequences
+            if isinstance(val, LoDTensor):
+                padded, lens = val.to_padded()
+                val = padded
+                if seq_len_name:
+                    out[seq_len_name] = jnp.asarray(lens)
+            elif seq_len_name and isinstance(val, (list, tuple)):
+                dt = np_dtype(_device_dtype(var.dtype)) if var else None
+                padded, lens = pad_sequences(val, dtype=dt)
+                val = padded
+                out[seq_len_name] = jnp.asarray(lens)
+            elif seq_len_name and seq_len_name not in feed:
+                arr0 = np.asarray(val)
+                out[seq_len_name] = jnp.full((arr0.shape[0],), arr0.shape[1],
+                                             np.int32)
+
+            arr = np.asarray(val)
+            if var is not None:
                 want = np_dtype(_device_dtype(var.dtype))
                 if arr.dtype != want:
                     arr = arr.astype(want)
-            except KeyError:
-                pass
             out[name] = jnp.asarray(arr)
         return out
 

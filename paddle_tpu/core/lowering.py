@@ -51,6 +51,11 @@ def _apply_stop_gradient(block: Block, name: str, val):
 def run_op(op: OpDesc, env: Dict[str, object], ctx: ExecContext, block: Block):
     """Execute one op by tracing its compute fn; rebind outputs in env."""
     impl = require_op(op.type)
+    # control-flow ops (dynamic_rnn/while/cond) lower sub-blocks themselves:
+    # they need the program and the enclosing environment (for captured vars
+    # like parameters — ≙ the reference's parent-scope lookup, scope.h:62).
+    ctx.program = block.program
+    ctx.env = env
     ins = {slot: [env[n] for n in names] for slot, names in op.inputs.items()}
     outs = impl.compute(ctx, ins, op.attrs)
     for slot, names in op.outputs.items():

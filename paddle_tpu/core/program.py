@@ -74,7 +74,7 @@ class VarDesc:
         "name", "shape", "dtype", "kind", "persistable", "is_parameter",
         "stop_gradient", "lod_level", "initializer", "trainable", "regularizer",
         "need_clip", "is_data", "optimize_attr", "gradient_clip_attr",
-        "sharding",
+        "sharding", "seq_len_var",
     )
 
     def __init__(self, name: str, shape: Sequence[int] = (), dtype: str = "float32",
@@ -99,6 +99,9 @@ class VarDesc:
         # the sharding pass (parallel/transpiler.py) — the pjit-native
         # reading of the reference's DistributeTranspiler var slicing.
         self.sharding = None
+        # ragged-sequence support (LoD parity, lod_tensor.h:58): padded
+        # sequence vars carry the name of their [B] length companion var.
+        self.seq_len_var = None
 
     def to_dict(self) -> dict:
         return {
@@ -107,6 +110,7 @@ class VarDesc:
             "is_parameter": self.is_parameter, "stop_gradient": self.stop_gradient,
             "lod_level": self.lod_level, "trainable": self.trainable,
             "sharding": list(self.sharding) if self.sharding is not None else None,
+            "seq_len_var": self.seq_len_var,
         }
 
     @staticmethod
@@ -117,6 +121,7 @@ class VarDesc:
         v.trainable = d.get("trainable", True)
         sh = d.get("sharding")
         v.sharding = tuple(sh) if sh is not None else None
+        v.seq_len_var = d.get("seq_len_var")
         return v
 
     def __repr__(self):
@@ -259,6 +264,7 @@ class Program:
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self._seed: Optional[int] = None
+        self._block_stack: List[int] = [0]
 
     # -- structure ----------------------------------------------------------
     @property
@@ -274,7 +280,26 @@ class Program:
         return b
 
     def current_block(self) -> Block:
-        return self.blocks[_current_block_idx[-1]] if _current_block_idx else self.global_block
+        return self.blocks[self._block_stack[-1]]
+
+    class _BlockGuard:
+        def __init__(self, program: "Program", block: "Block"):
+            self.program, self.block = program, block
+
+        def __enter__(self):
+            self.program._block_stack.append(self.block.idx)
+            return self.block
+
+        def __exit__(self, *exc):
+            self.program._block_stack.pop()
+            return False
+
+    def block_guard(self, block: Optional[Block] = None) -> "_BlockGuard":
+        """`with prog.block_guard():` — append ops into a fresh sub-block
+        (≙ framework.py Program._create_block/BlockGuard for control flow)."""
+        if block is None:
+            block = self.create_block(self._block_stack[-1])
+        return Program._BlockGuard(self, block)
 
     def all_parameters(self) -> List[VarDesc]:
         return [v for b in self.blocks for v in b.all_parameters()]
@@ -378,7 +403,6 @@ class Program:
 
 _main_program = Program()
 _startup_program = Program()
-_current_block_idx: List[int] = []
 
 
 def default_main_program() -> Program:

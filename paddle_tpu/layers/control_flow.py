@@ -1,0 +1,202 @@
+"""Control-flow layers: DynamicRNN, StaticRNN.
+
+≙ reference python/paddle/fluid/layers/control_flow.py (DynamicRNN:1313,
+StaticRNN:383). The reference interprets sub-blocks per timestep through
+recurrent_op's StepScopes (recurrent_op.cc:53-222); here the sub-block is
+*traced* once into a lax.scan body (ops/rnn_ops.py dynamic_rnn) — compiled,
+fused, differentiable through scan's native VJP.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.program import VarDesc, default_main_program, unique_name
+from ..layer_helper import LayerHelper
+from .sequence import _mark_seq
+
+__all__ = ["DynamicRNN", "StaticRNN"]
+
+
+class DynamicRNN:
+    BEFORE_RNN = 0
+    IN_RNN = 1
+    AFTER_RNN = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self.main_program = default_main_program()
+        self.status = DynamicRNN.BEFORE_RNN
+        parent_idx = self.main_program._block_stack[-1]
+        self.sub_block = self.main_program.create_block(parent_idx)
+        self.parent_block = self.main_program.block(parent_idx)
+        self.step_outer: List[VarDesc] = []
+        self.step_inner: List[VarDesc] = []
+        self.memories: List[VarDesc] = []
+        self.mem_init_vars: List[Optional[VarDesc]] = []
+        self.mem_init_values: List[float] = []
+        self.mem_shapes: List[list] = []
+        self.mem_updates = {}
+        self.output_inner: List[VarDesc] = []
+        self.outputs_outer: List[VarDesc] = []
+        self.seq_len_name: Optional[str] = None
+
+    # -- context ------------------------------------------------------------
+    class _BlockCtx:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            rnn = self.rnn
+            rnn.status = DynamicRNN.IN_RNN
+            rnn._guard = rnn.main_program.block_guard(rnn.sub_block)
+            rnn._guard.__enter__()
+            return rnn
+
+        def __exit__(self, exc_type, *exc):
+            rnn = self.rnn
+            rnn._guard.__exit__(exc_type, *exc)
+            rnn.status = DynamicRNN.AFTER_RNN
+            if exc_type is None:
+                rnn._append_rnn_op()
+            return False
+
+    def block(self):
+        if self.status != DynamicRNN.BEFORE_RNN:
+            raise RuntimeError("rnn.block() can only be entered once")
+        return DynamicRNN._BlockCtx(self)
+
+    # -- builder API (mirrors control_flow.py DynamicRNN) -------------------
+    def step_input(self, x: VarDesc) -> VarDesc:
+        self._assert_in_rnn("step_input")
+        if not getattr(x, "seq_len_var", None):
+            raise ValueError(f"step_input {x.name} must be a sequence var")
+        if self.seq_len_name is None:
+            self.seq_len_name = x.seq_len_var
+        inner = self.sub_block.create_var(
+            unique_name("dynamic_rnn_step_in"),
+            shape=(x.shape[0],) + tuple(x.shape[2:]), dtype=x.dtype)
+        self.step_outer.append(x)
+        self.step_inner.append(inner)
+        return inner
+
+    def memory(self, init: Optional[VarDesc] = None, shape=None,
+               value: float = 0.0, need_reorder: bool = False,
+               dtype: str = "float32") -> VarDesc:
+        self._assert_in_rnn("memory")
+        if init is not None:
+            inner = self.sub_block.create_var(
+                unique_name("dynamic_rnn_mem"), shape=init.shape,
+                dtype=init.dtype)
+            self.mem_init_vars.append(init)
+            self.mem_shapes.append(list(init.shape))
+            self.mem_init_values.append(0.0)
+        else:
+            assert shape is not None
+            inner = self.sub_block.create_var(
+                unique_name("dynamic_rnn_mem"), shape=(-1,) + tuple(shape),
+                dtype=dtype)
+            self.mem_init_vars.append(None)
+            self.mem_shapes.append(list(shape))
+            self.mem_init_values.append(float(value))
+        self.memories.append(inner)
+        return inner
+
+    def update_memory(self, ex_mem: VarDesc, new_mem: VarDesc):
+        self._assert_in_rnn("update_memory")
+        self.mem_updates[ex_mem.name] = new_mem.name
+
+    def output(self, *outputs: VarDesc):
+        self._assert_in_rnn("output")
+        for o in outputs:
+            self.output_inner.append(o)
+
+    def __call__(self):
+        if self.status != DynamicRNN.AFTER_RNN:
+            raise RuntimeError("rnn() must be called after the with-block")
+        if len(self.outputs_outer) == 1:
+            return self.outputs_outer[0]
+        return self.outputs_outer
+
+    # -- finalize -----------------------------------------------------------
+    def _append_rnn_op(self):
+        block = self.parent_block
+        outs = []
+        T = self.step_outer[0].shape[1] if self.step_outer else -1
+        for inner in self.output_inner:
+            out = block.create_var(unique_name("dynamic_rnn_out"),
+                                   shape=(inner.shape[0], T) + tuple(inner.shape[1:]),
+                                   dtype=inner.dtype)
+            _mark_seq(out, self.seq_len_name)
+            outs.append(out)
+        self.outputs_outer = outs
+        final_mems = [block.create_var(unique_name("dynamic_rnn_final_mem"),
+                                       shape=m.shape, dtype=m.dtype)
+                      for m in self.memories]
+        inputs = {"X": [v.name for v in self.step_outer],
+                  "SeqLen": [self.seq_len_name],
+                  "InitMems": [v.name for v in self.mem_init_vars
+                               if v is not None]}
+        block.append_op(
+            "dynamic_rnn", inputs,
+            {"Out": [o.name for o in outs],
+             "FinalMems": [m.name for m in final_mems]},
+            {"sub_block": self.sub_block.idx,
+             "step_input_vars": [v.name for v in self.step_inner],
+             "memory_vars": [m.name for m in self.memories],
+             "memory_updates": dict(self.mem_updates),
+             "memory_init_values": list(self.mem_init_values),
+             "memory_shapes": list(self.mem_shapes),
+             "memory_has_init": [v is not None for v in self.mem_init_vars],
+             "output_vars": [o.name for o in self.output_inner]})
+
+    def _assert_in_rnn(self, method):
+        if self.status != DynamicRNN.IN_RNN:
+            raise RuntimeError(f"{method} must be called inside rnn.block()")
+
+
+class StaticRNN:
+    """≙ control_flow.py:383 StaticRNN — fixed-length recurrence over a
+    known time dimension; same scan machinery with a full-length mask."""
+
+    def __init__(self, name=None):
+        self._drnn = DynamicRNN(name=name)
+        self._seq_len_added = False
+
+    def step(self):
+        return self._drnn.block()
+
+    def step_input(self, x: VarDesc) -> VarDesc:
+        if not getattr(x, "seq_len_var", None):
+            # synthesize a full-length companion for dense [B, T, ...] input
+            from . import tensor as tensor_layers
+            block = default_main_program().global_block
+            name = x.name + "@SEQ_LEN"
+            if name not in block.vars:
+                with self._drnn.main_program.block_guard(
+                        self._drnn.parent_block):
+                    ln = tensor_layers.fill_constant_batch_size_like(
+                        x, [-1], "int32", float(x.shape[1]))
+                    ln.stop_gradient = True
+                block.vars[name] = block.vars.pop(ln.name)
+                block.vars[name].name = name
+                # fix the op output reference
+                for op in self._drnn.parent_block.ops:
+                    for slot, names in op.outputs.items():
+                        op.outputs[slot] = [name if n == ln.name else n
+                                            for n in names]
+            x.seq_len_var = name
+            x.lod_level = 1
+        return self._drnn.step_input(x)
+
+    def memory(self, init=None, shape=None, init_value=0.0, **kw):
+        return self._drnn.memory(init=init, shape=shape, value=init_value)
+
+    def update_memory(self, mem, new):
+        return self._drnn.update_memory(mem, new)
+
+    def output(self, *outputs):
+        return self._drnn.output(*outputs)
+
+    def __call__(self):
+        return self._drnn()

@@ -47,21 +47,37 @@ def fc(input, size: int, num_flatten_dims: int = 1, param_attr=None,
                          bias_attr=bias_attr, act=act, name=name)
     dtype = helper.input_dtype()
     mul_results = []
+    seq_src = None
     for input_var in helper.multiple_input():
         input_shape = input_var.shape
-        param_shape = [int(np.prod(input_shape[num_flatten_dims:]))] + [size]
+        flatten = num_flatten_dims
+        # per-timestep fc on padded sequences (the reference's [T_total, D]
+        # row-major sequence fc becomes [B, T, D] with x_num_col_dims=2)
+        if getattr(input_var, "seq_len_var", None) and len(input_shape) > 2 \
+                and num_flatten_dims == 1:
+            flatten = len(input_shape) - 1
+            seq_src = input_var
+        param_shape = [int(np.prod(input_shape[flatten:]))] + [size]
         w = helper.create_parameter(ParamAttr_to(param_attr), param_shape, dtype)
         tmp = helper.create_tmp_variable(dtype)
         helper.append_op("mul", {"X": input_var, "Y": w}, {"Out": tmp},
-                         {"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1})
+                         {"x_num_col_dims": flatten, "y_num_col_dims": 1})
         mul_results.append(tmp)
     if len(mul_results) == 1:
         pre_bias = mul_results[0]
     else:
         pre_bias = helper.create_tmp_variable(dtype)
         helper.append_op("sum", {"X": mul_results}, {"Out": pre_bias})
-    pre_act = helper.append_bias_op(pre_bias) if bias_attr is not False else pre_bias
-    return helper.append_activation(pre_act)
+    if bias_attr is not False:
+        bias_dim = len(pre_bias.shape) - 1 if seq_src is not None else 1
+        pre_act = helper.append_bias_op(pre_bias, dim_start=bias_dim)
+    else:
+        pre_act = pre_bias
+    out = helper.append_activation(pre_act)
+    if seq_src is not None:
+        from .sequence import propagate_seq
+        propagate_seq(seq_src, out)
+    return out
 
 
 def ParamAttr_to(attr):
@@ -88,6 +104,11 @@ def embedding(input, size: Sequence[int], is_sparse: bool = False,
     helper.append_op("lookup_table", {"Ids": input, "W": w}, {"Out": tmp},
                      {"is_sparse": is_sparse, "is_distributed": is_distributed,
                       "padding_idx": padding_idx})
+    if getattr(input, "seq_len_var", None):
+        from .sequence import propagate_seq
+        propagate_seq(input, tmp)
+        tmp.shape = tuple(input.shape[:2]) + (size[1],)
+        tmp.dtype = dtype
     return tmp
 
 

@@ -1,0 +1,220 @@
+"""Sequence layer functions over padded+lengths ragged batches.
+
+≙ reference python/paddle/fluid/layers/nn.py sequence_* layers +
+dynamic_lstm:216 / dynamic_gru. Every sequence variable carries a
+`@SEQ_LEN` companion (VarDesc.seq_len_var) wired automatically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.program import VarDesc
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer
+
+__all__ = [
+    "sequence_pool", "sequence_softmax", "sequence_first_step",
+    "sequence_last_step", "sequence_expand", "sequence_conv",
+    "sequence_reshape", "sequence_concat", "sequence_erase",
+    "sequence_enumerate", "dynamic_lstm", "dynamic_gru", "edit_distance",
+]
+
+
+def _seq_len_of(x: VarDesc, helper: LayerHelper) -> str:
+    name = getattr(x, "seq_len_var", None)
+    if not name:
+        raise ValueError(
+            f"{x.name} is not a sequence variable (no @SEQ_LEN companion); "
+            "declare it with layers.data(..., lod_level=1)")
+    return name
+
+
+def _mark_seq(out: VarDesc, seq_len_name: str):
+    out.seq_len_var = seq_len_name
+    out.lod_level = 1
+    return out
+
+
+def propagate_seq(src: VarDesc, dst: VarDesc):
+    """Carry the sequence companion through a timestep-preserving layer."""
+    if getattr(src, "seq_len_var", None):
+        dst.seq_len_var = src.seq_len_var
+        dst.lod_level = src.lod_level
+    return dst
+
+
+def sequence_pool(input, pool_type: str):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("sequence_pool",
+                     {"X": input, "SeqLen": _seq_len_of(input, helper)},
+                     {"Out": out}, {"pooltype": pool_type})
+    if input.shape:
+        out.shape = tuple(input.shape[:1]) + tuple(input.shape[2:])
+        out.dtype = input.dtype
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("sequence_softmax",
+                     {"X": input, "SeqLen": _seq_len_of(input, helper)},
+                     {"Out": out})
+    out.shape, out.dtype = input.shape, input.dtype
+    return _mark_seq(out, input.seq_len_var)
+
+
+def sequence_expand(x, y, name=None):
+    helper = LayerHelper("sequence_expand")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("sequence_expand", {"X": x, "Y": y}, {"Out": out})
+    if x.shape and y.shape:
+        out.shape = (x.shape[0], y.shape[1]) + tuple(x.shape[1:])
+    return _mark_seq(out, _seq_len_of(y, helper))
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None):
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act)
+    dtype = input.dtype
+    d = input.shape[-1]
+    filter_shape = [filter_size * d, num_filters]
+    w = helper.create_parameter(helper.param_attr, filter_shape, dtype)
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("sequence_conv",
+                     {"X": input, "Filter": w,
+                      "SeqLen": _seq_len_of(input, helper)},
+                     {"Out": out},
+                     {"contextStride": filter_stride,
+                      "contextStart": -int(filter_size // 2),
+                      "contextLength": filter_size})
+    out.shape = tuple(input.shape[:2]) + (num_filters,)
+    out.dtype = dtype
+    _mark_seq(out, input.seq_len_var)
+    pre_act = helper.append_bias_op(out, dim_start=2)
+    res = helper.append_activation(pre_act)
+    if res is not out:
+        _mark_seq(res, input.seq_len_var)
+        res.shape = out.shape
+    return res
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("sequence_reshape", {"X": input}, {"Out": out},
+                     {"new_dim": new_dim})
+    return _mark_seq(out, _seq_len_of(input, helper))
+
+
+def sequence_concat(input, axis=-1, name=None):
+    helper = LayerHelper("sequence_concat")
+    out = helper.create_tmp_variable(input[0].dtype)
+    helper.append_op("sequence_concat", {"X": list(input)}, {"Out": out})
+    return _mark_seq(out, _seq_len_of(input[0], helper))
+
+
+def sequence_erase(input, tokens):
+    helper = LayerHelper("sequence_erase")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("sequence_erase", {"X": input}, {"Out": out},
+                     {"tokens": list(tokens)})
+    return _mark_seq(out, _seq_len_of(input, helper))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("sequence_enumerate", {"X": input}, {"Out": out},
+                     {"win_size": win_size, "pad_value": pad_value})
+    return _mark_seq(out, _seq_len_of(input, helper))
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None):
+    helper = LayerHelper("edit_distance")
+    out = helper.create_tmp_variable("float32")
+    seq_num = helper.create_tmp_variable("int64")
+    for v in (out, seq_num):
+        v.stop_gradient = True
+    inputs = {"Hyps": input, "Refs": label}
+    if getattr(input, "seq_len_var", None):
+        inputs["HypsLen"] = input.seq_len_var
+    if getattr(label, "seq_len_var", None):
+        inputs["RefsLen"] = label.seq_len_var
+    helper.append_op("edit_distance", inputs,
+                     {"Out": out, "SequenceNum": seq_num},
+                     {"normalized": normalized})
+    return out, seq_num
+
+
+# ---------------------------------------------------------------------------
+# Fused recurrent layers
+# ---------------------------------------------------------------------------
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """layers/nn.py:216. `size` = 4×hidden (reference convention); input is
+    the pre-projected [B, T, 4H]. Returns (hidden, cell) each [B, T, H]."""
+    helper = LayerHelper("lstm", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    hidden_size = size // 4
+    weight = helper.create_parameter(helper.param_attr,
+                                     [hidden_size, 4 * hidden_size], dtype)
+    bias_size = 4 * hidden_size + (3 * hidden_size if use_peepholes else 0)
+    bias = helper.create_parameter(helper.bias_attr, [1, bias_size], dtype,
+                                   is_bias=True)
+    hidden = helper.create_tmp_variable(dtype)
+    cell = helper.create_tmp_variable(dtype)
+    inputs = {"Input": input, "Weight": weight, "Bias": bias,
+              "SeqLen": _seq_len_of(input, helper)}
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
+    helper.append_op("dynamic_lstm", inputs,
+                     {"Hidden": hidden, "Cell": cell},
+                     {"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+                      "gate_activation": gate_activation,
+                      "cell_activation": cell_activation,
+                      "candidate_activation": candidate_activation})
+    shape = tuple(input.shape[:2]) + (hidden_size,)
+    hidden.shape = cell.shape = shape
+    hidden.dtype = cell.dtype = dtype
+    _mark_seq(hidden, input.seq_len_var)
+    _mark_seq(cell, input.seq_len_var)
+    return hidden, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, dtype="float32"):
+    """layers/nn.py dynamic_gru: `size` = hidden; input [B, T, 3H]."""
+    helper = LayerHelper("gru", param_attr=param_attr, bias_attr=bias_attr)
+    weight = helper.create_parameter(helper.param_attr, [size, 3 * size], dtype)
+    bias = helper.create_parameter(helper.bias_attr, [1, 3 * size], dtype,
+                                   is_bias=True)
+    hidden = helper.create_tmp_variable(dtype)
+    inputs = {"Input": input, "Weight": weight, "Bias": bias,
+              "SeqLen": _seq_len_of(input, helper)}
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    helper.append_op("dynamic_gru", inputs, {"Hidden": hidden},
+                     {"is_reverse": is_reverse,
+                      "gate_activation": gate_activation,
+                      "activation": candidate_activation})
+    hidden.shape = tuple(input.shape[:2]) + (size,)
+    hidden.dtype = dtype
+    return _mark_seq(hidden, input.seq_len_var)

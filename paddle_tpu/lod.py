@@ -1,0 +1,85 @@
+"""Host-side ragged-sequence containers and padding.
+
+≙ reference LoDTensor (paddle/fluid/framework/lod_tensor.h:110, python
+python/paddle/fluid/lod_tensor.py). On device a sequence batch is padded
+dense + lengths (ops/sequence_ops.py); this module is the host-side bridge:
+build from a list of variable-length sequences, pad to a bucketed max length
+(bounding XLA recompiles while keeping pad waste low — the static-shape
+answer to LoD's zero-padding batching).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _round_up(n: int, multiple: int) -> int:
+    if multiple <= 1:
+        return n
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+class LoDTensor:
+    """A batch of variable-length sequences (level-1 LoD parity)."""
+
+    def __init__(self, sequences: Optional[Sequence[np.ndarray]] = None):
+        self.sequences: List[np.ndarray] = [np.asarray(s) for s in (sequences or [])]
+
+    # reference-compatible construction: flat data + offsets
+    @staticmethod
+    def from_flat(data: np.ndarray, lod: Sequence[Sequence[int]]) -> "LoDTensor":
+        data = np.asarray(data)
+        offsets = list(lod[0])
+        seqs = [data[offsets[i]:offsets[i + 1]] for i in range(len(offsets) - 1)]
+        return LoDTensor(seqs)
+
+    def set(self, data, place=None):
+        self._flat = np.asarray(data)
+        return self
+
+    def set_lod(self, lod):
+        t = LoDTensor.from_flat(self._flat, lod)
+        self.sequences = t.sequences
+        return self
+
+    def lod(self):
+        offs = [0]
+        for s in self.sequences:
+            offs.append(offs[-1] + len(s))
+        return [offs]
+
+    def __len__(self):
+        return len(self.sequences)
+
+    def to_padded(self, pad_multiple: int = 8, pad_value=0,
+                  max_len: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (padded [B, T, ...], lengths [B] int32)."""
+        lens = np.asarray([len(s) for s in self.sequences], np.int32)
+        T = int(max_len if max_len is not None else
+                _round_up(int(lens.max() if len(lens) else 1), pad_multiple))
+        B = len(self.sequences)
+        tail = self.sequences[0].shape[1:] if B else ()
+        out = np.full((B, T) + tuple(tail), pad_value,
+                      self.sequences[0].dtype if B else np.float32)
+        for i, s in enumerate(self.sequences):
+            out[i, :len(s)] = s
+        return out, lens
+
+
+def pad_sequences(seqs: Sequence, dtype=None, pad_multiple: int = 8,
+                  pad_value=0) -> Tuple[np.ndarray, np.ndarray]:
+    """list of per-sequence arrays/lists -> (padded, lengths)."""
+    arrs = [np.asarray(s, dtype=dtype) for s in seqs]
+    return LoDTensor(arrs).to_padded(pad_multiple, pad_value)
+
+
+def create_lod_tensor(data, recursive_seq_lens=None, place=None) -> LoDTensor:
+    """≙ fluid.create_lod_tensor (lod_tensor.py): data may be a list of
+    sequences or flat ndarray + lengths."""
+    if recursive_seq_lens is None:
+        return LoDTensor(data)
+    lens = recursive_seq_lens[0]
+    offsets = np.concatenate([[0], np.cumsum(lens)])
+    return LoDTensor.from_flat(np.asarray(data), [offsets.tolist()])

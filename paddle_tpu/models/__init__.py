@@ -1,0 +1,15 @@
+"""Benchmark model zoo.
+
+≙ reference benchmark/fluid/models/{mnist,resnet,vgg,stacked_dynamic_lstm,
+machine_translation}.py — the five north-star configs (BASELINE.md).
+"""
+
+from . import mnist, resnet, vgg
+
+__all__ = ["mnist", "resnet", "vgg", "get_model"]
+
+
+def get_model(name: str):
+    import importlib
+    mod = importlib.import_module("paddle_tpu.models." + name)
+    return mod.get_model

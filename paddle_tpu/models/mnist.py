@@ -1,0 +1,31 @@
+"""LeNet-5-class MNIST CNN (≙ benchmark/fluid/models/mnist.py cnn_model):
+conv5x5x20-pool2 → conv5x5x50-pool2 → fc10 softmax."""
+
+from __future__ import annotations
+
+from .. import layers, nets, optimizer
+
+
+def cnn_model(data):
+    conv_pool_1 = nets.simple_img_conv_pool(
+        input=data, filter_size=5, num_filters=20, pool_size=2,
+        pool_stride=2, act="relu")
+    conv_pool_2 = nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=50, pool_size=2,
+        pool_stride=2, act="relu")
+    predict = layers.fc(input=conv_pool_2, size=10, act="softmax")
+    return predict
+
+
+def get_model(batch_size: int = 128, use_adam: bool = True):
+    """Build train program; returns (loss, acc, predict, feed names)."""
+    images = layers.data("pixel", [1, 28, 28])
+    label = layers.data("label", [1], dtype="int64")
+    predict = cnn_model(images)
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    batch_acc = layers.accuracy(input=predict, label=label)
+    opt = optimizer.AdamOptimizer(learning_rate=0.001) if use_adam else \
+        optimizer.MomentumOptimizer(learning_rate=0.01, momentum=0.9)
+    opt.minimize(avg_cost)
+    return avg_cost, batch_acc, predict, ["pixel", "label"]

@@ -1,0 +1,66 @@
+"""Stacked dynamic LSTM text classifier
+(≙ benchmark/fluid/models/stacked_dynamic_lstm.py — BASELINE config 4).
+
+Mirrors the reference exactly: embedding → tanh fc → hand-built LSTM cell
+inside DynamicRNN (per-step fc gates, sums, sigmoid/tanh) → last-step pool →
+fc softmax. The DynamicRNN sub-block lowers to one lax.scan (ops/rnn_ops.py).
+A fused alternative (`use_fused=True`) uses the dynamic_lstm op instead —
+the production path on TPU.
+"""
+
+from __future__ import annotations
+
+from .. import layers, optimizer
+
+
+def lstm_net(data, dict_size: int, lstm_size: int = 512, emb_dim: int = 512,
+             use_fused: bool = False):
+    sentence = layers.embedding(input=data, size=[dict_size, emb_dim])
+    sentence = layers.fc(input=sentence, size=lstm_size, act="tanh")
+
+    if use_fused:
+        proj = layers.fc(input=sentence, size=lstm_size * 4)
+        hidden, _ = layers.dynamic_lstm(proj, size=lstm_size * 4,
+                                        use_peepholes=False)
+        return layers.sequence_pool(hidden, "last")
+
+    rnn = layers.DynamicRNN()
+    with rnn.block():
+        word = rnn.step_input(sentence)
+        prev_hidden = rnn.memory(value=0.0, shape=[lstm_size])
+        prev_cell = rnn.memory(value=0.0, shape=[lstm_size])
+
+        def gate_common(ipt, hidden, size):
+            gate0 = layers.fc(input=ipt, size=size, bias_attr=True)
+            gate1 = layers.fc(input=hidden, size=size, bias_attr=False)
+            return layers.sums(input=[gate0, gate1])
+
+        forget_gate = layers.sigmoid(gate_common(word, prev_hidden, lstm_size))
+        input_gate = layers.sigmoid(gate_common(word, prev_hidden, lstm_size))
+        output_gate = layers.sigmoid(gate_common(word, prev_hidden, lstm_size))
+        cell_gate = layers.tanh(gate_common(word, prev_hidden, lstm_size))
+
+        cell = layers.sums(input=[
+            layers.elementwise_mul(x=forget_gate, y=prev_cell),
+            layers.elementwise_mul(x=input_gate, y=cell_gate),
+        ])
+        hidden = layers.elementwise_mul(x=output_gate, y=layers.tanh(x=cell))
+
+        rnn.update_memory(prev_cell, cell)
+        rnn.update_memory(prev_hidden, hidden)
+        rnn.output(hidden)
+
+    return layers.sequence_pool(rnn(), "last")
+
+
+def get_model(dict_size: int = 30000, lstm_size: int = 512,
+              emb_dim: int = 512, use_fused: bool = False):
+    data = layers.data(name="words", shape=[1], lod_level=1, dtype="int64")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    last = lstm_net(data, dict_size, lstm_size, emb_dim, use_fused)
+    logit = layers.fc(input=last, size=2, act="softmax")
+    loss = layers.mean(layers.cross_entropy(input=logit, label=label))
+    batch_acc = layers.accuracy(input=logit, label=label)
+    adam = optimizer.AdamOptimizer(learning_rate=0.001)
+    adam.minimize(loss)
+    return loss, batch_acc, logit, ["words", "label"]

@@ -1,0 +1,46 @@
+"""VGG-16 with batch norm + dropout (≙ benchmark/fluid/models/vgg.py
+vgg16_bn_drop)."""
+
+from __future__ import annotations
+
+from .. import layers, nets, optimizer
+
+
+def vgg16_bn_drop(input, is_test=False):
+    def conv_block(ipt, num_filter, groups, dropouts):
+        return nets.img_conv_group(
+            input=ipt, pool_size=2, pool_stride=2,
+            conv_num_filter=[num_filter] * groups, conv_filter_size=3,
+            conv_act="relu", conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts, pool_type="max")
+
+    conv1 = conv_block(input, 64, 2, [0.3, 0])
+    conv2 = conv_block(conv1, 128, 2, [0.4, 0])
+    conv3 = conv_block(conv2, 256, 3, [0.4, 0.4, 0])
+    conv4 = conv_block(conv3, 512, 3, [0.4, 0.4, 0])
+    conv5 = conv_block(conv4, 512, 3, [0.4, 0.4, 0])
+
+    drop = layers.dropout(x=conv5, dropout_prob=0.5, is_test=is_test)
+    fc1 = layers.fc(input=drop, size=512, act=None)
+    bn = layers.batch_norm(input=fc1, act="relu", is_test=is_test)
+    drop2 = layers.dropout(x=bn, dropout_prob=0.5, is_test=is_test)
+    fc2 = layers.fc(input=drop2, size=512, act=None)
+    return fc2
+
+
+def get_model(data_set: str = "cifar10", learning_rate: float = 1e-3,
+              is_test: bool = False):
+    if data_set == "cifar10":
+        classdim, data_shape = 10, [3, 32, 32]
+    else:
+        classdim, data_shape = 102, [3, 224, 224]
+    images = layers.data("data", data_shape)
+    label = layers.data("label", [1], dtype="int64")
+    net = vgg16_bn_drop(images, is_test=is_test)
+    predict = layers.fc(input=net, size=classdim, act="softmax")
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    batch_acc = layers.accuracy(input=predict, label=label)
+    opt = optimizer.AdamOptimizer(learning_rate=learning_rate)
+    opt.minimize(avg_cost)
+    return avg_cost, batch_acc, predict, ["data", "label"]

@@ -1,0 +1,102 @@
+"""Composite network helpers.
+
+≙ reference python/paddle/fluid/nets.py: simple_img_conv_pool,
+img_conv_group, sequence_conv_pool, glu, scaled_dot_product_attention.
+"""
+
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "glu",
+           "scaled_dot_product_attention", "sequence_conv_pool"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, act, param_attr=None,
+                         pool_type="max"):
+    conv_out = layers.conv2d(input=input, num_filters=num_filters,
+                             filter_size=filter_size, param_attr=param_attr,
+                             act=act)
+    return layers.pool2d(input=conv_out, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True,
+                   use_mkldnn=False):
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def _to_list(v):
+        if hasattr(v, "__len__"):
+            return list(v)
+        return [v] * len(conv_num_filter)
+
+    conv_padding = _to_list(conv_padding)
+    conv_filter_size = _to_list(conv_filter_size)
+    param_attr = param_attr if isinstance(param_attr, list) else \
+        [param_attr] * len(conv_num_filter)
+    conv_with_batchnorm = _to_list(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = _to_list(conv_batchnorm_drop_rate)
+
+    for i in range(len(conv_num_filter)):
+        local_conv_act = conv_act
+        if conv_with_batchnorm[i]:
+            local_conv_act = None
+        tmp = layers.conv2d(input=tmp, num_filters=conv_num_filter[i],
+                            filter_size=conv_filter_size[i],
+                            padding=conv_padding[i], param_attr=param_attr[i],
+                            act=local_conv_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            drop_rate = conv_batchnorm_drop_rate[i]
+            if abs(drop_rate) > 1e-5:
+                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate)
+    return layers.pool2d(input=tmp, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max"):
+    conv_out = layers.sequence_conv(input=input, num_filters=num_filters,
+                                    filter_size=filter_size,
+                                    param_attr=param_attr, act=act)
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type)
+
+
+def glu(input, dim=-1):
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """nets.py scaled_dot_product_attention: [B, T, D] multi-head attention
+    composed from matmul/softmax ops (the 2018 formulation)."""
+    if num_heads != 1:
+        d = queries.shape[-1]
+        head_dim = d // num_heads
+
+        def split_heads(x):
+            reshaped = layers.reshape(x, [0 if s == -1 else s for s in
+                                          (x.shape[0], x.shape[1], num_heads,
+                                           x.shape[2] // num_heads)])
+            return layers.transpose(reshaped, [0, 2, 1, 3])
+
+        q, k, v = map(split_heads, (queries, keys, values))
+    else:
+        q, k, v = queries, keys, values
+    scale = (q.shape[-1]) ** -0.5
+    scores = layers.matmul(q, k, transpose_y=True, alpha=scale)
+    weights = layers.softmax(scores)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)
+    if num_heads != 1:
+        ctx = layers.transpose(ctx, [0, 2, 1, 3])
+        ctx = layers.reshape(ctx, [ctx.shape[0], ctx.shape[1],
+                                   ctx.shape[2] * ctx.shape[3]])
+    return ctx

@@ -22,7 +22,22 @@ from ..core.registry import register_op, same_shape
 # X's shape beginning at `axis`; axis=-1 means trailing-aligned)
 # ---------------------------------------------------------------------------
 
+def harmonize(x, y):
+    """Mixed-precision rule: the Y (weight/bias) side follows X's float dtype.
+
+    This is the in-op reading of the reference's fp16 transpiler
+    (paddle/contrib/float16/float16_transpiler.py): activations may run in
+    bfloat16 while master params stay float32; casts are inserted where the
+    dtypes meet, and autodiff casts gradients back to the param dtype.
+    """
+    xt, yt = jnp.result_type(x), jnp.result_type(y)
+    if xt != yt and jnp.issubdtype(xt, jnp.floating) and jnp.issubdtype(yt, jnp.floating):
+        y = y.astype(xt)
+    return y
+
+
 def broadcast_y_to_x(x, y, axis: int):
+    y = harmonize(x, y)
     xnd, ynd = jnp.ndim(x), jnp.ndim(y)
     if ynd == 0 or xnd == ynd:
         return y
@@ -129,6 +144,7 @@ def matmul(ctx, ins, attrs):
     The contraction maps straight onto the MXU; alpha folds into the result.
     """
     x, y = ins["X"][0], ins["Y"][0]
+    y = harmonize(x, y)
     if attrs.get("transpose_X", False):
         x = jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
     if attrs.get("transpose_Y", False):
@@ -155,6 +171,7 @@ def mul(ctx, ins, attrs):
     """mul_op.cc: flatten X to 2-D at x_num_col_dims, Y at y_num_col_dims,
     GEMM, then restore leading dims. This is the core of layers.fc."""
     x, y = ins["X"][0], ins["Y"][0]
+    y = harmonize(x, y)
     xn = attrs.get("x_num_col_dims", 1)
     yn = attrs.get("y_num_col_dims", 1)
     xshape, yshape = x.shape, y.shape

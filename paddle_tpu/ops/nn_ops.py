@@ -151,16 +151,24 @@ def _conv2d_infer(op, block):
     out.dtype = x.dtype
 
 
+def _harmonize_w(x, w):
+    from .math_ops import harmonize
+    return harmonize(x, w)
+
+
 def _conv2d(x, w, attrs, feature_group_count=None):
+    w = _harmonize_w(x, w)
     s = _pair(attrs.get("strides", 1))
     p = _pair(attrs.get("paddings", 0))
     d = _pair(attrs.get("dilations", 1))
     groups = feature_group_count or attrs.get("groups", 1) or 1
+    # NOTE: no preferred_element_type upcast — the MXU accumulates bf16
+    # operands in fp32 internally, and jax 0.9's conv transpose rule cannot
+    # transpose a dtype-upcasting conv.
     return jax.lax.conv_general_dilated(
         x, w, window_strides=s, padding=[(p[0], p[0]), (p[1], p[1])],
         rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        feature_group_count=groups)
 
 
 @register_op("conv2d", infer_shape=_conv2d_infer)
@@ -194,6 +202,7 @@ def _conv2d_transpose_infer(op, block):
 def conv2d_transpose(ctx, ins, attrs):
     """conv_transpose_op.cc → gradient-style dilated conv (IOHW filter)."""
     x, w = ins["Input"][0], ins["Filter"][0]
+    w = _harmonize_w(x, w)
     s = _pair(attrs.get("strides", 1))
     p = _pair(attrs.get("paddings", 0))
     d = _pair(attrs.get("dilations", 1))
@@ -392,7 +401,7 @@ def cross_entropy(ctx, ins, attrs):
 def softmax_with_cross_entropy(ctx, ins, attrs):
     """softmax_with_cross_entropy_op.cu: numerically-stable fused version."""
     logits, label = ins["Logits"][0], ins["Label"][0]
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     else:

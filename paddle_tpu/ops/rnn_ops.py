@@ -1,0 +1,203 @@
+"""Recurrent ops: fused LSTM/GRU cells and the dynamic_rnn sub-block scanner.
+
+≙ reference recurrent machinery: fused kernels lstm_op/gru_op
+(operators/math/{lstm,gru}_compute.cu, paddle/cuda/src/hl_cuda_lstm.cu) and
+the sub-block interpreters recurrent_op.cc:222 / DynamicRNN
+(layers/control_flow.py:1313). TPU-native: everything is lax.scan over
+time-major arrays with length masking — XLA unrolls nothing, the scan body
+is one fused step, gradients come from scan's native VJP (the reference
+needed StepScopes + hand-written grad sub-blocks, recurrent_op.cc:53).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .sequence_ops import time_mask
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": lambda x: jnp.maximum(x, 0),
+    "identity": lambda x: x, None: jnp.tanh,
+}
+
+
+@register_op("dynamic_lstm")
+def dynamic_lstm(ctx, ins, attrs):
+    """lstm_op.cc. Input [B,T,4H] (pre-projected x·W_x), Weight [H,4H]
+    recurrent, Bias [1,4H] (+[1,3H] peephole tail when use_peepholes).
+    Gate layout i,c,f,o per the reference kernel
+    (operators/math/detail/lstm_kernel.h). Outputs Hidden/Cell [B,T,H]."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0].astype(x.dtype)
+    seq_len = ins["SeqLen"][0]
+    B, T, H4 = x.shape
+    H = H4 // 4
+    use_peep = attrs.get("use_peepholes", False)
+    bias = ins["Bias"][0].astype(x.dtype) if ins.get("Bias") else None
+    if bias is not None:
+        b_gate = bias.reshape(-1)[:4 * H]
+        b_peep = bias.reshape(-1)[4 * H:] if use_peep else None
+    else:
+        b_gate, b_peep = None, None
+    gact = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cact = _ACT[attrs.get("cell_activation", "tanh")]
+    hact = _ACT[attrs.get("candidate_activation", "tanh")]
+    reverse = attrs.get("is_reverse", False)
+
+    xs = jnp.moveaxis(x, 1, 0)  # [T,B,4H]
+    mask = jnp.moveaxis(time_mask(seq_len, T, x.dtype), 1, 0)  # [T,B]
+    if reverse:
+        xs = jnp.flip(xs, 0)
+        mask = jnp.flip(mask, 0)
+
+    h0 = ins["H0"][0].astype(x.dtype) if ins.get("H0") else jnp.zeros((B, H), x.dtype)
+    c0 = ins["C0"][0].astype(x.dtype) if ins.get("C0") else jnp.zeros((B, H), x.dtype)
+
+    def step(carry, inp):
+        h, c = carry
+        xt, m = inp
+        gates = xt + h @ w
+        if b_gate is not None:
+            gates = gates + b_gate
+        gi, gc, gf, go = jnp.split(gates, 4, axis=-1)
+        if use_peep:
+            wic, wfc, woc = jnp.split(b_peep, 3)
+            gi = gi + wic * c
+            gf = gf + wfc * c
+        i = gact(gi)
+        f = gact(gf)
+        cand = cact(gc)
+        c_new = f * c + i * cand
+        if use_peep:
+            go = go + woc * c_new
+        o = gact(go)
+        h_new = o * hact(c_new)
+        m1 = m[:, None]
+        h_new = m1 * h_new + (1 - m1) * h
+        c_new = m1 * c_new + (1 - m1) * c
+        return (h_new, c_new), (h_new * m1, c_new * m1)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, mask))
+    if reverse:
+        hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
+    return {"Hidden": [jnp.moveaxis(hs, 0, 1)], "Cell": [jnp.moveaxis(cs, 0, 1)]}
+
+
+@register_op("dynamic_gru")
+def dynamic_gru(ctx, ins, attrs):
+    """gru_op.cc. Input [B,T,3H] pre-projected, Weight [H,3H]: layout
+    [update u | reset r | candidate c] following gru_compute. Output [B,T,H]."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0].astype(x.dtype)
+    seq_len = ins["SeqLen"][0]
+    B, T, H3 = x.shape
+    H = H3 // 3
+    bias = ins["Bias"][0].astype(x.dtype).reshape(-1) if ins.get("Bias") else None
+    gact = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cact = _ACT[attrs.get("activation", "tanh")]
+    reverse = attrs.get("is_reverse", False)
+    w_ur = w[:, :2 * H]
+    w_c = w[:, 2 * H:]
+
+    xs = jnp.moveaxis(x, 1, 0)
+    mask = jnp.moveaxis(time_mask(seq_len, T, x.dtype), 1, 0)
+    if reverse:
+        xs = jnp.flip(xs, 0)
+        mask = jnp.flip(mask, 0)
+    h0 = ins["H0"][0].astype(x.dtype) if ins.get("H0") else jnp.zeros((B, H), x.dtype)
+
+    def step(h, inp):
+        xt, m = inp
+        xur = xt[:, :2 * H]
+        xc = xt[:, 2 * H:]
+        gur = xur + h @ w_ur
+        if bias is not None:
+            gur = gur + bias[:2 * H]
+        u, r = jnp.split(gact(gur), 2, axis=-1)
+        gc = xc + (r * h) @ w_c
+        if bias is not None:
+            gc = gc + bias[2 * H:]
+        cand = cact(gc)
+        h_new = u * h + (1.0 - u) * cand
+        m1 = m[:, None]
+        h_new = m1 * h_new + (1 - m1) * h
+        return h_new, h_new * m1
+
+    _, hs = jax.lax.scan(step, h0, (xs, mask))
+    if reverse:
+        hs = jnp.flip(hs, 0)
+    return {"Hidden": [jnp.moveaxis(hs, 0, 1)]}
+
+
+@register_op("dynamic_rnn")
+def dynamic_rnn(ctx, ins, attrs):
+    """The DynamicRNN/recurrent_op sub-block scanner (recurrent_op.cc:222).
+
+    Runs the ops of `sub_block` once per timestep under lax.scan. Step
+    inputs are time-sliced from padded [B,T,...] arrays; memories carry with
+    length masking; outer vars (parameters) are captured read-only from the
+    enclosing environment — the functional equivalent of StepScopes' parent
+    lookup (recurrent_op.cc:53).
+    """
+    from ..core import lowering
+
+    program = ctx.program
+    sub = program.block(attrs["sub_block"])
+    step_inner = list(attrs["step_input_vars"])     # inner per-step names
+    mem_inner = list(attrs["memory_vars"])          # inner memory names
+    mem_updates = dict(attrs["memory_updates"])     # inner -> updated name
+    mem_init_values = list(attrs["memory_init_values"])
+    mem_shapes = list(attrs["memory_shapes"])
+    out_inner = list(attrs["output_vars"])
+
+    xs_list = ins["X"]
+    seq_len = ins["SeqLen"][0]
+    init_mems_in = list(ins.get("InitMems", []))
+    has_init = list(attrs.get("memory_has_init", [False] * len(mem_inner)))
+    B, T = xs_list[0].shape[0], xs_list[0].shape[1]
+    dtype = xs_list[0].dtype if jnp.issubdtype(xs_list[0].dtype, jnp.floating) \
+        else jnp.float32
+
+    init = []
+    init_iter = iter(init_mems_in)
+    for i, name in enumerate(mem_inner):
+        if has_init[i]:
+            init.append(next(init_iter))
+        else:
+            shape = (B,) + tuple(s for s in mem_shapes[i] if s != -1)
+            init.append(jnp.full(shape, mem_init_values[i], dtype))
+
+    xs_tm = [jnp.moveaxis(x, 1, 0) for x in xs_list]
+    mask_tm = jnp.moveaxis(time_mask(seq_len, T, jnp.float32), 1, 0)  # [T,B]
+    outer_env = dict(ctx.env)
+
+    def body(carry, scanned):
+        mems = carry
+        xts, m = scanned[:-1], scanned[-1]
+        env = dict(outer_env)
+        for name, xt in zip(step_inner, xts):
+            env[name] = xt
+        for name, mem in zip(mem_inner, mems):
+            env[name] = mem
+        lowering.run_op_range(sub.ops, 0, len(sub.ops), env, ctx, sub)
+        new_mems = []
+        for name, old in zip(mem_inner, mems):
+            upd = env[mem_updates.get(name, name)]
+            mb = m.reshape((B,) + (1,) * (upd.ndim - 1)).astype(upd.dtype)
+            new_mems.append(mb * upd + (1 - mb) * old)
+        outs = []
+        for name in out_inner:
+            v = env[name]
+            mb = m.reshape((B,) + (1,) * (v.ndim - 1)).astype(v.dtype)
+            outs.append(v * mb)
+        return tuple(new_mems), tuple(outs)
+
+    final_mems, stacked = jax.lax.scan(body, tuple(init),
+                                       tuple(xs_tm) + (mask_tm,))
+    outs = [jnp.moveaxis(o, 0, 1) for o in stacked]
+    return {"Out": outs, "FinalMems": list(final_mems)}

@@ -1,0 +1,241 @@
+"""Ragged-sequence ops over the padded+lengths representation.
+
+≙ reference LoD sequence machinery (SURVEY.md §5 "long context"): LoDTensor
+offsets (lod_tensor.h:58) + sequence_{pool,softmax,expand,conv,...} ops and
+the sequence2batch scheduler (operators/math/sequence2batch.h). TPU-native
+representation: a sequence batch is a dense padded array [B, T, ...] plus an
+int32 lengths vector [B] (the `@SEQ_LEN` companion var) — static shapes for
+XLA, masking instead of compaction. The "no padding waste" property of LoD
+batching is recovered by length-bucketed feeding (data/feeder.py), which
+bounds pad waste while keeping one compiled executable per bucket.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op, same_shape
+
+
+def time_mask(seq_len, T, dtype=jnp.bool_):
+    """[B] lengths -> [B, T] mask."""
+    return (jnp.arange(T)[None, :] < seq_len[:, None]).astype(dtype)
+
+
+def _bshape(mask, x):
+    """[B,T] mask broadcast to x's rank [B,T,...]."""
+    return mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+
+
+@register_op("sequence_pool")
+def sequence_pool(ctx, ins, attrs):
+    """sequence_pool_op.cc: pooltype ∈ {sum, average, sqrt, max, last, first}.
+    X: [B, T, ...], SeqLen: [B]; Out: [B, ...]."""
+    x = ins["X"][0]
+    seq_len = ins["SeqLen"][0]
+    ptype = attrs.get("pooltype", "average").lower()
+    T = x.shape[1]
+    mask = _bshape(time_mask(seq_len, T, x.dtype), x)
+    if ptype == "sum":
+        out = jnp.sum(x * mask, axis=1)
+    elif ptype == "average":
+        denom = jnp.maximum(seq_len, 1).astype(x.dtype)
+        out = jnp.sum(x * mask, axis=1) / denom.reshape((-1,) + (1,) * (x.ndim - 2))
+    elif ptype == "sqrt":
+        denom = jnp.sqrt(jnp.maximum(seq_len, 1).astype(x.dtype))
+        out = jnp.sum(x * mask, axis=1) / denom.reshape((-1,) + (1,) * (x.ndim - 2))
+    elif ptype == "max":
+        neg = jnp.asarray(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                          else jnp.iinfo(x.dtype).min, x.dtype)
+        out = jnp.max(jnp.where(mask.astype(bool), x, neg), axis=1)
+    elif ptype == "last":
+        idx = jnp.maximum(seq_len - 1, 0).astype(jnp.int32)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1).squeeze(1)
+    elif ptype == "first":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    return {"Out": [out]}
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(ctx, ins, attrs):
+    """sequence_softmax_op.cc: softmax within each sequence (over T)."""
+    x = ins["X"][0]
+    seq_len = ins["SeqLen"][0]
+    squeeze = x.ndim >= 3 and x.shape[-1] == 1
+    v = x.reshape(x.shape[:2]) if squeeze and x.ndim == 3 else x
+    mask = time_mask(seq_len, v.shape[1])
+    mask = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+    logits = jnp.where(mask, v.astype(jnp.float32), -1e30)
+    out = jax.nn.softmax(logits, axis=1).astype(x.dtype)
+    out = out * mask.astype(x.dtype)
+    if squeeze and x.ndim == 3:
+        out = out[..., None]
+    return {"Out": [out]}
+
+
+@register_op("sequence_expand")
+def sequence_expand(ctx, ins, attrs):
+    """sequence_expand_op.cc: broadcast per-sequence rows X [B, D] along Y's
+    time axis -> [B, T, D] (the dense-padded reading; used to carry encoder
+    state into each decoder step)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    T = y.shape[1]
+    return {"Out": [jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])]}
+
+
+@register_op("sequence_conv")
+def sequence_conv(ctx, ins, attrs):
+    """sequence_conv_op.cc: sliding-window projection over time.
+    X: [B,T,D], Filter: [ctx_len*D, M] -> Out [B,T,M], masked."""
+    x = ins["X"][0]
+    w = ins["Filter"][0]
+    seq_len = ins["SeqLen"][0]
+    ctx_len = attrs.get("contextLength", 3)
+    ctx_start = attrs.get("contextStart", -((ctx_len - 1) // 2))
+    B, T, D = x.shape
+    mask = _bshape(time_mask(seq_len, T, x.dtype), x)
+    xm = x * mask
+    cols = []
+    for i in range(ctx_len):
+        off = ctx_start + i
+        shifted = jnp.roll(xm, -off, axis=1)
+        if off < 0:
+            shifted = shifted.at[:, :(-off)].set(0.0) if hasattr(shifted, "at") else shifted
+        elif off > 0:
+            shifted = shifted.at[:, T - off:].set(0.0)
+        cols.append(shifted)
+    stacked = jnp.concatenate(cols, axis=-1)  # [B,T,ctx_len*D]
+    out = jnp.einsum("btd,dm->btm", stacked, w.astype(stacked.dtype))
+    return {"Out": [out * _bshape(time_mask(seq_len, T, out.dtype), out)]}
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    new_dim = attrs["new_dim"]
+    B, T, D = x.shape
+    return {"Out": [x.reshape(B, T * D // new_dim, new_dim)]}
+
+
+@register_op("sequence_concat")
+def sequence_concat(ctx, ins, attrs):
+    """Concat along feature dim (axis=-1 flavor used in practice)."""
+    return {"Out": [jnp.concatenate(ins["X"], axis=-1)]}
+
+
+@register_op("sequence_slice")
+def sequence_slice(ctx, ins, attrs):
+    x = ins["X"][0]
+    offset = ins["Offset"][0].reshape(-1)
+    length = ins["Length"][0].reshape(-1)
+    B, T = x.shape[0], x.shape[1]
+    idx = offset[:, None] + jnp.arange(T)[None, :]
+    idx = jnp.minimum(idx, T - 1)
+    out = jnp.take_along_axis(x, idx.reshape((B, T) + (1,) * (x.ndim - 2)), axis=1)
+    mask = (jnp.arange(T)[None, :] < length[:, None]).astype(x.dtype)
+    return {"Out": [out * mask.reshape(mask.shape + (1,) * (x.ndim - 2))],
+            "SeqLenOut": [length.astype(jnp.int32)]}
+
+
+@register_op("sequence_enumerate")
+def sequence_enumerate(ctx, ins, attrs):
+    x = ins["X"][0]
+    win = attrs["win_size"]
+    pad = attrs.get("pad_value", 0)
+    B, T = x.shape[0], x.shape[1]
+    v = x.reshape(B, T)
+    outs = []
+    for i in range(win):
+        shifted = jnp.concatenate(
+            [v[:, i:], jnp.full((B, i), pad, v.dtype)], axis=1)
+        outs.append(shifted)
+    return {"Out": [jnp.stack(outs, axis=-1)]}
+
+
+@register_op("sequence_erase")
+def sequence_erase(ctx, ins, attrs):
+    """Mask out tokens: padded representation keeps positions, zeroing erased
+    tokens and adjusting lengths is done host-side; here tokens are replaced
+    by 0 (cannot compact under static shapes)."""
+    x = ins["X"][0]
+    tokens = jnp.asarray(attrs.get("tokens", []), x.dtype)
+    erase = jnp.isin(x, tokens)
+    return {"Out": [jnp.where(erase, jnp.zeros_like(x), x)]}
+
+
+@register_op("im2sequence")
+def im2sequence(ctx, ins, attrs):
+    """im2sequence_op.cc: image patches -> sequence [B, H'*W', C*kh*kw]."""
+    x = ins["X"][0]
+    kh, kw = attrs.get("kernels", [1, 1])
+    sh, sw = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0, 0, 0])
+    x = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
+    B, C, H, W = x.shape
+    oh = (H - kh) // sh + 1
+    ow = (W - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))  # [B, C*kh*kw, oh, ow]
+    out = patches.reshape(B, C * kh * kw, oh * ow).transpose(0, 2, 1)
+    return {"Out": [out]}
+
+
+@register_op("sequence_pad")
+def sequence_pad(ctx, ins, attrs):
+    """Identity in the padded world (kept for API parity)."""
+    return {"Out": [ins["X"][0]], "Length": [ins["SeqLen"][0]]}
+
+
+@register_op("sequence_unpad")
+def sequence_unpad(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("edit_distance")
+def edit_distance(ctx, ins, attrs):
+    """edit_distance_op.cc: Levenshtein distance between hyp/ref id rows via
+    a scan over the DP table (per batch row)."""
+    hyp = ins["Hyps"][0].reshape(ins["Hyps"][0].shape[0], -1).astype(jnp.int32)
+    ref = ins["Refs"][0].reshape(ins["Refs"][0].shape[0], -1).astype(jnp.int32)
+    hyp_len = ins["HypsLen"][0].reshape(-1) if ins.get("HypsLen") else \
+        jnp.full((hyp.shape[0],), hyp.shape[1], jnp.int32)
+    ref_len = ins["RefsLen"][0].reshape(-1) if ins.get("RefsLen") else \
+        jnp.full((ref.shape[0],), ref.shape[1], jnp.int32)
+    B, M = hyp.shape
+    N = ref.shape[1]
+
+    def row_fn(carry, j):
+        prev_row = carry  # [B, M+1]
+        jm = j - 1
+        ref_j = jnp.take_along_axis(ref, jm[None, None].repeat(B, 0), axis=1)[:, 0]
+
+        def col_step(row_carry, i):
+            row = row_carry
+            im = i - 1
+            hyp_i = hyp[:, im]
+            sub_cost = (hyp_i != ref_j).astype(jnp.int32)
+            val = jnp.minimum(
+                jnp.minimum(row[:, im] + 1, prev_row[:, i] + 1),
+                prev_row[:, im] + sub_cost)
+            row = row.at[:, i].set(val)
+            return row, None
+
+        init_row = jnp.zeros((B, M + 1), jnp.int32).at[:, 0].set(j)
+        row, _ = jax.lax.scan(col_step, init_row, jnp.arange(1, M + 1))
+        return row, row
+
+    row0 = jnp.tile(jnp.arange(M + 1, dtype=jnp.int32)[None, :], (B, 1))
+    _, rows = jax.lax.scan(row_fn, row0, jnp.arange(1, N + 1))
+    # rows: [N, B, M+1]; distance at [ref_len-1, b, hyp_len]
+    full = jnp.concatenate([row0[None], rows], axis=0)  # [N+1, B, M+1]
+    d = full[ref_len, jnp.arange(B), hyp_len].astype(jnp.float32)
+    if attrs.get("normalized", True):
+        d = d / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
+    return {"Out": [d.reshape(-1, 1)],
+            "SequenceNum": [jnp.asarray([B], jnp.int64)]}

@@ -1,0 +1,153 @@
+"""Sequence/ragged machinery tests: padded+lengths ops, fused LSTM/GRU,
+DynamicRNN scan lowering (≙ reference sequence op tests + DynamicRNN book
+tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.lod import LoDTensor, pad_sequences
+
+
+def run_seq_op(build, feed):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        out = build()
+    exe = pt.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=[out])[0]
+
+
+def test_pad_sequences_and_lod_tensor():
+    seqs = [np.arange(3), np.arange(5), np.arange(2)]
+    padded, lens = pad_sequences(seqs, dtype=np.int64, pad_multiple=4)
+    assert padded.shape == (3, 8)
+    np.testing.assert_array_equal(lens, [3, 5, 2])
+    lt = LoDTensor.from_flat(np.arange(10).reshape(10, 1), [[0, 3, 10]])
+    assert len(lt) == 2
+    assert lt.lod() == [[0, 3, 10]]
+
+
+@pytest.mark.parametrize("ptype,ref", [
+    ("sum", lambda x, l: np.array([x[i, :l[i]].sum(0) for i in range(len(l))])),
+    ("average", lambda x, l: np.array([x[i, :l[i]].mean(0) for i in range(len(l))])),
+    ("max", lambda x, l: np.array([x[i, :l[i]].max(0) for i in range(len(l))])),
+    ("last", lambda x, l: np.array([x[i, l[i] - 1] for i in range(len(l))])),
+    ("first", lambda x, l: x[:, 0]),
+])
+def test_sequence_pool(rng, ptype, ref):
+    x = rng.rand(3, 6, 4).astype(np.float32)
+    lens = np.array([2, 6, 3], np.int32)
+
+    def build():
+        d = layers.data("x", [4], lod_level=1)
+        return layers.sequence_pool(d, ptype)
+
+    got = run_seq_op(build, {"x": x, "x@SEQ_LEN": lens})
+    np.testing.assert_allclose(got, ref(x, lens), rtol=1e-5)
+
+
+def test_sequence_softmax(rng):
+    x = rng.rand(2, 5, 1).astype(np.float32)
+    lens = np.array([3, 5], np.int32)
+
+    def build():
+        d = layers.data("x", [1], lod_level=1)
+        return layers.sequence_softmax(d)
+
+    got = run_seq_op(build, {"x": x, "x@SEQ_LEN": lens})
+    for i, l in enumerate(lens):
+        e = np.exp(x[i, :l, 0] - x[i, :l, 0].max())
+        np.testing.assert_allclose(got[i, :l, 0], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(got[i, l:, 0], 0.0)
+
+
+def test_dynamic_lstm_respects_lengths(rng):
+    B, T, H = 2, 5, 8
+    x = rng.rand(B, T, 4 * H).astype(np.float32)
+    lens = np.array([3, 5], np.int32)
+
+    def build():
+        d = layers.data("x", [4 * H], lod_level=1)
+        hidden, cell = layers.dynamic_lstm(d, size=4 * H, use_peepholes=False)
+        return hidden
+
+    got = run_seq_op(build, {"x": x, "x@SEQ_LEN": lens})
+    assert got.shape == (B, T, H)
+    np.testing.assert_allclose(got[0, 3:], 0.0, atol=1e-7)  # masked tail
+    assert np.abs(got[1, 4]).sum() > 0
+
+
+def test_dynamic_gru_runs(rng):
+    B, T, H = 2, 4, 6
+    x = rng.rand(B, T, 3 * H).astype(np.float32)
+    lens = np.array([4, 2], np.int32)
+
+    def build():
+        d = layers.data("x", [3 * H], lod_level=1)
+        return layers.dynamic_gru(d, size=H)
+
+    got = run_seq_op(build, {"x": x, "x@SEQ_LEN": lens})
+    assert got.shape == (B, T, H)
+    np.testing.assert_allclose(got[1, 2:], 0.0, atol=1e-7)
+
+
+def test_dynamic_rnn_accumulator(rng):
+    """DynamicRNN computing a running sum must equal sequence_pool(sum)."""
+    x = rng.rand(3, 6, 4).astype(np.float32)
+    lens = np.array([2, 6, 3], np.int32)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        d = layers.data("x", [4], lod_level=1)
+        rnn = layers.DynamicRNN()
+        with rnn.block():
+            step = rnn.step_input(d)
+            acc = rnn.memory(value=0.0, shape=[4])
+            new_acc = layers.elementwise_add(acc, step)
+            rnn.update_memory(acc, new_acc)
+            rnn.output(new_acc)
+        out_seq = rnn()
+        last = layers.sequence_pool(out_seq, "last")
+        ref = layers.sequence_pool(d, "sum")
+    exe = pt.Executor()
+    exe.run(startup)
+    got_last, got_ref = exe.run(main, feed={"x": x, "x@SEQ_LEN": lens},
+                                fetch_list=[last, ref])
+    np.testing.assert_allclose(got_last, got_ref, rtol=1e-5)
+
+
+def test_stacked_lstm_model_trains(rng):
+    """≙ BASELINE config 4 (tiny): DynamicRNN LSTM trains on synthetic."""
+    from paddle_tpu.models import stacked_dynamic_lstm as m
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        loss, acc, logit, feeds = m.get_model(dict_size=100, lstm_size=16,
+                                              emb_dim=16)
+    exe = pt.Executor()
+    exe.run(startup)
+    losses = []
+    for i in range(15):
+        seqs = [rng.randint(0, 100, (rng.randint(3, 9), 1)) for _ in range(8)]
+        labels = np.array([[int(s.sum()) % 2] for s in seqs], np.int64)
+        (l,) = exe.run(main, feed={"words": seqs, "label": labels},
+                       fetch_list=[loss])
+        losses.append(float(np.ravel(l)[0]))
+    assert np.isfinite(losses).all()
+    assert min(losses[-5:]) < losses[0] + 0.1
+
+
+def test_fused_lstm_model_trains(rng):
+    from paddle_tpu.models import stacked_dynamic_lstm as m
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        loss, acc, logit, feeds = m.get_model(dict_size=100, lstm_size=16,
+                                              emb_dim=16, use_fused=True)
+    exe = pt.Executor()
+    exe.run(startup)
+    seqs = [rng.randint(0, 100, (rng.randint(3, 9), 1)) for _ in range(8)]
+    labels = np.array([[int(s.sum()) % 2] for s in seqs], np.int64)
+    (l,) = exe.run(main, feed={"words": seqs, "label": labels},
+                   fetch_list=[loss])
+    assert np.isfinite(np.ravel(l)[0])
