@@ -21,5 +21,16 @@ from . import regularizer
 from . import clip
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .layer_helper import LayerHelper
+from . import nets
+from . import io
+from . import metrics
+from . import parallel
+from .parallel import ParallelExecutor, BuildStrategy, ExecutionStrategy
+from . import reader
+from .data_feeder import DataFeeder
+from .lod import LoDTensor, create_lod_tensor
+from .utils import profiler
+from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent,
+                      EndEpochEvent, BeginStepEvent, EndStepEvent)
 
 __version__ = "0.1.0"

@@ -1,0 +1,52 @@
+"""DataFeeder: minibatch rows -> feed dict.
+
+≙ reference python/paddle/fluid/data_feeder.py:73 — converts a list of
+sample tuples (from a batched reader) into per-variable arrays, handling
+dtype, reshaping to the declared var shape, and ragged sequence vars
+(lod_level>=1 -> padded + lengths, lod.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .core.program import VarDesc, default_main_program
+from .core.types import np_dtype
+from .lod import pad_sequences
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        program = program or default_main_program()
+        self.feed_vars: List[VarDesc] = []
+        for v in feed_list:
+            if isinstance(v, str):
+                v = program.global_block.var(v)
+            self.feed_vars.append(v)
+        self.place = place
+
+    def feed(self, iterable) -> Dict[str, np.ndarray]:
+        """iterable: list of sample tuples, one entry per feed var."""
+        rows = list(iterable)
+        out: Dict[str, np.ndarray] = {}
+        for i, var in enumerate(self.feed_vars):
+            col = [row[i] for row in rows]
+            dtype = np_dtype({"int64": "int64", "float64": "float64"}.get(
+                var.dtype, var.dtype))
+            if var.lod_level >= 1:
+                seqs = [np.asarray(s, dtype).reshape(
+                    (-1,) + tuple(d for d in var.shape[2:] if d != -1))
+                    for s in col]
+                padded, lens = pad_sequences(seqs, dtype=dtype)
+                out[var.name] = padded
+                if var.seq_len_var:
+                    out[var.seq_len_var] = lens
+            else:
+                shape = tuple(d for d in var.shape[1:])
+                arr = np.asarray(col, dtype)
+                if shape and all(d > 0 for d in shape):
+                    arr = arr.reshape((-1,) + shape)
+                out[var.name] = arr
+        return out

@@ -1,0 +1,236 @@
+"""Model persistence: save/load variables, inference export, checkpoints.
+
+≙ reference python/paddle/fluid/io.py (save/load_vars/params/persistables
+:64-234, save/load_inference_model :301-378, checkpoint subsystem :466-735).
+The reference runs save/load *ops* through an executor; here persistence is
+host-side .npz (one file per var, or combined) plus the program JSON —
+functionally identical artifacts (dir of vars + serialized program), no
+device roundtrip beyond fetching arrays.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core.program import Program, VarDesc, default_main_program
+from .core.scope import Scope, global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "get_inference_program",
+    "save_checkpoint", "load_checkpoint", "clean_checkpoint",
+    "get_latest_checkpoint_serial",
+]
+
+SUCCESS_MARK_FILENAME = "_SUCCESS"
+CHECKPOINT_PREFIX = "checkpoint"
+
+
+def _is_persistable(var: VarDesc) -> bool:
+    return var.persistable
+
+
+def _is_parameter(var: VarDesc) -> bool:
+    return var.is_parameter
+
+
+# ---------------------------------------------------------------------------
+# save/load vars
+# ---------------------------------------------------------------------------
+
+def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] = None,
+              vars: Optional[Sequence] = None, predicate=None,
+              filename: Optional[str] = None, scope: Optional[Scope] = None):
+    """io.py:64 save_vars: one .npy per var, or a single combined file."""
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if (predicate or _is_persistable)(v)]
+    vars = [main_program.global_block.var(v) if isinstance(v, str) else v
+            for v in vars]
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        combined = {}
+        for v in vars:
+            val = scope.find_var(v.name)
+            if val is not None:
+                combined[v.name] = np.asarray(val)
+        np.savez(os.path.join(dirname, filename), **combined)
+        return
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        np.save(os.path.join(dirname, v.name.replace("/", "__")),
+                np.asarray(val))
+
+
+def save_params(executor=None, dirname: str = "", main_program=None,
+                filename=None, scope=None):
+    return save_vars(executor, dirname, main_program, None, _is_parameter,
+                     filename, scope)
+
+
+def save_persistables(executor=None, dirname: str = "", main_program=None,
+                      filename=None, scope=None):
+    return save_vars(executor, dirname, main_program, None, _is_persistable,
+                     filename, scope)
+
+
+def load_vars(executor=None, dirname: str = "", main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    """io.py:129 load_vars."""
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if (predicate or _is_persistable)(v)]
+    vars = [main_program.global_block.var(v) if isinstance(v, str) else v
+            for v in vars]
+    if filename is not None:
+        data = np.load(os.path.join(dirname, filename)
+                       if not filename.endswith(".npz")
+                       else os.path.join(dirname, filename), allow_pickle=False)
+        for v in vars:
+            if v.name in data:
+                scope.set_var(v.name, data[v.name])
+        return
+    for v in vars:
+        path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
+        if os.path.exists(path):
+            scope.set_var(v.name, np.load(path))
+
+
+def load_params(executor=None, dirname: str = "", main_program=None,
+                filename=None, scope=None):
+    return load_vars(executor, dirname, main_program, None, _is_parameter,
+                     filename, scope)
+
+
+def load_persistables(executor=None, dirname: str = "", main_program=None,
+                      filename=None, scope=None):
+    return load_vars(executor, dirname, main_program, None, _is_persistable,
+                     filename, scope)
+
+
+# ---------------------------------------------------------------------------
+# inference model export (io.py:301 save_inference_model)
+# ---------------------------------------------------------------------------
+
+def get_inference_program(target_vars, main_program=None) -> Program:
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    pruned = main_program.clone(for_test=True).prune(
+        targets=[t.name if isinstance(t, VarDesc) else t for t in target_vars])
+    return pruned
+
+
+def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
+                         target_vars, executor=None, main_program=None,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None, scope=None):
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    target_names = [t.name if isinstance(t, VarDesc) else t for t in target_vars]
+    pruned = main_program.clone(for_test=True).prune(targets=target_names,
+                                                     feeds=feeded_var_names)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {"program": pruned.to_dict(), "feed_names": list(feeded_var_names),
+            "fetch_names": target_names}
+    with open(os.path.join(dirname, model_filename or "__model__.json"), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, pruned,
+                      filename=params_filename, scope=scope)
+    return target_names
+
+
+def load_inference_model(dirname: str, executor=None,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None, scope=None):
+    with open(os.path.join(dirname, model_filename or "__model__.json")) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta["program"])
+    load_persistables(executor, dirname, program, filename=params_filename,
+                      scope=scope)
+    fetch_vars = [program.global_block.var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars
+
+
+# ---------------------------------------------------------------------------
+# checkpoint subsystem (io.py:466-735): serial dirs, _SUCCESS, keep-last-N
+# ---------------------------------------------------------------------------
+
+def _serial_dir(checkpoint_dir: str, serial: int) -> str:
+    return os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
+
+
+def get_latest_checkpoint_serial(checkpoint_dir: str) -> int:
+    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
+        return -1
+    best = -1
+    for name in os.listdir(checkpoint_dir):
+        m = re.fullmatch(rf"{CHECKPOINT_PREFIX}_(\d+)", name)
+        if m and os.path.exists(os.path.join(checkpoint_dir, name,
+                                             SUCCESS_MARK_FILENAME)):
+            best = max(best, int(m.group(1)))
+    return best
+
+
+def save_checkpoint(executor=None, checkpoint_dir: str = "", trainer_id: int = 0,
+                    trainer_args: Optional[dict] = None, main_program=None,
+                    max_num_checkpoints: int = 3, scope=None):
+    """io.py:466: write serial dir, then _SUCCESS marker, then scroll old."""
+    serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
+    cur = _serial_dir(checkpoint_dir, serial)
+    os.makedirs(cur, exist_ok=True)
+    save_persistables(executor, cur, main_program, scope=scope)
+    if trainer_args:
+        with open(os.path.join(cur, f"trainer_{trainer_id}.json"), "w") as f:
+            json.dump(trainer_args, f)
+    with open(os.path.join(cur, SUCCESS_MARK_FILENAME), "w") as f:
+        f.write("")
+    _scroll_delete(checkpoint_dir, max_num_checkpoints)
+    return serial
+
+
+def load_checkpoint(executor=None, checkpoint_dir: str = "", serial: Optional[int] = None,
+                    main_program=None, trainer_id: int = 0, scope=None):
+    """io.py:504: restore persistables (+ trainer args if present)."""
+    if serial is None:
+        serial = get_latest_checkpoint_serial(checkpoint_dir)
+    if serial < 0:
+        return None
+    cur = _serial_dir(checkpoint_dir, serial)
+    load_persistables(executor, cur, main_program, scope=scope)
+    args_path = os.path.join(cur, f"trainer_{trainer_id}.json")
+    if os.path.exists(args_path):
+        with open(args_path) as f:
+            return json.load(f)
+    return None
+
+
+def clean_checkpoint(checkpoint_dir: str, delete_dir: bool = False):
+    _scroll_delete(checkpoint_dir, max_num_checkpoints=0)
+    if delete_dir and os.path.isdir(checkpoint_dir) and not os.listdir(checkpoint_dir):
+        os.rmdir(checkpoint_dir)
+
+
+def _scroll_delete(checkpoint_dir: str, max_num_checkpoints: int):
+    if not os.path.isdir(checkpoint_dir):
+        return
+    serials = []
+    for name in os.listdir(checkpoint_dir):
+        m = re.fullmatch(rf"{CHECKPOINT_PREFIX}_(\d+)", name)
+        if m:
+            serials.append(int(m.group(1)))
+    serials.sort(reverse=True)
+    for s in serials[max_num_checkpoints:]:
+        shutil.rmtree(_serial_dir(checkpoint_dir, s), ignore_errors=True)

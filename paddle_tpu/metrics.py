@@ -1,0 +1,142 @@
+"""Python-side streaming metric accumulators.
+
+≙ reference python/paddle/fluid/metrics.py: MetricBase, CompositeMetric,
+Accuracy, ChunkEvaluator, EditDistance, DetectionMAP, Auc.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MetricBase", "CompositeMetric", "Accuracy", "ChunkEvaluator",
+           "EditDistance", "Auc"]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        for k, v in self.__dict__.items():
+            if k.startswith("_"):
+                continue
+            if isinstance(v, (int, float)):
+                setattr(self, k, 0)
+            elif isinstance(v, np.ndarray):
+                setattr(self, k, np.zeros_like(v))
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Accuracy(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        self.value += float(np.ravel(value)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("no batches accumulated")
+        return self.value / self.weight
+
+
+class ChunkEvaluator(MetricBase):
+    """metrics.py ChunkEvaluator: micro-F1 over chunk counts."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.ravel(num_infer_chunks)[0])
+        self.num_label_chunks += int(np.ravel(num_label_chunks)[0])
+        self.num_correct_chunks += int(np.ravel(num_correct_chunks)[0])
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        distances = np.asarray(distances).ravel()
+        self.total_distance += float(distances.sum())
+        self.seq_num += int(np.ravel(seq_num)[0])
+        self.instance_error += int((distances > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("no data accumulated")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class Auc(MetricBase):
+    """metrics.py Auc: streaming ROC AUC over a threshold histogram."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=200):
+        super().__init__(name)
+        self._num_thresholds = num_thresholds
+        self.tp_list = np.zeros((num_thresholds,))
+        self.fn_list = np.zeros((num_thresholds,))
+        self.tn_list = np.zeros((num_thresholds,))
+        self.fp_list = np.zeros((num_thresholds,))
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).ravel()
+        pos_score = preds[:, 1] if preds.ndim == 2 and preds.shape[1] >= 2 \
+            else preds.ravel()
+        kepsilon = 1e-7
+        thresholds = [(i + 1) * 1.0 / (self._num_thresholds - 1)
+                      for i in range(self._num_thresholds - 2)]
+        thresholds = [0.0 - kepsilon] + thresholds + [1.0 + kepsilon]
+        for i, t in enumerate(thresholds):
+            above = pos_score >= t
+            self.tp_list[i] += int((above & (labels > 0)).sum())
+            self.fp_list[i] += int((above & (labels <= 0)).sum())
+            self.fn_list[i] += int((~above & (labels > 0)).sum())
+            self.tn_list[i] += int((~above & (labels <= 0)).sum())
+
+    def eval(self):
+        epsilon = 1e-6
+        tpr = self.tp_list / (self.tp_list + self.fn_list + epsilon)
+        fpr = self.fp_list / (self.fp_list + self.tn_list + epsilon)
+        return float(np.abs(np.trapezoid(tpr, fpr)))

@@ -1,0 +1,75 @@
+"""Multi-host bootstrap: the DCN coordination layer.
+
+≙ reference multi-node rendezvous: gen_nccl_id_op.cc:30-90 (trainer-0 mints
+an ncclUniqueId and RPC-broadcasts it) + NCCLContextMap rank math
+(platform/nccl_helper.h:81-120) + the env-var job contract
+(PADDLE_TRAINER_ID/PADDLE_TRAINERS/PADDLE_PSERVER_IPS, trainer.py:226,
+benchmark/fluid/fluid_benchmark.py:62). TPU-native: one call to
+jax.distributed.initialize(coordinator, num_processes, process_id) — the
+coordinator address IS the rendezvous, XLA owns the collectives, and the
+global device mesh spans all hosts' chips over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None):
+    """Explicit multi-host init. Safe to call once per process."""
+    global _initialized
+    if _initialized:
+        return
+    if num_processes is None or num_processes <= 1:
+        _initialized = True
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def initialize_from_env():
+    """Read the reference's env contract and initialize.
+
+    PADDLE_TRAINERS          ≙ num_processes
+    PADDLE_TRAINER_ID        ≙ process_id
+    PADDLE_COORDINATOR       — coordinator host:port (new; plays the role of
+                               the pserver-0 endpoint used for gen_nccl_id)
+    Falls back to PADDLE_PSERVER_IPS[0]:PADDLE_PSERVER_PORT for the
+    coordinator so reference launch scripts keep working.
+    """
+    trainers = int(os.getenv("PADDLE_TRAINERS", "1"))
+    if trainers <= 1:
+        return
+    trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    coord = os.getenv("PADDLE_COORDINATOR")
+    if coord is None:
+        ips = os.getenv("PADDLE_PSERVER_IPS", "")
+        port = os.getenv("PADDLE_PSERVER_PORT", "6174")
+        if ips:
+            coord = f"{ips.split(',')[0]}:{port}"
+    initialize(coord, trainers, trainer_id)
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def global_device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()

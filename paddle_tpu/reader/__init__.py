@@ -1,0 +1,15 @@
+"""Reader composition library (host data plane).
+
+≙ reference python/paddle/reader/decorator.py:29-236 + python/paddle/batch.py.
+Readers are nullary callables returning sample iterators; decorators compose
+them. The device-side reader-op stack of the reference (double_buffer etc.,
+layers/io.py:295-574) is subsumed by data/pipeline.py's prefetching feeder —
+on a functional runtime prefetch is host logic, not graph ops.
+"""
+
+from .decorator import (map_readers, shuffle, chain, compose, buffered,
+                        firstn, xmap_readers, cache)
+from .decorator import batch
+
+__all__ = ["map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
+           "xmap_readers", "cache", "batch"]

@@ -1,0 +1,197 @@
+"""Reader decorators (≙ python/paddle/reader/decorator.py).
+
+A *reader creator* is a nullary callable returning an iterator of samples.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import queue as _queue
+import threading
+from typing import Callable, Iterable, List
+
+
+def map_readers(func: Callable, *readers):
+    """decorator.py:29 — zip N readers and map func over the tuples."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """decorator.py:51 — pool-based shuffling with a bounded buffer."""
+
+    def shuffled():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                while buf:
+                    yield buf.pop()
+        random.shuffle(buf)
+        while buf:
+            yield buf.pop()
+
+    return shuffled
+
+
+def chain(*readers):
+    """decorator.py:86 — concatenate readers."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """decorator.py:118 — zip readers, yielding flattened tuples."""
+    check_alignment = kwargs.get("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for vals in zip(*rs):
+                yield sum((make_tuple(v) for v in vals), ())
+        else:
+            for vals in itertools.zip_longest(*rs):
+                yield sum((make_tuple(v) for v in vals if v is not None), ())
+
+    return reader
+
+
+def buffered(reader, size: int):
+    """decorator.py:165 — background-thread prefetch into a bounded queue."""
+
+    class _End:
+        pass
+
+    def buffered_reader():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for s in reader():
+                    q.put(s)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is _End:
+                break
+            yield s
+
+    return buffered_reader
+
+
+def firstn(reader, n: int):
+    """decorator.py:208 — truncate to the first n samples."""
+
+    def reader_n():
+        for i, s in enumerate(reader()):
+            if i >= n:
+                break
+            yield s
+
+    return reader_n
+
+
+def xmap_readers(mapper: Callable, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """decorator.py:236 — parallel map over samples with worker threads."""
+
+    def xreader():
+        in_q: _queue.Queue = _queue.Queue(buffer_size)
+        out_q: _queue.Queue = _queue.Queue(buffer_size)
+        end = object()
+
+        def feed():
+            for i, s in enumerate(reader()):
+                in_q.put((i, s))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, s = item
+                out_q.put((i, mapper(s)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if order:
+            pending = {}
+            next_idx = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                i, s = item
+                pending[i] = s
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item[1]
+
+    return xreader
+
+
+def cache(reader):
+    """Materialize once, replay from memory thereafter."""
+    all_data: List = []
+    filled = [False]
+
+    def cached():
+        if not filled[0]:
+            for s in reader():
+                all_data.append(s)
+                yield s
+            filled[0] = True
+        else:
+            yield from all_data
+
+    return cached
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """≙ python/paddle/batch.py — group samples into lists."""
+
+    def batched():
+        b = []
+        for s in reader():
+            b.append(s)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batched

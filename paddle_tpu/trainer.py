@@ -1,0 +1,232 @@
+"""High-level event-driven Trainer + Inferencer.
+
+≙ reference python/paddle/fluid/trainer.py (Trainer:114, events :35-56,
+role dispatch :226, checkpoint auto-load :165-196,429-460) and
+inferencer.py. Role selection reads the same PADDLE_TRAINING_ROLE /
+PADDLE_PSERVER_* environment contract; on the TPU runtime "PSERVER" has no
+meaning (no parameter server process — collectives replace it), so that
+role raises with guidance, while TRAINER role initializes the JAX
+distributed runtime (parallel/distributed.py) — the gen_nccl_id/transpile
+equivalent.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+from .core.program import Program, program_guard, default_main_program, default_startup_program
+from .core.scope import Scope, scope_guard
+from .core.executor import Executor, Place
+from .parallel import ParallelExecutor
+from .data_feeder import DataFeeder
+from . import io as io_mod
+
+__all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent", "CheckpointConfig", "Trainer", "Inferencer"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """≙ trainer.py:59 CheckpointConfig."""
+
+    def __init__(self, checkpoint_dir: Optional[str] = None,
+                 max_num_checkpoints: int = 3, epoch_interval: int = 1,
+                 step_interval: int = 10):
+        self.checkpoint_dir = checkpoint_dir or os.path.join(
+            os.getcwd(), "checkpoint")
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(epoch_interval, 1)
+        self.step_interval = max(step_interval, 1)
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+
+
+class Trainer:
+    """train_func must return [loss] (or [loss, *metrics])."""
+
+    def __init__(self, train_func: Callable, optimizer_func: Callable,
+                 place: Optional[Place] = None, param_path: Optional[str] = None,
+                 parallel: bool = False,
+                 checkpoint_config: Optional[CheckpointConfig] = None):
+        self.parallel = parallel
+        self.place = place
+        self.checkpoint_cfg = checkpoint_config
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+
+        with program_guard(self.train_program, self.startup_program):
+            outs = train_func()
+            if isinstance(outs, tuple):
+                outs = list(outs)
+            if not isinstance(outs, list):
+                outs = [outs]
+            self.train_func_outputs = outs
+            self.loss = outs[0]
+            optimizer = optimizer_func()
+            optimizer.minimize(self.loss)
+
+        self._dist_init_if_necessary()
+
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            if param_path:
+                io_mod.load_persistables(self.exe, param_path,
+                                         self.train_program, scope=self.scope)
+            if self.checkpoint_cfg:
+                serial = io_mod.get_latest_checkpoint_serial(
+                    self.checkpoint_cfg.checkpoint_dir)
+                if serial >= 0:
+                    self.checkpoint_cfg.load_serial = serial
+                    args = io_mod.load_checkpoint(
+                        self.exe, self.checkpoint_cfg.checkpoint_dir, serial,
+                        self.train_program, scope=self.scope)
+                    if args:
+                        self.checkpoint_cfg.epoch_id = args.get("epoch_id", 0)
+                        self.checkpoint_cfg.step_id = args.get("step_id", 0)
+
+    # -- distributed role dispatch (trainer.py:226) -------------------------
+    def _dist_init_if_necessary(self):
+        role = os.getenv("PADDLE_TRAINING_ROLE")
+        if role is None:
+            return
+        if role == "PSERVER":
+            raise RuntimeError(
+                "PSERVER role does not exist on the TPU runtime: parameter "
+                "exchange is XLA collectives over ICI/DCN. Launch every "
+                "process as TRAINER with PADDLE_TRAINER_ID/PADDLE_TRAINERS "
+                "(-> jax.distributed.initialize).")
+        if role == "TRAINER":
+            from .parallel import distributed
+            distributed.initialize_from_env()
+            self.parallel = True
+
+    # -- train loop ---------------------------------------------------------
+    def train(self, num_epochs: int, event_handler: Callable,
+              reader: Callable, feed_order: Optional[list] = None):
+        with scope_guard(self.scope):
+            feed_vars = self._feed_vars(feed_order)
+            feeder = DataFeeder(feed_vars, program=self.train_program)
+            executor = (ParallelExecutor(loss_name=self.loss.name,
+                                         main_program=self.train_program,
+                                         scope=self.scope)
+                        if self.parallel else self.exe)
+            start_epoch = (self.checkpoint_cfg.epoch_id
+                           if self.checkpoint_cfg else 0)
+            for epoch_id in range(start_epoch, num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    fetch = self.train_func_outputs if begin.fetch_metrics else []
+                    feed = feeder.feed(data)
+                    if self.parallel:
+                        metrics = executor.run(fetch_list=fetch, feed=feed)
+                    else:
+                        metrics = executor.run(self.train_program, feed=feed,
+                                               fetch_list=fetch)
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                    if (self.checkpoint_cfg and
+                            step_id % self.checkpoint_cfg.step_interval == 0):
+                        self._save_checkpoint(epoch_id, step_id)
+                event_handler(EndEpochEvent(epoch_id))
+
+    def test(self, reader: Callable, feed_order: Optional[list] = None):
+        test_program = self.train_program.clone(for_test=True)
+        with scope_guard(self.scope):
+            feeder = DataFeeder(self._feed_vars(feed_order),
+                                program=self.train_program)
+            totals = None
+            count = 0
+            for data in reader():
+                outs = self.exe.run(test_program, feed=feeder.feed(data),
+                                    fetch_list=self.train_func_outputs)
+                vals = [float(np.ravel(o)[0]) for o in outs]
+                totals = vals if totals is None else \
+                    [a + b for a, b in zip(totals, vals)]
+                count += 1
+            return [t / max(count, 1) for t in (totals or [])]
+
+    def save_params(self, param_path: str):
+        with scope_guard(self.scope):
+            io_mod.save_persistables(self.exe, param_path, self.train_program,
+                                     scope=self.scope)
+
+    def save_inference_model(self, param_path, feeded_var_names, target_vars):
+        with scope_guard(self.scope):
+            io_mod.save_inference_model(param_path, feeded_var_names,
+                                        target_vars, self.exe,
+                                        self.train_program, scope=self.scope)
+
+    def stop(self):
+        pass
+
+    # -- internals ----------------------------------------------------------
+    def _feed_vars(self, feed_order):
+        block = self.train_program.global_block
+        if feed_order is None:
+            feed_vars = [v for v in block.vars.values()
+                         if getattr(v, "is_data", False)
+                         and not v.name.endswith("@SEQ_LEN")]
+        else:
+            feed_vars = [block.var(n) for n in feed_order]
+        return feed_vars
+
+    def _save_checkpoint(self, epoch_id, step_id):
+        io_mod.save_checkpoint(
+            self.exe, self.checkpoint_cfg.checkpoint_dir,
+            trainer_args={"epoch_id": epoch_id, "step_id": step_id},
+            main_program=self.train_program,
+            max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
+            scope=self.scope)
+
+
+class Inferencer:
+    """≙ python/paddle/fluid/inferencer.py."""
+
+    def __init__(self, infer_func: Callable, param_path: str,
+                 place: Optional[Place] = None, parallel: bool = False):
+        self.scope = Scope()
+        self.inference_program = Program()
+        startup = Program()
+        with program_guard(self.inference_program, startup):
+            self.predict_var = infer_func()
+        self.inference_program = self.inference_program.clone(for_test=True)
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            io_mod.load_params(self.exe, param_path, self.inference_program,
+                               scope=self.scope)
+
+    def infer(self, inputs: dict, return_numpy: bool = True):
+        with scope_guard(self.scope):
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=[self.predict_var],
+                                return_numpy=return_numpy)

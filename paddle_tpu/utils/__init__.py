@@ -1,0 +1,2 @@
+"""Utilities: profiling, timeline export, flags."""
+from . import profiler, timeline

@@ -1,0 +1,131 @@
+"""Profiling: host event timers + device (XLA/XPlane) tracing.
+
+≙ reference three-tier profiling (SURVEY.md §5): (a) host RecordEvent
+ranges + min/max/avg tables (platform/profiler.h:72-116, fluid/profiler.py
+:36-135); (b) CUPTI device tracer → chrome trace (device_tracer.cc,
+tools/timeline.py). TPU-native: (a) is a host-side timer registry below;
+(b) is jax.profiler's XPlane trace, viewable in TensorBoard/Perfetto —
+`profiler(...)` context manages both, and utils/timeline.py converts the
+host events to chrome://tracing JSON (the timeline.py parity tool).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
+           "reset_profiler", "get_profile_stats", "cuda_profiler"]
+
+_enabled = False
+_events_lock = threading.Lock()
+_events: List[dict] = []  # {name, thread, start, end}
+
+
+class RecordEvent:
+    """RAII timing range (platform/profiler.h:72). Usable as decorator/ctx."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.start = None
+
+    def __enter__(self):
+        if _enabled:
+            self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled and self.start is not None:
+            end = time.perf_counter()
+            with _events_lock:
+                _events.append({"name": self.name,
+                                "thread": threading.get_ident(),
+                                "start": self.start, "end": end})
+        return False
+
+
+def reset_profiler():
+    with _events_lock:
+        _events.clear()
+
+
+def start_profiler(state: str = "All", trace_dir: Optional[str] = None):
+    """≙ EnableProfiler. state kept for API parity (CPU/GPU/All)."""
+    global _enabled
+    _enabled = True
+    if trace_dir:
+        import jax
+        jax.profiler.start_trace(trace_dir)
+        start_profiler._trace_dir = trace_dir
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: Optional[str] = None):
+    """≙ DisableProfiler: print the event table; dump raw events if asked."""
+    global _enabled
+    _enabled = False
+    if getattr(start_profiler, "_trace_dir", None):
+        import jax
+        jax.profiler.stop_trace()
+        start_profiler._trace_dir = None
+    stats = get_profile_stats(sorted_key)
+    _print_table(stats)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            json.dump(_events, f)
+    return stats
+
+
+def get_profile_stats(sorted_key: Optional[str] = None) -> List[dict]:
+    agg: Dict[str, dict] = defaultdict(
+        lambda: {"calls": 0, "total": 0.0, "min": float("inf"), "max": 0.0})
+    with _events_lock:
+        for e in _events:
+            d = e["end"] - e["start"]
+            a = agg[e["name"]]
+            a["calls"] += 1
+            a["total"] += d
+            a["min"] = min(a["min"], d)
+            a["max"] = max(a["max"], d)
+    rows = [{"name": k, **v, "avg": v["total"] / max(v["calls"], 1)}
+            for k, v in agg.items()]
+    key = {"calls": "calls", "total": "total", "max": "max", "min": "min",
+           "ave": "avg", "avg": "avg"}.get(sorted_key or "total", "total")
+    rows.sort(key=lambda r: r[key], reverse=True)
+    return rows
+
+
+def _print_table(rows: List[dict]):
+    if not rows:
+        return
+    print(f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
+          f"{'Max(ms)':>10}{'Ave(ms)':>10}")
+    for r in rows:
+        print(f"{r['name']:<40}{r['calls']:>8}{r['total']*1e3:>12.3f}"
+              f"{r['min']*1e3:>10.3f}{r['max']*1e3:>10.3f}{r['avg']*1e3:>10.3f}")
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None,
+             trace_dir: Optional[str] = None):
+    """≙ fluid.profiler.profiler context manager (profiler.py:36)."""
+    reset_profiler()
+    start_profiler(state, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **k):
+    """API-parity alias (profiler.py cuda_profiler): device tracing on TPU
+    is jax.profiler — use `profiler(trace_dir=...)`."""
+    with profiler():
+        yield

@@ -1,0 +1,42 @@
+"""Host-event profile -> chrome://tracing JSON.
+
+≙ reference tools/timeline.py:1-30 (profiler proto → Chrome trace, with
+multi-trainer merge). Input here is the JSON event dump written by
+utils/profiler.stop_profiler(profile_path=...); multiple dumps merge with a
+per-file pid, exactly like the reference's multi-trainer merge.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence, Tuple
+
+__all__ = ["Timeline", "make_chrome_trace"]
+
+
+def make_chrome_trace(profile_files: Sequence[Tuple[str, str]],
+                      output_path: str):
+    """profile_files: [(label, path_to_events_json)]."""
+    trace_events: List[dict] = []
+    for pid, (label, path) in enumerate(profile_files):
+        with open(path) as f:
+            events = json.load(f)
+        trace_events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": label}})
+        for e in events:
+            trace_events.append({
+                "name": e["name"], "cat": "host", "ph": "X",
+                "pid": pid, "tid": e.get("thread", 0) % 1000,
+                "ts": e["start"] * 1e6, "dur": (e["end"] - e["start"]) * 1e6,
+            })
+    with open(output_path, "w") as f:
+        json.dump({"traceEvents": trace_events, "displayTimeUnit": "ms"}, f)
+
+
+class Timeline:
+    def __init__(self, profile_dict: Dict[str, str]):
+        self._files = list(profile_dict.items())
+
+    def save(self, path: str):
+        make_chrome_trace(self._files, path)
