@@ -111,7 +111,10 @@ class Executor:
         """Persistable vars the program reads that already exist in the scope."""
         state = {}
         block = program.global_block
-        read = {n for op in block.ops for n in op.input_names()}
+        # scan every block: control-flow sub-blocks (dynamic_rnn etc.) may be
+        # the only readers of a parameter (≙ parent-scope lookup, scope.h:62)
+        read = {n for b in program.blocks for op in b.ops
+                for n in op.input_names()}
         for name in sorted(read):
             try:
                 var = block.var(name)

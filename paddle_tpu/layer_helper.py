@@ -115,8 +115,10 @@ class LayerHelper:
 
     # -- common tails -------------------------------------------------------
     def append_bias_op(self, input_var: VarDesc, dim_start: int = 1,
-                       dim_end: Optional[int] = None) -> VarDesc:
-        size = list(input_var.shape[dim_start:dim_end])
+                       dim_end: Optional[int] = None,
+                       size: Optional[list] = None) -> VarDesc:
+        if size is None:
+            size = list(input_var.shape[dim_start:dim_end])
         bias_attr = self.bias_attr
         if bias_attr is None:
             return input_var

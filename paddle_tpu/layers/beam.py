@@ -1,0 +1,103 @@
+"""Beam-search layers (≙ layers/nn.py beam_search:2025 / beam_search_decode).
+
+Dense [B, W]-lane beams instead of the reference's 2-level-LoD candidate
+tensors — see ops/beam_ops.py for the device-side formulation.
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from .sequence import _mark_seq
+
+__all__ = ["beam_search", "beam_search_decode", "sequence_mask", "lod_reset",
+           "batch_gather"]
+
+
+def batch_gather(x, index, name=None):
+    """Per-row gather: x [B, W, ...] + index [B, K] -> [B, K, ...] (beam
+    state reorder by parent_idx)."""
+    helper = LayerHelper("batch_gather", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("batch_gather", {"X": x, "Index": index}, {"Out": out})
+    out.shape = tuple(index.shape[:2]) + tuple(x.shape[2:])
+    out.dtype = x.dtype
+    return out
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id,
+                log_probs=False, name=None):
+    """One beam expansion: (pre_ids [B,W], pre_scores [B,W], scores [B,W,V])
+    -> (selected_ids [B,W], selected_scores [B,W], parent_idx [B,W])."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_tmp_variable(pre_ids.dtype)
+    sel_scores = helper.create_tmp_variable(pre_scores.dtype)
+    parent = helper.create_tmp_variable("int32")
+    for v in (sel_ids, parent):
+        v.stop_gradient = True
+    helper.append_op(
+        "beam_search",
+        {"pre_ids": pre_ids, "pre_scores": pre_scores, "scores": scores},
+        {"selected_ids": sel_ids, "selected_scores": sel_scores,
+         "parent_idx": parent},
+        {"beam_size": beam_size, "end_id": end_id, "log_probs": log_probs})
+    B, W = scores.shape[0], beam_size
+    sel_ids.shape = sel_scores.shape = parent.shape = (B, W)
+    sel_scores.dtype = pre_scores.dtype
+    return sel_ids, sel_scores, parent
+
+
+def beam_search_decode(ids, parent_idx, scores, beam_size, end_id, name=None):
+    """Backtrack stacked selections [B,T,W] into sentences [B,W,T] + [B,W]."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent = helper.create_tmp_variable(ids.dtype)
+    sent_scores = helper.create_tmp_variable(scores.dtype)
+    sent.stop_gradient = sent_scores.stop_gradient = True
+    helper.append_op(
+        "beam_search_decode",
+        {"Ids": ids, "ParentIdx": parent_idx, "Scores": scores},
+        {"SentenceIds": sent, "SentenceScores": sent_scores},
+        {"end_id": end_id})
+    B, T, W = ids.shape
+    sent.shape = (B, W, T)
+    sent_scores.shape = (B, W)
+    sent_scores.dtype = scores.dtype
+    return sent, sent_scores
+
+
+def sequence_mask(x, maxlen=None, maxlen_ref=None, dtype="float32", name=None):
+    """lengths [B] -> [B, maxlen] mask (≙ sequence_mask op). Pass
+    `maxlen_ref` (any [B, T, ...] var) to take the time extent from a
+    runtime shape instead of a static attr."""
+    if maxlen is None and maxlen_ref is None:
+        raise ValueError("sequence_mask needs maxlen or maxlen_ref")
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_tmp_variable(dtype)
+    out.stop_gradient = True
+    inputs = {"X": x}
+    if maxlen_ref is not None:
+        inputs["MaxLenRef"] = maxlen_ref
+    helper.append_op("sequence_mask", inputs, {"Y": out},
+                     {"maxlen": -1 if maxlen is None else maxlen,
+                      "out_dtype": dtype})
+    out.shape = (x.shape[0],
+                 maxlen if maxlen is not None else maxlen_ref.shape[1])
+    return out
+
+
+def lod_reset(x, y=None, seq_len=None, name=None):
+    """lod_reset_op.cc: give `x` the sequence structure of `y` (or of an
+    explicit lengths var). Data is untouched; only the @SEQ_LEN companion
+    is rewired — sequence structure is metadata on the padded layout."""
+    helper = LayerHelper("lod_reset", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("lod_reset", {"X": x}, {"Out": out})
+    out.shape, out.dtype = x.shape, x.dtype
+    if y is not None:
+        if not getattr(y, "seq_len_var", None):
+            raise ValueError(
+                f"lod_reset: y={y.name} has no sequence structure "
+                "(no @SEQ_LEN companion); pass seq_len= instead")
+        _mark_seq(out, y.seq_len_var)
+    elif seq_len is not None:
+        _mark_seq(out, seq_len.name if hasattr(seq_len, "name") else seq_len)
+    return out

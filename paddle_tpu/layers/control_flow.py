@@ -36,6 +36,7 @@ class DynamicRNN:
         self.mem_init_vars: List[Optional[VarDesc]] = []
         self.mem_init_values: List[float] = []
         self.mem_shapes: List[list] = []
+        self.mem_dtypes: List[str] = []
         self.mem_updates = {}
         self.output_inner: List[VarDesc] = []
         self.outputs_outer: List[VarDesc] = []
@@ -91,6 +92,7 @@ class DynamicRNN:
             self.mem_init_vars.append(init)
             self.mem_shapes.append(list(init.shape))
             self.mem_init_values.append(0.0)
+            self.mem_dtypes.append(str(init.dtype))
         else:
             assert shape is not None
             inner = self.sub_block.create_var(
@@ -99,8 +101,18 @@ class DynamicRNN:
             self.mem_init_vars.append(None)
             self.mem_shapes.append(list(shape))
             self.mem_init_values.append(float(value))
+            self.mem_dtypes.append(str(dtype))
         self.memories.append(inner)
         return inner
+
+    def static_input(self, x: VarDesc) -> VarDesc:
+        """≙ DynamicRNN.static_input (control_flow.py:1313 area). The
+        reference copies/reorders a parent-scope LoDTensor into each step
+        scope; here sub-block ops read outer vars directly from the
+        enclosing trace environment (ops/rnn_ops.py dynamic_rnn `outer_env`),
+        so the full [B, T, ...] tensor is visible at every step as-is."""
+        self._assert_in_rnn("static_input")
+        return x
 
     def update_memory(self, ex_mem: VarDesc, new_mem: VarDesc):
         self._assert_in_rnn("update_memory")
@@ -147,6 +159,7 @@ class DynamicRNN:
              "memory_updates": dict(self.mem_updates),
              "memory_init_values": list(self.mem_init_values),
              "memory_shapes": list(self.mem_shapes),
+             "memory_dtypes": list(self.mem_dtypes),
              "memory_has_init": [v is not None for v in self.mem_init_vars],
              "output_vars": [o.name for o in self.output_inner]})
 
@@ -170,7 +183,7 @@ class StaticRNN:
         if not getattr(x, "seq_len_var", None):
             # synthesize a full-length companion for dense [B, T, ...] input
             from . import tensor as tensor_layers
-            block = default_main_program().global_block
+            block = self._drnn.parent_block
             name = x.name + "@SEQ_LEN"
             if name not in block.vars:
                 with self._drnn.main_program.block_guard(
@@ -178,19 +191,25 @@ class StaticRNN:
                     ln = tensor_layers.fill_constant_batch_size_like(
                         x, [-1], "int32", float(x.shape[1]))
                     ln.stop_gradient = True
-                block.vars[name] = block.vars.pop(ln.name)
+                old_name = ln.name
+                block.vars[name] = block.vars.pop(old_name)
                 block.vars[name].name = name
                 # fix the op output reference
                 for op in self._drnn.parent_block.ops:
                     for slot, names in op.outputs.items():
-                        op.outputs[slot] = [name if n == ln.name else n
+                        op.outputs[slot] = [name if n == old_name else n
                                             for n in names]
             x.seq_len_var = name
             x.lod_level = 1
         return self._drnn.step_input(x)
 
-    def memory(self, init=None, shape=None, init_value=0.0, **kw):
-        return self._drnn.memory(init=init, shape=shape, value=init_value)
+    def memory(self, init=None, shape=None, init_value=0.0,
+               dtype="float32", **kw):
+        return self._drnn.memory(init=init, shape=shape, value=init_value,
+                                 dtype=dtype)
+
+    def static_input(self, x):
+        return self._drnn.static_input(x)
 
     def update_memory(self, mem, new):
         return self._drnn.update_memory(mem, new)

@@ -48,7 +48,9 @@ def fc(input, size: int, num_flatten_dims: int = 1, param_attr=None,
     dtype = helper.input_dtype()
     mul_results = []
     seq_src = None
-    for input_var in helper.multiple_input():
+    flatten_used = num_flatten_dims
+    inputs_list = helper.multiple_input()
+    for in_idx, input_var in enumerate(inputs_list):
         input_shape = input_var.shape
         flatten = num_flatten_dims
         # per-timestep fc on padded sequences (the reference's [T_total, D]
@@ -57,8 +59,12 @@ def fc(input, size: int, num_flatten_dims: int = 1, param_attr=None,
                 and num_flatten_dims == 1:
             flatten = len(input_shape) - 1
             seq_src = input_var
+        flatten_used = max(flatten_used, flatten)
         param_shape = [int(np.prod(input_shape[flatten:]))] + [size]
-        w = helper.create_parameter(ParamAttr_to(param_attr), param_shape, dtype)
+        pa = ParamAttr_to(param_attr)
+        if pa.name is not None and len(inputs_list) > 1:
+            pa.name = f"{pa.name}_{in_idx}"  # one weight per fc input
+        w = helper.create_parameter(pa, param_shape, dtype)
         tmp = helper.create_tmp_variable(dtype)
         helper.append_op("mul", {"X": input_var, "Y": w}, {"Out": tmp},
                          {"x_num_col_dims": flatten, "y_num_col_dims": 1})
@@ -69,8 +75,10 @@ def fc(input, size: int, num_flatten_dims: int = 1, param_attr=None,
         pre_bias = helper.create_tmp_variable(dtype)
         helper.append_op("sum", {"X": mul_results}, {"Out": pre_bias})
     if bias_attr is not False:
-        bias_dim = len(pre_bias.shape) - 1 if seq_src is not None else 1
-        pre_act = helper.append_bias_op(pre_bias, dim_start=bias_dim)
+        # bias spans the feature (last) axis: alignment follows the flatten
+        # point, not the (possibly unknown at build time) tmp-var shape
+        pre_act = helper.append_bias_op(pre_bias, dim_start=flatten_used,
+                                        size=[size])
     else:
         pre_act = pre_bias
     out = helper.append_activation(pre_act)

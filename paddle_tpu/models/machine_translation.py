@@ -1,0 +1,258 @@
+"""Seq2seq with attention (≙ benchmark/fluid/models/machine_translation.py
+seq_to_seq_net, and the book's machine-translation chapter).
+
+Training: bi-LSTM encoder -> Bahdanau-style additive attention decoder built
+inside a DynamicRNN (one lax.scan after lowering). Generation: beam search
+on a StaticRNN over `max_length` steps with dense [B, W] beam lanes — the
+reference's While + LoD-candidate machinery (beam_search_op.cc) becomes a
+fixed-shape scan + top_k, the TPU-idiomatic formulation. Attention uses
+broadcast adds over the padded time axis, so no op ever needs the runtime
+sequence length as a static attribute.
+
+All parameters carry explicit names so the generation program (a separate
+Program) shares weights with the training program through the scope.
+"""
+
+from __future__ import annotations
+
+from .. import layers, optimizer
+from ..param_attr import ParamAttr
+
+
+def _pa(name):
+    return ParamAttr(name=name)
+
+
+def bi_lstm_encoder(input_seq, gate_size, prefix="enc"):
+    """Forward + reverse fused LSTM over pre-projected inputs
+    (≙ machine_translation.py bi_lstm_encoder)."""
+    fwd_proj = layers.fc(input=input_seq, size=gate_size * 4, act=None,
+                         bias_attr=False, param_attr=_pa(prefix + "_fw_proj"))
+    forward, _ = layers.dynamic_lstm(fwd_proj, size=gate_size * 4,
+                                     use_peepholes=False,
+                                     param_attr=_pa(prefix + "_fw_w"),
+                                     bias_attr=_pa(prefix + "_fw_b"))
+    rev_proj = layers.fc(input=input_seq, size=gate_size * 4, act=None,
+                         bias_attr=False, param_attr=_pa(prefix + "_rv_proj"))
+    reversed_, _ = layers.dynamic_lstm(rev_proj, size=gate_size * 4,
+                                       is_reverse=True, use_peepholes=False,
+                                       param_attr=_pa(prefix + "_rv_w"),
+                                       bias_attr=_pa(prefix + "_rv_b"))
+    return forward, reversed_
+
+
+def lstm_step(x_t, hidden_prev, cell_prev, size, nfd=1, prefix="dec_cell"):
+    """Composed LSTM cell from fc primitives (≙ reference lstm_step).
+    `nfd` = num_flatten_dims for the inner fcs (2 when beams ride a lane
+    axis: [B, W, D] inputs)."""
+    def linear(inputs, tag):
+        return layers.fc(input=inputs, size=size, num_flatten_dims=nfd,
+                         bias_attr=_pa(f"{prefix}_{tag}_b"),
+                         param_attr=_pa(f"{prefix}_{tag}_w"))
+
+    forget_g = layers.sigmoid(linear([hidden_prev, x_t], "f"))
+    input_g = layers.sigmoid(linear([hidden_prev, x_t], "i"))
+    output_g = layers.sigmoid(linear([hidden_prev, x_t], "o"))
+    cell_tilde = layers.tanh(linear([hidden_prev, x_t], "c"))
+    cell_t = layers.sums(input=[
+        layers.elementwise_mul(x=forget_g, y=cell_prev),
+        layers.elementwise_mul(x=input_g, y=cell_tilde)])
+    hidden_t = layers.elementwise_mul(x=output_g, y=layers.tanh(x=cell_t))
+    return hidden_t, cell_t
+
+
+def simple_attention(encoder_vec, encoder_proj, decoder_state, decoder_size,
+                     prefix="att"):
+    """Additive attention e_t = v·tanh(enc_proj_t + W_s s) over the padded
+    time axis (≙ reference simple_attention: its concat+fc-of-size-1 is the
+    same family with the weight split into enc_proj's fc and W_s).
+
+    decoder_state [B, D] -> context [B, C]."""
+    state_proj = layers.fc(input=decoder_state, size=decoder_size,
+                           bias_attr=False, param_attr=_pa(prefix + "_sp"))
+    summed = layers.elementwise_add(encoder_proj,
+                                    layers.unsqueeze(state_proj, [1]))
+    e = layers.fc(input=layers.tanh(summed), size=1, num_flatten_dims=2,
+                  bias_attr=False, param_attr=_pa(prefix + "_e"))  # [B,T,1]
+    weights = layers.sequence_softmax(layers.lod_reset(
+        layers.squeeze(e, [2]), y=encoder_proj))                   # [B,T]
+    context = layers.reduce_sum(
+        layers.elementwise_mul(encoder_vec, layers.unsqueeze(weights, [2])),
+        dim=1)                                                     # [B,C]
+    return context
+
+
+def beam_attention(encoder_vec, encoder_proj, decoder_state, decoder_size,
+                   src_mask, prefix="att"):
+    """Same attention with a beam lane: decoder_state [B, W, D], encoder
+    vars [B, T, .], src_mask [B, T] -> context [B, W, C]. Pure broadcast —
+    the encoder is never tiled per beam."""
+    state_proj = layers.fc(input=decoder_state, size=decoder_size,
+                           num_flatten_dims=2, bias_attr=False,
+                           param_attr=_pa(prefix + "_sp"))          # [B,W,D]
+    summed = layers.elementwise_add(
+        layers.unsqueeze(encoder_proj, [1]),       # [B,1,T,D]
+        layers.unsqueeze(state_proj, [2]))         # [B,W,1,D] -> [B,W,T,D]
+    e = layers.fc(input=layers.tanh(summed), size=1, num_flatten_dims=3,
+                  bias_attr=False, param_attr=_pa(prefix + "_e"))  # [B,W,T,1]
+    e = layers.squeeze(e, [3])                                     # [B,W,T]
+    neg = layers.scale(src_mask, scale=1e9, bias=-1e9)  # 0 valid, -1e9 pad
+    e = layers.elementwise_add(e, layers.unsqueeze(neg, [1]))
+    weights = layers.softmax(e)                                    # [B,W,T]
+    context = layers.reduce_sum(
+        layers.elementwise_mul(layers.unsqueeze(encoder_vec, [1]),
+                               layers.unsqueeze(weights, [3])), dim=2)
+    return context                                                 # [B,W,C]
+
+
+def encoder_net(src_word_idx, source_dict_dim, embedding_dim, encoder_size,
+                decoder_size):
+    src_embedding = layers.embedding(
+        input=src_word_idx, size=[source_dict_dim, embedding_dim],
+        dtype="float32", param_attr=_pa("src_emb"))
+    src_forward, src_reversed = bi_lstm_encoder(src_embedding, encoder_size)
+    encoded_vector = layers.lod_reset(
+        layers.concat([src_forward, src_reversed], axis=2), y=src_forward)
+    encoded_proj = layers.fc(input=encoded_vector, size=decoder_size,
+                             bias_attr=False, param_attr=_pa("enc_proj"))
+    backward_first = layers.sequence_pool(src_reversed, "first")
+    decoder_boot = layers.fc(input=backward_first, size=decoder_size,
+                             bias_attr=False, act="tanh",
+                             param_attr=_pa("dec_boot"))
+    return encoded_vector, encoded_proj, decoder_boot
+
+
+def train_net(source_dict_dim=30000, target_dict_dim=30000, embedding_dim=512,
+              encoder_size=512, decoder_size=512, learning_rate=2e-4,
+              with_optimizer=True):
+    """Build the training loss. Feeds: source_sequence, target_sequence,
+    label_sequence (next-word targets), all [B, T] int64 sequences."""
+    src = layers.data(name="source_sequence", shape=[1], dtype="int64",
+                      lod_level=1)
+    encoder_vec, encoder_proj, decoder_boot = encoder_net(
+        src, source_dict_dim, embedding_dim, encoder_size, decoder_size)
+
+    trg = layers.data(name="target_sequence", shape=[1], dtype="int64",
+                      lod_level=1)
+    trg_embedding = layers.embedding(
+        input=trg, size=[target_dict_dim, embedding_dim], dtype="float32",
+        param_attr=_pa("trg_emb"))
+
+    rnn = layers.DynamicRNN()
+    with rnn.block():
+        x = rnn.step_input(trg_embedding)
+        encoder_vec_s = rnn.static_input(encoder_vec)
+        encoder_proj_s = rnn.static_input(encoder_proj)
+        hidden_mem = rnn.memory(init=decoder_boot, need_reorder=True)
+        cell_mem = rnn.memory(value=0.0, shape=[decoder_size])
+        context = simple_attention(encoder_vec_s, encoder_proj_s, hidden_mem,
+                                   decoder_size)
+        decoder_inputs = layers.concat([context, x], axis=1)
+        h, c = lstm_step(decoder_inputs, hidden_mem, cell_mem, decoder_size)
+        rnn.update_memory(hidden_mem, h)
+        rnn.update_memory(cell_mem, c)
+        out = layers.fc(input=h, size=target_dict_dim, act="softmax",
+                        param_attr=_pa("dec_out_w"),
+                        bias_attr=_pa("dec_out_b"))
+        rnn.output(out)
+
+    prediction = rnn()                          # [B, T, V] seq-marked
+    label = layers.data(name="label_sequence", shape=[1], dtype="int64",
+                        lod_level=1)
+    # masked sequence cross-entropy: per-step CE zeroed beyond each length,
+    # normalized by total token count (≙ the reference's LoD-packed mean)
+    from ..core.program import default_main_program
+    seq_len = default_main_program().global_block.var(trg.seq_len_var)
+    ce = layers.cross_entropy(input=prediction, label=label)      # [B, T, 1]
+    mask = layers.sequence_mask(seq_len, maxlen_ref=prediction)   # [B, T]
+    ce = layers.elementwise_mul(layers.squeeze(ce, [2]), mask)
+    avg_cost = layers.elementwise_div(
+        layers.reduce_sum(ce), layers.reduce_sum(mask))
+    if with_optimizer:
+        opt = optimizer.AdamOptimizer(learning_rate=learning_rate)
+        opt.minimize(avg_cost)
+    return avg_cost, prediction, ["source_sequence", "target_sequence",
+                                  "label_sequence"]
+
+
+def decode_net(source_dict_dim=30000, target_dict_dim=30000, embedding_dim=512,
+               encoder_size=512, decoder_size=512, beam_size=4, max_length=32,
+               start_id=0, end_id=1):
+    """Beam-search generation program (≙ seq_to_seq_net is_generating=True).
+
+    Returns (sentence_ids [B, W, max_length], sentence_scores [B, W],
+    feed names). Runs max_length fixed steps; finished beams are frozen by
+    the beam_search op rather than exiting early (static shapes for XLA)."""
+    src = layers.data(name="source_sequence", shape=[1], dtype="int64",
+                      lod_level=1)
+    encoder_vec, encoder_proj, decoder_boot = encoder_net(
+        src, source_dict_dim, embedding_dim, encoder_size, decoder_size)
+    W = beam_size
+
+    from ..core.program import default_main_program
+    src_len = default_main_program().global_block.var(src.seq_len_var)
+    src_mask = layers.sequence_mask(src_len, maxlen_ref=encoder_vec)
+
+    boot = layers.expand(layers.unsqueeze(decoder_boot, [1]),
+                         [1, W, 1])                          # [B, W, D]
+    cell_init = layers.fill_constant_batch_size_like(
+        boot, [-1, W, decoder_size], "float32", 0.0)
+    # scores init: beam 0 live at 0.0, others -1e9 so step 1 diversifies
+    zeros_idx = layers.fill_constant_batch_size_like(
+        decoder_boot, [-1, 1], "int64", 0.0)
+    ones_row = layers.fill_constant_batch_size_like(
+        decoder_boot, [-1, W], "float32", 1.0)
+    scores_init = layers.scale(
+        layers.elementwise_sub(layers.one_hot(zeros_idx, W), ones_row),
+        scale=1e9)                                           # [B, W]
+    dummy_steps = layers.fill_constant_batch_size_like(
+        decoder_boot, [-1, max_length, 1], "float32", 0.0)
+
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        rnn.step_input(dummy_steps)
+        pre_ids = rnn.memory(shape=[W], init_value=float(start_id),
+                             dtype="int64")                  # [B, W]
+        pre_scores = rnn.memory(init=scores_init)            # [B, W]
+        hidden_mem = rnn.memory(init=boot)                   # [B, W, D]
+        cell_mem = rnn.memory(init=cell_init)
+
+        # ids carry fluid's trailing-1 convention so lookup_table's squeeze
+        # yields [B, W, E] for any W (including beam_size=1)
+        prev_emb = layers.embedding(
+            input=layers.unsqueeze(pre_ids, [2]),
+            size=[target_dict_dim, embedding_dim],
+            dtype="float32", param_attr=_pa("trg_emb"))      # [B, W, E]
+        context = beam_attention(rnn.static_input(encoder_vec),
+                                 rnn.static_input(encoder_proj),
+                                 hidden_mem, decoder_size,
+                                 rnn.static_input(src_mask))
+        decoder_inputs = layers.concat([context, prev_emb], axis=2)
+        h, c = lstm_step(decoder_inputs, hidden_mem, cell_mem, decoder_size,
+                         nfd=2)
+        probs = layers.fc(input=h, size=target_dict_dim, num_flatten_dims=2,
+                          act="softmax", param_attr=_pa("dec_out_w"),
+                          bias_attr=_pa("dec_out_b"))        # [B, W, V]
+        sel_ids, sel_scores, parent = layers.beam_search(
+            pre_ids, pre_scores, probs, beam_size=W, end_id=end_id)
+        h_sel = layers.batch_gather(h, parent)
+        c_sel = layers.batch_gather(c, parent)
+        rnn.update_memory(pre_ids, sel_ids)
+        rnn.update_memory(pre_scores, sel_scores)
+        rnn.update_memory(hidden_mem, h_sel)
+        rnn.update_memory(cell_mem, c_sel)
+        rnn.output(sel_ids, parent, sel_scores)
+
+    ids_steps, parent_steps, scores_steps = rnn()   # each [B, T, W]
+    sentence_ids, sentence_scores = layers.beam_search_decode(
+        ids_steps, parent_steps, scores_steps, beam_size=W, end_id=end_id)
+    return sentence_ids, sentence_scores, ["source_sequence"]
+
+
+def get_model(source_dict_dim=30000, target_dict_dim=30000, embedding_dim=512,
+              encoder_size=512, decoder_size=512, learning_rate=2e-4):
+    """BASELINE config 5 entry (≙ machine_translation.get_model)."""
+    avg_cost, prediction, feeds = train_net(
+        source_dict_dim, target_dict_dim, embedding_dim, encoder_size,
+        decoder_size, learning_rate)
+    return avg_cost, prediction, feeds

@@ -12,5 +12,6 @@ from . import optimizer_ops  # noqa: F401
 from . import control_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import beam_ops  # noqa: F401
 
 from ..core.registry import registered_ops  # noqa: F401

@@ -1,0 +1,131 @@
+"""Beam-search ops, dense TPU formulation.
+
+≙ reference beam_search_op.cc / beam_search_decode_op.cc. The reference
+keeps candidate sets in 2-level LoDTensors and does per-sequence heap
+selection on the host; here beams live on a fixed [B, W] lane layout so one
+`lax.top_k` over the flattened [B, W*V] joint scores does the selection on
+device, inside the decode scan, with no host round-trip.
+
+Conventions:
+  * `pre_ids` [B, W] int — token chosen by each beam at the previous step.
+  * `pre_scores` [B, W] float — accumulated log-prob per beam.
+  * `scores` [B, W, V] float — this step's distribution per beam
+    (probabilities by default; `log_probs=True` if already in log domain).
+  * finished beams (pre_ids == end_id) are frozen: their only continuation
+    is end_id at unchanged score, mirroring beam_search_op.cc's pruning of
+    ended hypotheses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+_NEG_INF = -1e9
+
+
+@register_op("beam_search")
+def beam_search(ctx, ins, attrs):
+    """One beam expansion step (≙ BeamSearch::operator() beam_search_op.cc).
+
+    Outputs: selected_ids [B, W], selected_scores [B, W], parent_idx [B, W]
+    (which source beam each selected hypothesis extends — the dense
+    equivalent of the LoD the reference threads through its candidates).
+    """
+    pre_ids = ins["pre_ids"][0]
+    pre_scores = ins["pre_scores"][0]
+    scores = ins["scores"][0]
+    W = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    B, Wi, V = scores.shape
+
+    logp = scores if attrs.get("log_probs", False) else jnp.log(
+        jnp.maximum(scores, 1e-20))
+    pre_ids2 = pre_ids.reshape(B, Wi)
+    finished = pre_ids2 == end_id
+    total = pre_scores.reshape(B, Wi, 1) + logp
+    # frozen beams: only end_id survives, score carried through unchanged
+    onehot_end = jnp.arange(V)[None, None, :] == end_id
+    frozen = jnp.where(onehot_end, pre_scores.reshape(B, Wi, 1), _NEG_INF)
+    total = jnp.where(finished[:, :, None], frozen, total)
+
+    flat = total.reshape(B, Wi * V)
+    sel_scores, flat_idx = jax.lax.top_k(flat, W)
+    parent = (flat_idx // V).astype(jnp.int32)
+    sel_ids = (flat_idx % V).astype(pre_ids.dtype)
+    return {"selected_ids": [sel_ids], "selected_scores": [sel_scores],
+            "parent_idx": [parent]}
+
+
+@register_op("beam_search_decode")
+def beam_search_decode(ctx, ins, attrs):
+    """Backtrack stacked per-step selections into full sentences
+    (≙ BeamSearchDecoder::Backtrace, beam_search_decode_op.cc).
+
+    Inputs: Ids [B, T, W], ParentIdx [B, T, W], Scores [B, T, W] (per-step
+    accumulated scores; final sentence score = last step's).
+    Outputs: SentenceIds [B, W, T] (end_id-padded after termination),
+    SentenceScores [B, W].
+    """
+    ids = ins["Ids"][0]
+    parents = ins["ParentIdx"][0].astype(jnp.int32)
+    scores = ins["Scores"][0]
+    end_id = int(attrs["end_id"])
+    B, T, W = ids.shape
+
+    ids_tm = jnp.moveaxis(ids, 1, 0)        # [T, B, W]
+    par_tm = jnp.moveaxis(parents, 1, 0)
+
+    def back(beam_ptr, step):
+        step_ids, step_par = step
+        tok = jnp.take_along_axis(step_ids, beam_ptr, axis=1)
+        prev = jnp.take_along_axis(step_par, beam_ptr, axis=1)
+        return prev, tok
+
+    init_ptr = jnp.tile(jnp.arange(W, dtype=jnp.int32)[None, :], (B, 1))
+    _, toks_rev = jax.lax.scan(back, init_ptr, (ids_tm[::-1], par_tm[::-1]))
+    sent = jnp.moveaxis(toks_rev[::-1], 0, 2)   # [B, W, T]
+
+    # pad everything after the first end_id with end_id
+    is_end = sent == end_id
+    seen_end = jnp.cumsum(is_end.astype(jnp.int32), axis=2) - is_end.astype(jnp.int32)
+    sent = jnp.where(seen_end > 0, jnp.asarray(end_id, sent.dtype), sent)
+    final_scores = scores[:, -1, :]
+    return {"SentenceIds": [sent], "SentenceScores": [final_scores]}
+
+
+@register_op("sequence_mask")
+def sequence_mask(ctx, ins, attrs):
+    """sequence_mask: lengths [B] -> [B, maxlen] 0/1 mask (dense analogue of
+    the LoD boundary info every LoD op consults implicitly)."""
+    from .sequence_ops import time_mask
+    x = ins["X"][0]
+    if ins.get("MaxLenRef"):
+        maxlen = ins["MaxLenRef"][0].shape[1]   # static at trace time
+    else:
+        maxlen = int(attrs["maxlen"])
+    dtype = attrs.get("out_dtype", "float32")
+    return {"Y": [time_mask(x.reshape(-1), maxlen, dtype)]}
+
+
+@register_op("batch_gather")
+def batch_gather(ctx, ins, attrs):
+    """Per-row gather: X [B, W, ...], Index [B, K] -> [B, K, ...]. The dense
+    analogue of the beam-state reorder the reference performs implicitly by
+    threading LoD through beam_search_op's selected candidates (and of
+    DynamicRNN memories' need_reorder path, control_flow.py:1313)."""
+    x = ins["X"][0]
+    idx = ins["Index"][0].astype(jnp.int32)
+    idxe = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.take_along_axis(x, idxe, axis=1)]}
+
+
+@register_op("lod_reset")
+def lod_reset(ctx, ins, attrs):
+    """lod_reset_op.cc: re-associate data with a new sequence structure. On
+    the padded representation the structure lives in VarDesc metadata, so
+    the device computation is identity; the front-end layer rewires the
+    @SEQ_LEN companion."""
+    return {"Out": [ins["X"][0]]}

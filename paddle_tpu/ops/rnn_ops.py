@@ -162,6 +162,7 @@ def dynamic_rnn(ctx, ins, attrs):
     B, T = xs_list[0].shape[0], xs_list[0].shape[1]
     dtype = xs_list[0].dtype if jnp.issubdtype(xs_list[0].dtype, jnp.floating) \
         else jnp.float32
+    mem_dtypes = list(attrs.get("memory_dtypes", []))
 
     init = []
     init_iter = iter(init_mems_in)
@@ -170,7 +171,8 @@ def dynamic_rnn(ctx, ins, attrs):
             init.append(next(init_iter))
         else:
             shape = (B,) + tuple(s for s in mem_shapes[i] if s != -1)
-            init.append(jnp.full(shape, mem_init_values[i], dtype))
+            mdt = mem_dtypes[i] if i < len(mem_dtypes) and mem_dtypes[i] else dtype
+            init.append(jnp.full(shape, mem_init_values[i], mdt))
 
     xs_tm = [jnp.moveaxis(x, 1, 0) for x in xs_list]
     mask_tm = jnp.moveaxis(time_mask(seq_len, T, jnp.float32), 1, 0)  # [T,B]
@@ -188,13 +190,13 @@ def dynamic_rnn(ctx, ins, attrs):
         new_mems = []
         for name, old in zip(mem_inner, mems):
             upd = env[mem_updates.get(name, name)]
-            mb = m.reshape((B,) + (1,) * (upd.ndim - 1)).astype(upd.dtype)
-            new_mems.append(mb * upd + (1 - mb) * old)
+            mb = m.reshape((B,) + (1,) * (upd.ndim - 1)) > 0
+            new_mems.append(jnp.where(mb, upd, old))
         outs = []
         for name in out_inner:
             v = env[name]
-            mb = m.reshape((B,) + (1,) * (v.ndim - 1)).astype(v.dtype)
-            outs.append(v * mb)
+            mb = m.reshape((B,) + (1,) * (v.ndim - 1)) > 0
+            outs.append(jnp.where(mb, v, jnp.zeros((), v.dtype)))
         return tuple(new_mems), tuple(outs)
 
     final_mems, stacked = jax.lax.scan(body, tuple(init),

@@ -275,7 +275,17 @@ def flatten(ctx, ins, attrs):
     return {"Out": [jnp.reshape(x, (int(np.prod(x.shape[:ax]) or 1), -1))]}
 
 
-@register_op("expand")
+def _expand_infer(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    times = op.attrs["expand_times"]
+    if x.shape and len(x.shape) == len(times):
+        out.shape = tuple(d * t if d != -1 else -1
+                          for d, t in zip(x.shape, times))
+    out.dtype = x.dtype
+
+
+@register_op("expand", infer_shape=_expand_infer)
 def expand(ctx, ins, attrs):
     x = ins["X"][0]
     times = attrs["expand_times"]

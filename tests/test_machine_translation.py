@@ -1,0 +1,141 @@
+"""Machine-translation model + beam-search op tests (≙ BASELINE config 5 and
+the reference's test_beam_search_op.py / test_beam_search_decode_op.py +
+book machine_translation chapter)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run_single(build, feed, nfetch=1):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        outs = build()
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    exe = pt.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=list(outs))
+
+
+def test_beam_search_op_golden(rng):
+    B, W, V = 2, 3, 7
+    pre_ids = rng.randint(2, V, (B, W)).astype(np.int64)
+    pre_ids[0, 1] = 1  # finished beam (end_id=1)
+    pre_scores = rng.randn(B, W).astype(np.float32)
+    probs = rng.rand(B, W, V).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+
+    def build():
+        pi = layers.data("pi", [W], dtype="int64")
+        ps = layers.data("ps", [W])
+        pr = layers.data("pr", [W, V])
+        return layers.beam_search(pi, ps, pr, beam_size=W, end_id=1)
+
+    ids, scores, parent = _run_single(
+        build, {"pi": pre_ids, "ps": pre_scores, "pr": probs})
+
+    # numpy reference
+    logp = np.log(np.maximum(probs, 1e-20))
+    total = pre_scores[:, :, None] + logp
+    for b in range(B):
+        for w in range(W):
+            if pre_ids[b, w] == 1:
+                total[b, w, :] = -1e9
+                total[b, w, 1] = pre_scores[b, w]
+    flat = total.reshape(B, W * V)
+    top = np.argsort(-flat, axis=1)[:, :W]
+    np.testing.assert_array_equal(parent, top // V)
+    np.testing.assert_array_equal(ids, top % V)
+    np.testing.assert_allclose(scores, np.take_along_axis(flat, top, 1),
+                               rtol=1e-5)
+
+
+def test_beam_search_decode_backtrack():
+    # T=3, W=2: hand-crafted parent chain
+    # step0: beams pick tokens [5, 6], parents [0, 0]
+    # step1: tokens [7, 8], parents [1, 0] (beam0 extends old beam1)
+    # step2: tokens [9, 1], parents [0, 1]
+    ids = np.array([[[5, 6], [7, 8], [9, 1]]], np.int64)       # [1,3,2]
+    parents = np.array([[[0, 0], [1, 0], [0, 1]]], np.int64)
+    scores = np.tile(np.array([[-1.0, -2.0]], np.float32), (1, 3, 1))
+
+    def build():
+        i = layers.data("i", [3, 2], dtype="int64")
+        p = layers.data("p", [3, 2], dtype="int64")
+        s = layers.data("s", [3, 2])
+        return layers.beam_search_decode(i, p, s, beam_size=2, end_id=1)
+
+    sent, sc = _run_single(build, {"i": ids, "p": parents, "s": scores})
+    # beam0 final: step2 tok 9 parent 0 <- step1 tok 7 parent 1 <- step0 tok 6
+    np.testing.assert_array_equal(sent[0, 0], [6, 7, 9])
+    # beam1 final: step2 tok 1(end) parent 1 <- step1 tok 8 parent 0 <- tok 5
+    np.testing.assert_array_equal(sent[0, 1], [5, 8, 1])
+    np.testing.assert_allclose(sc[0], [-1.0, -2.0])
+
+
+def _toy_batch(rng, B, vocab, tmin=3, tmax=7):
+    """Copy-task batches: target = source, label = source shifted."""
+    srcs, trgs, lbls = [], [], []
+    for _ in range(B):
+        T = rng.randint(tmin, tmax)
+        s = rng.randint(2, vocab, (T, 1)).astype(np.int64)
+        srcs.append(s)
+        trgs.append(s)
+        lbl = np.concatenate([s[1:], [[1]]]).astype(np.int64)
+        lbls.append(lbl)
+    return {"source_sequence": srcs, "target_sequence": trgs,
+            "label_sequence": lbls}
+
+
+VOCAB = 40
+DIMS = dict(source_dict_dim=VOCAB, target_dict_dim=VOCAB, embedding_dim=16,
+            encoder_size=16, decoder_size=16)
+
+
+def test_mt_attention_train(rng):
+    from paddle_tpu.models import machine_translation as mt
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        loss, pred, feeds = mt.train_net(learning_rate=5e-3, **DIMS)
+    exe = pt.Executor()
+    exe.run(startup)
+    losses = []
+    for i in range(20):
+        (l,) = exe.run(main, feed=_toy_batch(rng, 8, VOCAB),
+                       fetch_list=[loss])
+        losses.append(float(np.ravel(l)[0]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_mt_beam_decode(rng):
+    from paddle_tpu.models import machine_translation as mt
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        train, startup = pt.Program(), pt.Program()
+        with pt.program_guard(train, startup):
+            loss, pred, _ = mt.train_net(learning_rate=5e-3, **DIMS)
+        exe = pt.Executor()
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(train, feed=_toy_batch(rng, 4, VOCAB), fetch_list=[loss])
+
+        infer = pt.Program()
+        infer_startup = pt.Program()
+        with pt.program_guard(infer, infer_startup):
+            sent, scores, feeds = mt.decode_net(
+                beam_size=3, max_length=6, start_id=0, end_id=1, **DIMS)
+        srcs = [rng.randint(2, VOCAB, (5, 1)).astype(np.int64)
+                for _ in range(2)]
+        got_sent, got_scores = exe.run(
+            infer, feed={"source_sequence": srcs},
+            fetch_list=[sent, scores])
+    assert got_sent.shape == (2, 3, 6)
+    assert got_scores.shape == (2, 3)
+    assert (got_sent >= 0).all() and (got_sent < VOCAB).all()
+    assert np.isfinite(got_scores).all()
+    # beams must be sorted best-first
+    assert (np.diff(got_scores, axis=1) <= 1e-5).all()
