@@ -48,6 +48,24 @@ class NormalInitializer(Initializer):
                          "mean": self.loc, "std": self.scale, "seed": self.seed})
 
 
+class NumpyArrayInitializer(Initializer):
+    """≙ reference NumpyArrayInitializer: init from a literal array via the
+    assign_value op."""
+
+    def __init__(self, value):
+        import numpy as np
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        if tuple(var.shape) and tuple(self.value.shape) != tuple(var.shape):
+            raise ValueError(
+                f"NumpyArrayInitializer for {var.name}: value shape "
+                f"{self.value.shape} != parameter shape {var.shape}")
+        block.append_op("assign_value", {}, {"Out": var.name},
+                        {"shape": list(self.value.shape), "dtype": var.dtype,
+                         "values": self.value.reshape(-1).tolist()})
+
+
 class TruncatedNormalInitializer(Initializer):
     def __init__(self, loc: float = 0.0, scale: float = 1.0, seed: int = 0):
         self.loc, self.scale, self.seed = loc, scale, seed

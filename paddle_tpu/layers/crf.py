@@ -1,0 +1,95 @@
+"""CRF / CTC layers (≙ layers/nn.py linear_chain_crf, crf_decoding,
+ctc_greedy_decoder, chunk_eval around nn.py:~900-1100 in the reference)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from .sequence import _seq_len_of, _mark_seq
+
+__all__ = ["linear_chain_crf", "crf_decoding", "ctc_greedy_decoder",
+           "chunk_eval"]
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """≙ nn.py linear_chain_crf: creates the [N+2, N] transition parameter
+    (row 0 start, row 1 end, rest N x N) and emits the CRF NLL [B, 1]."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(helper.param_attr,
+                                         [size + 2, size], input.dtype)
+    alpha = helper.create_tmp_variable(input.dtype)
+    emission_exps = helper.create_tmp_variable(input.dtype)
+    transition_exps = helper.create_tmp_variable(input.dtype)
+    log_likelihood = helper.create_tmp_variable(input.dtype)
+    helper.append_op(
+        "linear_chain_crf",
+        {"Emission": input, "Transition": transition, "Label": label,
+         "SeqLen": _seq_len_of(input, helper)},
+        {"LogLikelihood": log_likelihood, "Alpha": alpha,
+         "EmissionExps": emission_exps, "TransitionExps": transition_exps})
+    log_likelihood.shape = (input.shape[0], 1)
+    log_likelihood.dtype = input.dtype
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr=None, label=None):
+    """≙ nn.py crf_decoding: Viterbi path (or 0/1 correctness marks when
+    `label` is given). Reuses the transition parameter by ParamAttr name."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(helper.param_attr,
+                                         [size + 2, size], input.dtype)
+    path = helper.create_tmp_variable("int64")
+    path.stop_gradient = True
+    inputs = {"Emission": input, "Transition": transition,
+              "SeqLen": _seq_len_of(input, helper)}
+    if label is not None:
+        inputs["Label"] = label
+    helper.append_op("crf_decoding", inputs, {"ViterbiPath": path})
+    path.shape = tuple(input.shape[:2])
+    return _mark_seq(path, input.seq_len_var)
+
+
+def ctc_greedy_decoder(input, blank, padding_value=0, name=None):
+    """≙ nn.py ctc_greedy_decoder: argmax over classes then ctc_align
+    (merge repeats, drop blanks)."""
+    from . import nn as nn_layers
+    helper = LayerHelper("ctc_align", name=name)
+    _, top_idx = nn_layers.topk(input, 1)
+    pred = nn_layers.squeeze(top_idx, [2])
+    out = helper.create_tmp_variable(pred.dtype)
+    out_len = helper.create_tmp_variable("int32")
+    out.stop_gradient = out_len.stop_gradient = True
+    helper.append_op("ctc_align",
+                     {"Input": pred, "SeqLen": _seq_len_of(input, helper)},
+                     {"Output": out, "OutLen": out_len},
+                     {"blank": blank, "padding_value": padding_value})
+    out.shape = tuple(input.shape[:2])
+    out_len.shape = (input.shape[0],)
+    out_len.persistable = False
+    _mark_seq(out, out_len.name)
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """≙ nn.py chunk_eval: chunk-level P/R/F1 + raw counts."""
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_tmp_variable("float32")
+    recall = helper.create_tmp_variable("float32")
+    f1 = helper.create_tmp_variable("float32")
+    num_infer = helper.create_tmp_variable("int64")
+    num_label = helper.create_tmp_variable("int64")
+    num_correct = helper.create_tmp_variable("int64")
+    for v in (precision, recall, f1, num_infer, num_label, num_correct):
+        v.stop_gradient = True
+    helper.append_op(
+        "chunk_eval",
+        {"Inference": input, "Label": label,
+         "SeqLen": _seq_len_of(input, helper)},
+        {"Precision": precision, "Recall": recall, "F1-Score": f1,
+         "NumInferChunks": num_infer, "NumLabelChunks": num_label,
+         "NumCorrectChunks": num_correct},
+        {"num_chunk_types": num_chunk_types, "chunk_scheme": chunk_scheme,
+         "excluded_chunk_types": excluded_chunk_types or []})
+    return precision, recall, f1, num_infer, num_label, num_correct

@@ -626,13 +626,21 @@ def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
 
 
 def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss (≙ nn.py warpctc): input [B,T,C] raw logits (sequence var),
+    label [B,L] int sequence var; returns Loss [B,1]."""
+    from .sequence import _seq_len_of
     helper = LayerHelper("warpctc")
     loss = helper.create_tmp_variable(input.dtype)
     grad = helper.create_tmp_variable(input.dtype)
     grad.stop_gradient = True
-    helper.append_op("warpctc", {"Logits": input, "Label": label},
+    helper.append_op("warpctc",
+                     {"Logits": input, "Label": label,
+                      "LogitsLen": _seq_len_of(input, helper),
+                      "LabelLen": _seq_len_of(label, helper)},
                      {"Loss": loss, "WarpCTCGrad": grad},
                      {"blank": blank, "norm_by_times": norm_by_times})
+    loss.shape = (input.shape[0], 1)
+    loss.dtype = input.dtype
     return loss
 
 

@@ -1,0 +1,307 @@
+"""Structured-prediction ops: linear-chain CRF, Viterbi decoding, CTC loss,
+CTC alignment, chunk evaluation.
+
+≙ reference linear_chain_crf_op.cc, crf_decoding_op.cc, warpctc_op.cc
+(external warp-ctc dynload), ctc_align_op.cu, chunk_eval_op.cc. The
+reference runs these on the host or via hand-written CUDA/warp-ctc; here
+each is a log-domain lax.scan over the padded time axis — fully
+differentiable through scan's VJP (the reference needed warp-ctc's
+hand-written gradient; CTC grads here come from jax.grad for free).
+
+Transition layout follows the reference (linear_chain_crf_op.h):
+Transition [N+2, N] with row 0 = start weights, row 1 = end weights,
+rows 2.. = the N x N transition matrix.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .sequence_ops import time_mask
+
+_NEG = -1e30
+
+
+def _split_transition(w):
+    return w[0], w[1], w[2:]  # start, end, trans[N,N]
+
+
+@register_op("linear_chain_crf")
+def linear_chain_crf(ctx, ins, attrs):
+    """Negative log-likelihood of a linear-chain CRF
+    (≙ LinearChainCRFOpKernel::Compute, linear_chain_crf_op.h).
+
+    Emission [B,T,N], Transition [N+2,N], Label [B,T] or [B,T,1] int,
+    SeqLen [B] -> LogLikelihood [B,1] (the reference's output name; its
+    value is the *negative* log-likelihood used directly as the cost)."""
+    em = ins["Emission"][0]
+    w = ins["Transition"][0].astype(em.dtype)
+    label = ins["Label"][0]
+    seq_len = ins["SeqLen"][0]
+    if label.ndim == 3:
+        label = label.reshape(label.shape[:2])
+    label = label.astype(jnp.int32)
+    B, T, N = em.shape
+    start, end, trans = _split_transition(w)
+    mask = time_mask(seq_len, T, em.dtype)              # [B,T]
+    t_idx = jnp.arange(T)
+
+    # ---- log partition via forward algorithm -------------------------------
+    alpha0 = start[None, :] + em[:, 0, :]               # [B,N]
+
+    def fwd(alpha, inp):
+        em_t, m = inp                                   # [B,N], [B]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) + em_t
+        return jnp.where(m[:, None] > 0, nxt, alpha), None
+
+    em_tm = jnp.moveaxis(em, 1, 0)
+    alpha, _ = jax.lax.scan(fwd, alpha0, (em_tm[1:], mask.T[1:]))
+    log_z = jax.nn.logsumexp(alpha + end[None, :], axis=1)     # [B]
+
+    # ---- gold path score ---------------------------------------------------
+    em_score = jnp.sum(
+        jnp.take_along_axis(em, label[:, :, None], axis=2)[..., 0] * mask,
+        axis=1)
+    pair_valid = mask[:, 1:]                                  # [B,T-1]
+    tr_score = jnp.sum(trans[label[:, :-1], label[:, 1:]] * pair_valid, axis=1)
+    last_idx = jnp.maximum(seq_len - 1, 0).astype(jnp.int32)
+    last_lbl = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    gold = em_score + tr_score + start[label[:, 0]] + end[last_lbl]
+
+    nll = (log_z - gold)[:, None]
+    return {"LogLikelihood": [nll], "Alpha": [alpha],
+            "EmissionExps": [jnp.exp(em)],
+            "TransitionExps": [jnp.exp(w)]}
+
+
+@register_op("crf_decoding")
+def crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (≙ CRFDecodingOpKernel, crf_decoding_op.h).
+    Without Label: ViterbiPath [B,T] = best tag ids (0 beyond length).
+    With Label: [B,T] 0/1 where 1 marks positions whose decoded tag equals
+    the label within the sequence (the reference's error-marking mode)."""
+    em = ins["Emission"][0]
+    w = ins["Transition"][0].astype(em.dtype)
+    seq_len = ins["SeqLen"][0]
+    B, T, N = em.shape
+    start, end, trans = _split_transition(w)
+    mask = time_mask(seq_len, T, em.dtype)
+
+    delta0 = start[None, :] + em[:, 0, :]
+
+    def vit(delta, inp):
+        em_t, m = inp
+        scores = delta[:, :, None] + trans[None]        # [B,N,N]
+        best_prev = jnp.argmax(scores, axis=1)          # [B,N]
+        nxt = jnp.max(scores, axis=1) + em_t
+        keep = m[:, None] > 0
+        return (jnp.where(keep, nxt, delta),
+                jnp.where(keep, best_prev,
+                          jnp.arange(N, dtype=best_prev.dtype)[None, :]))
+
+    em_tm = jnp.moveaxis(em, 1, 0)
+    delta, backptr = jax.lax.scan(vit, delta0, (em_tm[1:], mask.T[1:]))
+    # backptr [T-1,B,N]; identity rows where step was masked
+    last_tag = jnp.argmax(delta + end[None, :], axis=1).astype(jnp.int32)
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev.astype(jnp.int32), tag
+
+    first_tag, tags_rev = jax.lax.scan(back, last_tag, backptr[::-1])
+    path = jnp.concatenate([first_tag[None], tags_rev[::-1]], axis=0).T  # [B,T]
+    path = (path * mask.astype(path.dtype)).astype(jnp.int64)
+
+    if ins.get("Label"):
+        label = ins["Label"][0]
+        if label.ndim == 3:
+            label = label.reshape(label.shape[:2])
+        hit = (path == label.astype(path.dtype)) & (mask > 0)
+        return {"ViterbiPath": [hit.astype(jnp.int64)]}
+    return {"ViterbiPath": [path]}
+
+
+@register_op("warpctc")
+def warpctc(ctx, ins, attrs):
+    """CTC loss (≙ warpctc_op.cc, which dynloads Baidu warp-ctc). Log-domain
+    alpha recursion over the extended blank-interleaved label, one lax.scan
+    over time for the whole batch; gradients come from autodiff rather than
+    warp-ctc's hand-written backward.
+
+    Logits [B,T,C] raw (softmax applied internally, as warp-ctc does),
+    Label [B,L] int (padded), LogitsLen [B], LabelLen [B] -> Loss [B,1]."""
+    logits = ins["Logits"][0]
+    labels = ins["Label"][0]
+    if labels.ndim == 3:
+        labels = labels.reshape(labels.shape[:2])
+    labels = labels.astype(jnp.int32)
+    logit_len = ins["LogitsLen"][0].astype(jnp.int32)
+    label_len = ins["LabelLen"][0].astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    B, T, C = logits.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, jnp.int32).at[:, 1::2].set(labels)
+    s_idx = jnp.arange(S)
+    valid_s = s_idx[None, :] < (2 * label_len[:, None] + 1)
+    # skip-transition allowed: s>=2, ext[s] != blank, ext[s] != ext[s-2]
+    can_skip = (s_idx[None, :] >= 2) & (ext != blank) & \
+        (ext != jnp.roll(ext, 2, axis=1))
+
+    def emit(t):
+        return jnp.take_along_axis(logp[:, t, :], ext, axis=1)  # [B,S]
+
+    alpha = jnp.full((B, S), _NEG)
+    alpha = alpha.at[:, 0].set(logp[:, 0, blank])
+    alpha = alpha.at[:, 1].set(jnp.where(label_len > 0,
+                                         emit(0)[:, 1], _NEG))
+
+    def step(alpha, inp):
+        logp_t, live = inp                              # [B,C], [B]
+        em_t = jnp.take_along_axis(logp_t, ext, axis=1)
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), _NEG), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), _NEG), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(can_skip, prev2, _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        nxt = jnp.where(valid_s, merged + em_t, _NEG)
+        return jnp.where(live[:, None] > 0, nxt, alpha), None
+
+    live = time_mask(logit_len, T, jnp.float32).T[1:]   # [T-1,B]
+    alpha, _ = jax.lax.scan(step, alpha, (jnp.moveaxis(logp, 1, 0)[1:], live))
+
+    end1 = jnp.take_along_axis(alpha, (2 * label_len)[:, None], axis=1)[:, 0]
+    end2_idx = jnp.maximum(2 * label_len - 1, 0)
+    end2 = jnp.where(label_len > 0,
+                     jnp.take_along_axis(alpha, end2_idx[:, None],
+                                         axis=1)[:, 0], _NEG)
+    total = jnp.logaddexp(end1, end2)
+    # infeasible label/time combinations (e.g. repeats needing more frames
+    # than available): warp-ctc reports zero cost and zero gradient rather
+    # than a saturated sentinel; `where` cuts the gradient path too
+    feasible = total > _NEG / 2
+    loss = jnp.where(feasible, -total, 0.0)
+    if attrs.get("norm_by_times", False):
+        # the reference (warpctc_op.h) scales only the *gradient* by 1/T,
+        # leaving the reported Loss untouched — reproduce that through
+        # autodiff with a value-preserving, grad-scaling identity
+        t = jnp.maximum(logit_len, 1).astype(loss.dtype)
+        scaled = loss / t
+        loss = scaled + jax.lax.stop_gradient(loss - scaled)
+    return {"Loss": [loss[:, None]],
+            "WarpCTCGrad": [jnp.zeros_like(logits)]}
+
+
+@register_op("ctc_align")
+def ctc_align(ctx, ins, attrs):
+    """CTC greedy alignment (≙ ctc_align_op.cu): merge repeats, drop
+    blanks. Input [B,T] int + SeqLen; Output [B,T] left-compacted ids
+    padded with `padding_value`, plus OutLen [B]."""
+    x = ins["Input"][0]
+    if x.ndim == 3:
+        x = x.reshape(x.shape[:2])
+    seq_len = ins["SeqLen"][0]
+    blank = int(attrs.get("blank", 0))
+    pad = int(attrs.get("padding_value", 0))
+    B, T = x.shape
+    m = time_mask(seq_len, T, jnp.bool_)
+    prev = jnp.concatenate([jnp.full((B, 1), -1, x.dtype), x[:, :-1]], axis=1)
+    keep = (x != prev) & (x != blank) & m
+    # stable left-compaction: order keeps first, preserving time order
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compact = jnp.take_along_axis(x, order, axis=1)
+    out_len = keep.sum(axis=1).astype(jnp.int32)
+    pos = jnp.arange(T)[None, :]
+    out = jnp.where(pos < out_len[:, None], compact,
+                    jnp.asarray(pad, x.dtype))
+    return {"Output": [out], "OutLen": [out_len]}
+
+
+def _chunk_marks(tags, types, scheme):
+    """Per-position chunk start/end flags from scheme-coded labels.
+    Encoding follows chunk_eval_op.h: label = type * num_tag + tag with
+    tag order B,I (IOB) / I,E (IOE) / B,I,E,S (IOBES); `plain` = every
+    position its own chunk."""
+    if scheme == "plain":
+        return jnp.ones_like(tags, bool), jnp.ones_like(tags, bool)
+    prev_types = jnp.concatenate([jnp.full_like(types[:, :1], -1),
+                                  types[:, :-1]], axis=1)
+    next_types = jnp.concatenate([types[:, 1:],
+                                  jnp.full_like(types[:, :1], -1)], axis=1)
+    prev_tags = jnp.concatenate([jnp.full_like(tags[:, :1], -1),
+                                 tags[:, :-1]], axis=1)
+    next_tags = jnp.concatenate([tags[:, 1:],
+                                 jnp.full_like(tags[:, :1], -1)], axis=1)
+    if scheme == "IOB":      # tags: B=0, I=1
+        start = (tags == 0) | ((tags == 1) & ((prev_types != types) |
+                                              (prev_tags == -1)))
+        end = ((next_tags == 0) | (next_types != types) | (next_tags == -1))
+    elif scheme == "IOE":    # tags: I=0, E=1
+        start = ((prev_tags == -1) | (prev_types != types) |
+                 (prev_tags == 1))
+        end = (tags == 1) | (next_types != types) | (next_tags == -1)
+    elif scheme == "IOBES":  # B=0, I=1, E=2, S=3
+        start = (tags == 0) | (tags == 3)
+        end = (tags == 2) | (tags == 3)
+    else:
+        raise ValueError(f"unknown chunk scheme {scheme}")
+    return start, end
+
+
+@register_op("chunk_eval")
+def chunk_eval(ctx, ins, attrs):
+    """Chunk-level precision/recall/F1 (≙ chunk_eval_op.h). A chunk is
+    correct when inference and label agree on (start, end, type). Matching
+    is fully vectorized: each end position is annotated with its chunk's
+    start via a running cummax over start positions."""
+    inf = ins["Inference"][0]
+    lab = ins["Label"][0]
+    if inf.ndim == 3:
+        inf = inf.reshape(inf.shape[:2])
+    if lab.ndim == 3:
+        lab = lab.reshape(lab.shape[:2])
+    seq_len = ins["SeqLen"][0]
+    num_types = int(attrs["num_chunk_types"])
+    scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = list(attrs.get("excluded_chunk_types", []) or [])
+    num_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    B, T = inf.shape
+    m = time_mask(seq_len, T, jnp.bool_)
+    pos = jnp.arange(T)[None, :]
+
+    def analyze(x):
+        x = x.astype(jnp.int32)
+        inside = (x >= 0) & (x < num_types * num_tag) & m
+        tags = jnp.where(inside, x % num_tag, -1)
+        types = jnp.where(inside, x // num_tag, -1)
+        for ex in excluded:
+            inside = inside & (types != ex)
+        start, end = _chunk_marks(tags, types, scheme)
+        start = start & inside
+        end = end & inside
+        # start index of the chunk covering each position
+        run_start = jax.lax.cummax(jnp.where(start, pos, -1), axis=1)
+        return start, end, types, run_start, inside
+
+    i_s, i_e, i_ty, i_run, i_in = analyze(inf)
+    l_s, l_e, l_ty, l_run, l_in = analyze(lab)
+    num_inf = i_e.sum()
+    num_lab = l_e.sum()
+    correct = (i_e & l_e & (i_run == l_run) & (i_ty == l_ty)).sum()
+
+    p = correct / jnp.maximum(num_inf, 1)
+    r = correct / jnp.maximum(num_lab, 1)
+    f1 = jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+    as_f = lambda v: jnp.asarray(v, jnp.float32).reshape(1)
+    as_i = lambda v: jnp.asarray(v, jnp.int64).reshape(1)
+    return {"Precision": [as_f(p)], "Recall": [as_f(r)],
+            "F1-Score": [as_f(f1)],
+            "NumInferChunks": [as_i(num_inf)],
+            "NumLabelChunks": [as_i(num_lab)],
+            "NumCorrectChunks": [as_i(correct)]}

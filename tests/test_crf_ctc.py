@@ -1,0 +1,227 @@
+"""CRF / CTC / chunk_eval op tests vs brute-force numpy references
+(≙ reference test_linear_chain_crf_op.py, test_crf_decoding_op.py,
+test_warpctc_op.py, test_ctc_align_op.py, test_chunk_eval_op.py)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(build, feed):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        outs = build()
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    exe = pt.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=list(outs))
+
+
+# ---------------------------------------------------------------------------
+# brute-force references
+# ---------------------------------------------------------------------------
+
+def crf_brute(em, w, lens):
+    """Enumerate all paths: returns (logZ, score_fn)."""
+    start, end, trans = w[0], w[1], w[2:]
+    N = em.shape[-1]
+
+    def path_score(b, path):
+        s = start[path[0]] + end[path[-1]]
+        for t, y in enumerate(path):
+            s += em[b, t, y]
+        for t in range(1, len(path)):
+            s += trans[path[t - 1], path[t]]
+        return s
+
+    logZ, best = [], []
+    for b, L in enumerate(lens):
+        scores = [path_score(b, p)
+                  for p in itertools.product(range(N), repeat=L)]
+        logZ.append(np.logaddexp.reduce(scores))
+        best.append(max(itertools.product(range(N), repeat=L),
+                        key=lambda p: path_score(b, p)))
+    return np.array(logZ), path_score, best
+
+
+def ctc_brute(logits, labels, T, blank):
+    """Sum softmax path probabilities over all alignments of `labels`."""
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+
+    def collapse(path):
+        out, prev = [], None
+        for c in path:
+            if c != prev and c != blank:
+                out.append(c)
+            prev = c
+        return tuple(out)
+
+    total = 0.0
+    C = p.shape[-1]
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == tuple(labels):
+            total += np.prod([p[t, c] for t, c in enumerate(path)])
+    return -np.log(total)
+
+
+# ---------------------------------------------------------------------------
+
+def test_linear_chain_crf_matches_bruteforce(rng):
+    B, T, N = 3, 4, 3
+    em = rng.randn(B, T, N).astype(np.float32)
+    lens = np.array([4, 2, 3], np.int32)
+    lbl = rng.randint(0, N, (B, T)).astype(np.int64)
+    w = (rng.randn(N + 2, N) * 0.3).astype(np.float32)
+
+    def build():
+        e = layers.data("em", [N], lod_level=1)
+        l = layers.data("lbl", [1], dtype="int64", lod_level=1)
+        nll = layers.linear_chain_crf(
+            e, l, param_attr=pt.ParamAttr(
+                name="crf_w", initializer=pt.initializer.NumpyArrayInitializer(w)))
+        return nll
+
+    (nll,) = _run(build, {"em": em, "em@SEQ_LEN": lens,
+                          "lbl": lbl[..., None], "lbl@SEQ_LEN": lens})
+    logZ, path_score, _ = crf_brute(em, w, lens)
+    for b in range(B):
+        gold = path_score(b, list(lbl[b, :lens[b]]))
+        np.testing.assert_allclose(nll[b, 0], logZ[b] - gold, rtol=2e-4)
+
+
+def test_crf_decoding_matches_bruteforce(rng):
+    B, T, N = 3, 4, 3
+    em = rng.randn(B, T, N).astype(np.float32)
+    lens = np.array([4, 2, 3], np.int32)
+    w = (rng.randn(N + 2, N) * 0.3).astype(np.float32)
+
+    def build():
+        e = layers.data("em", [N], lod_level=1)
+        return layers.crf_decoding(e, param_attr=pt.ParamAttr(
+            name="crf_w", initializer=pt.initializer.NumpyArrayInitializer(w)))
+
+    (path,) = _run(build, {"em": em, "em@SEQ_LEN": lens})
+    _, _, best = crf_brute(em, w, lens)
+    for b in range(B):
+        np.testing.assert_array_equal(path[b, :lens[b]], best[b])
+        np.testing.assert_array_equal(path[b, lens[b]:], 0)
+
+
+def test_warpctc_matches_bruteforce(rng):
+    B, T, C, L = 2, 4, 3, 2
+    logits = rng.randn(B, T, C).astype(np.float32)
+    labels = np.array([[1, 2], [2, 0]], np.int64)  # 0 row2 pad beyond len
+    logit_len = np.array([4, 3], np.int32)
+    label_len = np.array([2, 1], np.int32)
+
+    def build():
+        x = layers.data("x", [C], lod_level=1)
+        l = layers.data("l", [1], dtype="int64", lod_level=1)
+        return layers.warpctc(x, l, blank=0)
+
+    (loss,) = _run(build, {"x": logits, "x@SEQ_LEN": logit_len,
+                           "l": labels[..., None], "l@SEQ_LEN": label_len})
+    for b in range(B):
+        want = ctc_brute(logits[b, :logit_len[b]],
+                         labels[b, :label_len[b]], logit_len[b], blank=0)
+        np.testing.assert_allclose(loss[b, 0], want, rtol=1e-4)
+
+
+def test_warpctc_trains(rng):
+    """CTC loss must be differentiable end-to-end (autodiff replaces
+    warp-ctc's hand-written gradient)."""
+    B, T, C = 4, 6, 5
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], lod_level=1)
+        logits = layers.fc(x, size=C, num_flatten_dims=2)
+        from paddle_tpu.layers.sequence import propagate_seq
+        propagate_seq(x, logits)
+        loss = layers.mean(layers.warpctc(logits, layers.data(
+            "l", [1], dtype="int64", lod_level=1), blank=0))
+        pt.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    feats = rng.randn(B, T, 8).astype(np.float32)
+    flen = np.full(B, T, np.int32)
+    labels = rng.randint(1, C, (B, 3, 1)).astype(np.int64)
+    llen = np.full(B, 3, np.int32)
+    losses = []
+    for _ in range(25):
+        (l,) = exe.run(main, feed={"x": feats, "x@SEQ_LEN": flen,
+                                   "l": labels, "l@SEQ_LEN": llen},
+                       fetch_list=[loss])
+        losses.append(float(np.ravel(l)[0]))
+    assert losses[-1] < losses[0] - 0.5
+
+
+def test_ctc_align_golden():
+    x = np.array([[0, 1, 1, 0, 2, 2, 0, 3],
+                  [2, 2, 2, 0, 0, 1, 0, 0]], np.int64)
+    lens = np.array([8, 6], np.int32)
+
+    def build():
+        d = layers.data("d", [1], dtype="int64", lod_level=1)
+        from paddle_tpu.layer_helper import LayerHelper
+        h = LayerHelper("ctc_align")
+        out = h.create_tmp_variable("int64")
+        olen = h.create_tmp_variable("int32")
+        h.append_op("ctc_align", {"Input": d, "SeqLen": "d@SEQ_LEN"},
+                    {"Output": out, "OutLen": olen},
+                    {"blank": 0, "padding_value": 0})
+        return out, olen
+
+    out, olen = _run(build, {"d": x[..., None], "d@SEQ_LEN": lens})
+    np.testing.assert_array_equal(olen, [3, 2])
+    np.testing.assert_array_equal(out[0, :3], [1, 2, 3])
+    np.testing.assert_array_equal(out[1, :2], [2, 1])
+    assert (out[0, 3:] == 0).all() and (out[1, 2:] == 0).all()
+
+
+def test_ctc_greedy_decoder(rng):
+    B, T, C = 2, 5, 4
+    x = rng.randn(B, T, C).astype(np.float32)
+    lens = np.array([5, 3], np.int32)
+
+    def build():
+        d = layers.data("d", [C], lod_level=1)
+        return layers.ctc_greedy_decoder(d, blank=0)
+
+    (out,) = _run(build, {"d": x, "d@SEQ_LEN": lens})
+    # manual reference
+    for b in range(B):
+        pred = x[b, :lens[b]].argmax(-1)
+        ref, prev = [], None
+        for c in pred:
+            if c != prev and c != 0:
+                ref.append(c)
+            prev = c
+        np.testing.assert_array_equal(out[b, :len(ref)], ref)
+
+
+def test_chunk_eval_iob():
+    # IOB, 2 types: tags B=0,I=1 => labels: type*2+tag
+    # seq: [B0 I0 B1 I1 I1 O] with O encoded as num_types*num_tag=4
+    lab = np.array([[0, 1, 2, 3, 3, 4]], np.int64)
+    inf = np.array([[0, 1, 2, 3, 4, 4]], np.int64)  # second chunk cut short
+    lens = np.array([6], np.int32)
+
+    def build():
+        i = layers.data("i", [1], dtype="int64", lod_level=1)
+        l = layers.data("l", [1], dtype="int64", lod_level=1)
+        return layers.chunk_eval(i, l, chunk_scheme="IOB", num_chunk_types=2)
+
+    p, r, f1, ni, nl, nc = _run(build, {
+        "i": inf[..., None], "i@SEQ_LEN": lens,
+        "l": lab[..., None], "l@SEQ_LEN": lens})
+    assert int(nl[0]) == 2
+    assert int(ni[0]) == 2
+    assert int(nc[0]) == 1          # only the first chunk matches exactly
+    np.testing.assert_allclose(p[0], 0.5)
+    np.testing.assert_allclose(r[0], 0.5)
