@@ -120,7 +120,7 @@ def run_block_with_autodiff(block: Block, env: Dict[str, object], ctx: ExecConte
 
 def build_step_fn(program: Program, feed_names: Sequence[str],
                   fetch_names: Sequence[str], state_in_names: Sequence[str],
-                  is_test: bool = False):
+                  is_test: bool = False, mesh=None):
     """Build the pure step function for block 0 of `program`.
 
     Returns (step, state_out_names): state_out_names is the set of
@@ -147,7 +147,7 @@ def build_step_fn(program: Program, feed_names: Sequence[str],
     state_out_names = state_in + persist_written
 
     def step(state: Dict[str, object], feed: Dict[str, object], rng):
-        ctx = ExecContext(rng, is_test=is_test)
+        ctx = ExecContext(rng, is_test=is_test, mesh=mesh)
         env: Dict[str, object] = {}
         env.update(state)
         env.update(feed)

@@ -76,10 +76,15 @@ class ExecContext:
     for jit purity.
     """
 
-    def __init__(self, rng_key, is_test: bool = False):
+    def __init__(self, rng_key, is_test: bool = False, mesh=None):
         self._rng_key = rng_key
         self._rng_counter = 0
         self.is_test = is_test
+        # Mesh the enclosing jit is partitioned over (None single-chip).
+        # Ops that lower into shard_map (ring attention) read this — the
+        # functional stand-in for the reference's DeviceContextPool device
+        # topology (device_context.h:173).
+        self.mesh = mesh
 
     def next_rng_key(self):
         import jax

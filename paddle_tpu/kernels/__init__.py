@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference keeps its hand-written kernel substrate in
+`paddle/fluid/operators/math/*.cu` and `paddle/cuda/src/hl_*.cu`; here the
+equivalent role is played by Pallas kernels that XLA cannot synthesize as
+well on its own (flash attention's online-softmax tiling, primarily).
+Everything else rides XLA fusion.
+"""
+
+from .flash_attention import dot_product_attention, flash_attention  # noqa: F401

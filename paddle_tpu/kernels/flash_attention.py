@@ -1,0 +1,294 @@
+"""Flash attention: Pallas TPU kernel + XLA reference path.
+
+The reference framework (2018 snapshot) has no attention op at all —
+attention is composed from matmul/softmax layers (e.g. the dot-product
+attention in python/paddle/fluid/nets.py and the seq2seq attention in
+tests/book machine_translation). On TPU the composed form materializes the
+[seq, seq] score matrix in HBM; this kernel keeps the score tiles in VMEM
+with the online-softmax recurrence, which is what makes long-context
+training feasible (HBM traffic O(S·d) instead of O(S²)).
+
+Layout convention: q, k, v are [batch, seq, heads, head_dim] ("BSHD").
+
+Forward is a Pallas kernel (grid over batch*heads × q-blocks × k-blocks,
+f32 accumulators in VMEM scratch). Backward is a custom VJP that recomputes
+attention blockwise from the saved logsumexp — standard flash-attention-2
+style — expressed in jnp so XLA schedules its matmuls on the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU backend of pallas; absent on some CPU-only wheels
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+# ---------------------------------------------------------------------------
+# Reference (XLA) implementation — also the CPU path and the numerics oracle
+# ---------------------------------------------------------------------------
+
+def mha_reference(q, k, v, bias=None, *, causal: bool = False,
+                  scale: Optional[float] = None):
+    """Plain attention. q,k,v: [B, S, H, D] (k/v may have S_kv != S_q)."""
+    d = q.shape[-1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(s.dtype)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        qi = jnp.arange(sq)[:, None] + (sk - sq)
+        ki = jnp.arange(sk)[None, :]
+        s = jnp.where(ki <= qi, s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
+                n_k, q_off):
+    """One (batch*head, q-block, k-block) grid step.
+
+    q_ref: [block_q, d]; k_ref/v_ref: [block_k, d]; accumulators live in
+    VMEM scratch across the k grid dimension (the innermost, sequential one).
+    """
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    iq = pl.program_id(1)
+    run = True
+    if causal:
+        # bottom-right alignment: q row i sits at global position i + q_off
+        # (matches mha_reference / the backward rule for sq != sk)
+        # whole k-block strictly after the last q row of this q-block → skip
+        run = (ik * block_k) <= (iq * block_q + block_q - 1 + q_off)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            qpos = q_off + iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, DEFAULT_MASK_VALUE)
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=1)[:, None]          # [bq, 1]
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)                      # [bq, bk]
+        l_next = l_prev * alpha + jnp.sum(p, axis=1)[:, None]
+        m_ref[:] = m_next
+        l_ref[:] = l_next
+        v_blk = v_ref[0].astype(jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_k - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:] + jnp.log(l_safe)).astype(lse_ref.dtype)
+
+
+def _flash_fwd(q3, k3, v3, *, scale, causal, block_q, block_k,
+               interpret=False):
+    """q3: [BH, Sq, D] -> (o [BH, Sq, D], lse [BH, Sq, 1])."""
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    n_q = pl.cdiv(sq, block_q)
+    n_k = pl.cdiv(sk, block_k)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, n_k=n_k,
+                               q_off=sk - sq)
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+    ]
+    if not _HAS_PLTPU:
+        raise RuntimeError("pallas TPU backend unavailable; use the "
+                           "mha_reference path")
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),   # acc
+        pltpu.VMEM((block_q, 1), jnp.float32),   # m
+        pltpu.VMEM((block_q, 1), jnp.float32),   # l
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, iq, ik: (b, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, iq, ik: (b, iq, 0)),
+        ],
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# custom VJP: forward saves lse; backward recomputes p blockwise in XLA
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k,
+                           interpret)
+    return o
+
+
+def _bshd_to_3d(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _3d_to_bshd(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    o3, lse = _flash_fwd(_bshd_to_3d(q), _bshd_to_3d(k), _bshd_to_3d(v),
+                         scale=scale, causal=causal, block_q=block_q,
+                         block_k=block_k, interpret=interpret)
+    o = _3d_to_bshd(o3, b, h)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, do):
+    """Chunked backward: scan over q blocks, recomputing p from the saved
+    lse per block. Peak memory O(block_q · Sk) per (b,h) instead of
+    O(Sq · Sk); dk/dv accumulate across the scan carry.
+
+      p = exp(s - lse);  ds = p * (dp - delta);  delta = rowsum(do * o)
+    """
+    q, k, v, o, lse = res
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    ki = jnp.arange(sk)[None, :]
+
+    bq = min(block_q, sq)
+    n_q = (sq + bq - 1) // bq
+    pad = n_q * bq - sq
+    if pad:
+        padded = lambda x: jnp.pad(x, ((0, 0), (0, pad)) + ((0, 0),) * (x.ndim - 2))
+    else:
+        padded = lambda x: x
+    # [b, n_q, bq, ...] blocks, scan over n_q
+    def blocks(x):
+        x = padded(x)
+        return x.reshape(b, n_q, bq, *x.shape[2:]).transpose(1, 0, 2, *range(3, x.ndim + 1))
+
+    q_b, o_b, do_b = blocks(q), blocks(o), blocks(do.astype(jnp.float32))
+    # lse: [b*h, sq, 1] -> [b, sq, h] so it blocks like the others
+    lse_bsh = lse.reshape(b, h, sq).transpose(0, 2, 1)
+    lse_b = blocks(lse_bsh)                                # [n_q, b, bq, h]
+
+    def step(carry, xs):
+        dk_acc, dv_acc = carry
+        i, qc, oc, doc, lsec = xs
+        qc = qc.astype(jnp.float32)                        # [b, bq, h, d]
+        s = jnp.einsum("bqhd,bkhd->bhqk", qc, kf,
+                       preferred_element_type=jnp.float32) * scale
+        qpos = i * bq + jnp.arange(bq)[:, None] + (sk - sq)
+        if causal:
+            s = jnp.where(ki <= qpos, s, DEFAULT_MASK_VALUE)
+        if pad:
+            s = jnp.where((qpos - (sk - sq)) < sq, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lsec.transpose(0, 2, 1)[:, :, :, None])
+        dv_acc = dv_acc + jnp.einsum("bhqk,bqhd->bkhd", p, doc)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", doc, vf)
+        delta = jnp.sum(doc * oc.astype(jnp.float32), axis=-1)  # [b,bq,h]
+        ds = p * (dp - delta.transpose(0, 2, 1)[..., None])
+        dk_acc = dk_acc + jnp.einsum("bhqk,bqhd->bkhd", ds, qc) * scale
+        dq_c = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
+        return (dk_acc, dv_acc), dq_c
+
+    init = (jnp.zeros((b, sk, h, d), jnp.float32),
+            jnp.zeros((b, sk, h, d), jnp.float32))
+    (dk, dv), dq_blocks = jax.lax.scan(
+        step, init, (jnp.arange(n_q), q_b, o_b, do_b, lse_b))
+    dq = dq_blocks.transpose(1, 0, 2, 3, 4).reshape(b, n_q * bq, h, d)[:, :sq]
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """Flash attention on [B, S, H, D] inputs (Pallas kernel)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash(q, k, v, float(scale), bool(causal), int(block_q),
+                  int(block_k), bool(interpret))
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
+
+def _tpu_ok(q, k):
+    if not _HAS_PLTPU or jax.default_backend() != "tpu":
+        return False
+    sq, sk, d = q.shape[1], k.shape[1], q.shape[-1]
+    # MXU-friendly: lane dim multiple of 128 after padding is handled by
+    # mosaic, but tiny/ragged heads are faster on the XLA path.
+    return sq >= 128 and sk >= 128 and sq % 128 == 0 and sk % 128 == 0 \
+        and d % 8 == 0
+
+
+def dot_product_attention(q, k, v, bias=None, *, causal: bool = False,
+                          scale: Optional[float] = None):
+    """Public entry: picks the Pallas kernel on TPU, XLA reference else.
+
+    bias (additive mask) forces the reference path — the kernel handles the
+    causal structure itself and arbitrary bias tiles would defeat the
+    block-skip.
+    """
+    if bias is None and _tpu_ok(q, k):
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return mha_reference(q, k, v, bias, causal=causal, scale=scale)

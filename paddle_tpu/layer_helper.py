@@ -140,3 +140,20 @@ class LayerHelper:
         tmp = self.create_tmp_variable(input_var.dtype)
         self.append_op(act_type, {"X": input_var}, {"Out": tmp}, act)
         return tmp
+
+
+def capture_new_params(fn):
+    """Run `fn()` and return (result, new parameter VarDescs).
+
+    Parameters always land in the default main program's *global* block
+    (create_parameter above), regardless of which sub-block is current —
+    so sharding-annotation code must diff the global block, not
+    current_block(). Shared by layers that tag Megatron-style tp shardings
+    (layers/attention.py, models/transformer.py).
+    """
+    block = default_main_program().global_block
+    before = set(block.vars)
+    out = fn()
+    new = [block.vars[n] for n in set(block.vars) - before
+           if block.vars[n].is_parameter]
+    return out, new

@@ -1,7 +1,7 @@
 """fluid.layers-equivalent flat namespace."""
 
 from . import nn, tensor, io, metric, ops, learning_rate_scheduler
-from . import sequence, control_flow, beam, crf
+from . import sequence, control_flow, beam, crf, attention
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
@@ -12,10 +12,12 @@ from .sequence import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .beam import *  # noqa: F401,F403
 from .crf import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()
 
 __all__ = (nn.__all__ + tensor.__all__ + io.__all__ + metric.__all__ +
            ops.__all__ + learning_rate_scheduler.__all__ + sequence.__all__ +
-           control_flow.__all__ + beam.__all__ + crf.__all__)
+           control_flow.__all__ + beam.__all__ + crf.__all__ +
+           attention.__all__)

@@ -1,0 +1,90 @@
+"""Attention layers (TPU-native extension; no 2018 reference equivalent).
+
+The reference composes attention from mul/softmax ops (nets.py:75 here keeps
+that form for parity). These layers instead emit the fused
+`scaled_dot_product_attention` op so the lowering can use the flash-attention
+Pallas kernel and, on an `sp` mesh axis, ring/Ulysses sequence parallelism
+(ops/attention_ops.py, parallel/ring.py).
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = ["fused_attention", "multi_head_attention"]
+
+
+def fused_attention(q, k, v, bias=None, causal=False, scale=0.0,
+                    sp_mode="none", name=None):
+    """Fused attention on [B, S, H, D] vars. Returns [B, S, H, D]."""
+    helper = LayerHelper("fused_attention", input=q, name=name)
+    out = helper.create_tmp_variable(q.dtype)
+    ins = {"Q": q, "K": k, "V": v}
+    if bias is not None:
+        ins["BiasMask"] = bias
+    helper.append_op("scaled_dot_product_attention", ins, {"Out": out},
+                     {"causal": bool(causal), "scale": float(scale),
+                      "sp_mode": sp_mode})
+    return out
+
+
+def multi_head_attention(queries, keys=None, values=None, *, num_heads,
+                         d_key=None, d_value=None, d_model=None,
+                         causal=False, sp_mode="none", dropout_rate=0.0,
+                         param_attr=None, bias_attr=None, tp_shard=False,
+                         name=None):
+    """Full MHA block on [B, S, d_model] vars: QKV projections → fused
+    attention → output projection. Self-attention when keys/values omitted.
+
+    tp_shard: mark projection weights Megatron-style (column-parallel QKV,
+    row-parallel output) for the `tp` mesh axis.
+    """
+    from . import nn as L
+    from .nn import dropout as drop_layer
+
+    keys = queries if keys is None else keys
+    values = keys if values is None else values
+    dm = int(queries.shape[-1]) if d_model is None else int(d_model)
+    d_key = dm // num_heads if d_key is None else d_key
+    d_value = d_key if d_value is None else d_value
+
+    from ..layer_helper import capture_new_params
+    new_weights = []  # (param, is_row_parallel) created by each projection
+
+    def proj(x, width, tag, row_parallel=False):
+        # explicit param names when the layer is named, so a separately
+        # built program (inference/decode) shares weights through the scope
+        pa, ba = param_attr, bias_attr
+        if name is not None:
+            pa = pa if pa is not None else ParamAttr(name=f"{name}_{tag}_w")
+            if ba is None:
+                ba = ParamAttr(name=f"{name}_{tag}_b")
+        out, created = capture_new_params(lambda: L.fc(
+            x, size=width, num_flatten_dims=2, param_attr=pa, bias_attr=ba,
+            name=None if name is None else f"{name}_{tag}"))
+        new_weights.extend((v, row_parallel) for v in created
+                           if len(v.shape) == 2)
+        return out
+
+    q = proj(queries, num_heads * d_key, "q")
+    k = proj(keys, num_heads * d_key, "k")
+    v = proj(values, num_heads * d_value, "v")
+
+    qr = L.reshape(q, [0, 0, num_heads, d_key])
+    kr = L.reshape(k, [0, 0, num_heads, d_key])
+    vr = L.reshape(v, [0, 0, num_heads, d_value])
+
+    ctx = fused_attention(qr, kr, vr, causal=causal, sp_mode=sp_mode,
+                          name=name)
+    merged = L.reshape(ctx, [0, 0, num_heads * d_value])
+    if dropout_rate:
+        merged = drop_layer(merged, dropout_prob=dropout_rate)
+    out = proj(merged, dm, "out", row_parallel=True)
+
+    if tp_shard:
+        # Megatron layout: QKV weights column-parallel (heads split over tp),
+        # output weight row-parallel (tp contributions psum'd by GSPMD)
+        for var, row_parallel in new_weights:
+            var.sharding = ("tp", None) if row_parallel else (None, "tp")
+    return out

@@ -1,0 +1,93 @@
+"""Decoder-only transformer LM — the long-context flagship.
+
+No 2018 reference equivalent (the reference's sequence models are LoD
+LSTMs/seq2seq, SURVEY.md §5 "long context"); this model exists to exercise
+the TPU-native extensions: fused/flash attention, ring & Ulysses sequence
+parallelism over the `sp` mesh axis, and Megatron-style tensor parallelism
+over `tp` — the capabilities the north star demands beyond reference parity.
+
+Pre-LN blocks: x + MHA(LN(x)), x + FFN(LN(x)); learned positional
+embeddings; weight-tied-free output head (fc to vocab).
+"""
+
+from __future__ import annotations
+
+from .. import layers
+from ..initializer import NormalInitializer
+from ..param_attr import ParamAttr
+
+
+def _ffn(x, d_model, d_ff, idx, tp_shard):
+    from ..layer_helper import capture_new_params
+    h, up_params = capture_new_params(lambda: layers.fc(
+        x, size=d_ff, num_flatten_dims=2, act="gelu",
+        param_attr=ParamAttr(name=f"ffn{idx}_in_w"),
+        bias_attr=ParamAttr(name=f"ffn{idx}_in_b"),
+        name=f"ffn{idx}_in"))
+    out, down_params = capture_new_params(lambda: layers.fc(
+        h, size=d_model, num_flatten_dims=2,
+        param_attr=ParamAttr(name=f"ffn{idx}_out_w"),
+        bias_attr=ParamAttr(name=f"ffn{idx}_out_b"),
+        name=f"ffn{idx}_out"))
+    if tp_shard:
+        for v in up_params:
+            if len(v.shape) == 2:
+                v.sharding = (None, "tp")     # column-parallel up-proj
+        for v in down_params:
+            if len(v.shape) == 2:
+                v.sharding = ("tp", None)     # row-parallel down-proj
+    return out
+
+
+def transformer_lm(src_ids, vocab_size, n_layers=2, d_model=128, n_heads=4,
+                   d_ff=512, max_len=2048, dropout_rate=0.0,
+                   causal=True, sp_mode="none", tp_shard=False):
+    """src_ids: [B, S] int64 var. Returns logits [B, S, vocab_size]."""
+    seq_len = int(src_ids.shape[1])
+    if seq_len > max_len:
+        raise ValueError(f"sequence length {seq_len} exceeds max_len "
+                         f"{max_len}; raise max_len")
+    emb = layers.embedding(src_ids, [vocab_size, d_model],
+                           param_attr=ParamAttr(
+                               name="tok_emb",
+                               initializer=NormalInitializer(scale=0.02)))
+    pos = layers.create_parameter([seq_len, d_model],
+                                  dtype="float32", name="pos_emb",
+                                  default_initializer=NormalInitializer(
+                                      scale=0.02))
+    x = layers.elementwise_add(emb, pos)
+    if dropout_rate:
+        x = layers.dropout(x, dropout_prob=dropout_rate)
+
+    for i in range(n_layers):
+        ln1 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln1_{i}",
+                                param_attr=ParamAttr(name=f"ln1_{i}_scale"),
+                                bias_attr=ParamAttr(name=f"ln1_{i}_bias"))
+        att = layers.multi_head_attention(
+            ln1, num_heads=n_heads, causal=causal, sp_mode=sp_mode,
+            dropout_rate=dropout_rate, tp_shard=tp_shard, name=f"attn{i}")
+        x = layers.elementwise_add(x, att)
+        ln2 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln2_{i}",
+                                param_attr=ParamAttr(name=f"ln2_{i}_scale"),
+                                bias_attr=ParamAttr(name=f"ln2_{i}_bias"))
+        ff = _ffn(ln2, d_model, d_ff, i, tp_shard)
+        x = layers.elementwise_add(x, ff)
+
+    x = layers.layer_norm(x, begin_norm_axis=2, name="ln_f",
+                          param_attr=ParamAttr(name="ln_f_scale"),
+                          bias_attr=ParamAttr(name="ln_f_bias"))
+    logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
+                       param_attr=ParamAttr(name="lm_head_w"),
+                       bias_attr=ParamAttr(name="lm_head_b"),
+                       name="lm_head")
+    return logits
+
+
+def transformer_lm_loss(vocab_size=1000, seq_len=128, **kw):
+    """Build data vars + LM loss. Returns (avg_cost, logits)."""
+    src = layers.data("src_ids", [seq_len], dtype="int64")
+    tgt = layers.data("tgt_ids", [seq_len, 1], dtype="int64")
+    logits = transformer_lm(src, vocab_size, **kw)
+    loss = layers.softmax_with_cross_entropy(logits, tgt)
+    avg = layers.mean(loss)
+    return avg, logits

@@ -1,0 +1,90 @@
+"""Attention ops.
+
+The reference has no attention op (2018): attention is composed from
+mul/softmax ops (python/paddle/fluid/nets.py scaled_dot_product_attention,
+tests/book machine_translation attention decoder). Here attention is a
+first-class op so the TPU lowering can pick the right kernel:
+
+* single chip / no sp axis — flash-attention Pallas kernel on TPU,
+  XLA reference path elsewhere (kernels/flash_attention.py);
+* mesh with an `sp` axis — ring attention (ppermute ring over ICI) or
+  Ulysses all-to-all sequence parallelism (parallel/ring.py), entered via
+  shard_map *inside* the jitted program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ..core.registry import register_op
+
+
+def _sdpa_infer(op, block):
+    q = block.var(op.input("Q")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape, out.dtype = q.shape, q.dtype
+
+
+@register_op("scaled_dot_product_attention", infer_shape=_sdpa_infer)
+def scaled_dot_product_attention(ctx, ins, attrs):
+    """Q,K,V: [B, S, H, D]. Optional BiasMask input: additive [.., Sq, Sk].
+
+    attrs:
+      causal:  bool
+      scale:   float; 0.0 means 1/sqrt(D)
+      sp_mode: "none" | "ring" | "ulysses" — how to use a mesh `sp` axis
+    """
+    from ..kernels.flash_attention import dot_product_attention
+    from ..parallel.ring import ring_attention, ulysses_attention
+    from ..parallel.mesh import DP, SP, TP
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias = ins["BiasMask"][0] if ins.get("BiasMask") else None
+    causal = bool(attrs.get("causal", False))
+    scale = attrs.get("scale", 0.0) or None
+    sp_mode = attrs.get("sp_mode", "none")
+
+    mesh = ctx.mesh
+    sp = mesh.shape.get(SP, 1) if mesh is not None else 1
+    tp = mesh.shape.get(TP, 1) if mesh is not None else 1
+    hdim = TP if (tp > 1 and q.shape[2] % tp == 0) else None
+    heads_local = q.shape[2] // (tp if hdim else 1)
+    use_sp = sp_mode in ("ring", "ulysses") and sp > 1
+    if use_sp:
+        # sp was explicitly requested for a multi-chip sp mesh — falling
+        # back to full attention would silently reintroduce the O(S²)
+        # per-device profile sp exists to avoid, so unmet preconditions
+        # are errors (shapes are static: this fires at trace time).
+        problems = []
+        if bias is not None:
+            problems.append("explicit bias/mask is unsupported under sp")
+        if q.shape[1] != k.shape[1]:
+            problems.append(f"sq={q.shape[1]} != sk={k.shape[1]}")
+        if q.shape[1] % sp:
+            problems.append(f"seq {q.shape[1]} not divisible by sp={sp}")
+        if sp_mode == "ulysses" and heads_local % sp:
+            problems.append(f"{heads_local} local heads not divisible by "
+                            f"sp={sp} (ulysses shards heads)")
+        if problems:
+            raise ValueError(
+                f"scaled_dot_product_attention(sp_mode={sp_mode!r}) cannot "
+                f"shard over sp={sp}: " + "; ".join(problems))
+    if not use_sp:
+        out = dot_product_attention(q, k, v, bias, causal=causal,
+                                    scale=scale)
+        return {"Out": [out]}
+
+    dp = mesh.shape.get(DP, 1)
+    bdim = DP if (dp > 1 and q.shape[0] % dp == 0) else None
+    # batch on dp, sequence on sp, heads on tp (each head independent)
+    spec = PartitionSpec(bdim, SP, hdim, None)
+    inner = ring_attention if sp_mode == "ring" else ulysses_attention
+
+    def local(q, k, v):
+        return inner(q, k, v, axis_name=SP, causal=causal, scale=scale)
+
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return {"Out": [fn(q, k, v)]}

@@ -122,7 +122,8 @@ class ParallelExecutor:
         compiled = self._cache.get(key)
         if compiled is None:
             step, state_out = lowering.build_step_fn(
-                program, list(feed_arrays), fetch_names, sorted(state))
+                program, list(feed_arrays), fetch_names, sorted(state),
+                mesh=self._mesh)
 
             def var_of(name):
                 try:

@@ -1,0 +1,118 @@
+"""Sequence/context parallelism: ring attention + Ulysses (all-to-all).
+
+The reference's entire long-sequence story is ragged batching (LoDTensor,
+lod_tensor.h:44-110) — no sequence parallelism existed in 2018. This module
+is the north-star extension SURVEY.md §5 calls for: shard the *sequence*
+axis of attention over the `sp` mesh axis so context length scales with the
+number of chips.
+
+Both primitives are written to run inside `shard_map` over a Mesh whose
+axis names include `sp` (see ops/attention_ops.py for how the op lowers
+itself into shard_map from inside a jitted program):
+
+* ring_attention — each device holds a [B, S/n, H, D] shard of q/k/v; K/V
+  shards rotate around the ring with `jax.lax.ppermute` (one ICI hop per
+  step) while a flash-style online-softmax accumulator folds in each block.
+  HBM never sees the full sequence; comm is overlapped by XLA with the
+  per-step einsums.
+* ulysses_attention — `jax.lax.all_to_all` reshards [B, S/n, H, D] →
+  [B, S, H/n, D] (sequence gathered, heads scattered), runs *local* full
+  attention per head group, then reshards back. One collective each way;
+  best when heads % sp == 0 and sequence fits per-device HBM.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One blockwise attention piece. q:[B,Sq,H,D] k,v:[B,Sk,H,D]
+    mask:[Sq,Sk] bool (True = attend) or None.
+    Returns (numerator [B,Sq,H,D] f32, row max m [B,H,Sq], row sum l)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                       # [B,H,Sq]
+    p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        # rows with no visible key: keep p at 0 (m == NEG_INF there)
+        p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    num = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return num, m, l
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Ring attention over sequence shards. q,k,v: [B, S_local, H, D]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]   # shard i -> i+1
+
+    qf = q.astype(jnp.float32)
+    o = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    m = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_loc), jnp.float32)
+
+    def step(i, carry):
+        o, m, l, kb, vb = carry
+        # kb arrived from shard (my - i) mod n — its global chunk index
+        src = (my - i) % n
+        if causal:
+            qpos = my * s_loc + jnp.arange(s_loc)[:, None]
+            kpos = src * s_loc + jnp.arange(s_loc)[None, :]
+            mask = kpos <= qpos
+        else:
+            mask = None
+        num, m_cur, l_cur = _block_attn(qf, kb.astype(jnp.float32),
+                                        vb.astype(jnp.float32), scale, mask)
+        m_new = jnp.maximum(m, m_cur)
+        # guard exp(-inf - -inf)
+        alpha = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - m_new))
+        beta = jnp.where(m_cur == NEG_INF, 0.0, jnp.exp(m_cur - m_new))
+        l = l * alpha + l_cur * beta
+        o = o * alpha.transpose(0, 2, 1)[..., None] \
+            + num * beta.transpose(0, 2, 1)[..., None]
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (o, m_new, l, kb, vb)
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o, m, l, k, v))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "sp",
+                      causal: bool = False, scale: Optional[float] = None,
+                      attn_fn=None):
+    """DeepSpeed-Ulysses-style SP. q,k,v: [B, S_local, H, D]; requires
+    H % sp_size == 0. attn_fn(q,k,v,causal,scale) runs on the full sequence
+    with H/sp heads — defaults to the flash/reference dispatcher."""
+    from ..kernels.flash_attention import dot_product_attention
+    if attn_fn is None:
+        def attn_fn(q, k, v, causal, scale):
+            return dot_product_attention(q, k, v, causal=causal, scale=scale)
+
+    def a2a(x, seq_to_head: bool):
+        # [B, S/n, H, D] <-> [B, S, H/n, D]
+        if seq_to_head:
+            return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                      concat_axis=1, tiled=True)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qg, kg, vg = a2a(q, True), a2a(k, True), a2a(v, True)
+    og = attn_fn(qg, kg, vg, causal, scale)
+    return a2a(og, False).astype(q.dtype)
