@@ -103,12 +103,22 @@ def run_block_with_autodiff(block: Block, env: Dict[str, object], ctx: ExecConte
     loss_scale = float(bop.attrs.get("loss_scale", 1.0))
 
     param_vals = {p: env[p] for p in param_names}
+    amp = getattr(ctx, "amp_dtype", None)
 
     def fwd(pvals):
         e = dict(env)
-        e.update(pvals)
+        if amp is not None:
+            # mixed precision: compute path sees low-precision params, but
+            # grads flow to the f32 masters (the cast is differentiated, so
+            # value_and_grad returns f32 grads for the optimizer ops)
+            adt = jnp.dtype(amp)
+            e.update({p: (v.astype(adt)
+                          if jnp.result_type(v) == jnp.float32 else v)
+                      for p, v in pvals.items()})
+        else:
+            e.update(pvals)
         e = run_op_range(ops, 0, bwd_idx, e, ctx, block)
-        loss = jnp.sum(e[loss_name])
+        loss = jnp.sum(e[loss_name].astype(jnp.float32))
         return loss * loss_scale, e
 
     (_, env2), grads = jax.value_and_grad(fwd, has_aux=True)(param_vals)
@@ -148,6 +158,7 @@ def build_step_fn(program: Program, feed_names: Sequence[str],
 
     def step(state: Dict[str, object], feed: Dict[str, object], rng):
         ctx = ExecContext(rng, is_test=is_test, mesh=mesh)
+        ctx.amp_dtype = program.amp_dtype
         env: Dict[str, object] = {}
         env.update(state)
         env.update(feed)
@@ -157,3 +168,47 @@ def build_step_fn(program: Program, feed_names: Sequence[str],
         return fetches, new_state
 
     return step, state_out_names
+
+
+def build_loop_fn(program: Program, feed_names: Sequence[str],
+                  fetch_names: Sequence[str], state_in_names: Sequence[str],
+                  n_steps: int, is_test: bool = False, mesh=None,
+                  per_step_feeds: bool = False):
+    """Build a function running `n_steps` training steps in ONE dispatch.
+
+    The reference amortizes host work with scope reuse
+    (scope_buffered_ssa_graph_executor.cc, num_iteration_per_drop_scope);
+    on TPU the equivalent lever is a device-side training loop: lax.scan
+    over the step function, so host→device dispatch (and any control-plane
+    latency) is paid once per n_steps instead of per step.
+
+    feed values: per_step_feeds=False → one feed dict reused every step
+    (fake-data benching, ≙ fluid_benchmark.py --use_fake_data);
+    per_step_feeds=True → each feed array carries a leading [n_steps] axis.
+
+    Returns (loop, state_out_names); loop(state, feed, rng) ->
+    (stacked_fetches, new_state) with each fetch stacked to [n_steps, ...].
+    """
+    step, state_out_names = build_step_fn(program, feed_names, fetch_names,
+                                          state_in_names, is_test=is_test,
+                                          mesh=mesh)
+
+    def loop(state: Dict[str, object], feed: Dict[str, object], rng):
+        def one(carry, i):
+            f = ({k: v[i] for k, v in feed.items()} if per_step_feeds
+                 else feed)
+            fetches, st = step(carry, f, jax.random.fold_in(rng, i))
+            return st, fetches
+
+        # scan carries must be structurally identical: seed state vars that
+        # the step writes but the scope didn't hold yet (zeros are safe —
+        # a read-before-write of such a var would fail in build_step_fn too)
+        out_shapes = jax.eval_shape(lambda s: one(s, jnp.int32(0))[0], state)
+        full = dict(state)
+        for k, sh in out_shapes.items():
+            if k not in full:
+                full[k] = jnp.zeros(sh.shape, sh.dtype)
+        new_state, stacked = jax.lax.scan(one, full, jnp.arange(n_steps))
+        return stacked, new_state
+
+    return loop, state_out_names

@@ -265,6 +265,11 @@ class Program:
         self.blocks: List[Block] = [Block(self, 0)]
         self._seed: Optional[int] = None
         self._block_stack: List[int] = [0]
+        # Mixed precision: when set (e.g. "bfloat16"), the lowering casts
+        # float32 parameters to this dtype inside the differentiated
+        # forward, keeping f32 master weights + f32 optimizer math — the
+        # standard TPU recipe (≙ contrib/float16's transpiler intent).
+        self.amp_dtype: Optional[str] = None
 
     # -- structure ----------------------------------------------------------
     @property
@@ -375,7 +380,8 @@ class Program:
         return Program.from_dict(json.loads(s))
 
     def fingerprint(self) -> str:
-        return hashlib.sha256(self.to_json().encode()).hexdigest()[:16]
+        tag = f"|amp={self.amp_dtype}"
+        return hashlib.sha256((self.to_json() + tag).encode()).hexdigest()[:16]
 
     def __str__(self):
         lines = []
