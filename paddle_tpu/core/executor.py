@@ -72,7 +72,11 @@ class Executor:
     def _fetch_name(f) -> str:
         return f.name if isinstance(f, VarDesc) else str(f)
 
-    def _prep_feed(self, program: Program, feed: Dict[str, object]):
+    def _prep_feed(self, program: Program, feed: Dict[str, object],
+                   per_step: bool = False):
+        """per_step: arrays carry a leading [n_steps] axis (run_loop's
+        per_step_feeds mode); ragged list/LoDTensor feeds are not supported
+        there — feed padded arrays (+ explicit lengths if not full)."""
         out = {}
         for name, val in feed.items():
             try:
@@ -84,6 +88,12 @@ class Executor:
             # (≙ DataFeeder LoD handling, data_feeder.py:73)
             seq_len_name = getattr(var, "seq_len_var", None) if var else None
             from ..lod import LoDTensor, pad_sequences
+            if isinstance(val, (LoDTensor, list, tuple)) and per_step:
+                raise ValueError(
+                    f"per-step feed {name!r}: ragged LoDTensor/list feeds "
+                    "are not supported with per_step_feeds=True; pass a "
+                    "padded [n_steps, B, T, ...] array (+ explicit "
+                    f"{seq_len_name!r} lengths if sequences are not full)")
             if isinstance(val, LoDTensor):
                 padded, lens = val.to_padded()
                 val = padded
@@ -96,8 +106,14 @@ class Executor:
                 out[seq_len_name] = jnp.asarray(lens)
             elif seq_len_name and seq_len_name not in feed:
                 arr0 = np.asarray(val)
-                out[seq_len_name] = jnp.full((arr0.shape[0],), arr0.shape[1],
-                                             np.int32)
+                # full-length sequences: [B, T, ...] -> lens [B]=T; with a
+                # leading step axis, [N, B, T, ...] -> lens [N, B]=T
+                if per_step:
+                    out[seq_len_name] = jnp.full(arr0.shape[:2], arr0.shape[2],
+                                                 np.int32)
+                else:
+                    out[seq_len_name] = jnp.full((arr0.shape[0],),
+                                                 arr0.shape[1], np.int32)
 
             arr = np.asarray(val)
             if var is not None:
@@ -127,28 +143,32 @@ class Executor:
         return state
 
     # -- main entry ---------------------------------------------------------
-    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
-            fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
-            return_numpy: bool = True, donate_state: bool = True):
+    def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
+                  build, key_extra, per_step_feed_prep=False):
+        """Shared body of run/run_loop: prep feeds/state, hit the jit cache
+        (≙ the reference's program cache, executor.py:165), execute, write
+        new state back to the scope."""
         program = program if program is not None else default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()
 
         fetch_names = [self._fetch_name(f) for f in fetch_list]
-        feed_arrays = self._prep_feed(program, feed)
+        feed_arrays = self._prep_feed(program, feed,
+                                      per_step=per_step_feed_prep)
         state = self._state_for(program, scope)
 
-        feed_sig = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feed_arrays.items()))
+        feed_sig = tuple(sorted((k, v.shape, str(v.dtype))
+                                for k, v in feed_arrays.items()))
         state_sig = tuple(sorted((k, jnp.shape(v), str(jnp.result_type(v)))
                                  for k, v in state.items()))
-        key = (program.fingerprint(), feed_sig, tuple(fetch_names), state_sig)
+        key = (program.fingerprint(), key_extra, feed_sig,
+               tuple(fetch_names), state_sig)
 
         compiled = self._cache.get(key)
         if compiled is None:
-            step, state_out = lowering.build_step_fn(
-                program, list(feed_arrays), fetch_names, sorted(state))
-            fn = jax.jit(step, donate_argnums=(0,) if donate_state else ())
+            fn, state_out = build(program, list(feed_arrays), fetch_names,
+                                  sorted(state))
             compiled = _Compiled(fn, sorted(state), state_out, fetch_names)
             self._cache[key] = compiled
 
@@ -163,6 +183,53 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
+            return_numpy: bool = True, donate_state: bool = True):
+        def build(program, feed_names, fetch_names, state_names):
+            step, state_out = lowering.build_step_fn(
+                program, feed_names, fetch_names, state_names)
+            fn = jax.jit(step, donate_argnums=(0,) if donate_state else ())
+            return fn, state_out
+
+        return self._run_impl(program, feed, fetch_list, scope, return_numpy,
+                              build, key_extra=("step", donate_state))
+
+    def run_loop(self, program: Optional[Program] = None,
+                 feed: Optional[dict] = None,
+                 fetch_list: Optional[Sequence] = None, n_steps: int = 1,
+                 scope: Optional[Scope] = None, per_step_feeds: bool = False,
+                 return_numpy: bool = True, unroll: int = 2):
+        """Run `n_steps` training steps in ONE device dispatch (lax.scan).
+
+        The reference pays host dispatch per step (executor.cc:322 interprets
+        ops every Run); on TPU — especially through a high-latency control
+        plane — the idiomatic fix is a device-side loop so dispatch cost is
+        paid once per n_steps. ≙ the intent of scope reuse in
+        scope_buffered_ssa_graph_executor.cc, realized as lax.scan.
+
+        feed: with per_step_feeds=False the same feed dict is reused every
+        step (fake-data benching, ≙ fluid_benchmark.py --use_fake_data);
+        with True every feed array carries a leading [n_steps] axis and step
+        i consumes slice i (one upload for the whole window).
+
+        unroll=2 default: measured on the v5e control plane, each scan
+        iteration carries ~2ms of sequencing overhead; unrolling the scan
+        body twice halves it with no semantic change.
+
+        Returns the fetches, each stacked to [n_steps, ...].
+        """
+        def build(program, feed_names, fetch_names, state_names):
+            loop, state_out = lowering.build_loop_fn(
+                program, feed_names, fetch_names, state_names,
+                n_steps=n_steps, per_step_feeds=per_step_feeds, unroll=unroll)
+            return jax.jit(loop, donate_argnums=(0,)), state_out
+
+        return self._run_impl(
+            program, feed, fetch_list, scope, return_numpy, build,
+            key_extra=("loop", n_steps, per_step_feeds, unroll),
+            per_step_feed_prep=per_step_feeds)
 
     def close(self):
         self._cache.clear()

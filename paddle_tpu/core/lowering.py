@@ -162,6 +162,15 @@ def build_step_fn(program: Program, feed_names: Sequence[str],
         env: Dict[str, object] = {}
         env.update(state)
         env.update(feed)
+        if program.amp_dtype is not None:
+            # AMP entry casts: float32 feeds run in the compute dtype, so the
+            # whole activation path is low-precision; params are cast inside
+            # the differentiated forward (run_block_with_autodiff) so their
+            # f32 masters keep receiving f32 grads.
+            adt = jnp.dtype(program.amp_dtype)
+            for k in feed:
+                if jnp.result_type(env[k]) == jnp.float32:
+                    env[k] = env[k].astype(adt)
         env = run_block_with_autodiff(block, env, ctx)
         fetches = tuple(env[n] for n in fetch_names)
         new_state = {n: env[n] for n in state_out_names if n in env}
@@ -173,7 +182,7 @@ def build_step_fn(program: Program, feed_names: Sequence[str],
 def build_loop_fn(program: Program, feed_names: Sequence[str],
                   fetch_names: Sequence[str], state_in_names: Sequence[str],
                   n_steps: int, is_test: bool = False, mesh=None,
-                  per_step_feeds: bool = False):
+                  per_step_feeds: bool = False, unroll: int = 1):
     """Build a function running `n_steps` training steps in ONE dispatch.
 
     The reference amortizes host work with scope reuse
@@ -194,6 +203,8 @@ def build_loop_fn(program: Program, feed_names: Sequence[str],
                                           mesh=mesh)
 
     def loop(state: Dict[str, object], feed: Dict[str, object], rng):
+        feed = {k: jnp.asarray(v) for k, v in feed.items()}
+
         def one(carry, i):
             f = ({k: v[i] for k, v in feed.items()} if per_step_feeds
                  else feed)
@@ -208,7 +219,8 @@ def build_loop_fn(program: Program, feed_names: Sequence[str],
         for k, sh in out_shapes.items():
             if k not in full:
                 full[k] = jnp.zeros(sh.shape, sh.dtype)
-        new_state, stacked = jax.lax.scan(one, full, jnp.arange(n_steps))
+        new_state, stacked = jax.lax.scan(one, full, jnp.arange(n_steps),
+                                          unroll=unroll)
         return stacked, new_state
 
     return loop, state_out_names

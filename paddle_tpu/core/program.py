@@ -195,6 +195,7 @@ class Block:
             return self.vars[name]
         v = VarDesc(name, **kwargs)
         self.vars[name] = v
+        self.program.invalidate_cache()
         return v
 
     def var(self, name: str) -> VarDesc:
@@ -232,6 +233,7 @@ class Block:
 
         op = OpDesc(type, canon(inputs), canon(outputs), attrs)
         self.ops.append(op)
+        self.program.invalidate_cache()
         from .registry import get_op  # local import to avoid cycle
         impl = get_op(type)
         if impl is not None and impl.infer_shape is not None:
@@ -262,14 +264,32 @@ class Program:
     """
 
     def __init__(self):
+        self._fp_cache: Optional[str] = None
         self.blocks: List[Block] = [Block(self, 0)]
         self._seed: Optional[int] = None
         self._block_stack: List[int] = [0]
         # Mixed precision: when set (e.g. "bfloat16"), the lowering casts
-        # float32 parameters to this dtype inside the differentiated
-        # forward, keeping f32 master weights + f32 optimizer math — the
+        # float32 parameters AND float32 feeds to this dtype inside the
+        # traced step, keeping f32 master weights + f32 optimizer math — the
         # standard TPU recipe (≙ contrib/float16's transpiler intent).
-        self.amp_dtype: Optional[str] = None
+        self._amp_dtype: Optional[str] = None
+
+    @property
+    def amp_dtype(self) -> Optional[str]:
+        return self._amp_dtype
+
+    @amp_dtype.setter
+    def amp_dtype(self, value: Optional[str]):
+        self._amp_dtype = value
+        self.invalidate_cache()
+
+    def invalidate_cache(self):
+        """Drop the memoized fingerprint after a structural mutation.
+
+        Block.append_op/create_var call this automatically; passes that
+        mutate descriptors in place (e.g. the sharding transpiler editing
+        VarDesc.sharding) must call it explicitly."""
+        self._fp_cache = None
 
     # -- structure ----------------------------------------------------------
     @property
@@ -354,13 +374,14 @@ class Program:
 
     # -- serialization ------------------------------------------------------
     def to_dict(self) -> dict:
-        return {"version": 1, "seed": self._seed,
+        return {"version": 1, "seed": self._seed, "amp_dtype": self._amp_dtype,
                 "blocks": [b.to_dict() for b in self.blocks]}
 
     @staticmethod
     def from_dict(d: dict) -> "Program":
         p = Program()
         p._seed = d.get("seed")
+        p._amp_dtype = d.get("amp_dtype")
         p.blocks = []
         for bd in d["blocks"]:
             b = Block(p, bd["idx"], bd["parent_idx"])
@@ -380,8 +401,13 @@ class Program:
         return Program.from_dict(json.loads(s))
 
     def fingerprint(self) -> str:
-        tag = f"|amp={self.amp_dtype}"
-        return hashlib.sha256((self.to_json() + tag).encode()).hexdigest()[:16]
+        # memoized: re-serializing a ~300-op program per Executor.run was a
+        # measurable per-step host cost (≙ the reference caching Prepare'd
+        # contexts, executor.cc:296). invalidate_cache() drops it on mutation.
+        if self._fp_cache is None:
+            self._fp_cache = hashlib.sha256(
+                self.to_json().encode()).hexdigest()[:16]
+        return self._fp_cache
 
     def __str__(self):
         lines = []

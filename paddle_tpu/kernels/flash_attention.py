@@ -271,12 +271,18 @@ def flash_attention(q, k, v, *, causal: bool = False,
 # Dispatch
 # ---------------------------------------------------------------------------
 
-def _tpu_ok(q, k):
+def _tpu_ok(q, k, causal: bool = False):
     if not _HAS_PLTPU or jax.default_backend() != "tpu":
         return False
     sq, sk, d = q.shape[1], k.shape[1], q.shape[-1]
     # MXU-friendly: lane dim multiple of 128 after padding is handled by
     # mosaic, but tiny/ragged heads are faster on the XLA path.
+    # causal sq > sk is excluded: rows whose causal window precedes all keys
+    # have no visible key, and the kernel's l==0 guard zeroes them while
+    # mha_reference softmaxes the finite DEFAULT_MASK_VALUE — keep both
+    # entry points on the (well-defined) reference semantics for that case.
+    if causal and sq > sk:
+        return False
     return sq >= 128 and sk >= 128 and sq % 128 == 0 and sk % 128 == 0 \
         and d % 8 == 0
 
@@ -289,6 +295,6 @@ def dot_product_attention(q, k, v, bias=None, *, causal: bool = False,
     causal structure itself and arbitrary bias tiles would defeat the
     block-skip.
     """
-    if bias is None and _tpu_ok(q, k):
+    if bias is None and _tpu_ok(q, k, causal):
         return flash_attention(q, k, v, causal=causal, scale=scale)
     return mha_reference(q, k, v, bias, causal=causal, scale=scale)

@@ -68,6 +68,17 @@ class LayerHelper:
                          is_bias: bool = False,
                          default_initializer: Optional[Initializer] = None) -> VarDesc:
         assert isinstance(attr, ParamAttr)
+        # Master-weight policy: parameters are always stored in float32 even
+        # when the layer computes in bfloat16/float16. Per-op dtype
+        # harmonization (ops/math_ops.harmonize) casts the weight down where
+        # it meets a low-precision activation, and the cast is differentiated
+        # so gradients/optimizer state stay f32 — the standard TPU mixed-
+        # precision recipe (≙ contrib/float16 master-weights intent). It also
+        # keeps the training state's dtype independent of the feed dtype,
+        # which the device-side lax.scan training loop requires (a stable
+        # carry pytree).
+        if dtype in ("bfloat16", "float16"):
+            dtype = "float32"
         if attr.name is None:
             attr.name = unique_name(".".join([self.name, "b" if is_bias else "w"]))
         init = attr.initializer or default_initializer or (

@@ -53,9 +53,20 @@ def multi_head_attention(queries, keys=None, values=None, *, num_heads,
     new_weights = []  # (param, is_row_parallel) created by each projection
 
     def proj(x, width, tag, row_parallel=False):
+        import copy
         # explicit param names when the layer is named, so a separately
-        # built program (inference/decode) shares weights through the scope
-        pa, ba = param_attr, bias_attr
+        # built program (inference/decode) shares weights through the scope.
+        # Each projection gets its OWN ParamAttr copy: create_parameter
+        # fills attr.name in place when it is None (layer_helper.py), and a
+        # shared object would silently alias Q/K/V/out onto one parameter.
+        # A user-supplied explicit name is suffixed per projection for the
+        # same reason — four projections cannot share one weight.
+        pa = copy.copy(param_attr) if param_attr is not None else None
+        ba = copy.copy(bias_attr) if bias_attr is not None else None
+        if pa is not None and pa.name is not None:
+            pa.name = f"{pa.name}.{tag}"
+        if ba is not None and ba.name is not None:
+            ba.name = f"{ba.name}.{tag}"
         if name is not None:
             pa = pa if pa is not None else ParamAttr(name=f"{name}_{tag}_w")
             if ba is None:
