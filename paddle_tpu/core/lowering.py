@@ -56,7 +56,14 @@ def run_op(op: OpDesc, env: Dict[str, object], ctx: ExecContext, block: Block):
     # like parameters — ≙ the reference's parent-scope lookup, scope.h:62).
     ctx.program = block.program
     ctx.env = env
+    ctx.block_idx = block.idx
     ins = {slot: [env[n] for n in names] for slot, names in op.inputs.items()}
+    if not impl.supports_sparse:
+        # ops without a SelectedRows kernel get sparse inputs densified
+        # (≙ the reference's data transform between mismatched kernels)
+        from .selected_rows import maybe_dense
+        ins = {slot: [maybe_dense(v) for v in vals]
+               for slot, vals in ins.items()}
     outs = impl.compute(ctx, ins, op.attrs)
     for slot, names in op.outputs.items():
         vals = outs.get(slot)
@@ -100,12 +107,60 @@ def run_block_with_autodiff(block: Block, env: Dict[str, object], ctx: ExecConte
     loss_name = bop.attrs["loss"]
     param_names = list(bop.attrs["params"])
     grad_names = list(bop.attrs["grad_names"])
+    grad_of = dict(zip(param_names, grad_names))
     loss_scale = float(bop.attrs.get("loss_scale", 1.0))
-
-    param_vals = {p: env[p] for p in param_names}
     amp = getattr(ctx, "amp_dtype", None)
 
-    def fwd(pvals):
+    # --- sparse embedding grads (≙ SelectedRows, selected_rows.h:30) ------
+    # lookup_table(is_sparse=True) params are differentiated through a
+    # per-op zero surrogate added to the gathered rows instead of through
+    # the full table, so the cotangent is [n_ids, D] — never [vocab, D].
+    # Restricted to block-0 lookups (embeddings inside control-flow
+    # sub-blocks fall back to dense grads).
+    sparse_ops = [
+        (i, op.inputs["W"][0], op.inputs["Ids"][0])
+        for i, op in enumerate(ops[:bwd_idx])
+        if op.type == "lookup_table" and op.attrs.get("is_sparse")
+        and op.inputs["W"][0] in grad_of
+    ]
+    # a table consumed by anything OTHER than its sparse lookups (tied
+    # softmax projection, a second dense lookup) must take the dense path —
+    # the surrogate only captures cotangents at the sparse lookup sites
+    sparse_op_idx = {i for i, _, _ in sparse_ops}
+    for j, op in enumerate(ops[:bwd_idx]):
+        if j in sparse_op_idx:
+            continue
+        used = set(op.input_names())
+        sparse_ops = [(i, w, ids) for i, w, ids in sparse_ops
+                      if w not in used]
+        sparse_op_idx = {i for i, _, _ in sparse_ops}
+    sparse_param_names = {w for _, w, _ in sparse_ops}
+    dense_param_vals = {p: env[p] for p in param_names
+                        if p not in sparse_param_names}
+
+    surrogates = {}
+    if sparse_ops:
+        # abstract pre-pass: learn each lookup's post-squeeze ids shape
+        # without running any real compute (≙ compile-time InferShape)
+        def probe(e_in):
+            pctx = ExecContext(ctx._rng_key, is_test=ctx.is_test,
+                               mesh=ctx.mesh)
+            pctx.amp_dtype = amp
+            pctx.sparse_probe = {}
+            run_op_range(ops, 0, bwd_idx, dict(e_in), pctx, block)
+            return {i: jnp.zeros(v.shape, jnp.int32)
+                    for i, v in pctx.sparse_probe.items()}
+        id_shapes = jax.eval_shape(probe, env)
+        for i, w_name, _ in sparse_ops:
+            wv = env[w_name]
+            sdt = jnp.result_type(wv)
+            if amp is not None and sdt == jnp.float32:
+                sdt = jnp.dtype(amp)  # match the amp-cast table's output
+            surrogates[i] = jnp.zeros(
+                tuple(id_shapes[i].shape) + (wv.shape[-1],), sdt)
+
+    def fwd(diff):
+        pvals, zvals = diff
         e = dict(env)
         if amp is not None:
             # mixed precision: compute path sees low-precision params, but
@@ -115,16 +170,46 @@ def run_block_with_autodiff(block: Block, env: Dict[str, object], ctx: ExecConte
             e.update({p: (v.astype(adt)
                           if jnp.result_type(v) == jnp.float32 else v)
                       for p, v in pvals.items()})
+            # sparse tables live outside pvals (grads come via surrogates),
+            # but their compute-dtype cast must match the dense params
+            for sp in sparse_param_names:
+                if jnp.result_type(e[sp]) == jnp.float32:
+                    e[sp] = e[sp].astype(adt)
         else:
             e.update(pvals)
-        e = run_op_range(ops, 0, bwd_idx, e, ctx, block)
+        ctx.sparse_surrogates = zvals
+        try:
+            e = run_op_range(ops, 0, bwd_idx, e, ctx, block)
+        finally:
+            ctx.sparse_surrogates = None
         loss = jnp.sum(e[loss_name].astype(jnp.float32))
         return loss * loss_scale, e
 
-    (_, env2), grads = jax.value_and_grad(fwd, has_aux=True)(param_vals)
+    orig_params = {p: env[p] for p in param_names}
+    (_, env2), (grads, gz) = jax.value_and_grad(fwd, has_aux=True)(
+        (dense_param_vals, surrogates))
     env = env2
-    for p, g in zip(param_names, grad_names):
-        env[g] = grads[p]
+    # the post-forward env holds the amp-cast param values; the optimizer
+    # suffix must update the f32 MASTERS, not a bf16-quantized copy (the
+    # whole point of master weights: small updates still accumulate)
+    env.update(orig_params)
+    for p, g in grad_of.items():
+        if p not in sparse_param_names:
+            env[g] = grads[p]
+
+    if sparse_ops:
+        from .selected_rows import (rowsparse_from_ids, merge_rowsparse,
+                                    squeeze_trailing_ids)
+        built: Dict[str, object] = {}
+        for i, w_name, ids_name in sparse_ops:
+            ids = squeeze_trailing_ids(env[ids_name])
+            height = int(env[w_name].shape[0])
+            rs = rowsparse_from_ids(ids, gz[i], height)
+            built[w_name] = (rs if w_name not in built
+                             else merge_rowsparse(built[w_name], rs))
+        for w_name, rs in built.items():
+            env[grad_of[w_name]] = rs
+
     return run_op_range(ops, bwd_idx + 1, len(ops), env, ctx, block)
 
 

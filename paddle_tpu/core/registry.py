@@ -29,19 +29,24 @@ class OpImpl:
     infer_shape: Optional[Callable] = None
     # host-side ops (feed/fetch/reader) are handled by the executor, not traced
     is_host_op: bool = False
+    # op understands RowSparseGrad inputs (≙ a SelectedRows kernel variant,
+    # e.g. adam_op.h's sparse path). Ops without it get sparse inputs
+    # auto-densified by the lowering (≙ the reference's sum_op mixing rule).
+    supports_sparse: bool = False
 
 
 _REGISTRY: Dict[str, OpImpl] = {}
 
 
 def register_op(type: str, infer_shape: Optional[Callable] = None,
-                is_host_op: bool = False):
+                is_host_op: bool = False, supports_sparse: bool = False):
     """Decorator: @register_op("relu", infer_shape=same_shape("X", "Out"))."""
 
     def deco(fn: Callable):
         if type in _REGISTRY:
             raise ValueError(f"op {type!r} registered twice")
-        _REGISTRY[type] = OpImpl(type, fn, infer_shape, is_host_op)
+        _REGISTRY[type] = OpImpl(type, fn, infer_shape, is_host_op,
+                                 supports_sparse)
         return fn
 
     return deco

@@ -1,0 +1,94 @@
+"""RowSparseGrad — the TPU-native SelectedRows.
+
+≙ reference paddle/fluid/framework/selected_rows.h:30: a {rows, value}
+pair representing a sparse slice of a [height, D] tensor, used for
+embedding gradients so optimizers touch only the rows a batch referenced
+(lookup_table_op.cc's is_sparse grad path; sparse kernels in adam_op.h,
+sgd_op.h, operators/math/selected_rows_functor.*).
+
+Differences forced by XLA's static shapes: `rows` has a FIXED size K (the
+number of id slots in the batch), deduplicated at construction with
+jnp.unique(size=K) + segment_sum — padding slots carry the OUT-OF-RANGE
+sentinel row `height` with zero values and mask=False. XLA scatters drop
+out-of-bounds indices (consumers pass mode='drop' explicitly), so both
+scatter-ADD (sgd) and row-wise SET (momentum/adam moment) updates ignore
+padding slots without masking arithmetic.
+
+The structure is a registered pytree, so it flows through jit, scan
+carries, and pjit sharding like any array bundle.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RowSparseGrad(NamedTuple):
+    rows: jax.Array      # [K] int32, unique; padding slots = height (OOB)
+    values: jax.Array    # [K, D]; padding slots = 0
+    mask: jax.Array      # [K] bool, True where the slot holds a real row
+    height: int          # static: dense dim-0 (vocab size)
+
+    @property
+    def dense_shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def to_dense(self):
+        """Materialize the [height, D] dense gradient (scatter-add)."""
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.rows].add(self.values, mode="drop")
+
+
+def squeeze_trailing_ids(ids):
+    """Fluid's trailing-1 ids convention ([B, T, 1] -> [B, T]) — the ONE
+    normalization shared by lookup_table's forward and the sparse-grad
+    assembly (core/lowering.py); keep them in sync here."""
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    return ids.astype(jnp.int32)
+
+
+def rowsparse_from_ids(ids, grads, height: int) -> RowSparseGrad:
+    """Build a deduplicated RowSparseGrad from raw (ids, per-slot grads).
+
+    ids: [...] int; grads: ids.shape + [D]. Duplicated ids are combined by
+    segment-sum (≙ MergeAdd in selected_rows_functor.h) so consumers can do
+    row-wise SET updates safely.
+    """
+    flat_ids = ids.reshape(-1).astype(jnp.int32)
+    k = flat_ids.shape[0]
+    d = grads.shape[-1]
+    flat_g = grads.reshape(k, d)
+    uniq, inv, counts = jnp.unique(
+        flat_ids, size=k, fill_value=height, return_inverse=True,
+        return_counts=True)
+    summed = jax.ops.segment_sum(flat_g, inv.reshape(-1), num_segments=k)
+    mask = counts > 0
+    uniq = jnp.where(mask, uniq, height)
+    summed = jnp.where(mask[:, None], summed, 0)
+    return RowSparseGrad(uniq, summed, mask, height)
+
+
+def merge_rowsparse(a: RowSparseGrad, b: RowSparseGrad) -> RowSparseGrad:
+    """Combine two sparse grads of the same table (tied embeddings —
+    ≙ sum_op's SelectedRows+SelectedRows branch)."""
+    assert a.height == b.height
+    ids = jnp.concatenate([a.rows, b.rows])  # padding already = height
+    vals = jnp.concatenate([a.values, b.values])
+    k = ids.shape[0]
+    uniq, inv, counts = jnp.unique(ids, size=k, fill_value=a.height,
+                                   return_inverse=True, return_counts=True)
+    summed = jax.ops.segment_sum(vals, inv.reshape(-1), num_segments=k)
+    mask = (counts > 0) & (uniq < a.height)
+    uniq = jnp.where(mask, uniq, a.height)
+    summed = jnp.where(mask[:, None], summed, 0)
+    return RowSparseGrad(uniq, summed, mask, a.height)
+
+
+def maybe_dense(x):
+    """Transparent fallback for ops without a sparse kernel (≙ the
+    reference's data-transform densification between mismatched kernels)."""
+    return x.to_dense() if isinstance(x, RowSparseGrad) else x

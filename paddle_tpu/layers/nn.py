@@ -101,11 +101,22 @@ def ParamAttr_to(attr):
 def embedding(input, size: Sequence[int], is_sparse: bool = False,
               is_distributed: bool = False, padding_idx: Optional[int] = None,
               param_attr=None, dtype: str = "float32") -> VarDesc:
-    """layers/nn.py:153. is_sparse/is_distributed are accepted for parity —
-    sparse grads are an XLA concern; distributed tables use the sharded
-    embedding path (parallel/)."""
+    """layers/nn.py:153.
+
+    is_sparse=True → RowSparseGrad gradients (≙ SelectedRows,
+    lookup_table_op.cc sparse path; see core/selected_rows.py).
+    is_distributed=True → the table is annotated vocab-sharded over the
+    ('tp','dp') mesh axes; under a sharded executor GSPMD partitions the
+    gather across devices and each device stores only its vocab slice
+    (≙ the distributed lookup table, distribute_transpiler.py:120-180,
+    re-read as a sharding annotation instead of pserver prefetch RPCs —
+    see docs/distributed_embedding.md for the sync-only decision)."""
     helper = LayerHelper("embedding", param_attr=param_attr)
     w = helper.create_parameter(helper.param_attr, size, dtype)
+    if is_distributed:
+        # vocab (dim 0) sharded over tp and/or dp — whichever axes the
+        # runtime mesh actually has (spec_for drops absent axes)
+        w.sharding = (("tp", "dp"), None)
     tmp = helper.create_tmp_variable(dtype)
     padding_idx = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx)

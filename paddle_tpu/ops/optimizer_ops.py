@@ -6,8 +6,13 @@ proximal_gd_op, proximal_adagrad_op}.h/.cc/.cu. Each op consumes Param +
 Grad + LearningRate (+ accumulators) and emits the updated tensors; the
 lowering rebinds the persistable names so the new values become next step's
 state — the functional reading of the reference's in-place param update.
-Dense only: sparse (SelectedRows) gradients are handled upstream because JAX
-gradients of gather are already scatter-adds fused by XLA.
+
+sgd/momentum/adam/adagrad additionally implement the SelectedRows sparse
+path (≙ their .h kernels specialized on SelectedRows grads): a
+RowSparseGrad (core/selected_rows.py) updates only the touched rows —
+"lazy" semantics for stateful optimizers, exactly like the reference,
+where untouched rows' moments do not decay. Other optimizers densify
+sparse grads via the registry fallback.
 """
 
 from __future__ import annotations
@@ -16,22 +21,38 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ..core.selected_rows import RowSparseGrad
 
 
 def _p(ins, slot):
     return ins[slot][0]
 
 
-@register_op("sgd")
+@register_op("sgd", supports_sparse=True)
 def sgd(ctx, ins, attrs):
     p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    if isinstance(g, RowSparseGrad):
+        # scatter-add update; padding slots point at the OOB sentinel row
+        # and are dropped (sgd_op.h SelectedRows branch)
+        return {"ParamOut": [p.at[g.rows].add(
+            (-lr * g.values).astype(p.dtype), mode="drop")]}
     return {"ParamOut": [p - lr * g]}
 
 
-@register_op("momentum")
+@register_op("momentum", supports_sparse=True)
 def momentum(ctx, ins, attrs):
     p, g, v, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity"), _p(ins, "LearningRate")
     mu = attrs["mu"]
+    if isinstance(g, RowSparseGrad):
+        rows, vals = g.rows, g.values.astype(p.dtype)
+        v_rows = v.at[rows].get(mode="clip")
+        v_new = mu * v_rows + vals
+        if attrs.get("use_nesterov", False):
+            delta = (vals + mu * v_new) * lr
+        else:
+            delta = lr * v_new
+        return {"ParamOut": [p.at[rows].add(-delta, mode="drop")],
+                "VelocityOut": [v.at[rows].set(v_new, mode="drop")]}
     v_new = mu * v + g
     if attrs.get("use_nesterov", False):
         p_new = p - (g + mu * v_new) * lr
@@ -40,13 +61,26 @@ def momentum(ctx, ins, attrs):
     return {"ParamOut": [p_new], "VelocityOut": [v_new]}
 
 
-@register_op("adam")
+@register_op("adam", supports_sparse=True)
 def adam(ctx, ins, attrs):
-    """adam_op.h: m/v moments + scalar beta-power accumulators."""
+    """adam_op.h: m/v moments + scalar beta-power accumulators. Sparse =
+    the reference's lazy mode: only touched rows' moments update."""
     p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
     m, v = _p(ins, "Moment1"), _p(ins, "Moment2")
     b1p, b2p = _p(ins, "Beta1Pow"), _p(ins, "Beta2Pow")
     b1, b2, eps = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999), attrs.get("epsilon", 1e-8)
+    if isinstance(g, RowSparseGrad):
+        rows, vals = g.rows, g.values.astype(p.dtype)
+        m_rows = m.at[rows].get(mode="clip")
+        v_rows = v.at[rows].get(mode="clip")
+        m_new = b1 * m_rows + (1 - b1) * vals
+        v_new = b2 * v_rows + (1 - b2) * jnp.square(vals)
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        delta = lr_t * m_new / (jnp.sqrt(v_new) + eps)
+        return {"ParamOut": [p.at[rows].add(-delta, mode="drop")],
+                "Moment1Out": [m.at[rows].set(m_new, mode="drop")],
+                "Moment2Out": [v.at[rows].set(v_new, mode="drop")],
+                "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
     m_new = b1 * m + (1 - b1) * g
     v_new = b2 * v + (1 - b2) * jnp.square(g)
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
@@ -55,10 +89,17 @@ def adam(ctx, ins, attrs):
             "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
 
 
-@register_op("adagrad")
+@register_op("adagrad", supports_sparse=True)
 def adagrad(ctx, ins, attrs):
     p, g, mom, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment"), _p(ins, "LearningRate")
     eps = attrs.get("epsilon", 1e-6)
+    if isinstance(g, RowSparseGrad):
+        rows, vals = g.rows, g.values.astype(p.dtype)
+        mom_rows = mom.at[rows].get(mode="clip")
+        mom_new = mom_rows + jnp.square(vals)
+        delta = lr * vals / (jnp.sqrt(mom_new) + eps)
+        return {"ParamOut": [p.at[rows].add(-delta, mode="drop")],
+                "MomentOut": [mom.at[rows].set(mom_new, mode="drop")]}
     mom_new = mom + jnp.square(g)
     return {"ParamOut": [p - lr * g / (jnp.sqrt(mom_new) + eps)],
             "MomentOut": [mom_new]}

@@ -399,15 +399,29 @@ def _lookup_infer(op, block):
 @register_op("lookup_table", infer_shape=_lookup_infer)
 def lookup_table(ctx, ins, attrs):
     """lookup_table_op.cc: embedding gather. padding_idx rows read as zero.
-    The is_sparse/is_distributed attrs are accepted; sparse gradients are an
-    XLA-level concern (gather transpose -> scatter-add) rather than a
-    SelectedRows runtime type."""
+
+    is_sparse=True grads: the autodiff (core/lowering.py) differentiates
+    through a zero surrogate added to the gathered rows instead of through
+    the table, yielding a RowSparseGrad (≙ SelectedRows grad,
+    lookup_table_op.cc's sparse path) whose size is O(n_ids), not O(vocab).
+    is_distributed=True is handled at layer level: the table is annotated
+    vocab-sharded over the mesh so GSPMD partitions the gather
+    (≙ distributed lookup table, distribute_transpiler.py:120-180)."""
+    from ..core.selected_rows import squeeze_trailing_ids
     ids, w = ins["Ids"][0], ins["W"][0]
-    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
-    if squeeze_last:
-        ids = ids.reshape(ids.shape[:-1])
-    ids = ids.astype(jnp.int32)
-    out = jnp.take(w, ids, axis=0)
+    ids = squeeze_trailing_ids(ids)
+
+    block0 = getattr(ctx, "block_idx", 0) == 0
+    probe = getattr(ctx, "sparse_probe", None)
+    if probe is not None and attrs.get("is_sparse") and block0:
+        probe[ctx.op_index] = ids
+    sur = getattr(ctx, "sparse_surrogates", None)
+    if (sur is not None and block0 and ctx.op_index in sur
+            and attrs.get("is_sparse")):
+        out = jnp.take(jax.lax.stop_gradient(w), ids, axis=0) \
+            + sur[ctx.op_index]
+    else:
+        out = jnp.take(w, ids, axis=0)
     pidx = attrs.get("padding_idx", -1)
     if pidx is not None and pidx >= 0:
         out = jnp.where((ids == pidx)[..., None], 0.0, out)

@@ -80,9 +80,28 @@ class ParallelExecutor:
             self._scope = share_vars_from._scope
 
     # -- sharding decisions -------------------------------------------------
+    def _divisible(self, spec: PartitionSpec, value) -> PartitionSpec:
+        """Drop spec axes a dim cannot be evenly split over (GSPMD rejects
+        explicit non-divisible shardings); e.g. a vocab of 50 over 8 devices
+        falls back to replication rather than erroring. ≙ the reference's
+        block-size rounding in slice_variable (distribute_transpiler.py:74),
+        which also degrades placement instead of failing."""
+        shape = jnp.shape(value)
+        dims = []
+        for i, axes in enumerate(tuple(spec)):
+            if axes is None or i >= len(shape):
+                dims.append(axes)
+                continue
+            ax_tuple = axes if isinstance(axes, tuple) else (axes,)
+            size = int(np.prod([self._mesh.shape[a] for a in ax_tuple]))
+            dims.append(axes if size and shape[i] % size == 0 else None)
+        while dims and dims[-1] is None:
+            dims.pop()
+        return PartitionSpec(*dims)
+
     def _state_spec(self, var: VarDesc, value) -> PartitionSpec:
         if var is not None and var.sharding:
-            return spec_for(var.sharding, self._mesh)
+            return self._divisible(spec_for(var.sharding, self._mesh), value)
         if (self._build_strategy.reduce_strategy == ReduceStrategy.Reduce
                 and var is not None and not var.is_parameter):
             # optimizer accumulators sharded over dp when cleanly divisible

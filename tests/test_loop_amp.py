@@ -199,6 +199,34 @@ class TestAmp:
                 v = scope.find_var(p.name)
                 assert str(np.asarray(v).dtype) == "float32", p.name
 
+    def test_amp_masters_accumulate_sub_resolution_updates(self):
+        """The optimizer must update the f32 masters, not the bf16-cast
+        copy: per-step deltas below bf16 resolution still accumulate."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [1])
+            y = layers.data("y", [1])
+            pred = layers.fc(input=x, size=1,
+                             param_attr=pt.ParamAttr(
+                                 initializer=pt.initializer.ConstantInitializer(1.0)),
+                             bias_attr=False)
+            loss = layers.mean(layers.square_error_cost(input=pred, label=y))
+            pt.optimizer.SGDOptimizer(learning_rate=5e-5).minimize(loss)
+        main.amp_dtype = "bfloat16"
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            feed = {"x": np.ones((4, 1), np.float32),
+                    "y": np.full((4, 1), 2.0, np.float32)}
+            for _ in range(20):
+                exe.run(main, feed=feed, fetch_list=[loss])
+            w = float(np.ravel(np.asarray(
+                scope.find_var(main.all_parameters()[0].name)))[0])
+        # grad = 2*(w-2) ≈ -2, delta ≈ 1e-4/step « bf16 resolution at 1.0
+        # (0.0078); 20 steps must accumulate ≈ 2e-3 in the f32 master
+        assert w > 1.0 + 1e-3, w
+
     def test_amp_dtype_survives_clone_and_json(self):
         main, _, _ = _mlp_program()
         main.amp_dtype = "bfloat16"

@@ -1,0 +1,278 @@
+"""Sparse (SelectedRows-parity) + distributed (vocab-sharded) embeddings.
+
+≙ reference tests: test_lookup_table_op (sparse grad path),
+test_sgd_op/test_adam_op SelectedRows branches, and the distributed
+lookup-table design (distribute_transpiler.py:120-180) re-read as GSPMD
+vocab sharding. See docs/distributed_embedding.md.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.selected_rows import (RowSparseGrad, rowsparse_from_ids,
+                                           merge_rowsparse)
+
+VOCAB, EMB, NCTX, NCLS = 50, 16, 4, 50
+
+
+def _word2vec_program(is_sparse, optimizer_f, is_distributed=False,
+                      vocab=VOCAB):
+    """CBOW-ish: mean of context embeddings -> softmax over vocab."""
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 42
+    with pt.program_guard(main, startup):
+        ctx_ids = layers.data("ctx", [NCTX], dtype="int64")
+        target = layers.data("target", [1], dtype="int64")
+        emb = layers.embedding(ctx_ids, size=[vocab, EMB],
+                               is_sparse=is_sparse,
+                               is_distributed=is_distributed)
+        avg = layers.reduce_mean(emb, dim=1)
+        logits = layers.fc(input=avg, size=NCLS)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, target))
+        optimizer_f().minimize(loss)
+    return main, startup, loss
+
+
+def _batch(rng, batch=8, lo=0, hi=VOCAB):
+    return {"ctx": rng.randint(lo, hi, (batch, NCTX)).astype("int64"),
+            "target": rng.randint(0, NCLS, (batch, 1)).astype("int64")}
+
+
+def _table_name(main):
+    return [p.name for p in main.all_parameters()
+            if "embedding" in p.name or "tbl" in p.name][0]
+
+
+def _train(main, startup, loss, feeds, scope=None):
+    scope = scope or pt.Scope()
+    losses = []
+    with pt.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        for f in feeds:
+            (l,) = exe.run(main, feed=f, fetch_list=[loss])
+            losses.append(float(np.ravel(l)[0]))
+        table = np.asarray(scope.find_var(_table_name(main)))
+    return losses, table, scope
+
+
+class TestRowSparseGrad:
+    def test_dedup_and_to_dense(self):
+        import jax.numpy as jnp
+        ids = jnp.asarray([[3, 1, 3], [0, 1, 3]])
+        g = jnp.arange(12, dtype=jnp.float32).reshape(2, 3, 2)
+        rs = rowsparse_from_ids(ids, g, height=5)
+        dense = np.zeros((5, 2), np.float32)
+        for i, idx in enumerate(np.ravel(ids)):
+            dense[int(idx)] += np.asarray(g).reshape(-1, 2)[i]
+        np.testing.assert_allclose(np.asarray(rs.to_dense()), dense)
+        # rows are unique among valid slots
+        rows = np.asarray(rs.rows)[np.asarray(rs.mask)]
+        assert len(rows) == len(set(rows.tolist()))
+
+    def test_merge(self):
+        import jax.numpy as jnp
+        a = rowsparse_from_ids(jnp.asarray([1, 2]),
+                               jnp.ones((2, 3)), height=6)
+        b = rowsparse_from_ids(jnp.asarray([2, 5]),
+                               2 * jnp.ones((2, 3)), height=6)
+        m = merge_rowsparse(a, b)
+        np.testing.assert_allclose(
+            np.asarray(m.to_dense()),
+            np.asarray(a.to_dense()) + np.asarray(b.to_dense()))
+
+
+class TestSparseTraining:
+    def test_sgd_sparse_matches_dense(self):
+        """Touched-rows-only SGD is EXACTLY dense SGD (zero grads for
+        untouched rows) — ≙ test_sgd_op's SelectedRows case."""
+        rng = np.random.RandomState(0)
+        feeds = [_batch(rng) for _ in range(5)]
+        opt = lambda: pt.optimizer.SGDOptimizer(learning_rate=0.5)
+        l_dense, t_dense, _ = _train(*_word2vec_program(False, opt), feeds)
+        l_sparse, t_sparse, _ = _train(*_word2vec_program(True, opt), feeds)
+        np.testing.assert_allclose(l_dense, l_sparse, rtol=2e-4)
+        np.testing.assert_allclose(t_dense, t_sparse, rtol=2e-3, atol=1e-5)
+
+    def test_adam_sparse_trains_lazily(self):
+        rng = np.random.RandomState(1)
+        # ids restricted to [0, 20): rows >= 20 must never move
+        feeds = [_batch(rng, hi=20) for _ in range(6)]
+        opt = lambda: pt.optimizer.AdamOptimizer(learning_rate=0.05)
+        main, startup, loss = _word2vec_program(True, opt)
+        losses, table, scope = _train(main, startup, loss, feeds)
+        assert losses[-1] < losses[0]
+        assert np.abs(table[20:]).sum() > 0  # init is nonzero
+        # rows < 20 moved, rows >= 20 identical across two more steps
+        more = [_batch(rng, hi=20) for _ in range(2)]
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            before = np.asarray(scope.find_var(_table_name(main)))
+            for f in more:
+                exe.run(main, feed=f, fetch_list=[loss])
+            after = np.asarray(scope.find_var(_table_name(main)))
+        np.testing.assert_array_equal(before[20:], after[20:])
+        assert np.abs(before[:20] - after[:20]).sum() > 0
+
+    def test_momentum_sparse_lazy_no_drift(self):
+        """Lazy momentum: a row touched once stops moving immediately
+        (dense momentum would keep drifting on decayed velocity)."""
+        rng = np.random.RandomState(2)
+        opt = lambda: pt.optimizer.MomentumOptimizer(learning_rate=0.1,
+                                                     momentum=0.9)
+        main, startup, loss = _word2vec_program(True, opt)
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            exe.run(main, feed=_batch(rng, lo=40, hi=50), fetch_list=[loss])
+            snap = np.asarray(scope.find_var(_table_name(main))).copy()
+            for _ in range(3):
+                exe.run(main, feed=_batch(rng, lo=0, hi=10),
+                        fetch_list=[loss])
+            final = np.asarray(scope.find_var(_table_name(main)))
+        np.testing.assert_array_equal(snap[40:], final[40:])
+
+    def test_fallback_densify_for_unported_optimizer(self):
+        """Optimizers without a sparse kernel see an auto-densified grad,
+        so sparse and dense programs behave IDENTICALLY."""
+        rng = np.random.RandomState(3)
+        feeds = [_batch(rng) for _ in range(4)]
+        opt = lambda: pt.optimizer.AdadeltaOptimizer(learning_rate=1.0)
+        l_dense, t_dense, _ = _train(*_word2vec_program(False, opt), feeds)
+        l_sparse, t_sparse, _ = _train(*_word2vec_program(True, opt), feeds)
+        np.testing.assert_allclose(l_dense, l_sparse, rtol=2e-4)
+        np.testing.assert_allclose(t_dense, t_sparse, rtol=2e-3, atol=1e-5)
+
+    def test_row0_moment_not_corrupted_by_padding_slots(self):
+        """Padding slots point at the OOB sentinel, so duplicate ids in a
+        batch that also touches row 0 must not wipe row 0's velocity."""
+        rng = np.random.RandomState(7)
+        opt = lambda: pt.optimizer.MomentumOptimizer(learning_rate=0.1,
+                                                     momentum=0.9)
+        main, startup, loss = _word2vec_program(True, opt)
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            # ids [0, 3, 3, 7]: a duplicate pair creates one padding slot
+            feed = {"ctx": np.array([[0, 3, 3, 7]], dtype="int64"),
+                    "target": np.array([[1]], dtype="int64")}
+            exe.run(main, feed=feed, fetch_list=[loss])
+            vel_name = [n for n in scope.local_var_names()
+                        if "velocity" in n.lower() and "embedding" in n]
+            vel = np.asarray(scope.find_var(vel_name[0]))
+        assert np.abs(vel[0]).sum() > 0, "row 0 velocity lost"
+        assert np.abs(vel[3]).sum() > 0 and np.abs(vel[7]).sum() > 0
+        assert np.abs(vel[1]).sum() == 0  # untouched row
+
+    def test_tied_weight_falls_back_to_dense(self):
+        """A table with a second (non-sparse-lookup) consumer must take the
+        dense grad path so no gradient contribution is dropped."""
+        from paddle_tpu.param_attr import ParamAttr
+
+        def build(is_sparse):
+            main, startup = pt.Program(), pt.Program()
+            main.random_seed = 7
+            with pt.program_guard(main, startup):
+                ids = layers.data("ctx", [NCTX], dtype="int64")
+                target = layers.data("target", [1], dtype="int64")
+                emb = layers.embedding(
+                    ids, size=[VOCAB, EMB], is_sparse=is_sparse,
+                    param_attr=ParamAttr(name="tied_tbl"))
+                # second consumer: tied output projection W^T
+                avg = layers.reduce_mean(emb, dim=1)        # [B, EMB]
+                tbl = main.global_block.var("tied_tbl")
+                logits = layers.matmul(avg, tbl, transpose_y=True)
+                loss = layers.mean(
+                    layers.softmax_with_cross_entropy(logits, target))
+                pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+            return main, startup, loss
+
+        rng = np.random.RandomState(8)
+        feeds = [_batch(rng) for _ in range(4)]
+        l_dense, t_dense, _ = _train(*build(False), feeds)
+        l_sparse, t_sparse, _ = _train(*build(True), feeds)
+        np.testing.assert_allclose(l_dense, l_sparse, rtol=2e-4)
+        np.testing.assert_allclose(t_dense, t_sparse, rtol=2e-3, atol=1e-5)
+
+    def test_amp_sparse_trains_with_f32_masters(self):
+        rng = np.random.RandomState(9)
+        opt = lambda: pt.optimizer.AdamOptimizer(learning_rate=0.05)
+        main, startup, loss = _word2vec_program(True, opt)
+        main.amp_dtype = "bfloat16"
+        feeds = [_batch(rng, hi=20)] * 6
+        losses, table, scope = _train(main, startup, loss, feeds)
+        assert losses[-1] < losses[0]
+        assert table.dtype == np.float32
+
+    def test_padding_idx_row_untouched(self):
+        rng = np.random.RandomState(4)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            ctx_ids = layers.data("ctx", [NCTX], dtype="int64")
+            target = layers.data("target", [1], dtype="int64")
+            emb = layers.embedding(ctx_ids, size=[VOCAB, EMB],
+                                   is_sparse=True, padding_idx=0)
+            avg = layers.reduce_mean(emb, dim=1)
+            logits = layers.fc(input=avg, size=NCLS)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, target))
+            pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+        feeds = [_batch(rng) for _ in range(3)]  # includes id 0
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            row0 = np.asarray(scope.find_var(_table_name(main)))[0].copy()
+            for f in feeds:
+                exe.run(main, feed=f, fetch_list=[loss])
+            row0_after = np.asarray(scope.find_var(_table_name(main)))[0]
+        np.testing.assert_array_equal(row0, row0_after)
+
+
+class TestDistributedEmbedding:
+    def test_vocab_sharded_matches_dense_and_saves_memory(self):
+        from paddle_tpu.parallel import ParallelExecutor, make_mesh
+        vocab = 64  # divisible by the 8-device mesh
+        rng = np.random.RandomState(5)
+        feeds = [_batch(rng, hi=vocab) for _ in range(4)]
+        opt = lambda: pt.optimizer.SGDOptimizer(learning_rate=0.5)
+
+        l_single, _, _ = _train(*_word2vec_program(False, opt, vocab=vocab),
+                                feeds)
+
+        main, startup, loss = _word2vec_program(
+            False, opt, is_distributed=True, vocab=vocab)
+        assert main.global_block.var(_table_name(main)).sharding is not None
+        mesh = make_mesh({"dp": 1, "tp": 8})
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            pe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                                  mesh=mesh, scope=scope)
+            l_shard = [float(np.ravel(pe.run([loss], feed=f)[0])[0])
+                       for f in feeds]
+            table = scope.find_var(_table_name(main))
+        np.testing.assert_allclose(l_single, l_shard, rtol=2e-4)
+        # each device holds only its vocab/8 slice of the table
+        assert table.addressable_shards[0].data.shape[0] == vocab // 8
+
+    def test_non_divisible_vocab_falls_back_to_replication(self):
+        from paddle_tpu.parallel import ParallelExecutor, make_mesh
+        rng = np.random.RandomState(6)
+        opt = lambda: pt.optimizer.SGDOptimizer(learning_rate=0.5)
+        main, startup, loss = _word2vec_program(
+            False, opt, is_distributed=True)  # vocab 50 on 8 devices
+        mesh = make_mesh({"dp": 1, "tp": 8})
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            pe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                                  mesh=mesh, scope=scope)
+            (l,) = pe.run([loss], feed=_batch(rng))
+        assert np.isfinite(np.ravel(l)[0])
