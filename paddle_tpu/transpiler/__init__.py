@@ -1,0 +1,6 @@
+"""Program→program rewrites (≙ python/paddle/fluid/transpiler/)."""
+
+from .distribute_transpiler import (DistributeTranspiler, TranspileStrategy,
+                                    transpile)
+
+__all__ = ["DistributeTranspiler", "TranspileStrategy", "transpile"]

@@ -1,0 +1,236 @@
+"""Automatic sharding pass: derive VarDesc.sharding from program structure.
+
+≙ reference DistributeTranspiler.transpile (transpiler/distribute_transpiler
+.py:244), which rewrites a program for a cluster (split params into blocks,
+insert send/recv, build pserver programs). On the TPU runtime the rewrite
+target is different — the program stays single-SPMD and the "distribution"
+is expressed as sharding annotations that GSPMD partitions — but the role
+is the same: the user writes a single-device program, calls transpile, and
+gets a distributed one with zero per-model sharding code.
+
+Derivations (strategy-gated):
+  * Megatron tensor parallelism for matmul chains: when matmul W1 feeds —
+    through elementwise/activation/reshape/attention ops — a second matmul
+    W2, W1 is column-parallel (None,'tp') and W2 row-parallel ('tp',None);
+    the intermediate stays tp-sharded and GSPMD inserts the psum at W2's
+    contraction. QKV→out-proj attention blocks fall out of the same rule
+    because the backward trace fans out through the attention op's Q/K/V.
+  * fc bias of a column-parallel matmul: sharded ('tp',).
+  * Embedding tables: vocab-sharded over ('tp','dp') (the distributed
+    lookup table, distribute_transpiler.py:120-180).
+  * Sequence parallelism: attention ops' sp_mode attr rewritten (ring /
+    ulysses over the 'sp' axis) — an actual op rewrite, not an annotation.
+  * Optimizer accumulators inherit their parameter's sharding (≙ pserver
+    optimizer blocks living with the param shard, listen_and_serv).
+
+Every sharded dim is checked divisible by the mesh axis size; otherwise
+that var stays replicated (≙ slice_variable's block rounding).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+from ..core.program import Program, VarDesc, default_main_program
+
+# ops a tp-sharded activation may flow through without breaking the
+# column→row Megatron pairing; values = input slots the trace follows
+_PASS_THROUGH = {
+    "elementwise_add": ["X"], "elementwise_sub": ["X"], "elementwise_mul": ["X"],
+    "scale": ["X"], "cast": ["X"], "dropout": ["X"],
+    "relu": ["X"], "gelu": ["X"], "tanh": ["X"], "sigmoid": ["X"],
+    "swish": ["X"], "relu6": ["X"], "leaky_relu": ["X"], "elu": ["X"],
+    "softsign": ["X"], "softplus": ["X"],
+    "reshape": ["X"], "reshape2": ["X"], "transpose": ["X"], "transpose2": ["X"],
+    "squeeze": ["X"], "unsqueeze": ["X"],
+    "scaled_dot_product_attention": ["Q", "K", "V"],
+}
+
+_MATMUL_TYPES = ("mul", "matmul")
+
+
+@dataclass
+class TranspileStrategy:
+    """What to derive (≙ the reference's transpile() arguments + config)."""
+    tp: bool = True                  # Megatron matmul-chain sharding
+    shard_embeddings: bool = True    # vocab-shard lookup tables
+    sp_mode: Optional[str] = None    # 'ring' | 'ulysses' -> rewrite attention
+
+
+def _mesh_axis_size(mesh, axis: str) -> int:
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(axis, 1))
+
+
+def transpile(program: Optional[Program] = None, mesh=None,
+              strategy: Optional[TranspileStrategy] = None) -> Program:
+    """Annotate `program` for the mesh; mutates in place and returns it."""
+    program = program if program is not None else default_main_program()
+    strategy = strategy or TranspileStrategy()
+    block = program.global_block
+    tp_size = _mesh_axis_size(mesh, "tp")
+    sp_size = _mesh_axis_size(mesh, "sp")
+
+    def var(name) -> Optional[VarDesc]:
+        try:
+            return block.var(name)
+        except KeyError:
+            return None
+
+    def is_trainable_param(v: Optional[VarDesc]) -> bool:
+        return v is not None and v.is_parameter and v.trainable
+
+    # -- producer map ------------------------------------------------------
+    produced_by: Dict[str, int] = {}
+    for i, op in enumerate(block.ops):
+        for n in op.output_names():
+            produced_by[n] = i
+
+    def trace_back_to_matmuls(name: str, seen: Set[int]) -> List[int]:
+        """Follow `name` backwards through pass-through ops; return indices
+        of the matmul ops whose outputs feed it."""
+        idx = produced_by.get(name)
+        if idx is None or idx in seen:
+            return []
+        seen.add(idx)
+        op = block.ops[idx]
+        if op.type in _MATMUL_TYPES:
+            return [idx]
+        slots = _PASS_THROUGH.get(op.type)
+        if slots is None:
+            return []
+        found: List[int] = []
+        for slot in slots:
+            for n in op.inputs.get(slot, []):
+                found.extend(trace_back_to_matmuls(n, seen))
+        return found
+
+    # -- Megatron tp pairing ----------------------------------------------
+    if strategy.tp and tp_size > 1:
+        col: Set[str] = set()
+        row: Set[str] = set()
+        def plain_matmul_weight(op):
+            """The 2-D trainable Y of a non-transposed matmul, else None.
+            Transposed matmuls store the weight in the opposite convention;
+            annotating them with the plain-layout specs would hand GSPMD an
+            anti-Megatron layout, so the pairing skips them."""
+            if op.attrs and (op.attrs.get("transpose_X")
+                             or op.attrs.get("transpose_Y")):
+                return None
+            y = op.inputs.get("Y")
+            w = var(y[0]) if y else None
+            return w if is_trainable_param(w) and len(w.shape) == 2 else None
+
+        for i, op in enumerate(block.ops):
+            if op.type not in _MATMUL_TYPES:
+                continue
+            w2 = plain_matmul_weight(op)
+            if w2 is None:
+                continue
+            for x_name in op.inputs.get("X", []):
+                for j in trace_back_to_matmuls(x_name, set()):
+                    m1 = block.ops[j]
+                    w1 = plain_matmul_weight(m1)
+                    if w1 is None:
+                        continue
+                    if w1.name == w2.name:      # tied weight — ambiguous
+                        continue
+                    # hidden dim must split evenly on both sides
+                    if w1.shape[1] % tp_size or w2.shape[0] % tp_size:
+                        continue
+                    col.add(w1.name)
+                    row.add(w2.name)
+                    # column-parallel fc's bias is sharded with the columns
+                    for k in range(j + 1, i):
+                        bop = block.ops[k]
+                        if (bop.type == "elementwise_add"
+                                and m1.output_names()
+                                and m1.output_names()[0] in bop.input_names()):
+                            for b_name in bop.inputs.get("Y", []):
+                                bv = var(b_name)
+                                if (is_trainable_param(bv)
+                                        and len(bv.shape) == 1
+                                        and bv.shape[0] == w1.shape[1]):
+                                    bv.sharding = bv.sharding or ("tp",)
+        conflicts = col & row
+        for name in col - conflicts:
+            v = var(name)
+            if v.sharding is None:
+                v.sharding = (None, "tp")
+        for name in row - conflicts:
+            v = var(name)
+            if v.sharding is None:
+                v.sharding = ("tp", None)
+
+    # -- embeddings --------------------------------------------------------
+    if strategy.shard_embeddings:
+        for op in block.ops:
+            if op.type != "lookup_table":
+                continue
+            w = var(op.inputs["W"][0])
+            if is_trainable_param(w) and w.sharding is None:
+                w.sharding = (("tp", "dp"), None)
+
+    # -- sequence parallelism: actual op rewrite ---------------------------
+    if strategy.sp_mode and sp_size > 1:
+        for op in block.ops:
+            if op.type == "scaled_dot_product_attention":
+                op.attrs["sp_mode"] = strategy.sp_mode
+
+    # -- optimizer accumulators follow their param -------------------------
+    for op in block.ops:
+        if "Param" not in op.inputs:
+            continue
+        p = var(op.inputs["Param"][0])
+        if p is None or p.sharding is None:
+            continue
+        for slot, names in op.inputs.items():
+            if slot in ("Param", "Grad", "LearningRate"):
+                continue
+            for n in names:
+                acc = var(n)
+                if (acc is not None and not acc.is_parameter
+                        and tuple(acc.shape) == tuple(p.shape)
+                        and acc.sharding is None):
+                    acc.sharding = p.sharding
+
+    program.invalidate_cache()
+    return program
+
+
+class DistributeTranspiler:
+    """API-parity wrapper (≙ fluid.DistributeTranspiler). The pserver
+    arguments are accepted for source compatibility; on this runtime the
+    single transpiled program serves every role (docs/distributed_embedding
+    .md records the sync-only decision)."""
+
+    def __init__(self):
+        self._program: Optional[Program] = None
+        self._startup: Optional[Program] = None
+
+    def transpile(self, trainer_id: int = 0, program: Optional[Program] = None,
+                  pservers: str = "", trainers: int = 1, sync_mode: bool = True,
+                  startup_program: Optional[Program] = None,
+                  mesh=None, strategy: Optional[TranspileStrategy] = None):
+        if not sync_mode:
+            raise NotImplementedError(
+                "async pserver mode is not provided on the TPU runtime "
+                "(sync-only by design; docs/distributed_embedding.md)")
+        from ..core.program import default_startup_program
+        self._startup = (startup_program if startup_program is not None
+                         else default_startup_program())
+        self._program = transpile(program, mesh=mesh, strategy=strategy)
+        return self._program
+
+    def get_trainer_program(self) -> Program:
+        return self._program
+
+    def get_pserver_program(self, endpoint: str = "") -> Program:
+        # every device runs the same SPMD program; param "blocks" live with
+        # their shards via GSPMD rather than on a pserver process
+        return self._program
+
+    def get_startup_program(self, *a, **kw) -> Program:
+        return self._startup

@@ -1,0 +1,147 @@
+"""Automatic sharding pass (≙ test_dist_transpiler.py /
+test_simple_dist_transpiler.py: assert on the transpiled program's
+structure, no cluster needed).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.transpiler import TranspileStrategy, transpile
+
+
+def _mlp(hidden=64, classes=32):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 3
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [16])
+        label = layers.data("label", [1], dtype="int64")
+        h = layers.fc(input=x, size=hidden, act="relu")
+        logits = layers.fc(input=h, size=classes)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.MomentumOptimizer(learning_rate=0.1,
+                                       momentum=0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _param_shardings(main):
+    return {v.name: v.sharding for v in main.global_block.vars.values()
+            if v.sharding is not None}
+
+
+class TestMegatronDerivation:
+    def test_fc_pair_column_then_row(self):
+        main, _, _ = _mlp()
+        transpile(main, mesh=make_mesh({"dp": 4, "tp": 2}))
+        sh = _param_shardings(main)
+        w1 = [n for n in sh if n.startswith("fc_0") and n.endswith("w_0")][0]
+        w2 = [n for n in sh if n.startswith("fc_1") and n.endswith("w_0")][0]
+        assert sh[w1] == (None, "tp")       # column-parallel
+        assert sh[w2] == ("tp", None)       # row-parallel
+        b1 = [n for n in sh if n.startswith("fc_0") and n.endswith("b_0")]
+        assert b1 and sh[b1[0]] == ("tp",)  # bias follows the columns
+
+    def test_accumulators_follow_param(self):
+        main, _, _ = _mlp()
+        transpile(main, mesh=make_mesh({"dp": 4, "tp": 2}))
+        blk = main.global_block
+        for v in blk.vars.values():
+            if "velocity" in v.name and "fc_0.w_0" in v.name:
+                assert v.sharding == (None, "tp"), v.name
+                break
+        else:
+            pytest.fail("no velocity accumulator found")
+
+    def test_non_divisible_hidden_stays_replicated(self):
+        main, _, _ = _mlp(hidden=30)  # 30 % 4 != 0
+        transpile(main, mesh=make_mesh({"dp": 2, "tp": 4}))
+        sh = _param_shardings(main)
+        assert not any(n.startswith("fc_") for n in sh), sh
+
+    def test_transformer_attention_and_ffn(self):
+        from paddle_tpu.models.transformer import transformer_lm_loss
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            avg, _ = transformer_lm_loss(vocab_size=64, seq_len=16,
+                                         n_layers=1, d_model=32, n_heads=4,
+                                         d_ff=64)
+            pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(avg)
+        transpile(main, mesh=make_mesh({"dp": 2, "tp": 2, "sp": 2}),
+                  strategy=TranspileStrategy(sp_mode="ring"))
+        sh = _param_shardings(main)
+        # QKV projections column-parallel, out-projection row-parallel
+        qkv = [n for n in sh
+               if any(t in n for t in ("_q_", "_k_", "_v_")) and "w" in n]
+        outp = [n for n in sh if "_o_" in n or "_out_" in n]
+        assert len(qkv) >= 3, sorted(sh)
+        for n in qkv:
+            assert sh[n] == (None, "tp"), (n, sh[n])
+        assert outp and all(sh[n] == ("tp", None) for n in outp), sorted(sh)
+        # token embedding vocab-sharded
+        assert sh.get("tok_emb") == (("tp", "dp"), None)
+        # attention ops rewritten to ring sequence parallelism
+        attn = [op for op in main.global_block.ops
+                if op.type == "scaled_dot_product_attention"]
+        assert attn and all(op.attrs.get("sp_mode") == "ring" for op in attn)
+
+    def test_tied_weight_not_sharded(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [64])
+            label = layers.data("label", [1], dtype="int64")
+            w = layers.create_parameter([64, 64], dtype="float32",
+                                        name="tied_w")
+            h = layers.relu(layers.matmul(x, w))
+            logits = layers.matmul(h, w)  # same W both sides
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        transpile(main, mesh=make_mesh({"dp": 4, "tp": 2}))
+        assert main.global_block.var("tied_w").sharding is None
+
+
+class TestTranspiledNumerics:
+    def test_losses_match_unsharded(self):
+        from paddle_tpu.parallel import ParallelExecutor
+        rng = np.random.RandomState(0)
+        feeds = [{"x": rng.rand(8, 16).astype("float32"),
+                  "label": rng.randint(0, 32, (8, 1)).astype("int64")}
+                 for _ in range(3)]
+
+        main, startup, loss = _mlp()
+        ref = []
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            for f in feeds:
+                ref.append(float(np.ravel(
+                    exe.run(main, feed=f, fetch_list=[loss])[0])[0]))
+
+        main2, startup2, loss2 = _mlp()
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        transpile(main2, mesh=mesh)
+        got = []
+        scope2 = pt.Scope()
+        with pt.scope_guard(scope2):
+            exe = pt.Executor()
+            exe.run(startup2)
+            pe = ParallelExecutor(loss_name=loss2.name, main_program=main2,
+                                  mesh=mesh, scope=scope2)
+            for f in feeds:
+                got.append(float(np.ravel(pe.run([loss2], feed=f)[0])[0]))
+        np.testing.assert_allclose(ref, got, rtol=2e-4)
+
+
+class TestApiParity:
+    def test_distribute_transpiler_wrapper(self):
+        main, _, _ = _mlp()
+        t = pt.DistributeTranspiler()
+        t.transpile(trainer_id=0, program=main, pservers="127.0.0.1:6174",
+                    trainers=2, mesh=make_mesh({"dp": 4, "tp": 2}))
+        assert t.get_trainer_program() is main
+        assert _param_shardings(main)
+        with pytest.raises(NotImplementedError):
+            pt.DistributeTranspiler().transpile(program=main, sync_mode=False)
