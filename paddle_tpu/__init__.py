@@ -27,6 +27,8 @@ from . import metrics
 from . import parallel
 from .parallel import ParallelExecutor, BuildStrategy, ExecutionStrategy
 from . import reader
+from . import recordio
+from . import dataset
 from . import transpiler
 from .transpiler import DistributeTranspiler, TranspileStrategy
 from .data_feeder import DataFeeder

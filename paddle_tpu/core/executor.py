@@ -105,7 +105,8 @@ class Executor:
                 val = padded
                 out[seq_len_name] = jnp.asarray(lens)
             elif seq_len_name and seq_len_name not in feed:
-                arr0 = np.asarray(val)
+                # shape-only inspection: never np.asarray a device array
+                arr0 = val if hasattr(val, "shape") else np.asarray(val)
                 # full-length sequences: [B, T, ...] -> lens [B]=T; with a
                 # leading step axis, [N, B, T, ...] -> lens [N, B]=T
                 if per_step:
@@ -115,6 +116,15 @@ class Executor:
                     out[seq_len_name] = jnp.full((arr0.shape[0],),
                                                  arr0.shape[1], np.int32)
 
+            if isinstance(val, jax.Array):
+                # already on device (double-buffer prefetch, reader/prefetch
+                # .py) — never round-trip through host numpy
+                want = (np_dtype(_device_dtype(var.dtype))
+                        if var is not None else None)
+                out[name] = (val if want is None
+                             or val.dtype == jnp.dtype(want)
+                             else val.astype(want))
+                continue
             arr = np.asarray(val)
             if var is not None:
                 want = np_dtype(_device_dtype(var.dtype))

@@ -1,0 +1,27 @@
+"""Dataset loaders (≙ python/paddle/dataset/, 14 modules).
+
+Each module exposes reader creators (`train()`, `test()`, …) returning
+zero-arg callables that yield samples — the same reader protocol the
+decorators in paddle_tpu.reader compose over. Files are cached under
+common.DATA_HOME; see common.download for the offline contract.
+"""
+
+from . import common
+from . import mnist
+from . import cifar
+from . import imdb
+from . import imikolov
+from . import movielens
+from . import uci_housing
+from . import wmt14
+from . import wmt16
+from . import conll05
+from . import sentiment
+from . import mq2007
+from . import flowers
+from . import voc2012
+from . import image
+
+__all__ = ["common", "mnist", "cifar", "imdb", "imikolov", "movielens",
+           "uci_housing", "wmt14", "wmt16", "conll05", "sentiment",
+           "mq2007", "flowers", "voc2012", "image"]

@@ -1,0 +1,66 @@
+"""CIFAR-10/100 loader (≙ python/paddle/dataset/cifar.py). Parses the
+python-pickle tar.gz batches into (float32[3072] in [0,1], int label)."""
+
+from __future__ import annotations
+
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100", "convert"]
+
+URL_PREFIX = "https://www.cs.toronto.edu/~kriz/"
+CIFAR10_URL = URL_PREFIX + "cifar-10-python.tar.gz"
+CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"
+CIFAR100_URL = URL_PREFIX + "cifar-100-python.tar.gz"
+CIFAR100_MD5 = "eb9058c3a382ffc7106e4002c42a8d85"
+
+
+def reader_creator(filename: str, sub_name: str):
+    def read_batch(batch):
+        data = batch[b"data"]
+        labels = batch.get(b"labels", batch.get(b"fine_labels"))
+        for s, l in zip(data, labels):
+            yield s.astype(np.float32) / 255.0, int(l)
+
+    def reader():
+        with tarfile.open(filename, mode="r") as f:
+            names = sorted(n for n in f.getnames() if sub_name in n)
+            for name in names:
+                batch = pickle.load(f.extractfile(name), encoding="bytes")
+                yield from read_batch(batch)
+
+    return reader
+
+
+def train100():
+    return reader_creator(
+        common.download(CIFAR100_URL, "cifar", CIFAR100_MD5), "train")
+
+
+def test100():
+    return reader_creator(
+        common.download(CIFAR100_URL, "cifar", CIFAR100_MD5), "test")
+
+
+def train10():
+    return reader_creator(
+        common.download(CIFAR10_URL, "cifar", CIFAR10_MD5), "data_batch")
+
+
+def test10():
+    return reader_creator(
+        common.download(CIFAR10_URL, "cifar", CIFAR10_MD5), "test_batch")
+
+
+def fetch():
+    common.download(CIFAR10_URL, "cifar", CIFAR10_MD5)
+    common.download(CIFAR100_URL, "cifar", CIFAR100_MD5)
+
+
+def convert(path: str):
+    common.convert(path, train10(), 1000, "cifar_train10")
+    common.convert(path, test10(), 1000, "cifar_test10")

@@ -1,0 +1,105 @@
+"""Dataset cache/download plumbing (≙ python/paddle/dataset/common.py).
+
+Files live under DATA_HOME (~/.cache/paddle_tpu/dataset/<module>/...,
+override with PADDLE_TPU_DATA_HOME). `download` verifies md5 and fetches
+over HTTP when the environment allows egress; in air-gapped environments
+it raises with the exact path to pre-place the file at.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Callable
+
+__all__ = ["DATA_HOME", "md5file", "download", "convert", "cluster_files_reader"]
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu", "dataset"))
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str,
+             save_name: str | None = None) -> str:
+    dirname = os.path.join(DATA_HOME, module_name)
+    os.makedirs(dirname, exist_ok=True)
+    filename = os.path.join(dirname,
+                            save_name or url.split("/")[-1])
+    if os.path.exists(filename) and (not md5sum
+                                     or md5file(filename) == md5sum):
+        return filename
+    try:
+        import urllib.request
+        tmp = filename + ".part"
+        urllib.request.urlretrieve(url, tmp)
+        if md5sum and md5file(tmp) != md5sum:
+            os.remove(tmp)
+            raise IOError(f"md5 mismatch downloading {url}")
+        os.replace(tmp, filename)
+        return filename
+    except Exception as e:
+        raise IOError(
+            f"cannot download {url} ({e}). In an offline environment, "
+            f"place the file at {filename} (md5 {md5sum or 'any'}).") from e
+
+
+def convert(output_path: str, reader: Callable, line_count: int,
+            name_prefix: str):
+    """Serialize a reader's samples into recordio shards
+    (≙ common.py convert / recordio_converter.py)."""
+    from .. import recordio
+
+    idx = 0
+    n = 0
+    w = None
+    path = None
+    for sample in reader():
+        if w is None:
+            path = os.path.join(output_path, f"{name_prefix}-{idx:05d}")
+            w = recordio.Writer(path)
+        w.write(pickle.dumps(sample, protocol=4))
+        n += 1
+        if n >= line_count:
+            w.close()
+            w, n, idx = None, 0, idx + 1
+    if w is not None:
+        w.close()
+
+
+def recordio_reader(paths):
+    """Read back samples written by convert()."""
+    from .. import recordio
+
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def reader():
+        for p in paths:
+            for rec in recordio.scan(p):
+                yield pickle.loads(rec)
+
+    return reader
+
+
+def cluster_files_reader(files_pattern: str, trainer_count: int,
+                         trainer_id: int, loader=pickle.load):
+    """Round-robin shard files across trainers (common.py:130)."""
+    import glob
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        for i, fn in enumerate(flist):
+            if i % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    yield from loader(f)
+
+    return reader

@@ -1,0 +1,186 @@
+"""CoNLL-2005 SRL loader (≙ python/paddle/dataset/conll05.py): parallel
+word/props files → (word, ctx windows, predicate, mark, label) samples."""
+
+from __future__ import annotations
+
+import gzip
+import itertools
+import tarfile
+
+from . import common
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+DATA_URL = "http://paddlemodels.bj.bcebos.com/conll05st/conll05st-tests.tar.gz"
+DATA_MD5 = "387719152ae52d60422c016e92a742fc"
+WORDDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st/wordDict.txt"
+WORDDICT_MD5 = "ea7fb7d4c75cc6254716f0177a506baa"
+VERBDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st/verbDict.txt"
+VERBDICT_MD5 = "0d2977293bbb6cbefab5b0f97db1e77c"
+TRGDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st/targetDict.txt"
+TRGDICT_MD5 = "d8c7f03ceb5fc2e5a0fa7503a4353751"
+EMB_URL = "http://paddlemodels.bj.bcebos.com/conll05st/emb"
+EMB_MD5 = "bf436eb0faa1f6f9103017f8be57cdb7"
+
+UNK_IDX = 0
+
+
+def load_label_dict(filename):
+    d = dict()
+    tag_dict = set()
+    with open(filename, "r") as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("B-"):
+                tag_dict.add(line[2:])
+            elif line.startswith("I-"):
+                tag_dict.add(line[2:])
+        index = 0
+        for tag in sorted(tag_dict):
+            d["B-" + tag] = index
+            index += 1
+            d["I-" + tag] = index
+            index += 1
+        d["O"] = index
+    return d
+
+
+def load_dict(filename):
+    d = dict()
+    with open(filename, "r") as f:
+        for i, line in enumerate(f):
+            d[line.strip()] = i
+    return d
+
+
+def corpus_reader(data_path, words_name, props_name):
+    """Yield (sentence tokens, label columns) per sentence; one sample per
+    predicate column, exactly the reference's traversal."""
+
+    def reader():
+        with tarfile.open(data_path) as tf:
+            wf = tf.extractfile(words_name)
+            pf = tf.extractfile(props_name)
+            with gzip.GzipFile(fileobj=wf) as words_file, \
+                    gzip.GzipFile(fileobj=pf) as props_file:
+                sentences = []
+                labels = []
+                one_seg = []
+                for word, label in zip(words_file, props_file):
+                    word = word.decode().strip()
+                    label = label.decode().strip().split()
+                    if len(label) == 0:  # sentence boundary
+                        for i in range(len(one_seg[0])):
+                            a_kind_lable = [x[i] for x in one_seg]
+                            labels.append(a_kind_lable)
+                        if len(labels) >= 1:
+                            verb_list = []
+                            for x in labels[0]:
+                                if x != "-":
+                                    verb_list.append(x)
+                            for i, lbl in enumerate(labels[1:]):
+                                cur_tag = "O"
+                                is_in_bracket = False
+                                lbl_seq = []
+                                verb_word = ""
+                                for l in lbl:
+                                    if l == "*" and not is_in_bracket:
+                                        lbl_seq.append("O")
+                                    elif l == "*" and is_in_bracket:
+                                        lbl_seq.append("I-" + cur_tag)
+                                    elif l == "*)":
+                                        lbl_seq.append("I-" + cur_tag)
+                                        is_in_bracket = False
+                                    elif l.startswith("(") and l.endswith(")"):
+                                        cur_tag = l[1:l.find("*")]
+                                        lbl_seq.append("B-" + cur_tag)
+                                        is_in_bracket = False
+                                    elif l.startswith("("):
+                                        cur_tag = l[1:l.find("*")]
+                                        lbl_seq.append("B-" + cur_tag)
+                                        is_in_bracket = True
+                                    else:
+                                        raise RuntimeError(
+                                            f"unexpected label: {l}")
+                                yield sentences, verb_list[i], lbl_seq
+                        sentences = []
+                        labels = []
+                        one_seg = []
+                    else:
+                        sentences.append(word)
+                        one_seg.append(label)
+
+    return reader
+
+
+def reader_creator(corpus_reader_fn, word_dict=None, predicate_dict=None,
+                   label_dict=None):
+    def reader():
+        for sentence, predicate, labels in corpus_reader_fn():
+            sen_len = len(sentence)
+            verb_index = labels.index("B-V")
+            mark = [0] * len(labels)
+            if verb_index > 0:
+                mark[verb_index - 1] = 1
+                ctx_n1 = sentence[verb_index - 1]
+            else:
+                ctx_n1 = "bos"
+            if verb_index > 1:
+                mark[verb_index - 2] = 1
+                ctx_n2 = sentence[verb_index - 2]
+            else:
+                ctx_n2 = "bos"
+            mark[verb_index] = 1
+            ctx_0 = sentence[verb_index]
+            if verb_index < len(labels) - 1:
+                mark[verb_index + 1] = 1
+                ctx_p1 = sentence[verb_index + 1]
+            else:
+                ctx_p1 = "eos"
+            if verb_index < len(labels) - 2:
+                mark[verb_index + 2] = 1
+                ctx_p2 = sentence[verb_index + 2]
+            else:
+                ctx_p2 = "eos"
+
+            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
+            ctx_n2_idx = [word_dict.get(ctx_n2, UNK_IDX)] * sen_len
+            ctx_n1_idx = [word_dict.get(ctx_n1, UNK_IDX)] * sen_len
+            ctx_0_idx = [word_dict.get(ctx_0, UNK_IDX)] * sen_len
+            ctx_p1_idx = [word_dict.get(ctx_p1, UNK_IDX)] * sen_len
+            ctx_p2_idx = [word_dict.get(ctx_p2, UNK_IDX)] * sen_len
+            pred_idx = [predicate_dict.get(predicate)] * sen_len
+            label_idx = [label_dict.get(w) for w in labels]
+            yield (word_idx, ctx_n2_idx, ctx_n1_idx, ctx_0_idx, ctx_p1_idx,
+                   ctx_p2_idx, pred_idx, mark, label_idx)
+
+    return reader
+
+
+def get_dict():
+    word_dict = load_dict(
+        common.download(WORDDICT_URL, "conll05st", WORDDICT_MD5))
+    verb_dict = load_dict(
+        common.download(VERBDICT_URL, "conll05st", VERBDICT_MD5))
+    label_dict = load_label_dict(
+        common.download(TRGDICT_URL, "conll05st", TRGDICT_MD5))
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    return common.download(EMB_URL, "conll05st", EMB_MD5)
+
+
+def test():
+    word_dict, verb_dict, label_dict = get_dict()
+    reader = corpus_reader(
+        common.download(DATA_URL, "conll05st", DATA_MD5),
+        words_name="conll05st-release/test.wsj/words/test.wsj.words.gz",
+        props_name="conll05st-release/test.wsj/props/test.wsj.props.gz")
+    return reader_creator(reader, word_dict, verb_dict, label_dict)
+
+
+def fetch():
+    get_dict()
+    get_embedding()
+    common.download(DATA_URL, "conll05st", DATA_MD5)

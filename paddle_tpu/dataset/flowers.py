@@ -1,0 +1,105 @@
+"""Oxford-102 flowers loader (≙ python/paddle/dataset/flowers.py): jpeg
+tgz + .mat label/setid files → (CHW float image, label) samples."""
+
+from __future__ import annotations
+
+import functools
+import tarfile
+
+import numpy as np
+
+from . import common
+from .image import load_image_bytes, simple_transform
+
+__all__ = ["train", "test", "valid"]
+
+DATA_URL = "http://paddlemodels.bj.bcebos.com/flowers/102flowers.tgz"
+LABEL_URL = "http://paddlemodels.bj.bcebos.com/flowers/imagelabels.mat"
+SETID_URL = "http://paddlemodels.bj.bcebos.com/flowers/setid.mat"
+DATA_MD5 = "52808999861908f626f3c1f4e79d11fa"
+LABEL_MD5 = "e0620be6f572b9609742df49c70aed4d"
+SETID_MD5 = "a5357ecc9cb78c4bef273ce3793fc85c"
+
+TRAIN_FLAG = "trnid"
+TEST_FLAG = "tstid"
+VALID_FLAG = "valid"
+
+
+def _loadmat(path):
+    try:
+        from scipy.io import loadmat
+        return loadmat(path)
+    except ImportError as e:
+        raise ImportError("flowers labels need scipy (loadmat)") from e
+
+
+def reader_creator(data_file, label_file, setid_file, dataset_name,
+                   mapper=None, buffered_size=1024, use_xmap=True):
+    labels = _loadmat(label_file)["labels"][0]
+    indexes = _loadmat(setid_file)[dataset_name][0]
+
+    if mapper is None:
+        mapper = functools.partial(default_mapper, True)
+
+    def raw_reader():
+        with tarfile.open(data_file) as f:
+            members = {m.name: m for m in f.getmembers()
+                       if m.name.endswith(".jpg")}
+            for index in indexes:
+                name = f"jpg/image_{index:05d}.jpg"
+                m = members.get(name)
+                if m is None:
+                    continue
+                yield f.extractfile(m).read(), int(labels[index - 1] - 1)
+
+    if use_xmap:
+        # parallel JPEG decode+transform (≙ the reference's xmap path)
+        from ..reader import xmap_readers
+        return xmap_readers(mapper, raw_reader, process_num=4,
+                            buffer_size=buffered_size)
+
+    def reader():
+        for sample in raw_reader():
+            yield mapper(sample)
+
+    return reader
+
+
+def default_mapper(is_train, sample):
+    img, label = sample
+    img = load_image_bytes(img)
+    img = simple_transform(img, 256, 224, is_train,
+                           mean=[103.94, 116.78, 123.68])
+    return img.flatten().astype("float32"), label
+
+
+train_mapper = functools.partial(default_mapper, True)
+test_mapper = functools.partial(default_mapper, False)
+
+
+def _files():
+    return (common.download(DATA_URL, "flowers", DATA_MD5),
+            common.download(LABEL_URL, "flowers", LABEL_MD5),
+            common.download(SETID_URL, "flowers", SETID_MD5))
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    d, l, s = _files()
+    return reader_creator(d, l, s, TRAIN_FLAG, mapper or train_mapper,
+                          buffered_size, use_xmap)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    d, l, s = _files()
+    return reader_creator(d, l, s, TEST_FLAG, mapper or test_mapper,
+                          buffered_size, use_xmap)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    d, l, s = _files()
+    return reader_creator(d, l, s, VALID_FLAG, mapper or test_mapper,
+                          buffered_size, use_xmap)
+
+
+def fetch():
+    _files()

@@ -1,0 +1,84 @@
+"""Image batch helpers (≙ python/paddle/dataset/image.py): decode /
+resize / crop / flip / CHW transforms used by the flowers & voc loaders.
+Uses PIL when available (the reference used cv2); pure-numpy fallbacks
+where possible."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["load_image_bytes", "load_image", "resize_short", "to_chw",
+           "center_crop", "random_crop", "left_right_flip", "simple_transform"]
+
+
+def _pil():
+    try:
+        from PIL import Image
+        return Image
+    except ImportError as e:
+        raise ImportError(
+            "image decoding needs Pillow (PIL); install it or feed "
+            "pre-decoded arrays") from e
+
+
+def load_image_bytes(data: bytes, is_color: bool = True) -> np.ndarray:
+    import io
+    img = _pil().open(io.BytesIO(data))
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def load_image(path: str, is_color: bool = True) -> np.ndarray:
+    img = _pil().open(path).convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    h, w = im.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    pil = _pil().fromarray(im)
+    return np.asarray(pil.resize((new_w, new_h)))
+
+
+def to_chw(im: np.ndarray, order=(2, 0, 1)) -> np.ndarray:
+    if im.ndim == 2:          # grayscale: add the channel dim
+        return im[np.newaxis]
+    return im.transpose(order)
+
+
+def center_crop(im: np.ndarray, size: int, is_color: bool = True):
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im: np.ndarray, size: int, is_color: bool = True):
+    h, w = im.shape[:2]
+    h_start = np.random.randint(0, h - size + 1)
+    w_start = np.random.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im: np.ndarray):
+    return im[:, ::-1]
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, is_color: bool = True,
+                     mean=None) -> np.ndarray:
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.array(mean, np.float32)
+        im -= mean if mean.ndim >= 2 else mean[:, None, None]
+    return im

@@ -1,0 +1,87 @@
+"""IMDB sentiment loader (≙ python/paddle/dataset/imdb.py). Parses the
+aclImdb tar: tokenize review files, build a frequency-cutoff word dict,
+yield (word-id sequence, 0/1 label)."""
+
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+
+from . import common
+
+__all__ = ["build_dict", "train", "test", "word_dict", "convert"]
+
+URL = "https://ai.stanford.edu/~amaas/data/sentiment/aclImdb_v1.tar.gz"
+MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+
+
+def tokenize(pattern):
+    """Yield lowercase, punctuation-stripped token lists for matching
+    members of the archive."""
+    with tarfile.open(common.download(URL, "imdb", MD5)) as tarf:
+        tf = tarf.next()
+        while tf is not None:
+            if bool(pattern.match(tf.name)):
+                data = tarf.extractfile(tf).read().decode("latin-1")
+                yield data.lower().translate(
+                    str.maketrans("", "", string.punctuation)).split()
+            tf = tarf.next()
+
+
+def build_dict(pattern, cutoff: int):
+    """word -> id for words with freq > cutoff; '<unk>' is the last id."""
+    word_freq = collections.defaultdict(int)
+    for doc in tokenize(pattern):
+        for word in doc:
+            word_freq[word] += 1
+    word_freq = {k: v for k, v in word_freq.items() if v > cutoff}
+    dictionary = sorted(word_freq.items(), key=lambda x: (-x[1], x[0]))
+    words, _ = list(zip(*dictionary)) if dictionary else ((), ())
+    word_idx = dict(zip(words, range(len(words))))
+    word_idx["<unk>"] = len(words)
+    return word_idx
+
+
+def reader_creator(pos_pattern, neg_pattern, word_idx):
+    unk = word_idx["<unk>"]
+
+    def load(pattern, out, label):
+        for doc in tokenize(pattern):
+            out.append(([word_idx.get(w, unk) for w in doc], label))
+
+    def reader():
+        data = []
+        load(pos_pattern, data, 0)
+        load(neg_pattern, data, 1)
+        yield from data
+
+    return reader
+
+
+def train(word_idx):
+    return reader_creator(
+        re.compile(r"aclImdb/train/pos/.*\.txt$"),
+        re.compile(r"aclImdb/train/neg/.*\.txt$"), word_idx)
+
+
+def test(word_idx):
+    return reader_creator(
+        re.compile(r"aclImdb/test/pos/.*\.txt$"),
+        re.compile(r"aclImdb/test/neg/.*\.txt$"), word_idx)
+
+
+def word_dict(cutoff: int = 150):
+    return build_dict(re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$"),
+                      cutoff)
+
+
+def fetch():
+    common.download(URL, "imdb", MD5)
+
+
+def convert(path: str):
+    w = word_dict()
+    common.convert(path, lambda: train(w)(), 1000, "imdb_train")
+    common.convert(path, lambda: test(w)(), 1000, "imdb_test")

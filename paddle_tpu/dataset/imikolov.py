@@ -1,0 +1,89 @@
+"""PTB language-model loader (≙ python/paddle/dataset/imikolov.py):
+n-gram or sequence samples over the Penn Treebank tarball."""
+
+from __future__ import annotations
+
+import collections
+import tarfile
+
+from . import common
+
+__all__ = ["build_dict", "train", "test", "convert"]
+
+URL = "http://www.fit.vutbr.cz/~imikolov/rnnlm/simple-examples.tgz"
+MD5 = "30177ea32e27c525793142b6bf2c8e2d"
+
+TRAIN_FILE = "./simple-examples/data/ptb.train.txt"
+TEST_FILE = "./simple-examples/data/ptb.valid.txt"
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def word_count(f, word_freq=None):
+    if word_freq is None:
+        word_freq = collections.defaultdict(int)
+    for line in f:
+        for w in line.strip().split():
+            word_freq[w] += 1
+        word_freq["<s>"] += 1
+        word_freq["<e>"] += 1
+    return word_freq
+
+
+def build_dict(min_word_freq: int = 50):
+    """word -> id over train+test, '<unk>' last (≙ imikolov build_dict)."""
+    with tarfile.open(common.download(URL, "imikolov", MD5)) as tf:
+        train_f = tf.extractfile(TRAIN_FILE)
+        test_f = tf.extractfile(TEST_FILE)
+        word_freq = word_count(
+            (l.decode() for l in test_f),
+            word_count((l.decode() for l in train_f)))
+        word_freq.pop("<unk>", None)
+        word_freq = {k: v for k, v in word_freq.items()
+                     if v >= min_word_freq}
+        dictionary = sorted(word_freq.items(), key=lambda x: (-x[1], x[0]))
+        words, _ = list(zip(*dictionary)) if dictionary else ((), ())
+        word_idx = dict(zip(words, range(len(words))))
+        word_idx["<unk>"] = len(words)
+    return word_idx
+
+
+def reader_creator(filename: str, word_idx, n: int, data_type: int):
+    def reader():
+        with tarfile.open(common.download(URL, "imikolov", MD5)) as tf:
+            f = tf.extractfile(filename)
+            unk = word_idx["<unk>"]
+            for line in f:
+                if data_type == DataType.NGRAM:
+                    words = ["<s>"] + line.decode().strip().split() + ["<e>"]
+                    if len(words) >= n:
+                        ids = [word_idx.get(w, unk) for w in words]
+                        for i in range(n, len(ids) + 1):
+                            yield tuple(ids[i - n:i])
+                else:
+                    words = line.decode().strip().split()
+                    ids = [word_idx.get(w, unk) for w in words]
+                    yield ([word_idx["<s>"]] + ids, ids + [word_idx["<e>"]])
+
+    return reader
+
+
+def train(word_idx, n: int, data_type: int = DataType.NGRAM):
+    return reader_creator(TRAIN_FILE, word_idx, n, data_type)
+
+
+def test(word_idx, n: int, data_type: int = DataType.NGRAM):
+    return reader_creator(TEST_FILE, word_idx, n, data_type)
+
+
+def fetch():
+    common.download(URL, "imikolov", MD5)
+
+
+def convert(path: str):
+    word_d = build_dict()
+    common.convert(path, train(word_d, 5), 1000, "imikolov_train")
+    common.convert(path, test(word_d, 5), 1000, "imikolov_test")

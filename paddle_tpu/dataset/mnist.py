@@ -1,0 +1,74 @@
+"""MNIST loader (≙ python/paddle/dataset/mnist.py). Parses the IDX
+format (big-endian magic 2051 images / 2049 labels, gzip) into
+(float32[784] scaled to [-1,1], int label) samples."""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "convert"]
+
+URL_PREFIX = "https://storage.googleapis.com/cvdf-datasets/mnist/"
+TRAIN_IMAGE = "train-images-idx3-ubyte.gz"
+TRAIN_IMAGE_MD5 = "f68b3c2dcbeaaa9fbdd348bbdeb94873"
+TRAIN_LABEL = "train-labels-idx1-ubyte.gz"
+TRAIN_LABEL_MD5 = "d53e105ee54ea40749a09fcbcd1e9432"
+TEST_IMAGE = "t10k-images-idx3-ubyte.gz"
+TEST_IMAGE_MD5 = "9fb629c4189551a2d022fa330f9573f3"
+TEST_LABEL = "t10k-labels-idx1-ubyte.gz"
+TEST_LABEL_MD5 = "ec29112dd5afa0611ce80d1b7f02629c"
+
+
+def reader_creator(image_path: str, label_path: str, buffer_size: int = 1024):
+    def reader():
+        with gzip.open(image_path, "rb") as img_f, \
+                gzip.open(label_path, "rb") as lbl_f:
+            img_magic, n_img, rows, cols = struct.unpack(
+                ">IIII", img_f.read(16))
+            lbl_magic, n_lbl = struct.unpack(">II", lbl_f.read(8))
+            if img_magic != 2051 or lbl_magic != 2049:
+                raise IOError("bad MNIST idx magic")
+            if n_img != n_lbl:
+                raise IOError("image/label count mismatch")
+            per = rows * cols
+            done = 0
+            while done < n_img:
+                k = min(buffer_size, n_img - done)
+                images = np.frombuffer(img_f.read(k * per),
+                                       np.uint8).reshape(k, per)
+                labels = np.frombuffer(lbl_f.read(k), np.uint8)
+                images = images.astype(np.float32) / 255.0 * 2.0 - 1.0
+                for i in range(k):
+                    yield images[i], int(labels[i])
+                done += k
+
+    return reader
+
+
+def train(buffer_size: int = 1024):
+    return reader_creator(
+        common.download(URL_PREFIX + TRAIN_IMAGE, "mnist", TRAIN_IMAGE_MD5),
+        common.download(URL_PREFIX + TRAIN_LABEL, "mnist", TRAIN_LABEL_MD5),
+        buffer_size)
+
+
+def test(buffer_size: int = 1024):
+    return reader_creator(
+        common.download(URL_PREFIX + TEST_IMAGE, "mnist", TEST_IMAGE_MD5),
+        common.download(URL_PREFIX + TEST_LABEL, "mnist", TEST_LABEL_MD5),
+        buffer_size)
+
+
+def fetch():
+    train()
+    test()
+
+
+def convert(path: str):
+    common.convert(path, train(), 1000, "mnist_train")
+    common.convert(path, test(), 1000, "mnist_test")

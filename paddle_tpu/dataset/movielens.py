@@ -1,0 +1,155 @@
+"""MovieLens-1M loader (≙ python/paddle/dataset/movielens.py): parse the
+ml-1m zip ('::'-separated .dat files) into rating samples with user/movie
+metadata."""
+
+from __future__ import annotations
+
+import re
+import zipfile
+from typing import Dict
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_movie_title_dict", "max_movie_id",
+           "max_user_id", "max_job_id", "movie_categories", "user_info",
+           "movie_info"]
+
+URL = "https://files.grouplens.org/datasets/movielens/ml-1m.zip"
+MD5 = "c4d9eecfca2ab87c1945afe126590906"
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index, [CATEGORIES_DICT[c] for c in self.categories],
+                [MOVIE_TITLE_DICT[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return f"<MovieInfo id({self.index}), title({self.title})>"
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+    def __repr__(self):
+        return f"<UserInfo id({self.index})>"
+
+
+AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+MOVIE_INFO: Dict[int, MovieInfo] = None
+MOVIE_TITLE_DICT = None
+CATEGORIES_DICT = None
+USER_INFO: Dict[int, UserInfo] = None
+
+
+def __initialize_meta_info__():
+    global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO
+    if MOVIE_INFO is not None:
+        return
+    fn = common.download(URL, "movielens", MD5)
+    pattern = re.compile(r"^(.*)\((\d+)\)$")
+    categories_set, title_word_set = set(), set()
+    MOVIE_INFO = {}
+    with zipfile.ZipFile(fn) as package:
+        for info in package.infolist():
+            assert isinstance(info, zipfile.ZipInfo)
+        with package.open("ml-1m/movies.dat") as movie_file:
+            for line in movie_file:
+                movie_id, title, categories = line.decode(
+                    "latin-1").strip().split("::")
+                categories = categories.split("|")
+                match = pattern.match(title)
+                title = match.group(1) if match else title
+                MOVIE_INFO[int(movie_id)] = MovieInfo(movie_id, categories,
+                                                      title)
+                categories_set.update(categories)
+                title_word_set.update(w.lower() for w in title.split())
+        MOVIE_TITLE_DICT = {w: i for i, w in enumerate(sorted(title_word_set))}
+        CATEGORIES_DICT = {c: i for i, c in enumerate(sorted(categories_set))}
+        USER_INFO = {}
+        with package.open("ml-1m/users.dat") as user_file:
+            for line in user_file:
+                uid, gender, age, job, _ = line.decode(
+                    "latin-1").strip().split("::")
+                USER_INFO[int(uid)] = UserInfo(uid, gender, age, job)
+
+
+def __reader__(rand_seed=0, test_ratio=0.1, is_test=False):
+    fn = common.download(URL, "movielens", MD5)
+    rand = np.random.RandomState(rand_seed)
+    with zipfile.ZipFile(fn) as package:
+        with package.open("ml-1m/ratings.dat") as rating:
+            for line in rating:
+                if (rand.rand() < test_ratio) == is_test:
+                    uid, mov_id, rating_v, _ = line.decode(
+                        "latin-1").strip().split("::")
+                    uid, mov_id = int(uid), int(mov_id)
+                    yield (USER_INFO[uid].value()
+                           + MOVIE_INFO[mov_id].value()
+                           + [[float(rating_v)]])
+
+
+def __reader_creator__(**kwargs):
+    __initialize_meta_info__()
+    return lambda: __reader__(**kwargs)
+
+
+def train():
+    return __reader_creator__(is_test=False)
+
+
+def test():
+    return __reader_creator__(is_test=True)
+
+
+def get_movie_title_dict():
+    __initialize_meta_info__()
+    return MOVIE_TITLE_DICT
+
+
+def movie_categories():
+    __initialize_meta_info__()
+    return CATEGORIES_DICT
+
+
+def max_movie_id():
+    __initialize_meta_info__()
+    return max(MOVIE_INFO.keys())
+
+
+def max_user_id():
+    __initialize_meta_info__()
+    return max(USER_INFO.keys())
+
+
+def max_job_id():
+    __initialize_meta_info__()
+    return max(u.job_id for u in USER_INFO.values())
+
+
+def user_info():
+    __initialize_meta_info__()
+    return USER_INFO
+
+
+def movie_info():
+    __initialize_meta_info__()
+    return MOVIE_INFO
+
+
+def fetch():
+    common.download(URL, "movielens", MD5)

@@ -1,0 +1,139 @@
+"""MQ2007 learning-to-rank loader (≙ python/paddle/dataset/mq2007.py):
+parse LETOR svmrank lines '<rel> qid:<q> 1:v1 2:v2 ... #docid = ...' into
+pointwise/pairwise/listwise samples."""
+
+from __future__ import annotations
+
+import os
+import random
+import zipfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+URL = "http://research.microsoft.com/en-us/um/beijing/projects/letor/LETOR4.0/Data/MQ2007.rar"
+MD5 = "7be1640ae95c6408dab0ae7207bdc706"
+
+
+class Query:
+    def __init__(self, query_id=-1, relevance_score=-1, feature_vector=None,
+                 description=""):
+        self.query_id = query_id
+        self.relevance_score = relevance_score
+        self.feature_vector = feature_vector or []
+        self.description = description
+
+    def __str__(self):
+        feas = " ".join(f"{i+1}:{f}" for i, f in
+                        enumerate(self.feature_vector))
+        return f"{self.relevance_score} qid:{self.query_id} {feas}"
+
+    def _parse_(self, text):
+        comment_position = text.find("#")
+        comment = ""
+        if comment_position != -1:
+            comment = text[comment_position + 1:].strip()
+            text = text[:comment_position]
+        parts = text.strip().split()
+        assert len(parts) >= 2, "invalid mq2007 line"
+        self.relevance_score = int(parts[0])
+        self.query_id = int(parts[1].split(":")[1])
+        for p in parts[2:]:
+            _, value = p.split(":")
+            self.feature_vector.append(float(value))
+        self.description = comment
+        return self
+
+
+class QueryList:
+    def __init__(self, querylist=None):
+        self.querylist = querylist or []
+
+    def __iter__(self):
+        return iter(self.querylist)
+
+    def __len__(self):
+        return len(self.querylist)
+
+    def __getitem__(self, i):
+        return self.querylist[i]
+
+    def _correct_ranking_(self):
+        self.querylist.sort(key=lambda x: -x.relevance_score)
+
+    def _add_query(self, query):
+        self.querylist.append(query)
+
+
+def gen_plain_txt(querylist):
+    """(query_id, score, feature) triples for pointwise training."""
+    for query in querylist:
+        yield querylist[0].query_id, query.relevance_score, \
+            np.array(query.feature_vector)
+
+
+def gen_point(querylist):
+    for query in querylist:
+        yield query.relevance_score, np.array(query.feature_vector)
+
+
+def gen_pair(querylist, partial_order="full"):
+    """Pairs (label-1 feature, label-2 feature) with score_1 > score_2."""
+    querylist._correct_ranking_()
+    for i, query_left in enumerate(querylist):
+        for query_right in querylist[i + 1:]:
+            if query_left.relevance_score > query_right.relevance_score:
+                yield 1, np.array(query_left.feature_vector), \
+                    np.array(query_right.feature_vector)
+
+
+def gen_list(querylist):
+    querylist._correct_ranking_()
+    relevance_score_list = [[q.relevance_score] for q in querylist]
+    feature_vector_list = [q.feature_vector for q in querylist]
+    yield np.array(relevance_score_list), np.array(feature_vector_list)
+
+
+def load_from_text(filepath, shuffle=False, fill_missing=-1):
+    query_dict = {}
+    query_order = []
+    with open(filepath, "r") as f:
+        for line in f:
+            query = Query()._parse_(line)
+            if query.query_id not in query_dict:
+                query_dict[query.query_id] = QueryList()
+                query_order.append(query.query_id)
+            query_dict[query.query_id]._add_query(query)
+    if shuffle:
+        random.shuffle(query_order)
+    return [query_dict[qid] for qid in query_order]
+
+
+def __reader__(filepath, format="pairwise", shuffle=False, fill_missing=-1):
+    query_lists = load_from_text(filepath, shuffle=shuffle,
+                                 fill_missing=fill_missing)
+    gen = {"plain_txt": gen_plain_txt, "pointwise": gen_point,
+           "pairwise": gen_pair, "listwise": gen_list}[format]
+    for querylist in query_lists:
+        yield from gen(querylist)
+
+
+def train(format="pairwise", shuffle=False, fill_missing=-1):
+    # the upstream archive is .rar (unsupported by stdlib); expect the
+    # extracted Fold1 text files in the cache dir
+    path = os.path.join(common.DATA_HOME, "MQ2007", "Fold1", "train.txt")
+    if not os.path.exists(path):
+        raise IOError(f"MQ2007: place extracted LETOR 4.0 Fold1 at {path}")
+    return lambda: __reader__(path, format=format, shuffle=shuffle,
+                              fill_missing=fill_missing)
+
+
+def test(format="pairwise", shuffle=False, fill_missing=-1):
+    path = os.path.join(common.DATA_HOME, "MQ2007", "Fold1", "test.txt")
+    if not os.path.exists(path):
+        raise IOError(f"MQ2007: place extracted LETOR 4.0 Fold1 at {path}")
+    return lambda: __reader__(path, format=format, shuffle=shuffle,
+                              fill_missing=fill_missing)

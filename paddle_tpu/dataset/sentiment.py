@@ -1,0 +1,82 @@
+"""Movie-review sentiment loader (≙ python/paddle/dataset/sentiment.py,
+which wraps NLTK's movie_reviews corpus). Parses the raw corpus zip
+directly (pos/neg .txt members) — no NLTK dependency."""
+
+from __future__ import annotations
+
+import collections
+import zipfile
+
+from . import common
+
+__all__ = ["get_word_dict", "train", "test"]
+
+URL = "https://raw.githubusercontent.com/nltk/nltk_data/gh-pages/packages/corpora/movie_reviews.zip"
+MD5 = "23c7478e7bdb425ff4b86b87b2ba0c22"
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+_word_dict = None
+_docs_cache = None
+
+
+def _load_docs():
+    global _docs_cache
+    if _docs_cache is not None:
+        return _docs_cache
+    path = common.download(URL, "sentiment", MD5)
+    docs = []
+    with zipfile.ZipFile(path) as z:
+        names = sorted(n for n in z.namelist() if n.endswith(".txt"))
+        for n in names:
+            if "/pos/" in n:
+                label = 0
+            elif "/neg/" in n:
+                label = 1
+            else:
+                continue
+            words = z.read(n).decode("latin-1").lower().split()
+            docs.append((words, label))
+    # interleave pos/neg like the reference's sorted categories walk
+    pos = [d for d in docs if d[1] == 0]
+    neg = [d for d in docs if d[1] == 1]
+    _docs_cache = [d for pair in zip(pos, neg) for d in pair]
+    return _docs_cache
+
+
+def get_word_dict():
+    """words sorted by frequency -> id (≙ sentiment.get_word_dict)."""
+    global _word_dict
+    if _word_dict is not None:
+        return _word_dict
+    freq = collections.defaultdict(int)
+    for words, _ in _load_docs():
+        for w in words:
+            freq[w] += 1
+    ranked = sorted(freq.items(), key=lambda x: (-x[1], x[0]))
+    _word_dict = {w: i for i, (w, _) in enumerate(ranked)}
+    return _word_dict
+
+
+def _sample(words, label):
+    d = get_word_dict()
+    return [d[w] for w in words if w in d], label
+
+
+def train():
+    def reader():
+        for words, label in _load_docs()[:NUM_TRAINING_INSTANCES]:
+            yield _sample(words, label)
+    return reader
+
+
+def test():
+    def reader():
+        for words, label in _load_docs()[NUM_TRAINING_INSTANCES:]:
+            yield _sample(words, label)
+    return reader
+
+
+def fetch():
+    common.download(URL, "sentiment", MD5)

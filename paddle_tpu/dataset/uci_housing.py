@@ -1,0 +1,57 @@
+"""UCI housing loader (≙ python/paddle/dataset/uci_housing.py):
+whitespace-separated 14-column floats, feature-normalized, 80/20 split."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+URL = "https://archive.ics.uci.edu/ml/machine-learning-databases/housing/housing.data"
+MD5 = "d4accdce7a25600298819f8e28e8d593"
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS",
+                 "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+UCI_TRAIN_DATA = None
+UCI_TEST_DATA = None
+
+
+def load_data(filename: str, feature_num: int = 14, ratio: float = 0.8):
+    global UCI_TRAIN_DATA, UCI_TEST_DATA
+    if UCI_TRAIN_DATA is not None and UCI_TEST_DATA is not None:
+        return
+    data = np.fromfile(filename, sep=" ").reshape(-1, feature_num)
+    maximums = data.max(axis=0)
+    minimums = data.min(axis=0)
+    avgs = data.sum(axis=0) / data.shape[0]
+    for i in range(feature_num - 1):
+        data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+    offset = int(data.shape[0] * ratio)
+    UCI_TRAIN_DATA = data[:offset].astype(np.float32)
+    UCI_TEST_DATA = data[offset:].astype(np.float32)
+
+
+def train():
+    load_data(common.download(URL, "uci_housing", MD5))
+
+    def reader():
+        for d in UCI_TRAIN_DATA:
+            yield d[:-1], d[-1:]
+
+    return reader
+
+
+def test():
+    load_data(common.download(URL, "uci_housing", MD5))
+
+    def reader():
+        for d in UCI_TEST_DATA:
+            yield d[:-1], d[-1:]
+
+    return reader
+
+
+def fetch():
+    common.download(URL, "uci_housing", MD5)

@@ -1,0 +1,58 @@
+"""PASCAL VOC2012 segmentation loader (≙ python/paddle/dataset/voc2012
+.py): image + label-png pairs from the VOCtrainval tar."""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from . import common
+from .image import load_image_bytes
+
+__all__ = ["train", "test", "val"]
+
+VOC_URL = ("http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+           "VOCtrainval_11-May-2012.tar")
+VOC_MD5 = "6cd6e144f989b92b3379bac3b3de84fd"
+SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+CACHE_DIR = "voc2012"
+
+
+def reader_creator(filename, sub_name):
+    def reader():
+        with tarfile.open(filename) as tf:
+            name_list = tf.extractfile(
+                SET_FILE.format(sub_name)).read().decode().split()
+            for name in name_list:
+                img = load_image_bytes(
+                    tf.extractfile(DATA_FILE.format(name)).read())
+                lbl = load_image_bytes(
+                    tf.extractfile(LABEL_FILE.format(name)).read(),
+                    is_color=False)
+                yield (img.transpose(2, 0, 1).astype(np.float32),
+                       lbl.astype(np.int64))
+
+    return reader
+
+
+def train():
+    return reader_creator(common.download(VOC_URL, CACHE_DIR, VOC_MD5),
+                          "train")
+
+
+def val():
+    return reader_creator(common.download(VOC_URL, CACHE_DIR, VOC_MD5), "val")
+
+
+def test():
+    return reader_creator(common.download(VOC_URL, CACHE_DIR, VOC_MD5),
+                          "trainval")
+
+
+def fetch():
+    common.download(VOC_URL, CACHE_DIR, VOC_MD5)

@@ -1,0 +1,94 @@
+"""WMT14 fr-en loader (≙ python/paddle/dataset/wmt14.py): tar of
+pre-tokenized parallel text + src.dict/trg.dict files."""
+
+from __future__ import annotations
+
+import tarfile
+
+from . import common
+
+__all__ = ["train", "test", "get_dict", "convert"]
+
+URL_TRAIN = ("http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz")
+MD5_TRAIN = "0791583d57d5beb693b9414c5b36798c"
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+
+def __read_to_dict(tar_file, dict_size):
+    def __to_dict(fd, size):
+        out_dict = {}
+        for line_count, line in enumerate(fd):
+            if line_count < size:
+                out_dict[line.strip().decode()] = line_count
+            else:
+                break
+        return out_dict
+
+    with tarfile.open(tar_file) as f:
+        names = [n for n in f.getnames() if n.endswith("src.dict")]
+        assert len(names) == 1
+        src_dict = __to_dict(f.extractfile(names[0]), dict_size)
+        names = [n for n in f.getnames() if n.endswith("trg.dict")]
+        assert len(names) == 1
+        trg_dict = __to_dict(f.extractfile(names[0]), dict_size)
+        return src_dict, trg_dict
+
+
+def reader_creator(tar_file, file_name, dict_size):
+    def reader():
+        src_dict, trg_dict = __read_to_dict(tar_file, dict_size)
+        with tarfile.open(tar_file) as f:
+            names = [n for n in f.getnames() if file_name in n]
+            for name in names:
+                for line in f.extractfile(name):
+                    line_split = line.decode().strip().split("\t")
+                    if len(line_split) != 2:
+                        continue
+                    src_words = line_split[0].split()
+                    src_ids = [src_dict.get(w, UNK_IDX) for w in src_words]
+                    trg_words = line_split[1].split()
+                    trg_ids = [trg_dict.get(w, UNK_IDX) for w in trg_words]
+                    trg_ids_next = trg_ids + [trg_dict[END]]
+                    trg_ids = [trg_dict[START]] + trg_ids
+                    yield src_ids, trg_ids, trg_ids_next
+
+    return reader
+
+
+def train(dict_size):
+    return reader_creator(
+        common.download(URL_TRAIN, "wmt14", MD5_TRAIN), "train/train",
+        dict_size)
+
+
+def test(dict_size):
+    return reader_creator(
+        common.download(URL_TRAIN, "wmt14", MD5_TRAIN), "test/test",
+        dict_size)
+
+
+def gen(dict_size):
+    return reader_creator(
+        common.download(URL_TRAIN, "wmt14", MD5_TRAIN), "gen/gen", dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    tar_file = common.download(URL_TRAIN, "wmt14", MD5_TRAIN)
+    src_dict, trg_dict = __read_to_dict(tar_file, dict_size)
+    if reverse:
+        src_dict = {v: k for k, v in src_dict.items()}
+        trg_dict = {v: k for k, v in trg_dict.items()}
+    return src_dict, trg_dict
+
+
+def fetch():
+    common.download(URL_TRAIN, "wmt14", MD5_TRAIN)
+
+
+def convert(path, dict_size):
+    common.convert(path, train(dict_size), 1000, "wmt14_train")
+    common.convert(path, test(dict_size), 1000, "wmt14_test")

@@ -1,0 +1,137 @@
+"""WMT16 en-de loader (≙ python/paddle/dataset/wmt16.py): tokenized
+parallel corpus in a tar ('src \\t trg' lines), frequency-sorted dicts
+with <s>/<e>/<unk> specials, samples = (src ids, trg ids, trg next-word
+ids)."""
+
+from __future__ import annotations
+
+import collections
+import os
+import tarfile
+
+from . import common
+
+__all__ = ["train", "test", "validation", "get_dict", "fetch", "convert"]
+
+URL = ("http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz")
+MD5 = "0c38be43600334966403524a40dcd81e"
+
+TOTAL_EN_WORDS = 11250
+TOTAL_DE_WORDS = 19220
+
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+
+
+def __build_dict(tar_file, dict_size, save_path, lang):
+    word_dict = collections.defaultdict(int)
+    with tarfile.open(tar_file) as f:
+        for line in f.extractfile("wmt16/train"):
+            line = line.decode()
+            line_split = line.strip().split("\t")
+            if len(line_split) != 2:
+                continue
+            sen = line_split[0] if lang == "en" else line_split[1]
+            for w in sen.split():
+                word_dict[w] += 1
+    with open(save_path, "w", encoding="utf-8") as fout:
+        fout.write(f"{START_MARK}\n{END_MARK}\n{UNK_MARK}\n")
+        for idx, word in enumerate(
+                sorted(word_dict.items(), key=lambda x: x[1], reverse=True)):
+            if idx + 3 == dict_size:
+                break
+            fout.write(word[0])
+            fout.write("\n")
+
+
+def __load_dict(tar_file, dict_size, lang, reverse=False):
+    dict_path = os.path.join(common.DATA_HOME, "wmt16",
+                             f"{lang}_{dict_size}.dict")
+    if not os.path.exists(dict_path) or (
+            len(open(dict_path, "rb").readlines()) != dict_size):
+        __build_dict(tar_file, dict_size, dict_path, lang)
+    word_dict = {}
+    with open(dict_path, "rb") as fdict:
+        for idx, line in enumerate(fdict):
+            if reverse:
+                word_dict[idx] = line.strip().decode()
+            else:
+                word_dict[line.strip().decode()] = idx
+    return word_dict
+
+
+def __get_dict_size(src_dict_size, trg_dict_size, src_lang):
+    src_dict_size = min(src_dict_size, TOTAL_EN_WORDS if src_lang == "en"
+                        else TOTAL_DE_WORDS)
+    trg_dict_size = min(trg_dict_size, TOTAL_DE_WORDS if src_lang == "en"
+                        else TOTAL_EN_WORDS)
+    return src_dict_size, trg_dict_size
+
+
+def reader_creator(tar_file, file_name, src_dict_size, trg_dict_size,
+                   src_lang):
+    def reader():
+        src_dict = __load_dict(tar_file, src_dict_size, src_lang)
+        trg_dict = __load_dict(tar_file, trg_dict_size,
+                               "de" if src_lang == "en" else "en")
+        start_id, end_id = src_dict[START_MARK], src_dict[END_MARK]
+        unk_id = src_dict[UNK_MARK]
+        src_col, trg_col = (0, 1) if src_lang == "en" else (1, 0)
+        with tarfile.open(tar_file) as f:
+            for line in f.extractfile(file_name):
+                line_split = line.decode().strip().split("\t")
+                if len(line_split) != 2:
+                    continue
+                src_ids = [start_id] + [
+                    src_dict.get(w, unk_id)
+                    for w in line_split[src_col].split()] + [end_id]
+                trg_words = line_split[trg_col].split()
+                trg_ids = [trg_dict.get(w, trg_dict[UNK_MARK])
+                           for w in trg_words]
+                trg_in = [trg_dict[START_MARK]] + trg_ids
+                trg_out = trg_ids + [trg_dict[END_MARK]]
+                yield src_ids, trg_in, trg_out
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    src_dict_size, trg_dict_size = __get_dict_size(src_dict_size,
+                                                   trg_dict_size, src_lang)
+    return reader_creator(common.download(URL, "wmt16", MD5, "wmt16.tar.gz"),
+                          "wmt16/train", src_dict_size, trg_dict_size,
+                          src_lang)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    src_dict_size, trg_dict_size = __get_dict_size(src_dict_size,
+                                                   trg_dict_size, src_lang)
+    return reader_creator(common.download(URL, "wmt16", MD5, "wmt16.tar.gz"),
+                          "wmt16/test", src_dict_size, trg_dict_size,
+                          src_lang)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    src_dict_size, trg_dict_size = __get_dict_size(src_dict_size,
+                                                   trg_dict_size, src_lang)
+    return reader_creator(common.download(URL, "wmt16", MD5, "wmt16.tar.gz"),
+                          "wmt16/val", src_dict_size, trg_dict_size, src_lang)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    dict_size = min(dict_size, TOTAL_EN_WORDS if lang == "en"
+                    else TOTAL_DE_WORDS)
+    tar_file = common.download(URL, "wmt16", MD5, "wmt16.tar.gz")
+    return __load_dict(tar_file, dict_size, lang, reverse)
+
+
+def fetch():
+    common.download(URL, "wmt16", MD5, "wmt16.tar.gz")
+
+
+def convert(path, src_dict_size, trg_dict_size, src_lang):
+    common.convert(path, train(src_dict_size, trg_dict_size, src_lang), 1000,
+                   "wmt16_train")
+    common.convert(path, test(src_dict_size, trg_dict_size, src_lang), 1000,
+                   "wmt16_test")

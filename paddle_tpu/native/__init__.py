@@ -1,0 +1,74 @@
+"""Native (C++) runtime pieces, compiled on demand.
+
+≙ the reference's C++ data plane (paddle/fluid/recordio/, operators/
+reader/). The build is a single g++ invocation cached by source hash —
+the framework stays importable (with Python fallbacks) when no toolchain
+is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "_build")
+_LOCK = threading.Lock()
+_LIBS = {}
+
+
+def _source_hash(path: str) -> str:
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:12]
+
+
+def load_library(name: str, extra_flags=()):
+    """Compile {name}.cpp (cached) and dlopen it. Returns None when the
+    toolchain or a dependency is missing — callers use Python fallbacks."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        src = os.path.join(_DIR, f"{name}.cpp")
+        so = os.path.join(_BUILD, f"{name}-{_source_hash(src)}.so")
+        if not os.path.exists(so):
+            os.makedirs(_BUILD, exist_ok=True)
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
+                   "-o", so + ".tmp"] + list(extra_flags)
+            try:
+                subprocess.run(cmd, check=True, capture_output=True)
+                os.replace(so + ".tmp", so)
+            except (subprocess.CalledProcessError, FileNotFoundError):
+                _LIBS[name] = None
+                return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            lib = None
+        _LIBS[name] = lib
+        return lib
+
+
+def recordio_lib():
+    lib = load_library("recordio", extra_flags=["-lz"])
+    if lib is not None and not getattr(lib, "_rio_configured", False):
+        lib.rio_writer_open.restype = ctypes.c_void_p
+        lib.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                        ctypes.c_long]
+        lib.rio_writer_write.restype = ctypes.c_int
+        lib.rio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_long]
+        lib.rio_writer_close.restype = ctypes.c_int
+        lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.rio_scanner_open.restype = ctypes.c_void_p
+        lib.rio_scanner_open.argtypes = [ctypes.c_char_p]
+        lib.rio_scanner_next.restype = ctypes.c_void_p
+        lib.rio_scanner_next.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_long)]
+        lib.rio_scanner_error.restype = ctypes.c_char_p
+        lib.rio_scanner_error.argtypes = [ctypes.c_void_p]
+        lib.rio_scanner_close.argtypes = [ctypes.c_void_p]
+        lib._rio_configured = True
+    return lib

@@ -1,0 +1,237 @@
+// RecordIO: chunked, CRC'd, optionally zlib-compressed record file format.
+//
+// ≙ reference paddle/fluid/recordio/{header,chunk,scanner,writer}.{h,cc}
+// (710 LoC C++ over snappy). Re-designed for a TPU host data plane: large
+// sequential chunks (streaming-friendly for hundreds-of-MB/s NVMe reads
+// feeding host->device transfers), zlib instead of snappy (in the base
+// image), and a flat C API consumed from Python via ctypes (the reference
+// used pybind, pybind/recordio.cc).
+//
+// Layout:
+//   file  := magic8 "PTRIO1\0\0" chunk*
+//   chunk := "CHNK" u32 n_records  u32 compressor(0 none|1 zlib)
+//            u64 compressed_len u64 raw_len u32 crc32(payload) payload
+//   raw payload := ( u32 len, bytes )*
+//
+// Build: compiled on first import by paddle_tpu/native/__init__.py
+// (g++ -O2 -shared -fPIC recordio.cpp -lz).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr char kFileMagic[8] = {'P', 'T', 'R', 'I', 'O', '1', '\0', '\0'};
+constexpr char kChunkMagic[4] = {'C', 'H', 'N', 'K'};
+
+struct Writer {
+  FILE* f = nullptr;
+  int compressor = 1;
+  size_t chunk_bytes = 1 << 20;  // flush threshold
+  std::string buf;               // raw payload being accumulated
+  uint32_t n_records = 0;
+  std::string err;
+
+  bool flush_chunk() {
+    if (n_records == 0) return true;
+    const unsigned char* payload =
+        reinterpret_cast<const unsigned char*>(buf.data());
+    uLongf out_len = 0;
+    std::vector<unsigned char> zbuf;
+    const unsigned char* out = payload;
+    if (compressor == 1) {
+      out_len = compressBound(buf.size());
+      zbuf.resize(out_len);
+      if (compress2(zbuf.data(), &out_len, payload, buf.size(),
+                    Z_BEST_SPEED) != Z_OK) {
+        err = "zlib compress failed";
+        return false;
+      }
+      out = zbuf.data();
+    } else {
+      out_len = buf.size();
+    }
+    uint32_t crc =
+        crc32(0L, reinterpret_cast<const Bytef*>(out), out_len);
+    uint64_t clen = out_len, rlen = buf.size();
+    if (fwrite(kChunkMagic, 1, 4, f) != 4 ||
+        fwrite(&n_records, 4, 1, f) != 1 ||
+        fwrite(&compressor, 4, 1, f) != 1 ||
+        fwrite(&clen, 8, 1, f) != 1 || fwrite(&rlen, 8, 1, f) != 1 ||
+        fwrite(&crc, 4, 1, f) != 1 ||
+        fwrite(out, 1, clen, f) != clen) {
+      err = "short write";
+      return false;
+    }
+    buf.clear();
+    n_records = 0;
+    return true;
+  }
+};
+
+struct Scanner {
+  FILE* f = nullptr;
+  uint64_t file_size = 0; // for validating length fields before allocating
+  std::string chunk;      // decompressed payload of current chunk
+  size_t pos = 0;         // cursor into chunk
+  uint32_t remaining = 0; // records left in current chunk
+  std::string record;     // last record returned
+  std::string err;
+
+  bool load_chunk() {
+    char magic[4];
+    if (fread(magic, 1, 4, f) != 4) return false;  // clean EOF
+    if (memcmp(magic, kChunkMagic, 4) != 0) {
+      err = "bad chunk magic";
+      return false;
+    }
+    uint32_t n, comp, crc;
+    uint64_t clen, rlen;
+    if (fread(&n, 4, 1, f) != 1 || fread(&comp, 4, 1, f) != 1 ||
+        fread(&clen, 8, 1, f) != 1 || fread(&rlen, 8, 1, f) != 1 ||
+        fread(&crc, 4, 1, f) != 1) {
+      err = "truncated chunk header";
+      return false;
+    }
+    // validate lengths BEFORE allocating: a corrupted header must raise
+    // IOError on the Python side, not std::bad_alloc -> terminate. The
+    // compressed payload cannot exceed the file; the raw payload cannot
+    // exceed zlib's max expansion (~1032x; 2048x leaves margin). For
+    // uncompressed chunks raw == stored.
+    bool bad = clen > file_size;
+    if (comp == 1) {
+      bad = bad || (clen == 0 && rlen != 0) ||
+            (clen > 0 && rlen / clen > 2048);
+    } else {
+      bad = bad || rlen != clen;
+    }
+    if (bad) {
+      err = "corrupt chunk length field";
+      return false;
+    }
+    std::string raw(clen, '\0');
+    if (fread(&raw[0], 1, clen, f) != clen) {
+      err = "truncated chunk payload";
+      return false;
+    }
+    if (crc32(0L, reinterpret_cast<const Bytef*>(raw.data()), clen) != crc) {
+      err = "crc mismatch";
+      return false;
+    }
+    if (comp == 1) {
+      chunk.resize(rlen);
+      uLongf dlen = rlen;
+      if (uncompress(reinterpret_cast<Bytef*>(&chunk[0]), &dlen,
+                     reinterpret_cast<const Bytef*>(raw.data()),
+                     clen) != Z_OK || dlen != rlen) {
+        err = "zlib uncompress failed";
+        return false;
+      }
+    } else {
+      chunk = std::move(raw);
+    }
+    pos = 0;
+    remaining = n;
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path, int compressor, long chunk_bytes) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  if (fwrite(kFileMagic, 1, 8, f) != 8) {
+    fclose(f);
+    return nullptr;
+  }
+  Writer* w = new Writer();
+  w->f = f;
+  w->compressor = compressor;
+  if (chunk_bytes > 0) w->chunk_bytes = static_cast<size_t>(chunk_bytes);
+  return w;
+}
+
+int rio_writer_write(void* handle, const char* data, long len) {
+  Writer* w = static_cast<Writer*>(handle);
+  uint32_t l = static_cast<uint32_t>(len);
+  w->buf.append(reinterpret_cast<const char*>(&l), 4);
+  w->buf.append(data, len);
+  w->n_records++;
+  if (w->buf.size() >= w->chunk_bytes) return w->flush_chunk() ? 0 : -1;
+  return 0;
+}
+
+int rio_writer_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  int rc = w->flush_chunk() ? 0 : -1;
+  fclose(w->f);
+  delete w;
+  return rc;
+}
+
+void* rio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  char magic[8];
+  if (fread(magic, 1, 8, f) != 8 || memcmp(magic, kFileMagic, 8) != 0) {
+    fclose(f);
+    return nullptr;
+  }
+  Scanner* s = new Scanner();
+  s->f = f;
+  long pos = ftell(f);
+  fseek(f, 0, SEEK_END);
+  s->file_size = static_cast<uint64_t>(ftell(f));
+  fseek(f, pos, SEEK_SET);
+  return s;
+}
+
+// Returns pointer to record bytes (valid until next call) or null at
+// EOF/error; *len receives the size, or -1 on error (see rio_scanner_error).
+const char* rio_scanner_next(void* handle, long* len) {
+  Scanner* s = static_cast<Scanner*>(handle);
+  while (s->remaining == 0) {
+    if (!s->load_chunk()) {
+      *len = s->err.empty() ? 0 : -1;
+      return nullptr;
+    }
+  }
+  if (s->pos + 4 > s->chunk.size()) {
+    s->err = "corrupt record length";
+    *len = -1;
+    return nullptr;
+  }
+  uint32_t l;
+  memcpy(&l, s->chunk.data() + s->pos, 4);
+  s->pos += 4;
+  if (s->pos + l > s->chunk.size()) {
+    s->err = "corrupt record payload";
+    *len = -1;
+    return nullptr;
+  }
+  s->record.assign(s->chunk.data() + s->pos, l);
+  s->pos += l;
+  s->remaining--;
+  *len = static_cast<long>(l);
+  return s->record.data();
+}
+
+const char* rio_scanner_error(void* handle) {
+  return static_cast<Scanner*>(handle)->err.c_str();
+}
+
+void rio_scanner_close(void* handle) {
+  Scanner* s = static_cast<Scanner*>(handle);
+  fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

@@ -10,6 +10,7 @@ on a functional runtime prefetch is host logic, not graph ops.
 from .decorator import (map_readers, shuffle, chain, compose, buffered,
                         firstn, xmap_readers, cache)
 from .decorator import batch
+from .prefetch import double_buffer, DeviceFeeder
 
 __all__ = ["map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
-           "xmap_readers", "cache", "batch"]
+           "xmap_readers", "cache", "batch", "double_buffer", "DeviceFeeder"]

@@ -1,0 +1,96 @@
+"""Double-buffered host→device prefetch.
+
+≙ reference double_buffer (python/paddle/fluid/layers/io.py:556) +
+create_double_buffer_reader_op.cc: a background stage that uploads the
+NEXT batch to the device while the CURRENT one computes, hiding
+host→device transfer latency. On the JAX runtime the upload is
+jax.device_put; a worker thread keeps `capacity` batches in flight
+(device transfers are async, so the thread only pays host-side staging).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Optional
+
+__all__ = ["double_buffer", "DeviceFeeder"]
+
+_STOP = object()
+
+
+def double_buffer(reader: Callable, place=None, capacity: int = 2):
+    """Wrap a feed-dict reader so device uploads overlap compute.
+
+    reader() yields dicts of numpy arrays (or anything jax.device_put
+    accepts). A worker thread stays `capacity` batches ahead; exceptions
+    propagate to the consumer. ≙ layers/io.py:556 double_buffer.
+    """
+    import jax
+
+    def buffered():
+        q: "queue.Queue" = queue.Queue(maxsize=capacity)
+        stop = threading.Event()
+        err = []
+
+        def put(item) -> bool:
+            """Bounded put that gives up when the consumer went away —
+            otherwise an abandoned epoch (exception/break in the train
+            loop) would pin this thread, the reader's file handles, and
+            `capacity` device batches forever."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for batch in reader():
+                    if stop.is_set():
+                        return
+                    if isinstance(batch, dict):
+                        batch = {k: jax.device_put(v)
+                                 for k, v in batch.items()}
+                    else:
+                        batch = jax.device_put(batch)
+                    if not put(batch):
+                        return
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err.append(e)
+            finally:
+                put(_STOP)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _STOP:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()  # unblock + terminate the worker on early exit
+
+    return buffered
+
+
+class DeviceFeeder:
+    """DataFeeder + double_buffer in one: converts raw reader rows with a
+    DataFeeder and keeps the uploads ahead of compute."""
+
+    def __init__(self, feeder, reader: Callable, capacity: int = 2):
+        self._feeder = feeder
+        self._reader = reader
+        self._capacity = capacity
+
+    def __iter__(self):
+        def feed_reader():
+            for data in self._reader():
+                yield self._feeder.feed(data)
+
+        yield from double_buffer(feed_reader, capacity=self._capacity)()

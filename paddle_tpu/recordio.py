@@ -1,0 +1,154 @@
+"""RecordIO reader/writer — native-backed with a pure-Python fallback.
+
+≙ reference paddle/fluid/recordio/ + python recordio_writer.py
+(python/paddle/fluid/recordio_writer.py). Format documented in
+paddle_tpu/native/recordio.cpp; both implementations produce and consume
+the identical byte layout (tested against each other).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+import zlib
+from typing import Iterator, Optional
+
+from .native import recordio_lib
+
+_FILE_MAGIC = b"PTRIO1\0\0"
+_CHUNK_MAGIC = b"CHNK"
+
+NO_COMPRESS, ZLIB_COMPRESS = 0, 1
+
+
+class _PyWriter:
+    def __init__(self, path: str, compressor: int, chunk_bytes: int):
+        self._f = open(path, "wb")
+        self._f.write(_FILE_MAGIC)
+        self._compressor = compressor
+        self._chunk_bytes = chunk_bytes
+        self._buf = bytearray()
+        self._n = 0
+
+    def write(self, record: bytes):
+        self._buf += struct.pack("<I", len(record)) + record
+        self._n += 1
+        if len(self._buf) >= self._chunk_bytes:
+            self._flush()
+
+    def _flush(self):
+        if not self._n:
+            return
+        payload = bytes(self._buf)
+        out = zlib.compress(payload, 1) if self._compressor == ZLIB_COMPRESS \
+            else payload
+        self._f.write(_CHUNK_MAGIC)
+        self._f.write(struct.pack("<IIQQI", self._n, self._compressor,
+                                  len(out), len(payload),
+                                  zlib.crc32(out) & 0xFFFFFFFF))
+        self._f.write(out)
+        self._buf = bytearray()
+        self._n = 0
+
+    def close(self):
+        self._flush()
+        self._f.close()
+
+
+def _py_scan(path: str) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        if f.read(8) != _FILE_MAGIC:
+            raise IOError(f"{path}: not a recordio file")
+        while True:
+            magic = f.read(4)
+            if not magic:
+                return
+            if magic != _CHUNK_MAGIC:
+                raise IOError(f"{path}: bad chunk magic")
+            hdr = f.read(28)
+            if len(hdr) != 28:
+                raise IOError(f"{path}: truncated chunk header")
+            n, comp, clen, rlen, crc = struct.unpack("<IIQQI", hdr)
+            raw = f.read(clen)
+            if len(raw) != clen:
+                raise IOError(f"{path}: truncated chunk")
+            if zlib.crc32(raw) & 0xFFFFFFFF != crc:
+                raise IOError(f"{path}: crc mismatch")
+            payload = zlib.decompress(raw) if comp == ZLIB_COMPRESS else raw
+            if len(payload) != rlen:
+                raise IOError(f"{path}: bad raw length")
+            pos = 0
+            for _ in range(n):
+                (l,) = struct.unpack_from("<I", payload, pos)
+                pos += 4
+                yield payload[pos:pos + l]
+                pos += l
+
+
+class Writer:
+    """with Writer(path) as w: w.write(b"...")  — chunks auto-flush."""
+
+    def __init__(self, path: str, compressor: int = ZLIB_COMPRESS,
+                 chunk_bytes: int = 1 << 20, force_python: bool = False):
+        lib = None if force_python else recordio_lib()
+        self._lib = lib
+        if lib is not None:
+            self._h = lib.rio_writer_open(path.encode(), compressor,
+                                          chunk_bytes)
+            if not self._h:
+                raise IOError(f"cannot open {path} for writing")
+        else:
+            self._py = _PyWriter(path, compressor, chunk_bytes)
+
+    def write(self, record: bytes):
+        if self._lib is not None:
+            if self._lib.rio_writer_write(self._h, record, len(record)) != 0:
+                raise IOError("recordio write failed")
+        else:
+            self._py.write(record)
+
+    def close(self):
+        if self._lib is not None:
+            if self._h is not None:
+                if self._lib.rio_writer_close(self._h) != 0:
+                    raise IOError("recordio close/flush failed")
+                self._h = None
+        else:
+            self._py.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def scan(path: str, force_python: bool = False) -> Iterator[bytes]:
+    """Yield records; raises IOError on corruption (crc/magic)."""
+    lib = None if force_python else recordio_lib()
+    if lib is None:
+        yield from _py_scan(path)
+        return
+    h = lib.rio_scanner_open(path.encode())
+    if not h:
+        raise IOError(f"{path}: not a recordio file")
+    try:
+        ln = ctypes.c_long()
+        while True:
+            ptr = lib.rio_scanner_next(h, ctypes.byref(ln))
+            if not ptr:
+                if ln.value == -1:
+                    raise IOError(
+                        f"{path}: {lib.rio_scanner_error(h).decode()}")
+                return
+            yield ctypes.string_at(ptr, ln.value)
+    finally:
+        lib.rio_scanner_close(h)
+
+
+def reader_creator(path: str):
+    """Reader-protocol adapter (≙ open_recordio_file, layers/io.py:295)."""
+    def reader():
+        return scan(path)
+    return reader

@@ -131,7 +131,13 @@ class Trainer:
 
     # -- train loop ---------------------------------------------------------
     def train(self, num_epochs: int, event_handler: Callable,
-              reader: Callable, feed_order: Optional[list] = None):
+              reader: Callable, feed_order: Optional[list] = None,
+              double_buffer: bool = True):
+        """double_buffer=True uploads the next batch to the device while
+        the current one computes (≙ layers/io.py:556 double_buffer +
+        create_double_buffer_reader_op.cc) — the host→device transfer is
+        the usual bottleneck of a feed-based loop."""
+        from .reader.prefetch import DeviceFeeder
         with scope_guard(self.scope):
             feed_vars = self._feed_vars(feed_order)
             feeder = DataFeeder(feed_vars, program=self.train_program)
@@ -143,11 +149,13 @@ class Trainer:
                            if self.checkpoint_cfg else 0)
             for epoch_id in range(start_epoch, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
-                for step_id, data in enumerate(reader()):
+                batches = (DeviceFeeder(feeder, reader)
+                           if double_buffer and not self.parallel
+                           else (feeder.feed(d) for d in reader()))
+                for step_id, feed in enumerate(batches):
                     begin = BeginStepEvent(epoch_id, step_id)
                     event_handler(begin)
                     fetch = self.train_func_outputs if begin.fetch_metrics else []
-                    feed = feeder.feed(data)
                     if self.parallel:
                         metrics = executor.run(fetch_list=fetch, feed=feed)
                     else:

@@ -1,0 +1,284 @@
+"""Data plane: recordio, dataset parsers (synthetic fixtures in the real
+file formats), double-buffered prefetch, Trainer integration.
+
+≙ reference tests: recordio/*_test.cc, python/paddle/dataset/tests/*,
+tests/test_cpp_reader.py (double buffer path).
+"""
+
+import gzip
+import io
+import os
+import pickle
+import struct
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import recordio
+from paddle_tpu.dataset import common
+
+
+@pytest.fixture
+def data_home(tmp_path, monkeypatch):
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    return tmp_path
+
+
+class TestRecordIO:
+    def test_round_trip_and_cross_impl(self, tmp_path):
+        p = str(tmp_path / "a.rio")
+        recs = [os.urandom(i * 13 % 257) for i in range(300)]
+        with recordio.Writer(p, chunk_bytes=1 << 12) as w:
+            for r in recs:
+                w.write(r)
+        assert list(recordio.scan(p)) == recs
+        assert list(recordio.scan(p, force_python=True)) == recs
+        p2 = str(tmp_path / "b.rio")
+        with recordio.Writer(p2, force_python=True, chunk_bytes=1 << 12) as w:
+            for r in recs:
+                w.write(r)
+        assert list(recordio.scan(p2)) == recs
+
+    def test_corruption_detected(self, tmp_path):
+        p = str(tmp_path / "c.rio")
+        with recordio.Writer(p) as w:
+            w.write(b"hello" * 100)
+        data = bytearray(open(p, "rb").read())
+        data[40] ^= 0xFF
+        open(p, "wb").write(bytes(data))
+        with pytest.raises(IOError):
+            list(recordio.scan(p))
+        with pytest.raises(IOError):
+            list(recordio.scan(p, force_python=True))
+
+    def test_convert_and_read_back(self, tmp_path):
+        samples = [(np.arange(4, dtype=np.float32) + i, i) for i in range(25)]
+        common.convert(str(tmp_path), lambda: iter(samples), 10, "unit")
+        shards = sorted(str(p) for p in tmp_path.glob("unit-*"))
+        assert len(shards) == 3  # 10+10+5
+        back = list(common.recordio_reader(shards)())
+        assert len(back) == 25
+        np.testing.assert_array_equal(back[7][0], samples[7][0])
+
+
+def _write_mnist_fixture(dirname, n=20):
+    os.makedirs(dirname, exist_ok=True)
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, (n, 28, 28), dtype=np.uint8)
+    labels = rng.randint(0, 10, (n,), dtype=np.uint8)
+    img_path = os.path.join(dirname, "train-images-idx3-ubyte.gz")
+    lbl_path = os.path.join(dirname, "train-labels-idx1-ubyte.gz")
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28) + images.tobytes())
+    with gzip.open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n) + labels.tobytes())
+    return img_path, lbl_path, images, labels
+
+
+class TestDatasetParsers:
+    def test_mnist_idx_format(self, data_home):
+        from paddle_tpu.dataset import mnist
+        img, lbl, images, labels = _write_mnist_fixture(
+            str(data_home / "mnist"))
+        samples = list(mnist.reader_creator(img, lbl, buffer_size=7)())
+        assert len(samples) == 20
+        np.testing.assert_allclose(
+            samples[3][0], images[3].reshape(-1) / 255.0 * 2.0 - 1.0,
+            rtol=1e-5, atol=1e-6)
+        assert samples[3][1] == int(labels[3])
+
+    def test_cifar_pickle_tar(self, data_home):
+        from paddle_tpu.dataset import cifar
+        rng = np.random.RandomState(1)
+        batch = {b"data": rng.randint(0, 256, (8, 3072), dtype=np.uint8),
+                 b"labels": rng.randint(0, 10, (8,)).tolist()}
+        tar_path = data_home / "cifar" / "cifar-10-python.tar.gz"
+        os.makedirs(tar_path.parent, exist_ok=True)
+        with tarfile.open(tar_path, "w:gz") as tf:
+            blob = pickle.dumps(batch)
+            info = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+        samples = list(cifar.reader_creator(str(tar_path), "data_batch")())
+        assert len(samples) == 8
+        np.testing.assert_allclose(samples[2][0],
+                                   batch[b"data"][2] / 255.0, rtol=1e-6)
+        assert samples[2][1] == batch[b"labels"][2]
+
+    def test_imdb_acl_tar(self, data_home, monkeypatch):
+        from paddle_tpu.dataset import imdb
+        tar_path = data_home / "imdb" / "aclImdb_v1.tar.gz"
+        os.makedirs(tar_path.parent, exist_ok=True)
+        docs = {"aclImdb/train/pos/0_9.txt": b"a great great movie!",
+                "aclImdb/train/neg/0_2.txt": b"terrible movie, just bad.",
+                "aclImdb/test/pos/0_8.txt": b"great fun",
+                "aclImdb/test/neg/0_3.txt": b"bad bad bad"}
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for name, text in docs.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(text)
+                tf.addfile(info, io.BytesIO(text))
+        monkeypatch.setattr(imdb, "MD5", common.md5file(str(tar_path)))
+        w = imdb.word_dict(cutoff=0)
+        assert "great" in w and "<unk>" in w
+        train = list(imdb.train(w)())
+        assert len(train) == 2
+        # pos label 0, neg label 1; tokens mapped through the dict
+        assert train[0][1] == 0 and train[1][1] == 1
+        assert all(isinstance(i, int) for i in train[0][0])
+
+    def test_uci_housing(self, data_home, monkeypatch):
+        from paddle_tpu.dataset import uci_housing
+        rng = np.random.RandomState(2)
+        data = rng.rand(50, 14).astype(np.float64)
+        path = data_home / "uci_housing" / "housing.data"
+        os.makedirs(path.parent, exist_ok=True)
+        with open(path, "w") as f:
+            for row in data:
+                f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+        monkeypatch.setattr(uci_housing, "MD5", common.md5file(str(path)))
+        monkeypatch.setattr(uci_housing, "UCI_TRAIN_DATA", None)
+        monkeypatch.setattr(uci_housing, "UCI_TEST_DATA", None)
+        train = list(uci_housing.train()())
+        test = list(uci_housing.test()())
+        assert len(train) == 40 and len(test) == 10
+        assert train[0][0].shape == (13,) and train[0][1].shape == (1,)
+
+    def test_wmt16_parallel_corpus(self, data_home, monkeypatch):
+        from paddle_tpu.dataset import wmt16
+        tar_path = data_home / "wmt16" / "wmt16.tar.gz"
+        os.makedirs(tar_path.parent, exist_ok=True)
+        lines = [b"a b c\tx y\n", b"b c\ty z\n", b"a a b\tx x\n"]
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for member in ("wmt16/train", "wmt16/test", "wmt16/val"):
+                blob = b"".join(lines)
+                info = tarfile.TarInfo(member)
+                info.size = len(blob)
+                tf.addfile(info, io.BytesIO(blob))
+        monkeypatch.setattr(wmt16, "MD5", common.md5file(str(tar_path)))
+        samples = list(wmt16.train(10, 10)())
+        assert len(samples) == 3
+        src, trg_in, trg_out = samples[0]
+        sd = wmt16.get_dict("en", 10)
+        assert src[0] == sd["<s>"] and src[-1] == sd["<e>"]
+        assert trg_out[-1] != trg_in[0]  # <e> vs <s>
+        assert len(trg_in) == len(trg_out)
+
+    def test_movielens_zip(self, data_home, monkeypatch):
+        from paddle_tpu.dataset import movielens
+        zpath = data_home / "movielens" / "ml-1m.zip"
+        os.makedirs(zpath.parent, exist_ok=True)
+        with zipfile.ZipFile(zpath, "w") as z:
+            z.writestr("ml-1m/movies.dat",
+                       "1::Toy Story (1995)::Animation|Comedy\n"
+                       "2::Jumanji (1995)::Adventure\n")
+            z.writestr("ml-1m/users.dat",
+                       "1::M::25::10::12345\n2::F::35::3::54321\n")
+            z.writestr("ml-1m/ratings.dat",
+                       "1::1::5::964982703\n2::2::3::964982703\n")
+        monkeypatch.setattr(movielens, "MD5", common.md5file(str(zpath)))
+        for attr in ("MOVIE_INFO", "MOVIE_TITLE_DICT", "CATEGORIES_DICT",
+                     "USER_INFO"):
+            monkeypatch.setattr(movielens, attr, None)
+        train = list(movielens.train()())
+        assert len(train) >= 1
+        assert movielens.max_user_id() == 2
+        assert movielens.max_movie_id() == 2
+        assert "animation" not in movielens.movie_categories()
+        assert "Animation" in movielens.movie_categories()
+
+    def test_imikolov_ngram(self, data_home, monkeypatch):
+        from paddle_tpu.dataset import imikolov
+        tar_path = data_home / "imikolov" / "simple-examples.tgz"
+        os.makedirs(tar_path.parent, exist_ok=True)
+        text = b"the cat sat\nthe dog sat\n"
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for member in (imikolov.TRAIN_FILE, imikolov.TEST_FILE):
+                info = tarfile.TarInfo(member)
+                info.size = len(text)
+                tf.addfile(info, io.BytesIO(text))
+        monkeypatch.setattr(imikolov, "MD5", common.md5file(str(tar_path)))
+        d = imikolov.build_dict(min_word_freq=1)
+        assert "the" in d and "<unk>" in d
+        grams = list(imikolov.train(d, 3)())
+        assert grams and all(len(g) == 3 for g in grams)
+        seqs = list(imikolov.train(d, 0, imikolov.DataType.SEQ)())
+        assert seqs and seqs[0][0][0] == d["<s>"]
+
+    def test_download_offline_error_names_path(self, data_home):
+        with pytest.raises(IOError, match="place the file at"):
+            common.download("http://127.0.0.1:1/none.tgz", "unit", "abc")
+
+
+class TestDoubleBuffer:
+    def test_order_and_device_residency(self):
+        import jax
+        from paddle_tpu.reader.prefetch import double_buffer
+
+        def reader():
+            for i in range(10):
+                yield {"x": np.full((2, 2), i, np.float32)}
+
+        got = list(double_buffer(reader)())
+        assert len(got) == 10
+        for i, b in enumerate(got):
+            assert isinstance(b["x"], jax.Array)
+            assert float(b["x"][0, 0]) == i
+
+    def test_exception_propagates(self):
+        from paddle_tpu.reader.prefetch import double_buffer
+
+        def reader():
+            yield {"x": np.zeros(2, np.float32)}
+            raise RuntimeError("boom")
+
+        it = double_buffer(reader)()
+        next(it)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(it)
+
+    def test_prep_feed_keeps_device_arrays(self):
+        import jax
+        import jax.numpy as jnp
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            pt.layers.data("x", [4])
+        exe = pt.Executor()
+        dev = jax.device_put(np.ones((2, 4), np.float32))
+        out = exe._prep_feed(main, {"x": dev})
+        assert out["x"] is dev  # no host round-trip
+
+
+class TestTrainerPipeline:
+    def test_trainer_with_dataset_reader_and_double_buffer(self, data_home):
+        from paddle_tpu.dataset import mnist
+        img, lbl, _, _ = _write_mnist_fixture(str(data_home / "mnist"), n=32)
+
+        def train_func():
+            from paddle_tpu import layers
+            pixel = pt.layers.data("pixel", [784])
+            label = pt.layers.data("label", [1], dtype="int64")
+            pred = pt.layers.fc(input=pixel, size=10, act="softmax")
+            loss = pt.layers.mean(
+                pt.layers.cross_entropy(input=pred, label=label))
+            return [loss]
+
+        losses = []
+
+        def handler(event):
+            if isinstance(event, pt.EndStepEvent) and event.metrics:
+                losses.append(float(np.ravel(event.metrics[0])[0]))
+
+        trainer = pt.Trainer(
+            train_func=train_func,
+            optimizer_func=lambda: pt.optimizer.SGDOptimizer(
+                learning_rate=0.5))
+        reader = pt.reader.batch(
+            mnist.reader_creator(img, lbl, buffer_size=8), batch_size=8)
+        trainer.train(num_epochs=3, event_handler=handler, reader=reader,
+                      feed_order=["pixel", "label"])
+        assert len(losses) == 12  # 4 batches x 3 epochs
+        assert losses[-1] < losses[0]
