@@ -15,7 +15,328 @@ from ..core.program import VarDesc, default_main_program, unique_name
 from ..layer_helper import LayerHelper
 from .sequence import _mark_seq
 
-__all__ = ["DynamicRNN", "StaticRNN"]
+__all__ = ["DynamicRNN", "StaticRNN", "While", "Switch", "IfElse",
+           "increment", "array_write", "array_read", "create_array",
+           "less_than", "less_equal", "greater_than", "greater_equal",
+           "equal", "not_equal", "logical_and", "logical_or", "logical_not"]
+
+
+def _compare_layer(op_type):
+    def layer(x, y, cond=None, **kwargs):
+        helper = LayerHelper(op_type)
+        if cond is None:
+            cond = helper.create_tmp_variable("bool")
+        helper.append_op(op_type, {"X": x, "Y": y}, {"Out": cond}, {})
+        return cond
+
+    layer.__name__ = op_type
+    layer.__doc__ = (f"{op_type} comparison (≙ layers/control_flow.py); "
+                     "pass cond= to rebind an existing bool var (the While "
+                     "idiom for updating the loop condition).")
+    return layer
+
+
+less_than = _compare_layer("less_than")
+less_equal = _compare_layer("less_equal")
+greater_than = _compare_layer("greater_than")
+greater_equal = _compare_layer("greater_equal")
+equal = _compare_layer("equal")
+not_equal = _compare_layer("not_equal")
+
+
+def _logical_layer(op_type, unary=False):
+    def layer(x, y=None, out=None, **kwargs):
+        helper = LayerHelper(op_type)
+        if out is None:
+            out = helper.create_tmp_variable("bool")
+        ins = {"X": x} if unary else {"X": x, "Y": y}
+        helper.append_op(op_type, ins, {"Out": out}, {})
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+logical_and = _logical_layer("logical_and")
+logical_or = _logical_layer("logical_or")
+logical_not = _logical_layer("logical_not", unary=True)
+
+
+def _written_outer_vars(sub_block) -> List[str]:
+    """Outer-block names a sub-block's ops rebind — the carry/written set
+    (≙ while_op.cc's input/output var scanning)."""
+    seen = []
+    for op in sub_block.ops:
+        for n in op.output_names():
+            if n not in sub_block.vars and n not in seen:
+                seen.append(n)
+    return seen
+
+
+def _read_outer_vars(sub_block) -> List[str]:
+    """Outer-block names a sub-block's ops read. Declared as the flow op's
+    inputs so Program.prune keeps their producers (the reference's while op
+    declares X inputs for the same reason, while_op.cc)."""
+    seen = []
+    for op in sub_block.ops:
+        for n in op.input_names():
+            if n not in sub_block.vars and n not in seen:
+                seen.append(n)
+    return seen
+
+
+def increment(x, value=1.0, in_place=True):
+    """layers/control_flow.py increment: x += value (dtype-preserving)."""
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_tmp_variable(x.dtype)
+    helper.append_op("increment", {"X": x}, {"Out": out}, {"step": value})
+    return out
+
+
+def create_array(dtype, max_len, element_shape=()):
+    """Dense tensor array (≙ create_array + LOD_TENSOR_ARRAY var, re-read
+    as a preallocated [max_len, ...] buffer for static shapes)."""
+    from .tensor import fill_constant
+    arr = fill_constant([max_len] + list(element_shape), dtype, 0.0)
+    # arrays collect differentiable per-step outputs; fill_constant's
+    # stop_gradient=True would sever grads at every array_write rebind
+    arr.stop_gradient = False
+    return arr
+
+
+def array_write(x, i, array):
+    """write_to_array: array[i] = x; returns the array (rebinding its
+    name, ≙ the reference's in-place array mutation)."""
+    helper = LayerHelper("array_write")
+    helper.append_op("array_write", {"Array": array, "X": x, "I": i},
+                     {"Out": array}, {})
+    return array
+
+
+def array_read(array, i):
+    """read_from_array: returns array[i]."""
+    helper = LayerHelper("array_read")
+    out = helper.create_tmp_variable(array.dtype)
+    out.shape = tuple(array.shape[1:])
+    helper.append_op("array_read", {"Array": array, "I": i}, {"Out": out}, {})
+    return out
+
+
+class While:
+    """General while loop (≙ layers/control_flow.py:608 While +
+    while_op.cc). The body mutates outer vars (increment, assign,
+    less_than(..., cond=cond), array_write); every outer var the body
+    writes becomes loop carry, and the op rebinds them on exit.
+
+    max_iters: when given, lowers to a fixed-length masked lax.scan —
+    bounded AND reverse-differentiable (a free lax.while_loop is not).
+
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            ...
+            layers.increment(i)
+            layers.less_than(i, n, cond=cond)
+    """
+
+    def __init__(self, cond, max_iters: Optional[int] = None, name=None):
+        if cond.dtype != "bool":
+            raise TypeError("While condition must be a bool var")
+        self.cond = cond
+        self.max_iters = max_iters
+        self.main_program = default_main_program()
+        parent_idx = self.main_program.current_block().idx
+        self.sub_block = self.main_program.create_block(parent_idx)
+
+    class _Ctx:
+        def __init__(self, w):
+            self.w = w
+
+        def __enter__(self):
+            self._guard = self.w.main_program.block_guard(self.w.sub_block)
+            self._guard.__enter__()
+            return self
+
+        def __exit__(self, *exc):
+            self._guard.__exit__(*exc)
+            if exc[0] is None:
+                self.w._append_op()
+            return False
+
+    def block(self):
+        return While._Ctx(self)
+
+    def _append_op(self):
+        written = _written_outer_vars(self.sub_block)
+        carry = list(written)
+        if self.cond.name not in carry:
+            carry.append(self.cond.name)
+        reads = _read_outer_vars(self.sub_block)
+        ins = list(dict.fromkeys(carry + reads))
+        parent = self.main_program.block(self.sub_block.parent_idx)
+        parent.append_op(
+            "while", {"X": ins}, {"Out": carry},
+            {"sub_block": self.sub_block.idx, "cond": self.cond.name,
+             "loop_vars": carry, "max_iters": self.max_iters})
+
+
+class Switch:
+    """First-true-case-wins switch (≙ layers/control_flow.py:1211),
+    the piecewise-LR building block:
+
+        with layers.Switch() as sw:
+            with sw.case(step < b1):
+                layers.assign(v1, lr)
+            with sw.default():
+                layers.assign(v2, lr)
+    """
+
+    def __init__(self, name=None):
+        self.main_program = default_main_program()
+        self.parent_idx = self.main_program.current_block().idx
+        self.case_conds: List[VarDesc] = []
+        self.case_blocks = []
+        self.default_block = None
+        self._inside = False
+
+    def __enter__(self):
+        self._inside = True
+        return self
+
+    def __exit__(self, *exc):
+        self._inside = False
+        if exc[0] is None:
+            self._append_op()
+        return False
+
+    class _CaseCtx:
+        def __init__(self, switch, block):
+            self.switch, self.block = switch, block
+
+        def __enter__(self):
+            self._guard = self.switch.main_program.block_guard(self.block)
+            self._guard.__enter__()
+            return self
+
+        def __exit__(self, *exc):
+            self._guard.__exit__(*exc)
+            return False
+
+    def case(self, condition):
+        if not self._inside:
+            raise RuntimeError("Switch.case must be used inside "
+                               "'with Switch()'")
+        blk = self.main_program.create_block(self.parent_idx)
+        self.case_conds.append(condition)
+        self.case_blocks.append(blk)
+        return Switch._CaseCtx(self, blk)
+
+    def default(self):
+        if not self._inside:
+            raise RuntimeError("Switch.default must be used inside "
+                               "'with Switch()'")
+        blk = self.main_program.create_block(self.parent_idx)
+        self.default_block = blk
+        return Switch._CaseCtx(self, blk)
+
+    def _append_op(self):
+        blocks = list(self.case_blocks)
+        if self.default_block is not None:
+            blocks.append(self.default_block)
+        written: List[str] = []
+        for b in blocks:
+            for n in _written_outer_vars(b):
+                if n not in written:
+                    written.append(n)
+        if not blocks:
+            raise RuntimeError("empty Switch")
+        reads: List[str] = []
+        for b in blocks:
+            for n in _read_outer_vars(b):
+                if n not in reads:
+                    reads.append(n)
+        parent = self.main_program.block(self.parent_idx)
+        parent.append_op(
+            "switch", {"Conds": [c.name for c in self.case_conds],
+                       "X": list(dict.fromkeys(written + reads))},
+            {"Out": written},
+            {"sub_blocks": [b.idx for b in blocks],
+             "has_default": self.default_block is not None,
+             "written_vars": written})
+
+
+class IfElse:
+    """Batch-wise branch select (≙ layers/control_flow.py:1070 IfElse).
+    cond is [B, 1] bool; each ROW takes its branch's output. The TPU
+    lowering computes both branches on the full batch and row-selects
+    (no dynamic shapes — ops/flow_ops.py ifelse)."""
+
+    def __init__(self, cond, name=None):
+        self.cond = cond
+        self.main_program = default_main_program()
+        self.parent_idx = self.main_program.current_block().idx
+        self.true_sub = self.main_program.create_block(self.parent_idx)
+        self.false_sub = self.main_program.create_block(self.parent_idx)
+        self._outputs = {True: [], False: []}
+        self._current: Optional[bool] = None
+
+    class _BranchCtx:
+        def __init__(self, ie, is_true):
+            self.ie, self.is_true = ie, is_true
+
+        def __enter__(self):
+            self.ie._current = self.is_true
+            blk = self.ie.true_sub if self.is_true else self.ie.false_sub
+            self._guard = self.ie.main_program.block_guard(blk)
+            self._guard.__enter__()
+            return self
+
+        def __exit__(self, *exc):
+            self._guard.__exit__(*exc)
+            self.ie._current = None
+            return False
+
+    def true_block(self):
+        return IfElse._BranchCtx(self, True)
+
+    def false_block(self):
+        return IfElse._BranchCtx(self, False)
+
+    def input(self, x):
+        """The reference slices rows for the active branch; the full-batch
+        lowering passes the var through unchanged."""
+        if self._current is None:
+            raise RuntimeError("IfElse.input used outside a branch block")
+        return x
+
+    def output(self, *outs):
+        if self._current is None:
+            raise RuntimeError("IfElse.output used outside a branch block")
+        self._outputs[self._current].extend(outs)
+
+    def __call__(self):
+        t_outs, f_outs = self._outputs[True], self._outputs[False]
+        if len(t_outs) != len(f_outs):
+            raise ValueError("IfElse branches declared different numbers "
+                             f"of outputs: {len(t_outs)} vs {len(f_outs)}")
+        if not t_outs:
+            raise ValueError("IfElse has no outputs")
+        parent = self.main_program.block(self.parent_idx)
+        merged = []
+        for tv, fv in zip(t_outs, f_outs):
+            out = parent.create_var(unique_name("ifelse_out"),
+                                    shape=tv.shape, dtype=tv.dtype)
+            merged.append(out)
+        reads = list(dict.fromkeys(_read_outer_vars(self.true_sub)
+                                   + _read_outer_vars(self.false_sub)))
+        parent.append_op(
+            "ifelse", {"Cond": self.cond.name, "X": reads},
+            {"Out": [m.name for m in merged]},
+            {"true_block": self.true_sub.idx,
+             "false_block": self.false_sub.idx,
+             "output_pairs": [(t.name, f.name)
+                              for t, f in zip(t_outs, f_outs)]})
+        return merged if len(merged) > 1 else merged[0]
 
 
 class DynamicRNN:

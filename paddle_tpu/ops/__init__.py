@@ -10,6 +10,7 @@ from . import nn_ops  # noqa: F401
 from . import tensor_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import control_ops  # noqa: F401
+from . import flow_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import beam_ops  # noqa: F401

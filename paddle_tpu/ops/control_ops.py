@@ -56,7 +56,10 @@ _register_logical("logical_not", jnp.logical_not, unary=True)
 
 @register_op("increment")
 def increment(ctx, ins, attrs):
-    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+    x = ins["X"][0]
+    # dtype-preserving (increment_op.cc): an int counter must stay int —
+    # loop carries depend on it
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}
 
 
 @register_op("is_empty")

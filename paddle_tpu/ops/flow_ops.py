@@ -1,0 +1,180 @@
+"""Block-structured control flow ops: while / conditional_block / switch /
+batch-wise if-else.
+
+≙ reference paddle/fluid/operators/{while_op.cc, conditional_block_op.cc}
+and the Switch/IfElse layers (python/paddle/fluid/layers/control_flow.py:
+608, 1070, 1211). The reference interprets sub-blocks with nested
+executors + StepScopes; here every sub-block is TRACED into the XLA
+program under lax.while_loop / lax.cond / select chains — static shapes,
+no host round-trips, differentiable where the construct allows.
+
+Shared convention: a sub-block op's "carry"/"written" vars are outer-block
+names its ops rebind; the op's outputs rebind those names in the enclosing
+environment (SSA by rebinding, matching the reference's in-place variable
+mutation semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _run_sub(ctx, sub, env):
+    from ..core import lowering
+    lowering.run_op_range(sub.ops, 0, len(sub.ops), env, ctx, sub)
+    return env
+
+
+def _scalar_bool(v):
+    return jnp.reshape(v, ()).astype(bool)
+
+
+@register_op("while")
+def while_op(ctx, ins, attrs):
+    """while_op.cc → lax.while_loop over the sub-block.
+
+    attrs: sub_block, cond (var name), loop_vars (outer names the body
+    rewrites, cond included), max_iters (optional): when set, lowers to a
+    fixed-length masked lax.scan instead — bounded, and differentiable in
+    reverse mode (lax.while_loop is not; ≙ while_grad_op needs the
+    reference's StepScope stack, here scan's native VJP).
+    """
+    program = ctx.program
+    sub = program.block(attrs["sub_block"])
+    cond_name = attrs["cond"]
+    carry_names = list(attrs["loop_vars"])
+    outer_env = dict(ctx.env)
+    carry0 = tuple(outer_env[n] for n in carry_names)
+    max_iters = attrs.get("max_iters")
+
+    def body_env(carry):
+        env = dict(outer_env)
+        env.update(zip(carry_names, carry))
+        return env
+
+    if max_iters is not None:
+        def body(carry, _):
+            env = body_env(carry)
+            pred = _scalar_bool(env[cond_name])
+            env = _run_sub(ctx, sub, env)
+            new = tuple(jnp.where(pred, env[n], old)
+                        for n, old in zip(carry_names, carry))
+            return new, None
+        final, _ = jax.lax.scan(body, carry0, None, length=int(max_iters))
+    else:
+        def cond_fn(carry):
+            return _scalar_bool(dict(zip(carry_names, carry))[cond_name])
+
+        def body_fn(carry):
+            env = _run_sub(ctx, sub, body_env(carry))
+            return tuple(env[n] for n in carry_names)
+
+        final = jax.lax.while_loop(cond_fn, body_fn, carry0)
+    return {"Out": list(final)}
+
+
+@register_op("conditional_block")
+def conditional_block(ctx, ins, attrs):
+    """conditional_block_op.cc → lax.cond: the sub-block runs (is traced)
+    in the true branch; written outer vars keep their prior values in the
+    false branch."""
+    program = ctx.program
+    sub = program.block(attrs["sub_block"])
+    written = list(attrs["written_vars"])
+    outer_env = dict(ctx.env)
+    cond = _scalar_bool(ins["Cond"][0])
+
+    def true_fn(vals):
+        env = dict(outer_env)
+        env.update(zip(written, vals))
+        env = _run_sub(ctx, sub, env)
+        return tuple(env[n] for n in written)
+
+    def false_fn(vals):
+        return vals
+
+    prior = tuple(outer_env[n] for n in written)
+    out = jax.lax.cond(cond, true_fn, false_fn, prior)
+    return {"Out": list(out)}
+
+
+@register_op("switch")
+def switch_op(ctx, ins, attrs):
+    """Switch layer (control_flow.py:1211): first-true case wins.
+
+    Every case block is traced; outputs are selected with a reversed
+    where-chain (default first, then later cases overridden by earlier
+    true conds) — branch-free and SPMD-friendly, semantically identical
+    to the reference's sequential conditional_block chain.
+    """
+    program = ctx.program
+    sub_blocks = list(attrs["sub_blocks"])    # cases in declaration order
+    has_default = attrs.get("has_default", False)
+    written = list(attrs["written_vars"])
+    conds = list(ins.get("Conds", []))        # one per non-default case
+    outer_env = dict(ctx.env)
+
+    prior = [outer_env[n] for n in written]
+    results = []                              # per-case written values
+    for b_idx in sub_blocks:
+        sub = program.block(b_idx)
+        env = _run_sub(ctx, sub, dict(outer_env))
+        results.append([env[n] for n in written])
+
+    n_cases = len(sub_blocks) - (1 if has_default else 0)
+    out = list(results[-1]) if has_default else list(prior)
+    for i in range(n_cases - 1, -1, -1):
+        pred = _scalar_bool(conds[i])
+        out = [jnp.where(pred, res, cur)
+               for res, cur in zip(results[i], out)]
+    return {"Out": out}
+
+
+@register_op("ifelse")
+def ifelse_op(ctx, ins, attrs):
+    """IfElse layer (control_flow.py:1070): BATCH-wise branch select.
+
+    The reference splits rows by cond, runs each branch on its slice, and
+    merges. The TPU reading computes both branches on the full batch and
+    row-selects — no dynamic shapes, identical results, and XLA dead-code
+    eliminates anything cheap enough to not matter.
+    """
+    program = ctx.program
+    true_sub = program.block(attrs["true_block"])
+    false_sub = program.block(attrs["false_block"])
+    out_pairs = list(attrs["output_pairs"])   # [(true_name, false_name)]
+    cond = ins["Cond"][0]
+    outer_env = dict(ctx.env)
+
+    env_t = _run_sub(ctx, true_sub, dict(outer_env))
+    env_f = _run_sub(ctx, false_sub, dict(outer_env))
+
+    outs = []
+    for t_name, f_name in out_pairs:
+        tv, fv = env_t[t_name], env_f[f_name]
+        c = cond.reshape((cond.shape[0],) + (1,) * (tv.ndim - 1))
+        outs.append(jnp.where(c, tv, fv))
+    return {"Out": outs}
+
+
+@register_op("array_write")
+def array_write(ctx, ins, attrs):
+    """Dense tensor-array write (≙ lod_tensor_array write_to_array op,
+    redesigned for static shapes): array is a [max_len, ...] buffer;
+    row i is replaced. Differentiable."""
+    arr, x, i = ins["Array"][0], ins["X"][0], ins["I"][0]
+    idx = jnp.reshape(i, ()).astype(jnp.int32)
+    return {"Out": [jax.lax.dynamic_update_index_in_dim(
+        arr, x.astype(arr.dtype), idx, 0)]}
+
+
+@register_op("array_read")
+def array_read(ctx, ins, attrs):
+    """Dense tensor-array read (≙ read_from_array op)."""
+    arr, i = ins["Array"][0], ins["I"][0]
+    idx = jnp.reshape(i, ()).astype(jnp.int32)
+    return {"Out": [jax.lax.dynamic_index_in_dim(arr, idx, 0,
+                                                 keepdims=False)]}

@@ -1,0 +1,289 @@
+"""General control flow: While / Switch / IfElse / tensor arrays.
+
+≙ reference tests: test_while_op.py, test_switch.py, test_ifelse_op
+(semantics asserted against numpy), and the decode-until-EOS While idiom.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+class TestWhile:
+    def test_counted_sum(self):
+        """sum 0..9 with a While counter (≙ test_while_op)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            i = layers.fill_constant([1], "int32", 0)
+            n = layers.fill_constant([1], "int32", 10)
+            total = layers.fill_constant([1], "float32", 0.0)
+            cond = layers.less_than(i, n)
+            w = layers.While(cond)
+            with w.block():
+                fi = layers.cast(i, "float32")
+                layers.assign(layers.elementwise_add(total, fi), total)
+                layers.increment(i, 1)
+                layers.less_than(i, n, cond=cond)
+        exe = pt.Executor()
+        exe.run(startup)
+        (tot, iv) = exe.run(main, fetch_list=[total, i])
+        assert float(np.ravel(tot)[0]) == sum(range(10))
+        assert int(np.ravel(iv)[0]) == 10
+
+    def test_decode_until_eos(self):
+        """greedy decode-until-EOS: argmax chain through an embedding +
+        projection, collecting tokens with array_write, stopping at EOS or
+        max_len — the custom decode-loop use case."""
+        vocab, emb_dim, max_len, eos = 12, 8, 6, 0
+        rng = np.random.RandomState(0)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            start = layers.data("start", [1], dtype="int64")
+            table = layers.create_parameter([vocab, emb_dim], "float32",
+                                            name="dec_emb")
+            proj = layers.create_parameter([emb_dim, vocab], "float32",
+                                           name="dec_proj")
+            step = layers.fill_constant([1], "int32", 0)
+            max_steps = layers.fill_constant([1], "int32", max_len)
+            tokens = layers.create_array("int32", max_len, [1])
+            cur = layers.cast(layers.reshape(start, [1]), "int32")
+            not_eos = layers.not_equal(
+                cur, layers.fill_constant([1], "int32", eos))
+            in_range = layers.less_than(step, max_steps)
+            cond = layers.logical_and(not_eos, in_range)
+            w = layers.While(cond)
+            with w.block():
+                emb = layers.gather(table, cur)
+                logits = layers.matmul(emb, proj)
+                nxt = layers.cast(
+                    layers.reshape(layers.argmax(logits, axis=-1), [1]),
+                    "int32")
+                layers.array_write(nxt, step, tokens)
+                layers.assign(nxt, cur)
+                layers.increment(step, 1)
+                layers.not_equal(cur, layers.fill_constant([1], "int32", eos),
+                                 cond=not_eos)
+                layers.less_than(step, max_steps, cond=in_range)
+                layers.logical_and(not_eos, in_range, out=cond)
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            toks, n = exe.run(main, feed={"start": np.array([3], "int64")},
+                              fetch_list=[tokens, step])
+        # numpy reference decode
+        with pt.scope_guard(scope):
+            tb = np.asarray(scope.find_var("dec_emb"))
+            pj = np.asarray(scope.find_var("dec_proj"))
+        want = []
+        cur_t = 3
+        for _ in range(max_len):
+            cur_t = int(np.argmax(tb[cur_t] @ pj))
+            want.append(cur_t)
+            if cur_t == eos:
+                break
+        got = [int(t) for t in np.ravel(toks)[:len(want)]]
+        assert got == want
+        assert int(np.ravel(n)[0]) == len(want)
+
+    def test_bounded_while_is_differentiable(self):
+        """max_iters lowers to masked scan -> grads flow (≙ while_grad)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            w = layers.create_parameter([4, 4], "float32", name="loop_w")
+            i = layers.fill_constant([1], "int32", 0)
+            n = layers.fill_constant([1], "int32", 3)
+            h = layers.assign(x)
+            cond = layers.less_than(i, n)
+            wh = layers.While(cond, max_iters=4)
+            with wh.block():
+                layers.assign(layers.tanh(layers.matmul(h, w)), h)
+                layers.increment(i, 1)
+                layers.less_than(i, n, cond=cond)
+            loss = layers.mean(h)
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(0).rand(2, 4).astype("float32")}
+        losses = [float(np.ravel(exe.run(main, feed=feed,
+                                         fetch_list=[loss])[0])[0])
+                  for _ in range(5)]
+        assert losses[-1] < losses[0]  # the loop body's weight trains
+
+
+class TestWhileRegressions:
+    def test_grads_flow_through_array_write(self):
+        """create_array must not sever gradients: loss over collected
+        per-step outputs trains the loop weight."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            w = layers.create_parameter([4, 4], "float32", name="arr_w")
+            arr = layers.create_array("float32", 3, [2, 4])
+            i = layers.fill_constant([1], "int32", 0)
+            n = layers.fill_constant([1], "int32", 3)
+            h = layers.assign(x)
+            cond = layers.less_than(i, n)
+            wh = layers.While(cond, max_iters=3)
+            with wh.block():
+                layers.assign(layers.tanh(layers.matmul(h, w)), h)
+                layers.array_write(h, i, arr)
+                layers.increment(i, 1)
+                layers.less_than(i, n, cond=cond)
+            loss = layers.mean(arr)
+            pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(0).rand(2, 4).astype("float32")}
+        losses = [float(np.ravel(exe.run(main, feed=feed,
+                                         fetch_list=[loss])[0])[0])
+                  for _ in range(5)]
+        assert losses[-1] != losses[0], "gradients severed through array"
+        assert losses[-1] < losses[0]
+
+    def test_prune_keeps_while_producers(self):
+        """≙ save_inference_model path: prune must keep the ops producing
+        loop-carry initial values (the while op declares them as inputs)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            i = layers.fill_constant([1], "int32", 0)
+            n = layers.fill_constant([1], "int32", 5)
+            total = layers.fill_constant([1], "float32", 0.0)
+            cond = layers.less_than(i, n)
+            w = layers.While(cond)
+            with w.block():
+                layers.assign(
+                    layers.elementwise_add(total, layers.cast(i, "float32")),
+                    total)
+                layers.increment(i, 1)
+                layers.less_than(i, n, cond=cond)
+        pruned = main.prune([total.name])
+        exe = pt.Executor()
+        exe.run(startup)
+        (tot,) = exe.run(pruned, fetch_list=[total])
+        assert float(np.ravel(tot)[0]) == sum(range(5))
+
+    def test_max_iters_zero_runs_zero_steps(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            i = layers.fill_constant([1], "int32", 0)
+            n = layers.fill_constant([1], "int32", 5)
+            cond = layers.less_than(i, n)
+            w = layers.While(cond, max_iters=0)
+            with w.block():
+                layers.increment(i, 1)
+                layers.less_than(i, n, cond=cond)
+        exe = pt.Executor()
+        exe.run(startup)
+        (iv,) = exe.run(main, fetch_list=[i])
+        assert int(np.ravel(iv)[0]) == 0
+
+
+class TestSwitch:
+    def test_piecewise_lr(self):
+        """piecewise LR by Switch (≙ test_switch.py + the reference's
+        piecewise_decay implementation idiom)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            step = layers.data("step", [1])
+            lr = layers.fill_constant([1], "float32", 0.0)
+            b1 = layers.fill_constant([1], "float32", 100.0)
+            b2 = layers.fill_constant([1], "float32", 200.0)
+            with layers.Switch() as sw:
+                with sw.case(layers.less_than(step, b1)):
+                    layers.assign(layers.fill_constant([1], "float32", 1.0),
+                                  lr)
+                with sw.case(layers.less_than(step, b2)):
+                    layers.assign(layers.fill_constant([1], "float32", 0.1),
+                                  lr)
+                with sw.default():
+                    layers.assign(layers.fill_constant([1], "float32", 0.01),
+                                  lr)
+        exe = pt.Executor()
+        exe.run(startup)
+        for step_v, want in ((0.0, 1.0), (99.0, 1.0), (100.0, 0.1),
+                             (150.0, 0.1), (200.0, 0.01), (10000.0, 0.01)):
+            (got,) = exe.run(main,
+                             feed={"step": np.array([step_v], "float32")},
+                             fetch_list=[lr])
+            assert float(np.ravel(got)[0]) == pytest.approx(want), step_v
+
+    def test_first_true_wins(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [1])
+            out = layers.fill_constant([1], "float32", -1.0)
+            zero = layers.fill_constant([1], "float32", 0.0)
+            with layers.Switch() as sw:
+                with sw.case(layers.greater_than(x, zero)):  # true for x=5
+                    layers.assign(layers.fill_constant([1], "float32", 10.0),
+                                  out)
+                with sw.case(layers.greater_than(x, zero)):  # also true
+                    layers.assign(layers.fill_constant([1], "float32", 20.0),
+                                  out)
+        exe = pt.Executor()
+        exe.run(startup)
+        (got,) = exe.run(main, feed={"x": np.array([5.0], "float32")},
+                         fetch_list=[out])
+        assert float(np.ravel(got)[0]) == 10.0  # first case, not second
+        (got,) = exe.run(main, feed={"x": np.array([-5.0], "float32")},
+                         fetch_list=[out])
+        assert float(np.ravel(got)[0]) == -1.0  # no case, no default
+
+
+class TestIfElse:
+    def test_batchwise_select(self):
+        """rows with cond take the true branch (≙ test_ifelse semantics)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [3])
+            limit = layers.fill_constant([1], "float32", 0.5)
+            cond = layers.less_than(x, limit)  # broadcast -> [B,3]? no: use col
+            col = layers.reduce_mean(x, dim=1, keep_dim=True)
+            cond = layers.less_than(col, limit)  # [B,1] bool
+            ie = layers.IfElse(cond)
+            with ie.true_block():
+                d = ie.input(x)
+                ie.output(layers.scale(d, scale=-1.0))
+            with ie.false_block():
+                d = ie.input(x)
+                ie.output(layers.scale(d, scale=2.0))
+            out = ie()
+        exe = pt.Executor()
+        exe.run(startup)
+        xv = np.array([[0.1, 0.2, 0.3], [0.9, 0.8, 0.7]], "float32")
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        want = np.where(xv.mean(1, keepdims=True) < 0.5, -xv, 2 * xv)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_branch_count_mismatch_raises(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [1])
+            cond = layers.less_than(x, layers.fill_constant([1], "float32",
+                                                            0.0))
+            ie = layers.IfElse(cond)
+            with ie.true_block():
+                ie.output(ie.input(x))
+            with pytest.raises(ValueError, match="different numbers"):
+                ie()
+
+
+class TestArrays:
+    def test_write_read_round_trip(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            arr = layers.create_array("float32", 4, [2])
+            v = layers.fill_constant([2], "float32", 7.0)
+            i = layers.fill_constant([1], "int32", 2)
+            layers.array_write(v, i, arr)
+            back = layers.array_read(arr, i)
+        exe = pt.Executor()
+        exe.run(startup)
+        a, b = exe.run(main, fetch_list=[arr, back])
+        np.testing.assert_allclose(a[2], [7.0, 7.0])
+        np.testing.assert_allclose(a[1], [0.0, 0.0])
+        np.testing.assert_allclose(b, [7.0, 7.0])
