@@ -10,7 +10,8 @@ parallelism is mesh sharding with XLA collectives over ICI/DCN.
 from .core import (Program, Block, OpDesc, VarDesc, program_guard,
                    default_main_program, default_startup_program,
                    Scope, global_scope, scope_guard,
-                   Executor, Place, CPUPlace, TPUPlace, unique_name)
+                   Executor, Place, CPUPlace, TPUPlace, unique_name,
+                   remat_scope)
 from . import ops  # registers the op library
 from . import backward
 from .backward import append_backward, calc_gradient, grad_var_name

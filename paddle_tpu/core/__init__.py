@@ -1,7 +1,8 @@
 from .program import (Program, Block, OpDesc, VarDesc, program_guard,
                       default_main_program, default_startup_program,
                       switch_main_program, switch_startup_program,
-                      unique_name, reset_unique_names)
+                      unique_name, reset_unique_names,
+                      remat_scope, current_remat_scope)
 from .scope import Scope, global_scope, scope_guard
 from .executor import Executor, Place, CPUPlace, TPUPlace
 from .registry import register_op, get_op, require_op, registered_ops
@@ -11,6 +12,7 @@ __all__ = [
     "Program", "Block", "OpDesc", "VarDesc", "program_guard",
     "default_main_program", "default_startup_program", "switch_main_program",
     "switch_startup_program", "unique_name", "reset_unique_names",
+    "remat_scope", "current_remat_scope",
     "Scope", "global_scope", "scope_guard",
     "Executor", "Place", "CPUPlace", "TPUPlace",
     "register_op", "get_op", "require_op", "registered_ops", "types",

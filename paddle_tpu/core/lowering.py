@@ -77,11 +77,79 @@ def run_op(op: OpDesc, env: Dict[str, object], ctx: ExecContext, block: Block):
             env[n] = _apply_stop_gradient(block, n, v)
 
 
+def _run_remat_segment(ops, start: int, stop: int, range_stop: int, env,
+                       ctx, block, live_out):
+    """Trace ops[start:stop] under jax.checkpoint: their intermediate
+    activations are rematerialized in the backward pass instead of saved
+    (≙ memory_optimization_transpiler.py's liveness-based var reuse,
+    re-read as XLA-native rematerialization).
+
+    Only values read AFTER the segment (by ops[stop:range_stop] or the
+    caller's live_out set) escape as checkpoint outputs — everything
+    returned from a checkpointed fn is a saved primal, so emitting every
+    intermediate would defeat the remat entirely.
+    """
+    seg = ops[start:stop]
+    read: List[str] = []
+    defined: set = set()
+    for op in seg:
+        for n in op.input_names():
+            if n in env and n not in defined and n not in read:
+                read.append(n)
+        defined.update(op.output_names())
+
+    if live_out is None:
+        # caller gave no liveness info (sub-block interpreters read
+        # arbitrary names from env afterwards): every output escapes —
+        # correctness over memory savings
+        written = []
+        for op in seg:
+            for n in op.output_names():
+                if n not in written:
+                    written.append(n)
+    else:
+        later_reads = set(live_out)
+        for op in ops[stop:range_stop]:
+            later_reads.update(op.input_names())
+        written = []
+        for op in seg:
+            for n in op.output_names():
+                if n in later_reads and n not in written:
+                    written.append(n)
+        if not written:  # keep the segment observable
+            written = list(seg[-1].output_names())
+
+    def seg_fn(vals):
+        e = dict(env)
+        e.update(zip(read, vals))
+        for k, op in enumerate(seg):
+            ctx.op_index = start + k
+            run_op(op, e, ctx, block)
+        return tuple(e[n] for n in written)
+
+    outs = jax.checkpoint(seg_fn)(tuple(env[n] for n in read))
+    env.update(zip(written, outs))
+
+
 def run_op_range(ops: Sequence[OpDesc], start: int, stop: int,
-                 env: Dict[str, object], ctx: ExecContext, block: Block):
-    for i in range(start, stop):
-        ctx.op_index = i
-        run_op(ops[i], env, ctx, block)
+                 env: Dict[str, object], ctx: ExecContext, block: Block,
+                 live_out=None):
+    """live_out: names the CALLER reads from env after this range — used
+    to bound what escapes a remat segment. None = everything may escape
+    (safe default for sub-block interpreters)."""
+    i = start
+    while i < stop:
+        tag = ops[i].attrs.get("remat_scope")
+        if tag is None:
+            ctx.op_index = i
+            run_op(ops[i], env, ctx, block)
+            i += 1
+            continue
+        j = i
+        while j < stop and ops[j].attrs.get("remat_scope") == tag:
+            j += 1
+        _run_remat_segment(ops, i, j, stop, env, ctx, block, live_out)
+        i = j
     return env
 
 
@@ -101,7 +169,8 @@ def run_block_with_autodiff(block: Block, env: Dict[str, object], ctx: ExecConte
     ops = block.ops
     bwd_idx = next((i for i, o in enumerate(ops) if o.type == AUTODIFF_OP), None)
     if bwd_idx is None:
-        return run_op_range(ops, 0, len(ops), env, ctx, block)
+        return run_op_range(ops, 0, len(ops), env, ctx, block,
+                            live_out=getattr(ctx, "live_out", None))
 
     bop = ops[bwd_idx]
     loss_name = bop.attrs["loss"]
@@ -159,6 +228,16 @@ def run_block_with_autodiff(block: Block, env: Dict[str, object], ctx: ExecConte
             surrogates[i] = jnp.zeros(
                 tuple(id_shapes[i].shape) + (wv.shape[-1],), sdt)
 
+    # names still needed once the forward finishes: the loss, whatever the
+    # optimizer suffix reads, the step's fetches/state, and sparse ids.
+    # Anything else may die inside the forward — which is what lets remat
+    # segments actually discard activations (their residuals must not be
+    # aux outputs of the differentiated function).
+    needed_after = {loss_name} | set(getattr(ctx, "live_out", ()) or ())
+    for op in ops[bwd_idx + 1:]:
+        needed_after.update(op.input_names())
+    needed_after.update(ids_name for _, _, ids_name in sparse_ops)
+
     def fwd(diff):
         pvals, zvals = diff
         e = dict(env)
@@ -179,16 +258,19 @@ def run_block_with_autodiff(block: Block, env: Dict[str, object], ctx: ExecConte
             e.update(pvals)
         ctx.sparse_surrogates = zvals
         try:
-            e = run_op_range(ops, 0, bwd_idx, e, ctx, block)
+            e = run_op_range(ops, 0, bwd_idx, e, ctx, block,
+                             live_out=needed_after)
         finally:
             ctx.sparse_surrogates = None
         loss = jnp.sum(e[loss_name].astype(jnp.float32))
-        return loss * loss_scale, e
+        return loss * loss_scale, {k: v for k, v in e.items()
+                                   if k in needed_after}
 
     orig_params = {p: env[p] for p in param_names}
     (_, env2), (grads, gz) = jax.value_and_grad(fwd, has_aux=True)(
         (dense_param_vals, surrogates))
-    env = env2
+    env = dict(env)
+    env.update(env2)
     # the post-forward env holds the amp-cast param values; the optimizer
     # suffix must update the f32 MASTERS, not a bf16-quantized copy (the
     # whole point of master weights: small updates still accumulate)
@@ -244,6 +326,7 @@ def build_step_fn(program: Program, feed_names: Sequence[str],
     def step(state: Dict[str, object], feed: Dict[str, object], rng):
         ctx = ExecContext(rng, is_test=is_test, mesh=mesh)
         ctx.amp_dtype = program.amp_dtype
+        ctx.live_out = set(fetch_names) | set(state_out_names)
         env: Dict[str, object] = {}
         env.update(state)
         env.update(feed)

@@ -57,6 +57,33 @@ def reset_unique_names():
 
 
 # ---------------------------------------------------------------------------
+# Remat scopes (≙ memory_optimization_transpiler intent): ops appended
+# inside `with remat_scope(tag):` carry attrs["remat_scope"]=tag; the
+# lowering wraps each maximal run of same-tagged ops in jax.checkpoint so
+# their activations are recomputed in the backward instead of stored.
+# ---------------------------------------------------------------------------
+
+_remat_stack: List[str] = []
+
+
+class remat_scope:
+    def __init__(self, tag: str):
+        self.tag = tag
+
+    def __enter__(self):
+        _remat_stack.append(self.tag)
+        return self
+
+    def __exit__(self, *exc):
+        _remat_stack.pop()
+        return False
+
+
+def current_remat_scope() -> Optional[str]:
+    return _remat_stack[-1] if _remat_stack else None
+
+
+# ---------------------------------------------------------------------------
 # Descriptors
 # ---------------------------------------------------------------------------
 
@@ -232,6 +259,9 @@ class Block:
             return out
 
         op = OpDesc(type, canon(inputs), canon(outputs), attrs)
+        scope_tag = current_remat_scope()
+        if scope_tag is not None:
+            op.attrs.setdefault("remat_scope", scope_tag)
         self.ops.append(op)
         self.program.invalidate_cache()
         from .registry import get_op  # local import to avoid cycle

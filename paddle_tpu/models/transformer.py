@@ -12,7 +12,10 @@ embeddings; weight-tied-free output head (fc to vocab).
 
 from __future__ import annotations
 
+import contextlib
+
 from .. import layers
+from ..core.program import remat_scope
 from ..initializer import NormalInitializer
 from ..param_attr import ParamAttr
 
@@ -41,7 +44,8 @@ def _ffn(x, d_model, d_ff, idx, tp_shard):
 
 def transformer_lm(src_ids, vocab_size, n_layers=2, d_model=128, n_heads=4,
                    d_ff=512, max_len=2048, dropout_rate=0.0,
-                   causal=True, sp_mode="none", tp_shard=False):
+                   causal=True, sp_mode="none", tp_shard=False,
+                   remat=False):
     """src_ids: [B, S] int64 var. Returns logits [B, S, vocab_size]."""
     seq_len = int(src_ids.shape[1])
     if seq_len > max_len:
@@ -60,18 +64,23 @@ def transformer_lm(src_ids, vocab_size, n_layers=2, d_model=128, n_heads=4,
         x = layers.dropout(x, dropout_prob=dropout_rate)
 
     for i in range(n_layers):
-        ln1 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln1_{i}",
-                                param_attr=ParamAttr(name=f"ln1_{i}_scale"),
-                                bias_attr=ParamAttr(name=f"ln1_{i}_bias"))
-        att = layers.multi_head_attention(
-            ln1, num_heads=n_heads, causal=causal, sp_mode=sp_mode,
-            dropout_rate=dropout_rate, tp_shard=tp_shard, name=f"attn{i}")
-        x = layers.elementwise_add(x, att)
-        ln2 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln2_{i}",
-                                param_attr=ParamAttr(name=f"ln2_{i}_scale"),
-                                bias_attr=ParamAttr(name=f"ln2_{i}_bias"))
-        ff = _ffn(ln2, d_model, d_ff, i, tp_shard)
-        x = layers.elementwise_add(x, ff)
+        # remat: each transformer layer becomes one jax.checkpoint segment
+        # (activation memory ~O(n_layers) -> O(1) per layer boundary)
+        scope = remat_scope(f"tfm_layer_{i}") if remat \
+            else contextlib.nullcontext()
+        with scope:
+            ln1 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln1_{i}",
+                                    param_attr=ParamAttr(name=f"ln1_{i}_scale"),
+                                    bias_attr=ParamAttr(name=f"ln1_{i}_bias"))
+            att = layers.multi_head_attention(
+                ln1, num_heads=n_heads, causal=causal, sp_mode=sp_mode,
+                dropout_rate=dropout_rate, tp_shard=tp_shard, name=f"attn{i}")
+            x = layers.elementwise_add(x, att)
+            ln2 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln2_{i}",
+                                    param_attr=ParamAttr(name=f"ln2_{i}_scale"),
+                                    bias_attr=ParamAttr(name=f"ln2_{i}_bias"))
+            ff = _ffn(ln2, d_model, d_ff, i, tp_shard)
+            x = layers.elementwise_add(x, ff)
 
     x = layers.layer_norm(x, begin_norm_axis=2, name="ln_f",
                           param_attr=ParamAttr(name="ln_f_scale"),

@@ -2,5 +2,7 @@
 
 from .distribute_transpiler import (DistributeTranspiler, TranspileStrategy,
                                     transpile)
+from .memory_optimize import memory_optimize, release_memory
 
-__all__ = ["DistributeTranspiler", "TranspileStrategy", "transpile"]
+__all__ = ["DistributeTranspiler", "TranspileStrategy", "transpile",
+           "memory_optimize", "release_memory"]
