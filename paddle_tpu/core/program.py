@@ -83,6 +83,22 @@ def current_remat_scope() -> Optional[str]:
     return _remat_stack[-1] if _remat_stack else None
 
 
+def iter_optimizer_state_inputs(block) -> Iterator[tuple]:
+    """Yield (param_name, accumulator_name) for every optimizer-state input
+    of Param-carrying ops (velocity, moments, …) — the one shared
+    definition of "what is optimizer state" used by the sharding transpiler
+    and ParallelExecutor's ZeRO-1 placement."""
+    for op in block.ops:
+        if "Param" not in op.inputs:
+            continue
+        p_name = op.inputs["Param"][0]
+        for slot, names in op.inputs.items():
+            if slot in ("Param", "Grad", "LearningRate"):
+                continue
+            for n in names:
+                yield p_name, n
+
+
 # ---------------------------------------------------------------------------
 # Descriptors
 # ---------------------------------------------------------------------------

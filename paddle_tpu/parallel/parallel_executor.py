@@ -99,16 +99,38 @@ class ParallelExecutor:
             dims.pop()
         return PartitionSpec(*dims)
 
+    def _optimizer_state_names(self) -> set:
+        """Names of optimizer accumulator vars (velocity, moments, …).
+        ≙ identifying the per-param state the reference's kReduce mode
+        places on the grad's reduce device
+        (multi_devices_graph_builder.cc:234-259). Cached per program
+        CONTENT (fingerprint), so mutating the program between runs —
+        which the compile cache supports — refreshes the set."""
+        from ..core.program import iter_optimizer_state_inputs
+        fp = self._program.fingerprint()
+        if getattr(self, "_acc_cache_for", None) != fp:
+            self._acc_cache = {acc for _, acc in iter_optimizer_state_inputs(
+                self._program.global_block)}
+            self._acc_cache_for = fp
+        return self._acc_cache
+
     def _state_spec(self, var: VarDesc, value) -> PartitionSpec:
         if var is not None and var.sharding:
             return self._divisible(spec_for(var.sharding, self._mesh), value)
         if (self._build_strategy.reduce_strategy == ReduceStrategy.Reduce
-                and var is not None and not var.is_parameter):
-            # optimizer accumulators sharded over dp when cleanly divisible
+                and var is not None and not var.is_parameter
+                and var.name in self._optimizer_state_names()):
+            # ZeRO-1: shard the accumulator on its first dp-divisible axis.
+            # GSPMD then computes the optimizer update dp-sharded (grads
+            # arrive reduce-scattered) and all-gathers the updated param —
+            # exactly the reduce-then-broadcast dataflow of the reference's
+            # kReduce mode, derived instead of hand-built.
             shape = jnp.shape(value)
             dp_size = self._mesh.shape.get(DP, 1)
-            if shape and shape[0] % max(dp_size, 1) == 0 and shape[0] >= dp_size > 1:
-                return PartitionSpec(DP)
+            if dp_size > 1:
+                for i, s in enumerate(shape):
+                    if s % dp_size == 0 and s >= dp_size:
+                        return PartitionSpec(*([None] * i + [DP]))
         return PartitionSpec()
 
     def _feed_spec(self, var: Optional[VarDesc], value) -> PartitionSpec:

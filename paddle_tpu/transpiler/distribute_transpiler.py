@@ -180,21 +180,16 @@ def transpile(program: Optional[Program] = None, mesh=None,
                 op.attrs["sp_mode"] = strategy.sp_mode
 
     # -- optimizer accumulators follow their param -------------------------
-    for op in block.ops:
-        if "Param" not in op.inputs:
-            continue
-        p = var(op.inputs["Param"][0])
+    from ..core.program import iter_optimizer_state_inputs
+    for p_name, acc_name in iter_optimizer_state_inputs(block):
+        p = var(p_name)
         if p is None or p.sharding is None:
             continue
-        for slot, names in op.inputs.items():
-            if slot in ("Param", "Grad", "LearningRate"):
-                continue
-            for n in names:
-                acc = var(n)
-                if (acc is not None and not acc.is_parameter
-                        and tuple(acc.shape) == tuple(p.shape)
-                        and acc.sharding is None):
-                    acc.sharding = p.sharding
+        acc = var(acc_name)
+        if (acc is not None and not acc.is_parameter
+                and tuple(acc.shape) == tuple(p.shape)
+                and acc.sharding is None):
+            acc.sharding = p.sharding
 
     program.invalidate_cache()
     return program
