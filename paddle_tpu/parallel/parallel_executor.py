@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..core.program import Program, VarDesc, default_main_program
+from ..core.program import (Program, VarDesc, default_main_program,
+                            iter_optimizer_state_inputs)
 from ..core.scope import Scope, global_scope
 from ..core.executor import Executor, _Compiled
 from ..core import lowering
@@ -106,7 +107,6 @@ class ParallelExecutor:
         (multi_devices_graph_builder.cc:234-259). Cached per program
         CONTENT (fingerprint), so mutating the program between runs —
         which the compile cache supports — refreshes the set."""
-        from ..core.program import iter_optimizer_state_inputs
         fp = self._program.fingerprint()
         if getattr(self, "_acc_cache_for", None) != fp:
             self._acc_cache = {acc for _, acc in iter_optimizer_state_inputs(

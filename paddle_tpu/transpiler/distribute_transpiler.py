@@ -32,7 +32,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set
 
-from ..core.program import Program, VarDesc, default_main_program
+from ..core.program import (Program, VarDesc, default_main_program,
+                            iter_optimizer_state_inputs)
 
 # ops a tp-sharded activation may flow through without breaking the
 # column→row Megatron pairing; values = input slots the trace follows
@@ -180,7 +181,6 @@ def transpile(program: Optional[Program] = None, mesh=None,
                 op.attrs["sp_mode"] = strategy.sp_mode
 
     # -- optimizer accumulators follow their param -------------------------
-    from ..core.program import iter_optimizer_state_inputs
     for p_name, acc_name in iter_optimizer_state_inputs(block):
         p = var(p_name)
         if p is None or p.sharding is None:

@@ -1,0 +1,122 @@
+"""Two-process multi-host smoke test + env-contract parsing.
+
+≙ reference test_dist_train.py:26-100 in spirit: the reference spawns
+pserver+trainer with multiprocessing on one box; here two REAL processes
+rendezvous through jax.distributed.initialize (the gen_nccl_id
+equivalent) with a local coordinator, build the global 2-process device
+view, and run a psum over DCN. Env parsing covers the
+PADDLE_TRAINERS/PADDLE_TRAINER_ID contract (trainer.py:226).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import paddle_tpu  # noqa: F401 — ensures the package imports in this env
+from paddle_tpu.parallel import distributed
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+_WORKER = textwrap.dedent("""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.parallel import distributed
+    distributed.initialize_from_env()
+    assert distributed.process_count() == 2, distributed.process_count()
+    rank = distributed.process_index()
+    assert rank == int(os.environ["PADDLE_TRAINER_ID"])
+    # one cross-process collective over the coordinator-built world
+    # (≙ the first NCCL allreduce proving the rendezvoused communicator)
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    got = multihost_utils.process_allgather(jnp.asarray([float(rank + 1)]))
+    assert float(got.sum()) == 3.0, got  # 1 + 2
+    print(f"OK rank={rank}")
+""")
+
+
+@pytest.mark.parametrize("use_legacy_pserver_env", [False, True])
+def test_two_process_rendezvous_and_collective(tmp_path,
+                                               use_legacy_pserver_env):
+    port = _free_port()
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["PADDLE_TRAINERS"] = "2"
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        if use_legacy_pserver_env:
+            env["PADDLE_PSERVER_IPS"] = "127.0.0.1"
+            env["PADDLE_PSERVER_PORT"] = str(port)
+            env.pop("PADDLE_COORDINATOR", None)
+        else:
+            env["PADDLE_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("worker timed out (rendezvous hung)")
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"OK rank={rank}" in out, out
+
+
+class TestEnvContractParsing:
+    def test_single_trainer_is_noop(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINERS", "1")
+        monkeypatch.setattr(distributed, "_initialized", False)
+        distributed.initialize_from_env()  # must not try to rendezvous
+
+    def test_coordinator_fallback_to_pserver_env(self, monkeypatch):
+        seen = {}
+
+        def fake_init(coordinator_address=None, num_processes=None,
+                      process_id=None):
+            seen.update(coordinator=coordinator_address,
+                        n=num_processes, pid=process_id)
+
+        monkeypatch.setattr(distributed, "initialize", fake_init)
+        monkeypatch.setenv("PADDLE_TRAINERS", "4")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        monkeypatch.delenv("PADDLE_COORDINATOR", raising=False)
+        monkeypatch.setenv("PADDLE_PSERVER_IPS", "10.0.0.5,10.0.0.6")
+        monkeypatch.setenv("PADDLE_PSERVER_PORT", "6174")
+        distributed.initialize_from_env()
+        assert seen == {"coordinator": "10.0.0.5:6174", "n": 4, "pid": 2}
+
+    def test_explicit_coordinator_wins(self, monkeypatch):
+        seen = {}
+        monkeypatch.setattr(
+            distributed, "initialize",
+            lambda coordinator_address=None, num_processes=None,
+            process_id=None: seen.update(c=coordinator_address))
+        monkeypatch.setenv("PADDLE_TRAINERS", "2")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        monkeypatch.setenv("PADDLE_COORDINATOR", "coord:1234")
+        monkeypatch.setenv("PADDLE_PSERVER_IPS", "ignored")
+        distributed.initialize_from_env()
+        assert seen["c"] == "coord:1234"
