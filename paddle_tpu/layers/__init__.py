@@ -1,7 +1,7 @@
 """fluid.layers-equivalent flat namespace."""
 
 from . import nn, tensor, io, metric, ops, learning_rate_scheduler
-from . import sequence, control_flow, beam, crf, attention
+from . import sequence, control_flow, beam, crf, attention, detection
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
@@ -13,6 +13,7 @@ from .control_flow import *  # noqa: F401,F403
 from .beam import *  # noqa: F401,F403
 from .crf import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()

@@ -1,0 +1,197 @@
+"""Detection layers (≙ python/paddle/fluid/layers/detection.py, 911 LoC).
+
+Dense-shape conventions (vs the reference's LoD outputs) are documented on
+each op in ops/detection_ops.py; ground-truth tensors are padded [B, G, …]
+with all-zero box rows as padding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..core.program import VarDesc
+from ..layer_helper import LayerHelper
+
+__all__ = ["prior_box", "anchor_generator", "box_coder", "box_clip",
+           "bipartite_match", "target_assign", "mine_hard_examples",
+           "multiclass_nms", "detection_output", "ssd_loss", "roi_pool",
+           "roi_align", "iou_similarity"]
+
+
+def iou_similarity(x, y, name=None):
+    """layers/detection.py iou_similarity wrapper (op in ops/math_ops)."""
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("iou_similarity", {"X": x, "Y": y}, {"Out": out}, {})
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    """layers/detection.py:prior_box. Returns (boxes, variances),
+    each [H, W, n_priors, 4]."""
+    helper = LayerHelper("prior_box", name=name)
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError(
+            f"prior_box: max_sizes ({len(max_sizes)}) must pair 1:1 with "
+            f"min_sizes ({len(min_sizes)})")
+    boxes = helper.create_tmp_variable("float32")
+    var = helper.create_tmp_variable("float32")
+    helper.append_op(
+        "prior_box", {"Input": input, "Image": image},
+        {"Boxes": boxes, "Variances": var},
+        {"min_sizes": list(min_sizes), "max_sizes": list(max_sizes or []),
+         "aspect_ratios": list(aspect_ratios), "variances": list(variance),
+         "flip": flip, "clip": clip, "step_w": steps[0], "step_h": steps[1],
+         "offset": offset})
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, stride,
+                     variance=(0.1, 0.1, 0.2, 0.2), offset=0.5, name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_tmp_variable("float32")
+    var = helper.create_tmp_variable("float32")
+    helper.append_op(
+        "anchor_generator", {"Input": input},
+        {"Anchors": anchors, "Variances": var},
+        {"anchor_sizes": list(anchor_sizes),
+         "aspect_ratios": list(aspect_ratios), "stride": list(stride),
+         "variances": list(variance), "offset": offset})
+    return anchors, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_tmp_variable(target_box.dtype)
+    ins = {"PriorBox": prior_box, "TargetBox": target_box}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = prior_box_var
+    helper.append_op("box_coder", ins, {"OutputBox": out},
+                     {"code_type": code_type,
+                      "box_normalized": box_normalized})
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("box_clip", {"Input": input, "ImInfo": im_info},
+                     {"Output": out}, {})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_tmp_variable("int32")
+    dist = helper.create_tmp_variable(dist_matrix.dtype)
+    helper.append_op("bipartite_match", {"DistMat": dist_matrix},
+                     {"ColToRowMatchIndices": idx,
+                      "ColToRowMatchDist": dist},
+                     {"match_type": match_type,
+                      "dist_threshold": dist_threshold})
+    return idx, dist
+
+
+def target_assign(input, matched_indices, mismatch_value=0, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    weight = helper.create_tmp_variable("float32")
+    helper.append_op("target_assign",
+                     {"X": input, "MatchIndices": matched_indices},
+                     {"Out": out, "OutWeight": weight},
+                     {"mismatch_value": mismatch_value})
+    return out, weight
+
+
+def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio=3.0,
+                       name=None):
+    helper = LayerHelper("mine_hard_examples", name=name)
+    mask = helper.create_tmp_variable("float32")
+    upd = helper.create_tmp_variable("int32")
+    helper.append_op("mine_hard_examples",
+                     {"ClsLoss": cls_loss, "MatchIndices": match_indices},
+                     {"NegMask": mask, "UpdatedMatchIndices": upd},
+                     {"neg_pos_ratio": neg_pos_ratio})
+    return mask, upd
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.01, nms_top_k=64,
+                   keep_top_k=16, nms_threshold=0.3, background_label=0,
+                   name=None):
+    """Out [B, keep_top_k, 6] = (label, score, x0, y0, x1, y1); label -1
+    marks padding rows (dense stand-in for the reference's LoD result)."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_tmp_variable(bboxes.dtype)
+    helper.append_op("multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
+                     {"Out": out},
+                     {"score_threshold": score_threshold,
+                      "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                      "nms_threshold": nms_threshold,
+                      "background_label": background_label})
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=64,
+                     keep_top_k=16, score_threshold=0.01, name=None):
+    """layers/detection.py detection_output: decode + NMS.
+    loc [B,M,4] offsets, scores [B,M,C] (post-softmax)."""
+    from . import nn as L
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    scores_t = L.transpose(scores, perm=[0, 2, 1])       # [B,C,M]
+    return multiclass_nms(decoded, scores_t,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label, name=name)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, loc_loss_weight=1.0, conf_loss_weight=1.0,
+             name=None):
+    """layers/detection.py:ssd_loss — one fused op here (the reference
+    composes ~10 ops; ops/detection_ops.py ssd_loss documents the math).
+    Returns per-image loss [B, 1]."""
+    helper = LayerHelper("ssd_loss", name=name)
+    loss = helper.create_tmp_variable("float32")
+    ins = {"Location": location, "Confidence": confidence,
+           "GtBox": gt_box, "GtLabel": gt_label, "PriorBox": prior_box}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = prior_box_var
+    helper.append_op("ssd_loss", ins, {"Loss": loss},
+                     {"background_label": background_label,
+                      "overlap_threshold": overlap_threshold,
+                      "neg_pos_ratio": neg_pos_ratio,
+                      "loc_loss_weight": loc_loss_weight,
+                      "conf_loss_weight": conf_loss_weight})
+    return loss
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, name=None):
+    """rois: dense [R, 5] = (batch_idx, x0, y0, x1, y1)."""
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("roi_pool", {"X": input, "ROIs": rois}, {"Out": out},
+                     {"pooled_height": pooled_height,
+                      "pooled_width": pooled_width,
+                      "spatial_scale": spatial_scale})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, name=None):
+    helper = LayerHelper("roi_align", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("roi_align", {"X": input, "ROIs": rois}, {"Out": out},
+                     {"pooled_height": pooled_height,
+                      "pooled_width": pooled_width,
+                      "spatial_scale": spatial_scale})
+    return out

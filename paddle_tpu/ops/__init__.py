@@ -16,5 +16,6 @@ from . import rnn_ops  # noqa: F401
 from . import beam_ops  # noqa: F401
 from . import crf_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
 
 from ..core.registry import registered_ops  # noqa: F401

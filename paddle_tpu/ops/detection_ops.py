@@ -1,0 +1,552 @@
+"""Detection/vision op family.
+
+≙ reference paddle/fluid/operators/detection/ (prior_box_op, box_coder_op,
+multiclass_nms_op, bipartite_match_op, target_assign_op, mine_hard_examples
+_op, box_clip, anchor_generator_op) + roi_pool_op. The reference's kernels
+produce VARIABLE-size outputs carried in LoD; XLA needs static shapes, so
+every op here is re-designed dense: fixed capacities with validity masks
+(-1 labels / zero padding), the standard TPU detection formulation — and
+batch/box loops become vectorized lax ops, never host loops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# Prior / anchor generation
+# ---------------------------------------------------------------------------
+
+def _prior_box_infer(op, block):
+    x = block.var(op.input("Input")[0])
+    h, w = x.shape[-2], x.shape[-1]
+    n_ar = len(_expand_ars(op.attrs))
+    n_priors = n_ar * len(op.attrs["min_sizes"]) + len(
+        op.attrs.get("max_sizes", []))
+    for slot in ("Boxes", "Variances"):
+        v = block.var(op.output(slot)[0])
+        v.shape = (h, w, n_priors, 4)
+        v.dtype = "float32"
+
+
+def _expand_ars(attrs):
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", [1.0]):
+        if not any(abs(ar - x) < 1e-6 for x in ars):
+            ars.append(float(ar))
+            if attrs.get("flip", False):
+                ars.append(1.0 / float(ar))
+    return ars
+
+
+@register_op("prior_box", infer_shape=_prior_box_infer)
+def prior_box(ctx, ins, attrs):
+    """prior_box_op.cc: SSD prior boxes per feature-map cell.
+
+    Boxes/Variances: [H, W, n_priors, 4] in normalized xmin,ymin,xmax,ymax.
+    """
+    x, image = ins["Input"][0], ins["Image"][0]
+    fh, fw = x.shape[-2], x.shape[-1]
+    ih, iw = image.shape[-2], image.shape[-1]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0)) or iw / fw
+    step_h = float(attrs.get("step_h", 0.0)) or ih / fh
+    offset = float(attrs.get("offset", 0.5))
+    ars = _expand_ars(attrs)
+
+    widths, heights = [], []
+    for ms in min_sizes:
+        for ar in ars:
+            widths.append(ms * (ar ** 0.5))
+            heights.append(ms / (ar ** 0.5))
+    for ms, mxs in zip(min_sizes, max_sizes):
+        widths.append((ms * mxs) ** 0.5)
+        heights.append((ms * mxs) ** 0.5)
+    widths = jnp.asarray(widths, jnp.float32)      # [P]
+    heights = jnp.asarray(heights, jnp.float32)
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w   # [W]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h   # [H]
+    cxg = cx[None, :, None]    # [1, W, 1]
+    cyg = cy[:, None, None]    # [H, 1, 1]
+    wg = widths[None, None, :] / 2.0
+    hg = heights[None, None, :] / 2.0
+    boxes = jnp.stack(jnp.broadcast_arrays(
+        (cxg - wg) / iw, (cyg - hg) / ih,
+        (cxg + wg) / iw, (cyg + hg) / ih), axis=-1)  # [H, W, P, 4]
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+def _anchor_infer(op, block):
+    x = block.var(op.input("Input")[0])
+    h, w = x.shape[-2], x.shape[-1]
+    n = len(op.attrs["anchor_sizes"]) * len(op.attrs["aspect_ratios"])
+    for slot in ("Anchors", "Variances"):
+        v = block.var(op.output(slot)[0])
+        v.shape = (h, w, n, 4)
+        v.dtype = "float32"
+
+
+@register_op("anchor_generator", infer_shape=_anchor_infer)
+def anchor_generator(ctx, ins, attrs):
+    """anchor_generator_op.cc (Faster-RCNN anchors, absolute coords)."""
+    x = ins["Input"][0]
+    fh, fw = x.shape[-2], x.shape[-1]
+    sizes = jnp.asarray([float(s) for s in attrs["anchor_sizes"]])
+    ars = jnp.asarray([float(a) for a in attrs["aspect_ratios"]])
+    stride = [float(s) for s in attrs.get("stride", [16.0, 16.0])]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    offset = float(attrs.get("offset", 0.5))
+
+    ar_sqrt = jnp.sqrt(ars)                        # [A]
+    ws = (sizes[None, :] / ar_sqrt[:, None]).reshape(-1)   # [A*S]
+    hs = (sizes[None, :] * ar_sqrt[:, None]).reshape(-1)
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * stride[1]
+    cxg = cx[None, :, None]
+    cyg = cy[:, None, None]
+    anchors = jnp.stack(jnp.broadcast_arrays(
+        cxg - ws / 2, cyg - hs / 2, cxg + ws / 2, cyg + hs / 2), axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), anchors.shape)
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+# ---------------------------------------------------------------------------
+# Box arithmetic
+# ---------------------------------------------------------------------------
+
+def _center_form(boxes):
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    return (boxes[..., 0] + w / 2, boxes[..., 1] + h / 2, w, h)
+
+
+@register_op("box_coder")
+def box_coder(ctx, ins, attrs):
+    """box_coder_op.cc: encode targets against priors, or decode offsets.
+
+    PriorBox [M,4], TargetBox encode: [M,4] / decode: [N,M,4] (or [M,4]).
+    """
+    prior = ins["PriorBox"][0]
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    target = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    one = 0.0 if norm else 1.0
+
+    pcx, pcy, pw, ph = _center_form(prior)
+    pw = pw + one
+    ph = ph + one
+    if pvar is None:
+        pvar = jnp.ones(prior.shape[-1:], prior.dtype)
+
+    if code_type.startswith("encode"):
+        tcx, tcy, tw, th = _center_form(target)
+        tw = tw + one
+        th = th + one
+        out = jnp.stack([
+            (tcx - pcx) / pw / pvar[..., 0],
+            (tcy - pcy) / ph / pvar[..., 1],
+            jnp.log(jnp.maximum(tw / pw, 1e-10)) / pvar[..., 2],
+            jnp.log(jnp.maximum(th / ph, 1e-10)) / pvar[..., 3]], axis=-1)
+    else:
+        t = target
+        squeeze = t.ndim == 2
+        if squeeze:
+            t = t[None]
+        cx = pvar[..., 0] * t[..., 0] * pw + pcx
+        cy = pvar[..., 1] * t[..., 1] * ph + pcy
+        w = jnp.exp(pvar[..., 2] * t[..., 2]) * pw
+        h = jnp.exp(pvar[..., 3] * t[..., 3]) * ph
+        out = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - one, cy + h / 2 - one], axis=-1)
+        if squeeze:
+            out = out[0]
+    return {"OutputBox": [out]}
+
+
+@register_op("box_clip")
+def box_clip(ctx, ins, attrs):
+    """box_clip_op.cc: clip boxes into [0, im-1] per image (ImInfo [N,3])."""
+    boxes, im_info = ins["Input"][0], ins["ImInfo"][0]
+    h = im_info[..., 0] / im_info[..., 2] - 1.0
+    w = im_info[..., 1] / im_info[..., 2] - 1.0
+    h = h.reshape(h.shape + (1,) * (boxes.ndim - h.ndim))
+    w = w.reshape(w.shape + (1,) * (boxes.ndim - w.ndim))
+    x0 = jnp.clip(boxes[..., 0::2], 0.0, w)
+    y0 = jnp.clip(boxes[..., 1::2], 0.0, h)
+    out = jnp.stack([x0[..., 0], y0[..., 0], x0[..., 1], y0[..., 1]],
+                    axis=-1)
+    return {"Output": [out]}
+
+
+def _iou_matrix(a, b):
+    """[N,4] x [M,4] -> [N,M] IoU (normalized corner boxes)."""
+    ax0, ay0, ax1, ay1 = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+    bx0, by0, bx1, by1 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    ix0 = jnp.maximum(ax0[:, None], bx0[None, :])
+    iy0 = jnp.maximum(ay0[:, None], by0[None, :])
+    ix1 = jnp.minimum(ax1[:, None], bx1[None, :])
+    iy1 = jnp.minimum(ay1[:, None], by1[None, :])
+    iw = jnp.maximum(ix1 - ix0, 0.0)
+    ih = jnp.maximum(iy1 - iy0, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax1 - ax0) * (ay1 - ay0), 0.0)
+    area_b = jnp.maximum((bx1 - bx0) * (by1 - by0), 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Matching / assignment (SSD training pipeline)
+# ---------------------------------------------------------------------------
+
+def _greedy_match(s, match_type="bipartite", thresh=0.5):
+    """Greedy bipartite matching of one [N, M] similarity matrix: repeat
+    N times taking the global argmax and retiring its row+column (exactly
+    bipartite_match_op.cc's loop as a lax.scan). Returns per column the
+    matched row index (-1 unmatched) and similarity. 'per_prediction'
+    additionally matches any free column whose best row similarity
+    exceeds thresh (the SSD rule)."""
+    N, M = s.shape
+
+    def body(carry, _):
+        s_cur, row_of_col, dist_of_col = carry
+        flat = s_cur.reshape(-1)
+        idx = jnp.argmax(flat)
+        r, c = idx // M, idx % M
+        v = flat[idx]
+        take = v > 0.0
+        row_of_col = jnp.where(take & (jnp.arange(M) == c), r, row_of_col)
+        dist_of_col = jnp.where(take & (jnp.arange(M) == c), v, dist_of_col)
+        s_cur = jnp.where(take & ((jnp.arange(N)[:, None] == r)
+                                  | (jnp.arange(M)[None, :] == c)),
+                          -1.0, s_cur)
+        return (s_cur, row_of_col, dist_of_col), None
+
+    init = (s, jnp.full((M,), -1, jnp.int32), jnp.zeros((M,), s.dtype))
+    (_, row_of_col, dist_of_col), _ = jax.lax.scan(body, init, None,
+                                                   length=N)
+    if match_type == "per_prediction":
+        best_row = jnp.argmax(s, axis=0).astype(jnp.int32)   # [M]
+        best_val = jnp.max(s, axis=0)
+        extra = (row_of_col < 0) & (best_val > thresh)
+        row_of_col = jnp.where(extra, best_row, row_of_col)
+        dist_of_col = jnp.where(extra, best_val, dist_of_col)
+    return row_of_col, dist_of_col
+
+
+@register_op("bipartite_match")
+def bipartite_match(ctx, ins, attrs):
+    """bipartite_match_op.cc on a [B, N, M] similarity matrix — see
+    _greedy_match for the dense redesign."""
+    sim = ins["DistMat"][0]
+    if sim.ndim == 2:
+        sim = sim[None]
+    match_type = attrs.get("match_type", "bipartite")
+    thresh = float(attrs.get("dist_threshold", 0.5))
+    rows, dists = jax.vmap(
+        lambda s: _greedy_match(s, match_type, thresh))(sim)
+    return {"ColToRowMatchIndices": [rows], "ColToRowMatchDist": [dists]}
+
+
+@register_op("target_assign")
+def target_assign(ctx, ins, attrs):
+    """target_assign_op.cc: gather per-prior targets by match indices.
+
+    X [B, N, K] row features (gt boxes/labels), MatchIndices [B, M] row
+    index per prior (-1 unmatched) -> Out [B, M, K], OutWeight [B, M, 1]
+    (1 for matched, mismatch_value rows get weight 0 ... reference puts
+    mismatch_value into Out and 0 weight).
+    """
+    x = ins["X"][0]
+    match = ins["MatchIndices"][0]
+    mismatch_value = attrs.get("mismatch_value", 0)
+    if x.ndim == 2:
+        x = x[None]
+    B, N, K = x.shape
+    safe = jnp.maximum(match, 0)
+    out = jnp.take_along_axis(x, safe[:, :, None].astype(jnp.int32),
+                              axis=1)
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch_value, x.dtype))
+    weight = matched.astype(jnp.float32)
+    return {"Out": [out], "OutWeight": [weight]}
+
+
+@register_op("mine_hard_examples")
+def mine_hard_examples(ctx, ins, attrs):
+    """mine_hard_examples_op.cc (max_negative mode): keep the hardest
+    negatives at neg_pos_ratio per image.
+
+    ClsLoss [B, M], MatchIndices [B, M] -> UpdatedMatchIndices where
+    selected negatives STAY -1 and unselected negatives become -2 (our
+    dense convention; reference emits a NegIndices LoD tensor instead),
+    plus NegMask [B, M] float for loss masking.
+    """
+    cls_loss = ins["ClsLoss"][0]
+    match = ins["MatchIndices"][0]
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    B, M = cls_loss.shape
+    is_neg = match < 0
+    n_pos = jnp.sum(~is_neg, axis=1)                     # [B]
+    n_neg = jnp.minimum((n_pos * ratio).astype(jnp.int32),
+                        jnp.sum(is_neg, axis=1))
+    neg_loss = jnp.where(is_neg, cls_loss, -jnp.inf)     # [B, M]
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank_of = jnp.argsort(order, axis=1)                 # rank per column
+    selected = (rank_of < n_neg[:, None]) & is_neg
+    return {"NegMask": [selected.astype(jnp.float32)],
+            "UpdatedMatchIndices": [jnp.where(is_neg & ~selected,
+                                              -2, match)]}
+
+
+# ---------------------------------------------------------------------------
+# NMS / output decoding
+# ---------------------------------------------------------------------------
+
+@register_op("multiclass_nms")
+def multiclass_nms(ctx, ins, attrs):
+    """multiclass_nms_op.cc, dense TPU redesign.
+
+    BBoxes [B, M, 4], Scores [B, C, M] -> Out [B, keep_top_k, 6]
+    rows = (label, score, xmin, ymin, xmax, ymax); invalid rows have
+    label -1 (the reference emits variable-length LoD results instead).
+    Per class: score threshold + top-k + O(k²) IoU suppression — the
+    standard static-shape NMS (no data-dependent shapes anywhere).
+    """
+    bboxes, scores = ins["BBoxes"][0], ins["Scores"][0]
+    score_thresh = float(attrs.get("score_threshold", 0.01))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 16))
+    bg_label = int(attrs.get("background_label", 0))
+    B, C, M = scores.shape
+    k = min(nms_top_k, M)
+
+    def nms_one_class(boxes, cls_scores):
+        # [M,4], [M] -> (scores [k], boxes [k,4], valid [k])
+        s = jnp.where(cls_scores > score_thresh, cls_scores, -jnp.inf)
+        top_s, top_i = jax.lax.top_k(s, k)
+        top_b = boxes[top_i]
+        iou = _iou_matrix(top_b, top_b)
+        valid0 = top_s > -jnp.inf
+
+        def body(keep, i):
+            # drop i if any higher-scored kept box overlaps > threshold
+            over = (iou[i] > nms_thresh) & (jnp.arange(k) < i) & keep
+            keep = keep.at[i].set(keep[i] & ~jnp.any(over))
+            return keep, None
+
+        keep, _ = jax.lax.scan(body, valid0, jnp.arange(k))
+        return jnp.where(keep, top_s, -jnp.inf), top_b, keep
+
+    def one_image(boxes, img_scores):
+        # vmap classes; mask background by forcing its scores to -inf
+        cls_ids = jnp.arange(C)
+        cls_scores = jnp.where((cls_ids == bg_label)[:, None], -jnp.inf,
+                               img_scores)
+        s, b, kmask = jax.vmap(nms_one_class, in_axes=(None, 0))(
+            boxes, cls_scores)                     # [C,k], [C,k,4], [C,k]
+        flat_s = s.reshape(-1)
+        flat_b = b.reshape(-1, 4)
+        flat_l = jnp.broadcast_to(cls_ids[:, None], (C, k)).reshape(-1)
+        kk = min(keep_top_k, flat_s.shape[0])
+        top_s, top_i = jax.lax.top_k(flat_s, kk)
+        rows = jnp.concatenate([
+            jnp.where(top_s > -jnp.inf, flat_l[top_i], -1)[:, None]
+               .astype(jnp.float32),
+            jnp.where(top_s > -jnp.inf, top_s, 0.0)[:, None],
+            flat_b[top_i]], axis=1)
+        return rows
+
+    out = jax.vmap(one_image)(bboxes, scores)
+    return {"Out": [out]}
+
+
+@register_op("ssd_loss")
+def ssd_loss(ctx, ins, attrs):
+    """The SSD multibox loss (≙ layers/detection.py ssd_loss, which
+    composes iou_similarity → bipartite_match → target_assign →
+    mine_hard_examples → conf/loc losses as ~10 ops; here the pipeline is
+    one fused op — same math, one XLA computation).
+
+    Location [B,M,4] (encoded offsets), Confidence [B,M,C], GtBox [B,G,4]
+    (normalized corners; all-zero rows = padding), GtLabel [B,G,1] int,
+    PriorBox [M,4], PriorBoxVar [M,4] → Loss [B,1].
+    """
+    loc = ins["Location"][0]
+    conf = ins["Confidence"][0]
+    gt_box = ins["GtBox"][0]
+    gt_label = ins["GtLabel"][0]
+    prior = ins["PriorBox"][0]
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else \
+        jnp.asarray([0.1, 0.1, 0.2, 0.2], loc.dtype)
+    thresh = float(attrs.get("overlap_threshold", 0.5))
+    neg_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    bg = int(attrs.get("background_label", 0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+    B, M, C = conf.shape
+    G = gt_box.shape[1]
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    gt_valid = jnp.any(jnp.abs(gt_box) > 0, axis=-1)          # [B,G]
+
+    pcx, pcy, pw, ph = _center_form(prior)
+
+    def one(loc_i, conf_i, gts, labels, valid):
+        sim = _iou_matrix(gts, prior) * valid[:, None]         # [G,M]
+        # SSD matching = greedy bipartite pass (every gt gets a prior,
+        # collisions resolved like bipartite_match_op.cc) + threshold pass
+        match, _ = _greedy_match(sim, "per_prediction", thresh)
+        matched = match >= 0
+        safe = jnp.maximum(match, 0)
+
+        # conf loss: targets = matched gt label else background
+        tgt = jnp.where(matched, labels[safe].astype(jnp.int32), bg)
+        logp = jax.nn.log_softmax(conf_i.astype(jnp.float32), axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt[:, None], axis=-1)[:, 0]  # [M]
+        # hard negative mining at neg_ratio
+        n_pos = jnp.sum(matched)
+        neg_loss = jnp.where(matched, -jnp.inf, ce)
+        order = jnp.argsort(-neg_loss)
+        rank = jnp.argsort(order)
+        n_neg = jnp.minimum((n_pos * neg_ratio).astype(jnp.int32),
+                            jnp.sum(~matched))
+        neg_sel = (rank < n_neg) & ~matched
+        conf_loss = jnp.sum(jnp.where(matched | neg_sel, ce, 0.0))
+
+        # loc loss: smooth-l1 on encoded matched gt vs predicted offsets
+        g = gts[safe]                                          # [M,4]
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-10)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-10)
+        enc = jnp.stack([(gcx - pcx) / pw / pvar[..., 0],
+                         (gcy - pcy) / ph / pvar[..., 1],
+                         jnp.log(gw / pw) / pvar[..., 2],
+                         jnp.log(gh / ph) / pvar[..., 3]], axis=-1)
+        diff = jnp.abs(loc_i - enc)
+        sl1 = jnp.sum(jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5),
+                      axis=-1)
+        loc_loss = jnp.sum(jnp.where(matched, sl1, 0.0))
+
+        denom = jnp.maximum(n_pos.astype(jnp.float32), 1.0)
+        return (conf_w * conf_loss + loc_w * loc_loss) / denom
+
+    losses = jax.vmap(one)(loc, conf, gt_box, gt_label, gt_valid)
+    return {"Loss": [losses[:, None]]}
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling
+# ---------------------------------------------------------------------------
+
+@register_op("roi_pool")
+def roi_pool(ctx, ins, attrs):
+    """roi_pool_op.cc: max-pool each ROI into a fixed [Ph, Pw] grid.
+
+    X [N, C, H, W]; ROIs [R, 5] = (batch_idx, x0, y0, x1, y1) in input
+    coords (the dense stand-in for the reference's LoD roi batching).
+    Masked-max formulation: every bin takes max over the cells whose
+    center falls in the bin's integer span — vectorized, differentiable.
+    """
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x0 = jnp.round(roi[1] * scale)
+        y0 = jnp.round(roi[2] * scale)
+        x1 = jnp.round(roi[3] * scale)
+        y1 = jnp.round(roi[4] * scale)
+        rw = jnp.maximum(x1 - x0 + 1.0, 1.0)
+        rh = jnp.maximum(y1 - y0 + 1.0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = x[b]                                  # [C, H, W]
+
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        hstart = jnp.floor(iy * bin_h) + y0         # [ph]
+        hend = jnp.ceil((iy + 1) * bin_h) + y0
+        wstart = jnp.floor(ix * bin_w) + x0         # [pw]
+        wend = jnp.ceil((ix + 1) * bin_w) + x0
+        ymask = (ys[None, :] >= hstart[:, None]) & (ys[None, :] < hend[:, None])
+        xmask = (xs[None, :] >= wstart[:, None]) & (xs[None, :] < wend[:, None])
+        mask = ymask[:, None, :, None] & xmask[None, :, None, :]  # [ph,pw,H,W]
+        vals = jnp.where(mask[None], img[:, None, None, :, :], -jnp.inf)
+        out = jnp.max(vals, axis=(-1, -2))          # [C, ph, pw]
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return {"Out": [jax.vmap(one_roi)(rois.astype(jnp.float32))]}
+
+
+@register_op("roi_align")
+def roi_align(ctx, ins, attrs):
+    """roi_align_op.cc: average of bilinear samples per bin (sampling
+    ratio fixed at 2x2, the common setting)."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    S = 2  # samples per bin axis
+
+    def bilinear(img, y, yx):
+        # clamp sample coords into the image so border ROIs interpolate
+        # instead of extrapolating (roi_align_op.cc clamps the same way)
+        y = jnp.clip(y, 0.0, H - 1.0)
+        yx = jnp.clip(yx, 0.0, W - 1.0)
+        y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(yx), 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        ly, lx = y - y0, yx - x0
+        y0i, x0i, y1i, x1i = (y0.astype(jnp.int32), x0.astype(jnp.int32),
+                              y1.astype(jnp.int32), x1.astype(jnp.int32))
+        v = (img[:, y0i, x0i] * (1 - ly) * (1 - lx)
+             + img[:, y0i, x1i] * (1 - ly) * lx
+             + img[:, y1i, x0i] * ly * (1 - lx)
+             + img[:, y1i, x1i] * ly * lx)
+        return v
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x0, y0, x1, y1 = roi[1] * scale, roi[2] * scale, roi[3] * scale, \
+            roi[4] * scale
+        rw = jnp.maximum(x1 - x0, 1.0)
+        rh = jnp.maximum(y1 - y0, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        img = x[b]
+        iy = jnp.arange(ph, dtype=jnp.float32)[:, None, None, None]
+        ix = jnp.arange(pw, dtype=jnp.float32)[None, :, None, None]
+        sy = jnp.arange(S, dtype=jnp.float32)[None, None, :, None]
+        sx = jnp.arange(S, dtype=jnp.float32)[None, None, None, :]
+        yy = y0 + (iy + (sy + 0.5) / S) * bin_h    # [ph,1,S,1]
+        xx = x0 + (ix + (sx + 0.5) / S) * bin_w    # [1,pw,1,S]
+        yy = jnp.broadcast_to(yy, (ph, pw, S, S)).reshape(-1)
+        xx = jnp.broadcast_to(xx, (ph, pw, S, S)).reshape(-1)
+        v = bilinear(img, yy, xx)                  # [C, ph*pw*S*S]
+        v = v.reshape(C, ph, pw, S * S).mean(-1)
+        return v
+
+    return {"Out": [jax.vmap(one_roi)(rois.astype(jnp.float32))]}
