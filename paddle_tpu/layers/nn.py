@@ -29,6 +29,7 @@ __all__ = [
     "elementwise_pow", "clip", "clip_by_norm", "scale", "cast", "gather",
     "scatter", "slice", "shape", "maxout", "smooth_l1", "warpctc",
     "label_smooth", "bilinear_interp", "resize_bilinear", "random_crop",
+    "nce", "row_conv", "mean_iou", "bpr_loss", "spp",
 ]
 
 
@@ -678,4 +679,74 @@ def random_crop(x, shape, seed=None):
     helper = LayerHelper("random_crop")
     out = helper.create_tmp_variable(x.dtype)
     helper.append_op("random_crop", {"X": x}, {"Out": out}, {"shape": list(shape)})
+    return out
+
+
+def nce(input, label, num_total_classes, num_neg_samples=10, param_attr=None,
+        bias_attr=None, name=None):
+    """layers/nn.py nce (noise-contrastive estimation head). Returns the
+    per-row NCE cost [B, 1]; weights [V, D] + bias [V] are parameters."""
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                [num_total_classes, dim], "float32")
+    b = helper.create_parameter(helper.bias_attr, [num_total_classes],
+                                "float32", is_bias=True)
+    cost = helper.create_tmp_variable("float32")
+    sample_logits = helper.create_tmp_variable("float32")
+    sample_labels = helper.create_tmp_variable("int32")
+    sample_logits.stop_gradient = True
+    sample_labels.stop_gradient = True
+    helper.append_op("nce",
+                     {"Input": input, "Label": label, "Weight": w,
+                      "Bias": b},
+                     {"Cost": cost, "SampleLogits": sample_logits,
+                      "SampleLabels": sample_labels},
+                     {"num_total_classes": num_total_classes,
+                      "num_neg_samples": num_neg_samples})
+    return cost
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """layers/nn.py row_conv (lookahead convolution, DeepSpeech2)."""
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act,
+                         name=name)
+    dim = input.shape[-1]
+    f = helper.create_parameter(helper.param_attr,
+                                [future_context_size + 1, dim], "float32")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("row_conv", {"X": input, "Filter": f}, {"Out": out}, {})
+    return helper.append_activation(out)
+
+
+def mean_iou(input, label, num_classes, name=None):
+    """layers/nn.py:mean_iou — returns (mean_iou, out_wrong, out_correct)."""
+    helper = LayerHelper("mean_iou", name=name)
+    miou = helper.create_tmp_variable("float32")
+    wrong = helper.create_tmp_variable("int32")
+    correct = helper.create_tmp_variable("int32")
+    helper.append_op("mean_iou", {"Predictions": input, "Labels": label},
+                     {"OutMeanIou": miou, "OutWrong": wrong,
+                      "OutCorrect": correct},
+                     {"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def bpr_loss(input, label, name=None):
+    """layers/nn.py bpr_loss (Bayesian Personalized Ranking)."""
+    helper = LayerHelper("bpr_loss", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("bpr_loss", {"X": input, "Label": label}, {"Y": out}, {})
+    return out
+
+
+def spp(input, pyramid_height, pool_type="max", name=None):
+    """Spatial pyramid pooling layer (spp_op.cc)."""
+    helper = LayerHelper("spp", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("spp", {"X": input}, {"Out": out},
+                     {"pyramid_height": pyramid_height,
+                      "pooling_type": pool_type})
     return out

@@ -9,7 +9,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["MetricBase", "CompositeMetric", "Accuracy", "ChunkEvaluator",
-           "EditDistance", "Auc"]
+           "EditDistance", "Auc",
+           "Precision", "Recall", "DetectionMAP"]
 
 
 class MetricBase:
@@ -140,3 +141,136 @@ class Auc(MetricBase):
         tpr = self.tp_list / (self.tp_list + self.fn_list + epsilon)
         fpr = self.fp_list / (self.fp_list + self.tn_list + epsilon)
         return float(np.abs(np.trapezoid(tpr, fpr)))
+
+
+class Precision(MetricBase):
+    """Binary precision accumulator (≙ fluid.metrics.Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).ravel()
+        labels = np.asarray(labels).ravel()
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels != 1)).sum())
+
+    def eval(self):
+        return self.tp / (self.tp + self.fp) if self.tp + self.fp else 0.0
+
+
+class Recall(MetricBase):
+    """Binary recall accumulator (≙ fluid.metrics.Recall)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).ravel()
+        labels = np.asarray(labels).ravel()
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds != 1) & (labels == 1)).sum())
+
+    def eval(self):
+        return self.tp / (self.tp + self.fn) if self.tp + self.fn else 0.0
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision over detection results
+    (≙ fluid.metrics.DetectionMAP / detection_map_op.cc, 11-point
+    interpolated by default).
+
+    update(detections, gts): detections = [N, 6] rows
+    (label, score, x0, y0, x1, y1) with label -1 = padding (the dense
+    multiclass_nms output for ONE image); gts = [G, 5] rows
+    (label, x0, y0, x1, y1), all-zero rows = padding.
+    """
+
+    def __init__(self, name=None, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="11point"):
+        super().__init__(name)
+        self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._dets = []   # (label, score, matched) per detection
+        self._n_gt = {}   # label -> count
+
+    @staticmethod
+    def _iou(a, b):
+        ix0 = max(a[0], b[0]); iy0 = max(a[1], b[1])
+        ix1 = min(a[2], b[2]); iy1 = min(a[3], b[3])
+        inter = max(ix1 - ix0, 0.0) * max(iy1 - iy0, 0.0)
+        ua = max(a[2] - a[0], 0) * max(a[3] - a[1], 0)
+        ub = max(b[2] - b[0], 0) * max(b[3] - b[1], 0)
+        return inter / (ua + ub - inter) if ua + ub - inter > 0 else 0.0
+
+    def update(self, detections, gts):
+        """gts rows: (label, x0, y0, x1, y1[, difficult]). When
+        evaluate_difficult=False (the VOC convention), difficult ground
+        truths are excluded from the GT count and detections matching
+        them count as neither TP nor FP."""
+        detections = np.asarray(detections, np.float64)
+        gts = np.asarray(gts, np.float64)
+        gts = [g for g in gts if np.abs(g[1:5]).sum() > 0]
+        difficult = [len(g) > 5 and g[5] > 0 for g in gts]
+        for g, dif in zip(gts, difficult):
+            if self.evaluate_difficult or not dif:
+                self._n_gt[int(g[0])] = self._n_gt.get(int(g[0]), 0) + 1
+        used = [False] * len(gts)
+        dets = [d for d in detections if d[0] >= 0]
+        dets.sort(key=lambda d: -d[1])
+        for d in dets:
+            lbl = int(d[0])
+            best, best_i = 0.0, -1
+            for i, g in enumerate(gts):
+                if int(g[0]) != lbl or used[i]:
+                    continue
+                iou = self._iou(d[2:6], g[1:5])
+                if iou > best:
+                    best, best_i = iou, i
+            matched = best >= self.overlap_threshold and best_i >= 0
+            if matched:
+                used[best_i] = True
+                if not self.evaluate_difficult and difficult[best_i]:
+                    continue  # ignored: neither TP nor FP
+            self._dets.append((lbl, float(d[1]), matched))
+
+    def eval(self):
+        aps = []
+        for lbl, n_gt in self._n_gt.items():
+            rows = sorted((d for d in self._dets if d[0] == lbl),
+                          key=lambda d: -d[1])
+            tp = np.cumsum([1.0 if m else 0.0 for _, _, m in rows])
+            fp = np.cumsum([0.0 if m else 1.0 for _, _, m in rows])
+            if len(rows) == 0:
+                aps.append(0.0)
+                continue
+            recall = tp / max(n_gt, 1)
+            precision = tp / np.maximum(tp + fp, 1e-12)
+            if self.ap_version == "11point":
+                ap = np.mean([precision[recall >= t].max()
+                              if (recall >= t).any() else 0.0
+                              for t in np.linspace(0, 1, 11)])
+            else:  # "integral"
+                ap = 0.0
+                prev_r = 0.0
+                for p, r in zip(precision, recall):
+                    ap += p * (r - prev_r)
+                    prev_r = r
+            aps.append(float(ap))
+        return float(np.mean(aps)) if aps else 0.0

@@ -17,5 +17,6 @@ from . import beam_ops  # noqa: F401
 from . import crf_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
 
 from ..core.registry import registered_ops  # noqa: F401

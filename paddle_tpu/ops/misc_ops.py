@@ -1,0 +1,289 @@
+"""Remaining op-family stragglers.
+
+≙ reference paddle/fluid/operators/{nce_op, precision_recall_op,
+mean_iou_op, row_conv_op, spp_op, pool_with_index (max_pool2d_with_index),
+sequence_scatter_op, sequence_expand_as_op, bpr_loss_op,
+positive_negative_pair_op, fake_quantize_op, fake_dequantize_op}.
+Dense static-shape redesigns where the reference used LoD.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op, same_shape
+
+
+@register_op("nce")
+def nce(ctx, ins, attrs):
+    """nce_op.cc: noise-contrastive estimation. Input [B,D], Label [B,T],
+    Weight [V,D], Bias [V]. Uniform negative sampler (the reference's
+    default), num_neg_samples negatives per row drawn from the traced PRNG
+    stream. Cost [B,1] = binary logistic loss over pos + sampled neg."""
+    x = ins["Input"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    w = ins["Weight"][0]
+    b = ins["Bias"][0] if ins.get("Bias") else None
+    k = int(attrs.get("num_neg_samples", 10))
+    vocab = int(attrs.get("num_total_classes", w.shape[0]))
+    B = x.shape[0]
+    if label.ndim == 1:
+        label = label[:, None]
+    T = label.shape[1]
+
+    neg = jax.random.randint(ctx.next_rng_key(), (B, k), 0, vocab)
+    samples = jnp.concatenate([label, neg], axis=1)          # [B, T+k]
+    sw = w[samples]                                          # [B, T+k, D]
+    logits = jnp.einsum("bd,bsd->bs", x, sw)
+    if b is not None:
+        logits = logits + b[samples]
+    # uniform noise probability -> constant log-odds correction
+    logits = logits - jnp.log(jnp.asarray(k / vocab, logits.dtype))
+    labels01 = jnp.concatenate(
+        [jnp.ones((B, T)), jnp.zeros((B, k))], axis=1).astype(logits.dtype)
+    ce = jnp.maximum(logits, 0) - logits * labels01 + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
+    return {"Cost": [jnp.sum(ce, axis=1, keepdims=True)],
+            "SampleLogits": [logits], "SampleLabels": [samples]}
+
+
+@register_op("precision_recall")
+def precision_recall(ctx, ins, attrs):
+    """precision_recall_op.cc: per-class TP/FP/FN/TN from (MaxProbs'
+    argmax) Indices + Labels, macro/micro precision/recall/F1 for the
+    batch and for the accumulated states (StatesInfo [C,4] carried by the
+    caller)."""
+    indices = ins["Indices"][0].reshape(-1).astype(jnp.int32)
+    labels = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    C = int(attrs["class_number"])
+    weights = (ins["Weights"][0].reshape(-1)
+               if ins.get("Weights") else jnp.ones_like(indices,
+                                                        jnp.float32))
+    cls = jnp.arange(C)
+    pred_c = (indices[None, :] == cls[:, None]).astype(jnp.float32)  # [C,N]
+    true_c = (labels[None, :] == cls[:, None]).astype(jnp.float32)
+    wrow = weights[None, :]
+    tp = jnp.sum(pred_c * true_c * wrow, axis=1)
+    fp = jnp.sum(pred_c * (1 - true_c) * wrow, axis=1)
+    fn = jnp.sum((1 - pred_c) * true_c * wrow, axis=1)
+    tn = jnp.sum((1 - pred_c) * (1 - true_c) * wrow, axis=1)
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)       # [C,4]
+
+    def metrics(states):
+        tp_, fp_, tn_, fn_ = (states[:, 0], states[:, 1], states[:, 2],
+                              states[:, 3])
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_ + 1e-12), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_ + 1e-12), 0.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / (prec + rec + 1e-12),
+                       0.0)
+        macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+        stp, sfp, sfn = tp_.sum(), fp_.sum(), fn_.sum()
+        mp = jnp.where(stp + sfp > 0, stp / (stp + sfp + 1e-12), 0.0)
+        mr = jnp.where(stp + sfn > 0, stp / (stp + sfn + 1e-12), 0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / (mp + mr + 1e-12), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    accum = batch_states
+    if ins.get("StatesInfo"):
+        accum = accum + ins["StatesInfo"][0]
+    return {"BatchMetrics": [metrics(batch_states)],
+            "AccumMetrics": [metrics(accum)],
+            "AccumStatesInfo": [accum]}
+
+
+@register_op("mean_iou")
+def mean_iou(ctx, ins, attrs):
+    """mean_iou_op.cc: mean IoU over classes for segmentation maps."""
+    pred = ins["Predictions"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    C = int(attrs["num_classes"])
+    cls = jnp.arange(C)
+    p = pred[None, :] == cls[:, None]
+    l = label[None, :] == cls[:, None]
+    inter = jnp.sum(p & l, axis=1).astype(jnp.float32)
+    union = jnp.sum(p | l, axis=1).astype(jnp.float32)
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    mean = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    wrong = jnp.sum(p & ~l, axis=1).astype(jnp.int32)
+    correct = inter.astype(jnp.int32)
+    return {"OutMeanIou": [mean.reshape(())],
+            "OutWrong": [wrong], "OutCorrect": [correct]}
+
+
+@register_op("row_conv", infer_shape=same_shape())
+def row_conv(ctx, ins, attrs):
+    """row_conv_op.cc (lookahead conv, DeepSpeech2): out[t] =
+    sum_{j<k} filter[j] * x[t+j]. X dense [B,T,D], Filter [k,D]."""
+    x, f = ins["X"][0], ins["Filter"][0]
+    k = f.shape[0]
+    B, T, D = x.shape
+    pad = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(pad[:, j:j + T, :] * f[j] for j in range(k))
+    return {"Out": [out]}
+
+
+def _spp_infer(op, block):
+    x = block.var(op.input("X")[0])
+    h = op.attrs["pyramid_height"]
+    c = x.shape[1]
+    bins = sum(4 ** i for i in range(h))
+    out = block.var(op.output("Out")[0])
+    out.shape = (x.shape[0], c * bins)
+    out.dtype = x.dtype
+
+
+@register_op("spp", infer_shape=_spp_infer)
+def spp(ctx, ins, attrs):
+    """spp_op.cc: spatial pyramid pooling — concat max/avg pools at bin
+    grids 1x1, 2x2, 4x4, ... (pyramid_height levels), flattened."""
+    import math
+    x = ins["X"][0]
+    h_levels = int(attrs["pyramid_height"])
+    ptype = attrs.get("pooling_type", "max")
+    B, C, H, W = x.shape
+    outs = []
+    for lvl in range(h_levels):
+        n = 2 ** lvl
+        # bin boundaries are static Python ints: slice per bin at trace
+        # time (n*n small slices) instead of materializing a
+        # [B,C,n,n,H,W] masked broadcast
+        bins = []
+        for by in range(n):
+            ys, ye = math.floor(by * H / n), math.ceil((by + 1) * H / n)
+            for bx in range(n):
+                xs, xe = math.floor(bx * W / n), math.ceil((bx + 1) * W / n)
+                cell = x[:, :, ys:ye, xs:xe]
+                bins.append(cell.max((-1, -2)) if ptype == "max"
+                            else cell.mean((-1, -2)))
+        # channel-major within a level: [B, C, n*n] -> [B, C*n*n]
+        outs.append(jnp.stack(bins, axis=-1).reshape(B, -1))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("max_pool2d_with_index")
+def max_pool2d_with_index(ctx, ins, attrs):
+    """pool_with_index: max pool + flat argmax indices (for unpooling)."""
+    x = ins["X"][0]
+    k = attrs["ksize"]
+    k = (k, k) if isinstance(k, int) else tuple(k)
+    s = attrs.get("strides", k)
+    s = (s, s) if isinstance(s, int) else tuple(s)
+    p = attrs.get("paddings", 0)
+    p = (p, p) if isinstance(p, int) else tuple(p)
+    B, C, H, W = x.shape
+    oh = (H + 2 * p[0] - k[0]) // s[0] + 1
+    ow = (W + 2 * p[1] - k[1]) // s[1] + 1
+    pad = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                  constant_values=-jnp.inf)
+    # window index grids
+    iy = jnp.arange(oh)[:, None] * s[0] + jnp.arange(k[0])[None, :]  # [oh,kh]
+    ix = jnp.arange(ow)[:, None] * s[1] + jnp.arange(k[1])[None, :]
+    win = pad[:, :, iy[:, None, :, None], ix[None, :, None, :]]
+    # win: [B,C,oh,ow,kh,kw]
+    flat = win.reshape(B, C, oh, ow, -1)
+    arg = jnp.argmax(flat, axis=-1)
+    out = jnp.max(flat, axis=-1)
+    # convert window-local argmax to UNPADDED input flat index (H*W)
+    ky, kx = arg // k[1], arg % k[1]
+    gy = jnp.arange(oh)[None, None, :, None] * s[0] + ky - p[0]
+    gx = jnp.arange(ow)[None, None, None, :] * s[1] + kx - p[1]
+    idx = gy * W + gx
+    return {"Out": [out], "Mask": [idx.astype(jnp.int32)]}
+
+
+@register_op("sequence_scatter")
+def sequence_scatter(ctx, ins, attrs):
+    """sequence_scatter_op.cc, dense: X [N,D], Ids [N,L] int (pad -1),
+    Updates [N,L] -> Out[i, Ids[i,j]] += Updates[i,j] (pads dropped)."""
+    x, ids, upd = ins["X"][0], ins["Ids"][0].astype(jnp.int32), \
+        ins["Updates"][0]
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    if upd.ndim == 3 and upd.shape[-1] == 1:
+        upd = upd[..., 0]
+    D = x.shape[1]
+    safe = jnp.where(ids >= 0, ids, D)  # OOB sentinel -> dropped
+
+    def one(row, i_row, u_row):
+        return row.at[i_row].add(u_row.astype(row.dtype), mode="drop")
+
+    return {"Out": [jax.vmap(one)(x, safe, upd)]}
+
+
+@register_op("sequence_expand_as")
+def sequence_expand_as(ctx, ins, attrs):
+    """sequence_expand_as_op.cc, dense: tile X rows [B,D] along Y's time
+    axis -> [B,T,D] (≙ expanding each row to its ref sequence length; the
+    dense form broadcasts to the padded T with masking downstream)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    T = y.shape[1]
+    return {"Out": [jnp.broadcast_to(x[:, None], (x.shape[0], T)
+                                     + tuple(x.shape[1:]))]}
+
+
+@register_op("bpr_loss")
+def bpr_loss(ctx, ins, attrs):
+    """bpr_loss_op.cc (Bayesian Personalized Ranking): for each row,
+    -mean_j log sigmoid(score[label] - score[j]) over j != label."""
+    x = ins["X"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label[:, 0]
+    B, C = x.shape
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)     # [B,1]
+    diff = pos - x                                           # [B,C]
+    logsig = -jnp.maximum(-diff, 0) - jnp.log1p(jnp.exp(-jnp.abs(diff)))
+    mask = jnp.arange(C)[None, :] != label[:, None]
+    loss = -jnp.sum(jnp.where(mask, logsig, 0.0), axis=1,
+                    keepdims=True) / (C - 1)
+    return {"Y": [loss]}
+
+
+@register_op("positive_negative_pair")
+def positive_negative_pair(ctx, ins, attrs):
+    """positive_negative_pair_op.cc: within each query, count prediction
+    pairs ordered correctly / incorrectly / tied w.r.t. label order."""
+    score = ins["Score"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    qid = ins["QueryID"][0].reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    lbl_gt = label[:, None] > label[None, :]
+    sc_diff = score[:, None] - score[None, :]
+    considered = same_q & lbl_gt
+    pos = jnp.sum(considered & (sc_diff > 0)).astype(jnp.float32)
+    neg = jnp.sum(considered & (sc_diff < 0)).astype(jnp.float32)
+    neu = jnp.sum(considered & (sc_diff == 0)).astype(jnp.float32)
+    acc = (ins["AccumulatePositivePair"][0].reshape(())
+           if ins.get("AccumulatePositivePair") else 0.0)
+    accn = (ins["AccumulateNegativePair"][0].reshape(())
+            if ins.get("AccumulateNegativePair") else 0.0)
+    accu = (ins["AccumulateNeutralPair"][0].reshape(())
+            if ins.get("AccumulateNeutralPair") else 0.0)
+    return {"PositivePair": [(pos + acc).reshape((1,))],
+            "NegativePair": [(neg + accn).reshape((1,))],
+            "NeutralPair": [(neu + accu).reshape((1,))]}
+
+
+@register_op("fake_quantize_abs_max", infer_shape=same_shape())
+def fake_quantize_abs_max(ctx, ins, attrs):
+    """fake_quantize_op.cc: symmetric abs-max quantize-dequantize in the
+    forward (quant-aware training); straight-through in the backward."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    rng = float(2 ** (bits - 1) - 1)
+    scale = jnp.max(jnp.abs(x))
+    inv = jnp.where(scale > 0, rng / scale, 0.0)
+    y = x * inv
+    # straight-through estimator: forward = round(y), backward d/dx = inv
+    q = y + jax.lax.stop_gradient(jnp.round(y) - y)
+    return {"Out": [q], "OutScale": [scale.reshape((1,))]}
+
+
+@register_op("fake_dequantize_max_abs", infer_shape=same_shape())
+def fake_dequantize_max_abs(ctx, ins, attrs):
+    """fake_dequantize_op.cc: out = x * scale / max_range."""
+    x, scale = ins["X"][0], ins["Scale"][0]
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": [x.astype(jnp.float32) * scale.reshape(()) / max_range]}

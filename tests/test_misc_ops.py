@@ -1,0 +1,274 @@
+"""Misc op batch vs numpy goldens (≙ reference test_nce.py,
+test_precision_recall_op.py, test_mean_iou.py, test_row_conv_op.py,
+test_spp_op.py, test_pool_max_op.py, test_bpr_loss_op.py,
+test_positive_negative_pair_op.py, test_fake_quantize_op.py) + the new
+metric accumulators.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, metrics
+from op_test import OpTest
+
+
+class TestRowConv(OpTest):
+    def test_golden_and_grad(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 5, 3).astype(np.float32)
+        f = rng.rand(3, 3).astype(np.float32)
+        want = np.zeros_like(x)
+        T = 5
+        pad = np.pad(x, ((0, 0), (0, 2), (0, 0)))
+        for j in range(3):
+            want += pad[:, j:j + T, :] * f[j]
+        self.op_type = "row_conv"
+        self.inputs = {"X": x, "Filter": f}
+        self.outputs = {"Out": want}
+        self.check_output()
+        self.check_grad(["in_X", "in_Filter"], "Out")
+
+
+class TestMeanIou(OpTest):
+    def test_golden(self):
+        pred = np.array([0, 1, 1, 2, 2, 2], np.int32)
+        label = np.array([0, 1, 2, 2, 2, 1], np.int32)
+        # c0: i=1,u=1; c1: i=1,u=3; c2: i=2,u=4 -> mean(1, 1/3, 1/2)
+        want = np.float32((1 + 1 / 3 + 1 / 2) / 3)
+        self.op_type = "mean_iou"
+        self.inputs = {"Predictions": pred, "Labels": label}
+        self.attrs = {"num_classes": 3}
+        self.outputs = {"OutMeanIou": want,
+                        "OutWrong": np.array([0, 1, 1], np.int32),
+                        "OutCorrect": np.array([1, 1, 2], np.int32)}
+        self.check_output()
+
+
+class TestBprLoss(OpTest):
+    def test_golden_and_grad(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(4, 5).astype(np.float32)
+        label = rng.randint(0, 5, (4, 1)).astype(np.int64)
+        want = np.zeros((4, 1), np.float32)
+        for i in range(4):
+            li = label[i, 0]
+            s = 0.0
+            for j in range(5):
+                if j != li:
+                    d = x[i, li] - x[i, j]
+                    s += -np.log(1.0 / (1.0 + np.exp(-d)))
+            want[i, 0] = s / 4
+        self.op_type = "bpr_loss"
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Y": want}
+        self.check_output(atol=1e-5)
+        self.check_grad(["in_X"], "Y")
+
+
+class TestSpp(OpTest):
+    def test_golden(self):
+        rng = np.random.RandomState(2)
+        x = rng.rand(1, 2, 4, 4).astype(np.float32)
+        outs = [x.max((2, 3)).reshape(1, -1)]
+        # level 1: 2x2 bins of a 4x4 map = 2x2 blocks
+        blocks = x.reshape(1, 2, 2, 2, 2, 2).max((3, 5)).reshape(1, -1)
+        outs.append(blocks)
+        want = np.concatenate(outs, axis=1)
+        self.op_type = "spp"
+        self.inputs = {"X": x}
+        self.attrs = {"pyramid_height": 2, "pooling_type": "max"}
+        self.outputs = {"Out": want}
+        self.check_output()
+
+
+class TestMaxPoolWithIndex(OpTest):
+    def test_golden(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(1, 1, 4, 4).astype(np.float32)
+        self.op_type = "max_pool2d_with_index"
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        out = x.reshape(1, 1, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5) \
+               .reshape(1, 1, 2, 2, 4)
+        want = out.max(-1)
+        # flat argmax in the 4x4 input
+        idx = np.zeros((1, 1, 2, 2), np.int32)
+        for oy in range(2):
+            for ox in range(2):
+                win = x[0, 0, oy * 2:oy * 2 + 2, ox * 2:ox * 2 + 2]
+                a = int(np.argmax(win))
+                idx[0, 0, oy, ox] = (oy * 2 + a // 2) * 4 + ox * 2 + a % 2
+        self.outputs = {"Out": want, "Mask": idx}
+        self.check_output()
+
+
+class TestSequenceScatter(OpTest):
+    def test_golden(self):
+        x = np.zeros((2, 6), np.float32)
+        ids = np.array([[0, 2, 2, -1], [5, 1, -1, -1]], np.int64)
+        upd = np.array([[1., 2., 3., 9.], [4., 5., 9., 9.]], np.float32)
+        want = np.array([[1, 0, 5, 0, 0, 0], [0, 5, 0, 0, 0, 4]], np.float32)
+        self.op_type = "sequence_scatter"
+        self.inputs = {"X": x, "Ids": ids, "Updates": upd}
+        self.outputs = {"Out": want}
+        self.check_output()
+
+
+class TestSequenceExpandAs(OpTest):
+    def test_golden(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        y = np.zeros((2, 4, 1), np.float32)
+        want = np.broadcast_to(x[:, None], (2, 4, 3)).copy()
+        self.op_type = "sequence_expand_as"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": want}
+        self.check_output()
+
+
+class TestPrecisionRecallOp(OpTest):
+    def test_golden(self):
+        idx = np.array([0, 1, 1, 2], np.int32)
+        lbl = np.array([0, 1, 2, 2], np.int32)
+        self.op_type = "precision_recall"
+        self.inputs = {"Indices": idx, "Labels": lbl}
+        self.attrs = {"class_number": 3}
+        # per-class: c0 tp1 fp0 fn0; c1 tp1 fp1 fn0; c2 tp1 fp0 fn1
+        p = np.array([1.0, 0.5, 1.0])
+        r = np.array([1.0, 1.0, 0.5])
+        f1 = 2 * p * r / (p + r)
+        micro_p = 3 / 4
+        micro_r = 3 / 4
+        micro_f = 0.75
+        want = np.array([p.mean(), r.mean(), f1.mean(),
+                         micro_p, micro_r, micro_f], np.float32)
+        states = np.array([[1, 0, 3, 0], [1, 1, 2, 0], [1, 0, 2, 1]],
+                          np.float32)
+        self.outputs = {"BatchMetrics": want, "AccumMetrics": want,
+                        "AccumStatesInfo": states}
+        self.check_output(atol=1e-5)
+
+
+class TestFakeQuantize(OpTest):
+    def test_round_trip(self):
+        rng = np.random.RandomState(4)
+        x = (rng.rand(3, 4).astype(np.float32) - 0.5) * 8
+        scale = np.abs(x).max()
+        q = np.round(x / scale * 127)
+        self.op_type = "fake_quantize_abs_max"
+        self.inputs = {"X": x}
+        self.attrs = {"bit_length": 8}
+        self.outputs = {"Out": q.astype(np.float32),
+                        "OutScale": np.array([scale], np.float32)}
+        self.check_output(atol=1e-4)
+
+    def test_straight_through_gradient(self):
+        """The STE must pass gradient ~inv through round (a zero grad
+        means quant-aware training silently freezes)."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.registry import require_op
+        from paddle_tpu.core.registry import ExecContext
+        impl = require_op("fake_quantize_abs_max")
+        x = jnp.asarray([[1.0, -2.0]], jnp.float32)
+
+        def f(x):
+            ctx = ExecContext(jax.random.PRNGKey(0))
+            out = impl.compute(ctx, {"X": [x]}, {"bit_length": 8})
+            return jnp.sum(out["Out"][0])
+
+        g = jax.grad(f)(x)
+        assert np.abs(np.asarray(g)).min() > 1.0  # ~127/scale each
+
+    def test_dequantize(self):
+        x = np.array([[127.0, -64.0]], np.float32)
+        scale = np.array([2.0], np.float32)
+        self.op_type = "fake_dequantize_max_abs"
+        self.inputs = {"X": x, "Scale": scale}
+        self.attrs = {"max_range": 127.0}
+        self.outputs = {"Out": x * 2.0 / 127.0}
+        self.check_output()
+
+
+class TestPositiveNegativePair(OpTest):
+    def test_golden(self):
+        score = np.array([0.9, 0.5, 0.8, 0.2], np.float32)
+        label = np.array([1.0, 0.0, 0.0, 1.0], np.float32)
+        qid = np.array([0, 0, 1, 1], np.int32)
+        # q0: pair (0,1): label 1>0, score .9>.5 -> positive
+        # q1: pair (3,2): label 1>0, score .2<.8 -> negative
+        self.op_type = "positive_negative_pair"
+        self.inputs = {"Score": score, "Label": label, "QueryID": qid}
+        self.outputs = {"PositivePair": np.array([1.0], np.float32),
+                        "NegativePair": np.array([1.0], np.float32),
+                        "NeutralPair": np.array([0.0], np.float32)}
+        self.check_output()
+
+
+class TestNCE:
+    def test_trains_word2vec_style(self):
+        rng = np.random.RandomState(5)
+        vocab, dim = 50, 16
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = 3
+        with pt.program_guard(main, startup):
+            ctx_ids = layers.data("ctx", [4], dtype="int64")
+            target = layers.data("target", [1], dtype="int64")
+            emb = layers.embedding(ctx_ids, size=[vocab, dim])
+            avg = layers.reduce_mean(emb, dim=1)
+            cost = layers.nce(avg, target, num_total_classes=vocab,
+                              num_neg_samples=8)
+            loss = layers.mean(cost)
+            pt.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = {"ctx": rng.randint(0, 50, (16, 4)).astype("int64"),
+                "target": rng.randint(0, 50, (16, 1)).astype("int64")}
+        losses = [float(np.ravel(exe.run(main, feed=feed,
+                                         fetch_list=[loss])[0])[0])
+                  for _ in range(10)]
+        assert losses[-1] < losses[0]
+
+
+class TestMetricClasses:
+    def test_precision_recall(self):
+        p = metrics.Precision()
+        r = metrics.Recall()
+        preds = np.array([1, 1, 0, 1])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.eval() == pytest.approx(2 / 3)
+        assert r.eval() == pytest.approx(2 / 3)
+
+    def test_detection_map_perfect_and_miss(self):
+        m = metrics.DetectionMAP()
+        gts = np.array([[1, 0.1, 0.1, 0.4, 0.4],
+                        [2, 0.5, 0.5, 0.8, 0.8]], np.float32)
+        dets = np.array([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                         [2, 0.8, 0.5, 0.5, 0.8, 0.8],
+                         [-1, 0, 0, 0, 0, 0]], np.float32)
+        m.update(dets, gts)
+        assert m.eval() == pytest.approx(1.0)
+        m.reset()
+        # detection for class 1 misses (wrong location)
+        dets_bad = np.array([[1, 0.9, 0.6, 0.6, 0.9, 0.9]], np.float32)
+        m.update(dets_bad, gts)
+        assert m.eval() == pytest.approx(0.0)
+
+    def test_detection_map_difficult_excluded(self):
+        gts = np.array([[1, 0.1, 0.1, 0.4, 0.4, 0],   # normal
+                        [1, 0.5, 0.5, 0.8, 0.8, 1]],  # difficult
+                       np.float32)
+        dets = np.array([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                         [1, 0.8, 0.5, 0.5, 0.8, 0.8]], np.float32)
+        m = metrics.DetectionMAP(evaluate_difficult=False)
+        m.update(dets, gts)
+        # difficult gt excluded from the count; its detection ignored
+        assert m.eval() == pytest.approx(1.0)
+        m2 = metrics.DetectionMAP(evaluate_difficult=True)
+        m2.update(dets, gts)
+        assert m2.eval() == pytest.approx(1.0)
+        m3 = metrics.DetectionMAP(evaluate_difficult=True)
+        m3.update(dets[:1], gts)  # only one of two gts found
+        assert m3.eval() < 1.0
