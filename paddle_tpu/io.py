@@ -25,6 +25,7 @@ __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "get_inference_program",
+    "export_serving_model", "load_serving_model",
     "save_checkpoint", "load_checkpoint", "clean_checkpoint",
     "get_latest_checkpoint_serial",
 ]
@@ -162,6 +163,98 @@ def load_inference_model(dirname: str, executor=None,
                       scope=scope)
     fetch_vars = [program.global_block.var(n) for n in meta["fetch_names"]]
     return program, meta["feed_names"], fetch_vars
+
+
+# ---------------------------------------------------------------------------
+# AOT serving export
+# ---------------------------------------------------------------------------
+
+def export_serving_model(dirname: str, feeded_var_names: Sequence[str],
+                         target_vars, executor=None, main_program=None,
+                         scope: Optional[Scope] = None, batch_size: int = 1):
+    """Ahead-of-time serving export (≙ the deployment role of
+    inference/analysis + PaddlePredictor, paddle_inference_api.h).
+
+    Prunes the program to the targets, binds the trained weights as
+    CONSTANTS, jit-compiles the forward, and serializes it with
+    jax.export (StableHLO). The artifact is self-contained: serving needs
+    only jax + the two files written here — no program interpreter, no
+    framework, no weight files. Shape-specialized to `batch_size` (XLA
+    AOT is static-shape; export per served batch size).
+    """
+    import jax
+    import jax.numpy as jnp
+    from .core import lowering
+    from .core.executor import _device_dtype
+    from .core.types import np_dtype
+
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    target_names = [t.name if isinstance(t, VarDesc) else t
+                    for t in target_vars]
+    pruned = main_program.clone(for_test=True).prune(
+        targets=target_names, feeds=feeded_var_names)
+
+    state = {}
+    for var in pruned.list_vars():
+        if var.persistable and scope.has_var(var.name):
+            v = scope.find_var(var.name)
+            if v is not None:
+                state[var.name] = jnp.asarray(v)
+    step, _ = lowering.build_step_fn(pruned, list(feeded_var_names),
+                                     target_names, [], is_test=True)
+    key = jax.random.PRNGKey(0)
+
+    def serve(*feeds):
+        env = dict(zip(feeded_var_names, feeds))
+        fetches, _ = step(state, env, key)
+        return fetches
+
+    example = []
+    feed_meta = []
+    for name in feeded_var_names:
+        var = pruned.global_block.var(name)
+        dims = tuple(int(s) for s in var.shape)
+        if dims and dims[0] == -1:   # layers.data's symbolic batch dim
+            shape = (batch_size,) + dims[1:]
+        else:                        # append_batch_size=False: static shape
+            shape = dims
+        if any(s < 0 for s in shape):
+            raise ValueError(
+                f"export_serving_model: feed {name!r} has symbolic dims "
+                f"{dims}; AOT export needs fully static shapes — pad or "
+                "declare the feed with concrete sizes")
+        dt = np_dtype(_device_dtype(var.dtype))
+        example.append(jax.ShapeDtypeStruct(shape, dt))
+        feed_meta.append({"name": name, "shape": list(shape),
+                          "dtype": np.dtype(dt).name})
+
+    exported = jax.export.export(jax.jit(serve))(*example)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "serving.stablehlo"), "wb") as f:
+        f.write(exported.serialize())
+    with open(os.path.join(dirname, "serving.json"), "w") as f:
+        json.dump({"feeds": feed_meta, "fetch_names": target_names,
+                   "batch_size": batch_size}, f)
+    return dirname
+
+
+def load_serving_model(dirname: str):
+    """Load an AOT artifact: returns (predict_fn, feed_names,
+    fetch_names); predict_fn(*arrays) runs the compiled StableHLO."""
+    import jax
+
+    with open(os.path.join(dirname, "serving.json")) as f:
+        meta = json.load(f)
+    with open(os.path.join(dirname, "serving.stablehlo"), "rb") as f:
+        exported = jax.export.deserialize(bytearray(f.read()))
+
+    def predict(*arrays):
+        return exported.call(*arrays)
+
+    return predict, [m["name"] for m in meta["feeds"]], meta["fetch_names"]
 
 
 # ---------------------------------------------------------------------------

@@ -175,8 +175,11 @@ def mul(ctx, ins, attrs):
     xn = attrs.get("x_num_col_dims", 1)
     yn = attrs.get("y_num_col_dims", 1)
     xshape, yshape = x.shape, y.shape
-    x2 = jnp.reshape(x, (int(np.prod(xshape[:xn]) or 1), -1))
-    y2 = jnp.reshape(y, (int(np.prod(yshape[:yn]) or 1), -1))
+    # explicit sizes, no -1: jax.export's shape checks reject inferred dims
+    x2 = jnp.reshape(x, (int(np.prod(xshape[:xn]) or 1),
+                         int(np.prod(xshape[xn:]) or 1)))
+    y2 = jnp.reshape(y, (int(np.prod(yshape[:yn]) or 1),
+                         int(np.prod(yshape[yn:]) or 1)))
     out = x2 @ y2
     return {"Out": [jnp.reshape(out, tuple(xshape[:xn]) + tuple(yshape[yn:]))]}
 

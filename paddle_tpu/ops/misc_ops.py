@@ -106,7 +106,9 @@ def mean_iou(ctx, ins, attrs):
     valid = union > 0
     iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
     mean = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
-    wrong = jnp.sum(p & ~l, axis=1).astype(jnp.int32)
+    # reference semantics: correct/(wrong+correct) == per-class IoU, so
+    # wrong = union - intersection (both pred- and label-side mismatches)
+    wrong = (union - inter).astype(jnp.int32)
     correct = inter.astype(jnp.int32)
     return {"OutMeanIou": [mean.reshape(())],
             "OutWrong": [wrong], "OutCorrect": [correct]}

@@ -3,6 +3,7 @@
 from .distribute_transpiler import (DistributeTranspiler, TranspileStrategy,
                                     transpile)
 from .memory_optimize import memory_optimize, release_memory
+from .inference_transpiler import InferenceTranspiler
 
 __all__ = ["DistributeTranspiler", "TranspileStrategy", "transpile",
-           "memory_optimize", "release_memory"]
+           "memory_optimize", "release_memory", "InferenceTranspiler"]

@@ -1,0 +1,147 @@
+"""Inference transpiler (BN-fold) + AOT serving export.
+
+≙ reference test_inference_transpiler (outputs equal after BN folding,
+bn ops gone) and the PaddlePredictor deployment path re-read as a
+jax.export StableHLO artifact.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _convnet():
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 9
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [3, 8, 8])
+        conv = layers.conv2d(img, num_filters=4, filter_size=3, padding=1)
+        bn = layers.batch_norm(conv, act="relu")
+        conv2 = layers.conv2d(bn, num_filters=2, filter_size=3, padding=1)
+        bn2 = layers.batch_norm(conv2)
+        out = layers.reduce_mean(bn2, dim=[1, 2, 3], keep_dim=True)
+    return main, startup, out
+
+
+class TestBNFold:
+    def test_outputs_match_and_bn_removed(self):
+        """The realistic flow: TRAIN first (non-trivial running stats and
+        trained scale/shift), prune to the inference program, fold."""
+        main, startup, out = _convnet()
+        with pt.program_guard(main, startup):
+            loss = pt.layers.mean(out)
+            pt.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+        scope = pt.Scope()
+        rng = np.random.RandomState(0)
+        feed = {"img": rng.rand(2, 3, 8, 8).astype("float32")}
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            for _ in range(5):
+                exe.run(main, feed=feed, fetch_list=[loss])
+            infer = main.clone(for_test=True).prune([out.name])
+            (want,) = exe.run(infer, feed=feed, fetch_list=[out])
+
+            t = pt.transpiler.InferenceTranspiler()
+            t.transpile(infer, scope=scope)
+            types = [op.type for op in infer.global_block.ops]
+            assert "batch_norm" not in types, types
+            assert types.count("conv2d") == 2
+            (got,) = exe.run(infer, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_residual_branch_not_folded(self):
+        """A pre-BN activation with a second reader (skip connection) must
+        NOT be folded — the rewrite would dangle that reader."""
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = 2
+        with pt.program_guard(main, startup):
+            img = layers.data("img", [2, 4, 4])
+            conv = layers.conv2d(img, num_filters=2, filter_size=3,
+                                 padding=1)
+            bn = layers.batch_norm(conv, is_test=True)
+            out = layers.elementwise_add(bn, conv)  # residual read of conv
+            res = layers.reduce_mean(out, dim=[1, 2, 3], keep_dim=True)
+        scope = pt.Scope()
+        rng = np.random.RandomState(3)
+        feed = {"img": rng.rand(1, 2, 4, 4).astype("float32")}
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            (want,) = exe.run(main, feed=feed, fetch_list=[res])
+            pt.transpiler.InferenceTranspiler().transpile(main, scope=scope)
+            (got,) = exe.run(main, feed=feed, fetch_list=[res])
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_refuses_training_program(self):
+        main, startup, out = _convnet()
+        with pt.program_guard(main, startup):
+            loss = pt.layers.mean(out)
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        with pytest.raises(ValueError, match="inference program"):
+            pt.transpiler.InferenceTranspiler().transpile(main,
+                                                          scope=pt.Scope())
+
+
+class TestServingExport:
+    def test_export_load_predict(self, tmp_path):
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = 4
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [16])
+            h = layers.fc(input=x, size=32, act="relu")
+            pred = layers.fc(input=h, size=4, act="softmax")
+        scope = pt.Scope()
+        rng = np.random.RandomState(1)
+        feed_x = rng.rand(3, 16).astype("float32")
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            (want,) = exe.run(main, feed={"x": feed_x}, fetch_list=[pred])
+            d = str(tmp_path / "serving")
+            pt.io.export_serving_model(d, ["x"], [pred], exe, main,
+                                       scope=scope, batch_size=3)
+        assert os.path.exists(os.path.join(d, "serving.stablehlo"))
+
+        predict, feeds, fetches = pt.io.load_serving_model(d)
+        assert feeds == ["x"] and fetches == [pred.name]
+        got = predict(feed_x)
+        np.testing.assert_allclose(np.asarray(got[0]), want, rtol=1e-5)
+
+    def test_artifact_is_self_contained(self, tmp_path):
+        """The artifact must run WITHOUT the framework: a subprocess that
+        imports only jax deserializes and executes it."""
+        import subprocess
+        import sys
+        import textwrap
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            pred = layers.fc(input=x, size=2)
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            d = str(tmp_path / "srv")
+            pt.io.export_serving_model(d, ["x"], [pred], exe, main,
+                                       scope=scope, batch_size=1)
+        code = textwrap.dedent(f"""
+            import os
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            with open({os.path.join(d, 'serving.stablehlo')!r}, "rb") as f:
+                ex = jax.export.deserialize(bytearray(f.read()))
+            out = ex.call(np.ones((1, 4), np.float32))
+            print("SERVED", np.asarray(out[0]).shape)
+        """)
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "SERVED (1, 2)" in r.stdout

@@ -39,8 +39,9 @@ class TestMeanIou(OpTest):
         self.op_type = "mean_iou"
         self.inputs = {"Predictions": pred, "Labels": label}
         self.attrs = {"num_classes": 3}
+        # wrong = union - inter so that correct/(wrong+correct) == IoU
         self.outputs = {"OutMeanIou": want,
-                        "OutWrong": np.array([0, 1, 1], np.int32),
+                        "OutWrong": np.array([0, 2, 2], np.int32),
                         "OutCorrect": np.array([1, 1, 2], np.int32)}
         self.check_output()
 
