@@ -34,6 +34,9 @@ from . import transpiler
 from .transpiler import DistributeTranspiler, TranspileStrategy
 from .data_feeder import DataFeeder
 from .lod import LoDTensor, create_lod_tensor
+from . import flags
+from .flags import FLAGS
+from . import debugger
 from .utils import profiler
 from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent)

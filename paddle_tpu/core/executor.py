@@ -175,10 +175,31 @@ class Executor:
         key = (program.fingerprint(), key_extra, feed_sig,
                tuple(fetch_names), state_sig)
 
+        from ..flags import FLAGS
+        key = key + (FLAGS.check_nan_inf,)
         compiled = self._cache.get(key)
+        was_cached = compiled is not None
         if compiled is None:
-            fn, state_out = build(program, list(feed_arrays), fetch_names,
-                                  sorted(state))
+            raw, state_out, donate = build(program, list(feed_arrays),
+                                           fetch_names, sorted(state))
+            if FLAGS.check_nan_inf:
+                # ≙ FLAGS_check_nan_inf (operator.cc:590): every float
+                # primitive of the compiled step is instrumented; a nan/inf
+                # raises host-side naming the generating primitive. The
+                # checkified step is what gets jitted (one compiled
+                # artifact, no per-call transform), and donation is OFF so
+                # a throw cannot strand the scope on deleted buffers.
+                from jax.experimental import checkify
+
+                checked = jax.jit(checkify.checkify(
+                    raw, errors=checkify.float_checks))
+
+                def fn(state, feed, rng, _checked=checked):
+                    err, out = _checked(state, feed, rng)
+                    err.throw()
+                    return out
+            else:
+                fn = jax.jit(raw, donate_argnums=donate)
             compiled = _Compiled(fn, sorted(state), state_out, fetch_names)
             self._cache[key] = compiled
 
@@ -186,7 +207,18 @@ class Executor:
         self._run_counter += 1
         rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._run_counter)
 
-        fetches, new_state = compiled.fn(state, feed_arrays, rng)
+        if FLAGS.benchmark:
+            import logging
+            import time as _time
+            t0 = _time.time()
+            fetches, new_state = compiled.fn(state, feed_arrays, rng)
+            jax.block_until_ready((fetches, new_state))
+            logging.getLogger("paddle_tpu").warning(
+                "[benchmark] run %s: %.2f ms%s", program.fingerprint(),
+                (_time.time() - t0) * 1e3,
+                "" if was_cached else " (includes compile)")
+        else:
+            fetches, new_state = compiled.fn(state, feed_arrays, rng)
         for name, val in new_state.items():
             scope.set_var(name, val)
 
@@ -200,8 +232,7 @@ class Executor:
         def build(program, feed_names, fetch_names, state_names):
             step, state_out = lowering.build_step_fn(
                 program, feed_names, fetch_names, state_names)
-            fn = jax.jit(step, donate_argnums=(0,) if donate_state else ())
-            return fn, state_out
+            return step, state_out, (0,) if donate_state else ()
 
         return self._run_impl(program, feed, fetch_list, scope, return_numpy,
                               build, key_extra=("step", donate_state))
@@ -234,7 +265,7 @@ class Executor:
             loop, state_out = lowering.build_loop_fn(
                 program, feed_names, fetch_names, state_names,
                 n_steps=n_steps, per_step_feeds=per_step_feeds, unroll=unroll)
-            return jax.jit(loop, donate_argnums=(0,)), state_out
+            return loop, state_out, (0,)
 
         return self._run_impl(
             program, feed, fetch_list, scope, return_numpy, build,

@@ -1,0 +1,63 @@
+"""Program visualization (≙ python/paddle/fluid/debugger.py +
+graphviz.py): pretty printer and graphviz .dot emitter for programs."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .core.program import Program, default_main_program
+
+__all__ = ["pprint_program_codes", "draw_block_graphviz"]
+
+
+def pprint_program_codes(program: Optional[Program] = None) -> str:
+    """Readable program listing (≙ debugger.pprint_program_codes)."""
+    program = program if program is not None else default_main_program()
+    return str(program)
+
+
+def _esc(s: str) -> str:
+    return s.replace('"', '\\"')
+
+
+def draw_block_graphviz(block, path: Optional[str] = None,
+                        highlights=()) -> str:
+    """Emit a graphviz .dot for one block: ops as boxes, vars as ellipses,
+    dataflow edges (≙ debugger.draw_block_graphviz / graphviz.py). Returns
+    the dot text; writes it to `path` when given — rendering is the
+    user's `dot -Tpng` (no binary dependency here)."""
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_nodes = set()
+
+    def var_node(name):
+        if name not in var_nodes:
+            var_nodes.add(name)
+            style = ""
+            try:
+                v = block.var(name)
+                if v.is_parameter:
+                    style = ', style=filled, fillcolor="lightblue"'
+                elif v.persistable:
+                    style = ', style=filled, fillcolor="lightgrey"'
+            except KeyError:
+                pass
+            if name in highlights:
+                style = ', style=filled, fillcolor="orange"'
+            lines.append(f'  "v_{_esc(name)}" [label="{_esc(name)}", '
+                         f'shape=ellipse{style}];')
+        return f'"v_{_esc(name)}"'
+
+    for i, op in enumerate(block.ops):
+        op_id = f'"op_{i}_{_esc(op.type)}"'
+        lines.append(f'  {op_id} [label="{_esc(op.type)}", shape=box, '
+                     'style=filled, fillcolor="greenyellow"];')
+        for n in op.input_names():
+            lines.append(f"  {var_node(n)} -> {op_id};")
+        for n in op.output_names():
+            lines.append(f"  {op_id} -> {var_node(n)};")
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot

@@ -1,0 +1,97 @@
+"""Process flags, initialized from FLAGS_* environment variables.
+
+≙ the reference's gflags layer: C++ defines flags near point of use
+(FLAGS_check_nan_inf / FLAGS_benchmark in operator.cc/executor.cc,
+FLAGS_fraction_of_gpu_memory_to_use in platform/gpu_info.cc), and
+python/paddle/fluid/__init__.py's __bootstrap__ forwards FLAGS_* env
+vars into gflags via core.init_gflags. Here the registry is Python and
+the env contract is identical: `FLAGS_check_nan_inf=1 python train.py`.
+
+Flags whose mechanism belongs to XLA on this runtime (memory fractions,
+mkldnn) are accepted for launch-script compatibility and documented as
+no-ops rather than silently unknown.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+__all__ = ["FLAGS", "DEFINE_flag", "reset_flags_from_env"]
+
+
+class _Flags:
+    def __init__(self):
+        object.__setattr__(self, "_defs", {})   # name -> (type, default, help, noop)
+        object.__setattr__(self, "_values", {})
+
+    def __getattr__(self, name: str):
+        if name in self._values:
+            return self._values[name]
+        raise AttributeError(f"undefined flag {name!r}")
+
+    def __setattr__(self, name: str, value):
+        if name not in self._defs:
+            raise AttributeError(f"undefined flag {name!r}")
+        typ = self._defs[name][0]
+        self._values[name] = self._parse(typ, value)
+
+    @staticmethod
+    def _parse(typ, value):
+        if typ is bool and isinstance(value, str):
+            return value.strip().lower() in ("1", "true", "yes", "on")
+        return typ(value)
+
+    def _define(self, name, typ, default, help_str, noop=False):
+        self._defs[name] = (typ, default, help_str, noop)
+        env = os.environ.get(f"FLAGS_{name}")
+        if env is None:
+            self._values[name] = default
+            return
+        try:
+            self._values[name] = self._parse(typ, env)
+        except (TypeError, ValueError) as e:
+            if noop:
+                # compat flags exist to tolerate foreign launch scripts:
+                # never make the package unimportable over one
+                import warnings
+                warnings.warn(f"ignoring malformed FLAGS_{name}={env!r}: "
+                              f"{e}; using default {default!r}")
+                self._values[name] = default
+            else:
+                raise ValueError(
+                    f"malformed FLAGS_{name}={env!r}: {e}") from e
+
+    def help(self) -> Dict[str, str]:
+        return {n: d[2] + (" [no-op on this runtime]" if d[3] else "")
+                for n, d in self._defs.items()}
+
+
+FLAGS = _Flags()
+
+
+def DEFINE_flag(name: str, typ, default: Any, help_str: str = "",
+                noop: bool = False):
+    FLAGS._define(name, typ, default, help_str, noop)
+
+
+def reset_flags_from_env():
+    """Re-read every FLAGS_* env var (tests; ≙ re-running __bootstrap__)."""
+    for name, (typ, default, help_str, noop) in list(FLAGS._defs.items()):
+        FLAGS._define(name, typ, default, help_str, noop)
+
+
+# --- the reference's user-visible flag surface -----------------------------
+DEFINE_flag("check_nan_inf", bool, False,
+            "validate every executed step for nan/inf, reporting the "
+            "generating primitive (≙ operator.cc:590 per-op check; here "
+            "jax.experimental.checkify instruments the compiled step)")
+DEFINE_flag("benchmark", bool, False,
+            "log per-run wall time from the Executor (≙ FLAGS_benchmark "
+            "per-op memory/time logging)")
+DEFINE_flag("fraction_of_gpu_memory_to_use", float, 0.92,
+            "accepted for launch-script compatibility", noop=True)
+DEFINE_flag("use_mkldnn", bool, False,
+            "accepted for launch-script compatibility", noop=True)
+DEFINE_flag("eager_delete_scope", bool, True,
+            "accepted for launch-script compatibility", noop=True)
