@@ -95,6 +95,12 @@ class Executor:
                     "padded [n_steps, B, T, ...] array (+ explicit "
                     f"{seq_len_name!r} lengths if sequences are not full)")
             if isinstance(val, LoDTensor):
+                if val.lod_level > 1:
+                    raise NotImplementedError(
+                        f"feed {name!r}: nested (level-{val.lod_level}) "
+                        "LoDTensor feeds are not supported by the executor "
+                        "— call to_padded() yourself and feed the dense "
+                        "array plus per-level length arrays explicitly")
                 padded, lens = val.to_padded()
                 val = padded
                 if seq_len_name:

@@ -28,8 +28,11 @@ class DataFeeder:
         self.place = place
 
     def feed(self, iterable) -> Dict[str, np.ndarray]:
-        """iterable: list of sample tuples, one entry per feed var."""
+        """iterable: list of sample tuples, one entry per feed var. A
+        BucketedBatch (reader/bucketing.py) pins ragged slots' padded
+        length to its bucket bound, bounding XLA recompiles."""
         rows = list(iterable)
+        pad_to = getattr(iterable, "pad_to", None)
         out: Dict[str, np.ndarray] = {}
         for i, var in enumerate(self.feed_vars):
             col = [row[i] for row in rows]
@@ -39,7 +42,13 @@ class DataFeeder:
                 seqs = [np.asarray(s, dtype).reshape(
                     (-1,) + tuple(d for d in var.shape[2:] if d != -1))
                     for s in col]
-                padded, lens = pad_sequences(seqs, dtype=dtype)
+                # pin to the bucket bound only for slots that fit it: a
+                # second ragged slot (e.g. targets bucketed by source
+                # length) falls back to batch-max padding
+                use = pad_to if (pad_to is not None and seqs and
+                                 max(len(s) for s in seqs) <= pad_to) \
+                    else None
+                padded, lens = pad_sequences(seqs, dtype=dtype, max_len=use)
                 out[var.name] = padded
                 if var.seq_len_var:
                     out[var.seq_len_var] = lens

@@ -22,18 +22,64 @@ def _round_up(n: int, multiple: int) -> int:
 
 
 class LoDTensor:
-    """A batch of variable-length sequences (level-1 LoD parity)."""
+    """A batch of variable-length sequences.
 
-    def __init__(self, sequences: Optional[Sequence[np.ndarray]] = None):
-        self.sequences: List[np.ndarray] = [np.asarray(s) for s in (sequences or [])]
+    Level-1: `sequences` is a list of arrays. Multi-level (nested) LoD
+    (lod_tensor.h:44-58, e.g. paragraph→sentence→word): `sequences` is a
+    list of LISTS (recursively) with arrays at the leaves; `lod()` returns
+    one offset table per level and `to_padded` pads every nesting level
+    ([B, S, W, ...] for level 2) with per-level length arrays.
+    """
 
-    # reference-compatible construction: flat data + offsets
+    def __init__(self, sequences: Optional[Sequence] = None):
+        self.sequences: List = [self._ingest(s) for s in (sequences or [])]
+
+    @staticmethod
+    def _ingest(s):
+        """One element of `sequences`. ndarray = a leaf sequence; a list
+        whose children are ndarrays (or deeper lists) = a NESTED element —
+        including rectangular ones, which must not collapse to a leaf.
+        Python list-of-scalars / list-of-rows stay leaf [T] / [T, D]."""
+        if isinstance(s, np.ndarray):
+            return s
+        if isinstance(s, (list, tuple)):
+            if any(isinstance(c, (np.ndarray, list, tuple))
+                   and LoDTensor._is_sequencey(c) for c in s):
+                return [LoDTensor._ingest(c) for c in s]
+        return np.asarray(s)
+
+    @staticmethod
+    def _is_sequencey(c) -> bool:
+        """True when c is itself a sequence-of-sequences or an ndarray —
+        i.e. its parent is a nesting level, not a leaf row matrix."""
+        if isinstance(c, np.ndarray):
+            return True
+        return bool(c) and isinstance(c, (list, tuple)) and isinstance(
+            c[0], (list, tuple, np.ndarray))
+
+    @property
+    def lod_level(self) -> int:
+        def depth(x):
+            return 1 if isinstance(x, np.ndarray) else 1 + max(
+                (depth(c) for c in x), default=1)
+        return max((depth(s) for s in self.sequences), default=1)
+
+    # reference-compatible construction: flat data + offsets (any depth)
     @staticmethod
     def from_flat(data: np.ndarray, lod: Sequence[Sequence[int]]) -> "LoDTensor":
         data = np.asarray(data)
-        offsets = list(lod[0])
-        seqs = [data[offsets[i]:offsets[i + 1]] for i in range(len(offsets) - 1)]
-        return LoDTensor(seqs)
+        # innermost level slices the data rows
+        offsets = list(lod[-1])
+        pieces: List = [data[offsets[i]:offsets[i + 1]]
+                        for i in range(len(offsets) - 1)]
+        # outer levels group the previous level's pieces
+        for level in reversed(lod[:-1]):
+            offs = list(level)
+            pieces = [pieces[offs[i]:offs[i + 1]]
+                      for i in range(len(offs) - 1)]
+        t = LoDTensor()
+        t.sequences = pieces  # structure is explicit: bypass _ingest
+        return t
 
     def set(self, data, place=None):
         self._flat = np.asarray(data)
@@ -45,41 +91,84 @@ class LoDTensor:
         return self
 
     def lod(self):
-        offs = [0]
-        for s in self.sequences:
-            offs.append(offs[-1] + len(s))
-        return [offs]
+        """Offset tables, outermost first (≙ LoD, lod_tensor.h:58)."""
+        levels: List[List[int]] = []
+        layer = self.sequences
+        while True:
+            offs = [0]
+            leaf = all(isinstance(s, np.ndarray) for s in layer)
+            for s in layer:
+                offs.append(offs[-1] + len(s))
+            levels.append(offs)
+            if leaf:
+                return levels
+            layer = [c for s in layer for c in s]
 
     def __len__(self):
         return len(self.sequences)
 
     def to_padded(self, pad_multiple: int = 8, pad_value=0,
-                  max_len: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
-        """-> (padded [B, T, ...], lengths [B] int32)."""
-        lens = np.asarray([len(s) for s in self.sequences], np.int32)
+                  max_len: Optional[int] = None):
+        """Level-1 -> (padded [B, T, ...], lengths [B] int32).
+        Level-2 -> (padded [B, S, W, ...], (outer_lens [B],
+        inner_lens [B, S])) — nested sequences padded at every level."""
+        if self.lod_level <= 1:
+            return self._pad_level1(self.sequences, pad_multiple, pad_value,
+                                    max_len)
+        assert self.lod_level == 2, "deeper nesting: pad recursively"
+        B = len(self.sequences)
+        outer_lens = np.asarray([len(s) for s in self.sequences], np.int32)
+        S = _round_up(int(outer_lens.max() if B else 1), 1)
+        leaves = [leaf for s in self.sequences for leaf in s]
+        W = int(max_len if max_len is not None else
+                _round_up(max((len(x) for x in leaves), default=1),
+                          pad_multiple))
+        tail = leaves[0].shape[1:] if leaves else ()
+        dtype = leaves[0].dtype if leaves else np.float32
+        out = np.full((B, S, W) + tuple(tail), pad_value, dtype)
+        inner_lens = np.zeros((B, S), np.int32)
+        for i, s in enumerate(self.sequences):
+            for j, leaf in enumerate(s):
+                out[i, j, :len(leaf)] = leaf
+                inner_lens[i, j] = len(leaf)
+        return out, (outer_lens, inner_lens)
+
+    @staticmethod
+    def _pad_level1(sequences, pad_multiple, pad_value, max_len):
+        lens = np.asarray([len(s) for s in sequences], np.int32)
+        if max_len is not None and len(lens) and int(lens.max()) > max_len:
+            raise ValueError(
+                f"pad_sequences: a sequence of length {int(lens.max())} "
+                f"exceeds max_len={max_len} (bucketed on a different "
+                "slot? pin pad_to only to slots that fit)")
         T = int(max_len if max_len is not None else
                 _round_up(int(lens.max() if len(lens) else 1), pad_multiple))
-        B = len(self.sequences)
-        tail = self.sequences[0].shape[1:] if B else ()
+        B = len(sequences)
+        tail = sequences[0].shape[1:] if B else ()
         out = np.full((B, T) + tuple(tail), pad_value,
-                      self.sequences[0].dtype if B else np.float32)
-        for i, s in enumerate(self.sequences):
+                      sequences[0].dtype if B else np.float32)
+        for i, s in enumerate(sequences):
             out[i, :len(s)] = s
         return out, lens
 
 
 def pad_sequences(seqs: Sequence, dtype=None, pad_multiple: int = 8,
-                  pad_value=0) -> Tuple[np.ndarray, np.ndarray]:
-    """list of per-sequence arrays/lists -> (padded, lengths)."""
+                  pad_value=0,
+                  max_len: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """list of per-sequence arrays/lists -> (padded, lengths); max_len
+    pins the padded length (the bucketing decorator uses this to bound
+    the number of distinct shapes XLA sees)."""
     arrs = [np.asarray(s, dtype=dtype) for s in seqs]
-    return LoDTensor(arrs).to_padded(pad_multiple, pad_value)
+    return LoDTensor(arrs).to_padded(pad_multiple, pad_value,
+                                     max_len=max_len)
 
 
 def create_lod_tensor(data, recursive_seq_lens=None, place=None) -> LoDTensor:
     """≙ fluid.create_lod_tensor (lod_tensor.py): data may be a list of
-    sequences or flat ndarray + lengths."""
+    sequences or flat ndarray + per-level lengths (every level is
+    cumsum'd to offsets and forwarded — multi-level supported)."""
     if recursive_seq_lens is None:
         return LoDTensor(data)
-    lens = recursive_seq_lens[0]
-    offsets = np.concatenate([[0], np.cumsum(lens)])
-    return LoDTensor.from_flat(np.asarray(data), [offsets.tolist()])
+    lod = [np.concatenate([[0], np.cumsum(lens)]).tolist()
+           for lens in recursive_seq_lens]
+    return LoDTensor.from_flat(np.asarray(data), lod)

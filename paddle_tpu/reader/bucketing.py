@@ -1,0 +1,66 @@
+"""Length bucketing: bound ragged-batch recompiles.
+
+≙ the reference's length-aware batching machinery (lod_rank_table +
+sequence2batch length-sorted scheduling, operators/math/sequence2batch.h;
+layers/control_flow.py:666-813): the 2018 design reorders sequences so no
+padding is wasted. On a static-shape compiler the equivalent lever is
+BUCKETS: batch sequences of similar length together and pad each batch to
+its bucket's bound, so an epoch of arbitrary lengths compiles at most
+len(bounds)+1 executables instead of one per distinct batch shape.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Callable, Optional, Sequence
+
+__all__ = ["BucketedBatch", "bucket_by_length"]
+
+
+class BucketedBatch(list):
+    """A list of samples + the pinned pad length for its ragged slots.
+    DataFeeder honors `pad_to` so every batch from the same bucket has
+    the identical padded shape."""
+
+    def __init__(self, samples, pad_to: int):
+        super().__init__(samples)
+        self.pad_to = pad_to
+
+
+def bucket_by_length(reader: Callable, batch_size: int,
+                     bounds: Sequence[int] = (16, 32, 64, 128, 256),
+                     key: Optional[Callable] = None,
+                     drop_last: bool = False):
+    """Decorator: group samples into length buckets, yield BucketedBatch.
+
+    key(sample) -> length; defaults to len(sample[0]). Samples longer than
+    the last bound fall into an overflow bucket padded to the next
+    multiple of the last bound (shapes stay bounded: at most one overflow
+    shape per multiple actually seen).
+    """
+    bounds = sorted(bounds)
+    key = key or (lambda sample: len(sample[0]))
+
+    def bucketed():
+        buckets = {}
+
+        def bound_for(n: int) -> int:
+            i = bisect.bisect_left(bounds, n)
+            if i < len(bounds):
+                return bounds[i]
+            last = bounds[-1]
+            return ((n + last - 1) // last) * last  # overflow multiples
+
+        for sample in reader():
+            b = bound_for(key(sample))
+            bucket = buckets.setdefault(b, [])
+            bucket.append(sample)
+            if len(bucket) == batch_size:
+                yield BucketedBatch(bucket, b)
+                buckets[b] = []
+        if not drop_last:
+            for b in sorted(buckets):
+                if buckets[b]:
+                    yield BucketedBatch(buckets[b], b)
+
+    return bucketed
