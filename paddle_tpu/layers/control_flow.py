@@ -16,6 +16,7 @@ from ..layer_helper import LayerHelper
 from .sequence import _mark_seq
 
 __all__ = ["DynamicRNN", "StaticRNN", "While", "Switch", "IfElse",
+           "Pipeline",
            "increment", "array_write", "array_read", "create_array",
            "less_than", "less_equal", "greater_than", "greater_equal",
            "equal", "not_equal", "logical_and", "logical_or", "logical_not"]
@@ -540,3 +541,108 @@ class StaticRNN:
 
     def __call__(self):
         return self._drnn()
+
+
+class Pipeline:
+    """GPipe pipeline parallelism over homogeneous stages (additive
+    capability — SURVEY §2.4 notes the reference has none; designed
+    TPU-first, parallel/pipeline.py has the schedule).
+
+        pipe = layers.Pipeline(num_stages=4, num_microbatches=8)
+        with pipe.stage():
+            x = pipe.stage_input(h)                      # [mb, D]
+            w = pipe.stage_param([D, D])                 # THIS stage's slice
+            b = pipe.stage_param([D], is_bias=True)
+            y = layers.tanh(layers.elementwise_add(layers.matmul(x, w), b))
+            pipe.output(y)
+        h = pipe()                                       # [B, D]
+
+    Parameters are stored STACKED [num_stages, ...] and annotated sharded
+    over 'pp', so each stage's slice lives on its own devices; without a
+    pp mesh axis the op runs the numerically identical sequential scan.
+    """
+
+    def __init__(self, num_stages: int, num_microbatches: int, name=None):
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.helper = LayerHelper(name or "pipeline")
+        self.main_program = default_main_program()
+        parent_idx = self.main_program.current_block().idx
+        self.sub_block = self.main_program.create_block(parent_idx)
+        self._x_outer = None
+        self._x_inner = None
+        self._out_inner = None
+        self._stacked = []      # outer stacked param vars
+        self._inner = []        # inner per-stage slice names
+
+    class _StageCtx:
+        def __init__(self, p):
+            self.p = p
+
+        def __enter__(self):
+            self._guard = self.p.main_program.block_guard(self.p.sub_block)
+            self._guard.__enter__()
+            return self
+
+        def __exit__(self, *exc):
+            self._guard.__exit__(*exc)
+            return False
+
+    def stage(self):
+        return Pipeline._StageCtx(self)
+
+    def stage_input(self, x: VarDesc) -> VarDesc:
+        self._x_outer = x
+        inner = self.sub_block.create_var(
+            unique_name("pipe_x"), shape=tuple(x.shape), dtype=x.dtype)
+        self._x_inner = inner
+        return inner
+
+    def stage_param(self, shape, dtype="float32", is_bias=False,
+                    param_attr=None) -> VarDesc:
+        """Create a stacked [num_stages]+shape parameter sharded over 'pp'
+        and return the INNER per-stage slice var the stage code uses."""
+        import numpy as np
+        from ..initializer import XavierInitializer
+        from ..param_attr import ParamAttr
+        attr = ParamAttr.to_attr(param_attr)
+        # default init must use the PER-STAGE fan, not the stacked 3-D
+        # shape (which Xavier would read as a conv kernel)
+        default = None
+        if not is_bias:
+            if len(shape) >= 2:
+                fi, fo = int(np.prod(shape[:-1])), int(shape[-1])
+            else:
+                fi = fo = int(shape[0])
+            default = XavierInitializer(fan_in=fi, fan_out=fo)
+        stacked = self.helper.create_parameter(
+            attr, [self.num_stages] + list(shape), dtype, is_bias=is_bias,
+            default_initializer=default)
+        stacked.sharding = ("pp",) + (None,) * len(shape)
+        inner = self.sub_block.create_var(
+            unique_name("pipe_p"), shape=tuple(shape), dtype=dtype)
+        self._stacked.append(stacked)
+        self._inner.append(inner.name)
+        return inner
+
+    def output(self, var: VarDesc):
+        self._out_inner = var.name
+
+    def __call__(self) -> VarDesc:
+        if self._x_inner is None or self._out_inner is None:
+            raise RuntimeError("Pipeline needs stage_input() and output()")
+        parent = self.main_program.block(self.sub_block.parent_idx)
+        out = parent.create_var(unique_name("pipeline_out"),
+                                shape=tuple(self._x_outer.shape),
+                                dtype=self._x_outer.dtype)
+        parent.append_op(
+            "pipeline",
+            {"X": self._x_outer, "Params": self._stacked},
+            {"Out": out},
+            {"sub_block": self.sub_block.idx,
+             "x_var": self._x_inner.name,
+             "param_vars": list(self._inner),
+             "out_var": self._out_inner,
+             "n_microbatches": self.num_microbatches,
+             "num_stages": self.num_stages})
+        return out

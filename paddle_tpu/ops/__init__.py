@@ -18,5 +18,6 @@ from . import crf_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
+from . import pipeline_ops  # noqa: F401
 
 from ..core.registry import registered_ops  # noqa: F401

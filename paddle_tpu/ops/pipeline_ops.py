@@ -1,0 +1,70 @@
+"""The `pipeline` op: sub-block GPipe lowering.
+
+Mirrors the dynamic_rnn pattern (ops/rnn_ops.py): the sub-block defines
+ONE stage's computation over inner placeholder vars (the per-stage
+parameter slice + the stage input); the op traces it as the gpipe
+stage_fn. With a 'pp' mesh axis the schedule runs shard_map+ppermute
+(parallel/pipeline.py); without one it falls back to the numerically
+identical sequential scan, so CPU tests and single-chip runs work
+unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("pipeline")
+def pipeline_op(ctx, ins, attrs):
+    from ..core import lowering
+    from ..parallel.pipeline import gpipe, sequential_stages
+
+    program = ctx.program
+    sub = program.block(attrs["sub_block"])
+    x_inner = attrs["x_var"]
+    param_inner = list(attrs["param_vars"])    # inner slice names
+    out_inner = attrs["out_var"]
+    m = int(attrs["n_microbatches"])
+
+    stacked = list(ins["Params"])              # [S, ...] per param
+    x = ins["X"][0]                            # [B, ...]
+    if not stacked:
+        raise ValueError(
+            "pipeline: the stage declared no stage_param()s — per-stage "
+            "parameters must come from pipe.stage_param (ordinary layers "
+            "create unstacked globals the schedule cannot slice)")
+    s = stacked[0].shape[0]
+    want = int(attrs.get("num_stages", s))
+    if s != want:
+        raise ValueError(f"pipeline: stacked params have {s} stages, "
+                         f"layer declared {want}")
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"pipeline: batch {b} not divisible by "
+                         f"n_microbatches {m}")
+    xs = x.reshape((m, b // m) + tuple(x.shape[1:]))
+    outer_env = dict(ctx.env)
+
+    def stage_fn(p_slices, xmb):
+        env = dict(outer_env)
+        env[x_inner] = xmb
+        env.update(zip(param_inner, p_slices))
+        lowering.run_op_range(sub.ops, 0, len(sub.ops), env, ctx, sub)
+        return env[out_inner]
+
+    mesh = ctx.mesh
+    params = tuple(stacked)
+    if mesh is not None and "pp" in mesh.axis_names \
+            and int(mesh.shape["pp"]) > 1:
+        pp = int(mesh.shape["pp"])
+        if pp != s:
+            raise ValueError(f"pipeline: {s} stages but pp axis size {pp}")
+        out = gpipe(lambda p, xmb: stage_fn(tuple(p), xmb), params, xs,
+                    mesh=mesh)
+    else:
+        out = sequential_stages(lambda p, xmb: stage_fn(tuple(p), xmb),
+                                params, xs)
+    return {"Out": [out.reshape((b,) + tuple(out.shape[2:]))]}

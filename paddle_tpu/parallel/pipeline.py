@@ -1,0 +1,79 @@
+"""Pipeline parallelism: the GPipe schedule over the 'pp' mesh axis.
+
+ADDITIVE capability (SURVEY §2.4 last row: the reference has no pipeline
+parallelism; this is north-star work designed TPU-first). Homogeneous
+stages hold their parameter slice on their own devices (stacked leaves
+[S, ...] sharded over 'pp'); microbatches flow stage-to-stage over ICI
+via jax.lax.ppermute inside ONE lax.scan of S+M-1 ticks — the classic
+bubble fraction (S-1)/(S+M-1). The whole schedule is differentiable
+(scan + ppermute VJPs), so training just works through it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["gpipe", "sequential_stages"]
+
+
+def sequential_stages(stage_fn: Callable, params, x):
+    """Reference semantics: apply the S stacked stages in order (used when
+    no 'pp' mesh axis is available — identical math, no parallelism)."""
+    s = jax.tree.leaves(params)[0].shape[0]
+
+    def body(carry, p_slice):
+        return stage_fn(p_slice, carry), None
+
+    out, _ = jax.lax.scan(body, x, params, length=s)
+    return out
+
+
+def gpipe(stage_fn: Callable, params, xs, *, mesh: Mesh, axis: str = "pp"):
+    """Run GPipe over `mesh`'s `axis`.
+
+    stage_fn(param_slice, x[mb, ...]) -> y[mb, ...] (same shape: stages
+    are homogeneous). params: pytree with leading stage dim S == mesh
+    axis size on every leaf. xs: [M, mb, ...] microbatched inputs
+    (replicated). Returns [M, mb, ...] outputs, numerically identical to
+    applying the S stages sequentially.
+    """
+    s = int(mesh.shape[axis])
+    m = int(xs.shape[0])
+    perm = [(i, (i + 1) % s) for i in range(s)]
+    # split the per-microbatch batch dim over 'dp' when present so data-
+    # parallel replicas pipeline their own slice instead of redundantly
+    # recomputing the full batch
+    dp = int(mesh.shape.get("dp", 1))
+    x_spec = P(None, "dp") if dp > 1 and xs.shape[1] % dp == 0 else P()
+
+    def body(local_params, xs_full):
+        p = jax.tree.map(lambda a: a[0], local_params)  # this stage's slice
+        idx = jax.lax.axis_index(axis)
+
+        def tick(carry, t):
+            recv, outbuf = carry
+            # stage 0 consumes microbatch t (zeros once the feed drains);
+            # later stages consume what the previous stage ppermuted over
+            x0 = jnp.where(t < m, xs_full[jnp.minimum(t, m - 1)],
+                           jnp.zeros_like(xs_full[0]))
+            x_in = jnp.where(idx == 0, x0, recv)
+            y = stage_fn(p, x_in)
+            widx = jnp.clip(t - (s - 1), 0, m - 1)
+            write = (idx == s - 1) & (t >= s - 1)
+            outbuf = jnp.where(write, outbuf.at[widx].set(y), outbuf)
+            recv_next = jax.lax.ppermute(y, axis, perm)
+            return (recv_next, outbuf), None
+
+        init = (jnp.zeros_like(xs_full[0]), jnp.zeros_like(xs_full))
+        (_, outbuf), _ = jax.lax.scan(tick, init, jnp.arange(s + m - 1))
+        # results live on the last stage; replicate via masked psum
+        return jax.lax.psum(
+            jnp.where(idx == s - 1, outbuf, jnp.zeros_like(outbuf)), axis)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(P(axis), x_spec),
+                       out_specs=x_spec, check_vma=False)
+    return fn(params, xs)
