@@ -41,4 +41,4 @@ from .utils import profiler
 from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent)
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

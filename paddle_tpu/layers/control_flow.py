@@ -575,21 +575,8 @@ class Pipeline:
         self._stacked = []      # outer stacked param vars
         self._inner = []        # inner per-stage slice names
 
-    class _StageCtx:
-        def __init__(self, p):
-            self.p = p
-
-        def __enter__(self):
-            self._guard = self.p.main_program.block_guard(self.p.sub_block)
-            self._guard.__enter__()
-            return self
-
-        def __exit__(self, *exc):
-            self._guard.__exit__(*exc)
-            return False
-
     def stage(self):
-        return Pipeline._StageCtx(self)
+        return self.main_program.block_guard(self.sub_block)
 
     def stage_input(self, x: VarDesc) -> VarDesc:
         self._x_outer = x
@@ -626,6 +613,13 @@ class Pipeline:
         return inner
 
     def output(self, var: VarDesc):
+        in_shape = tuple(self._x_inner.shape) if self._x_inner is not None \
+            else None
+        if in_shape is not None and tuple(var.shape) != in_shape:
+            raise ValueError(
+                f"Pipeline stages must be homogeneous: stage output shape "
+                f"{tuple(var.shape)} != stage input shape {in_shape} (the "
+                "same stage function runs on every pp rank)")
         self._out_inner = var.name
 
     def __call__(self) -> VarDesc:

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI entry (≙ paddle/scripts/paddle_build.sh: build + test in one place).
+# Runs the full suite on the 8-device virtual CPU mesh, the multi-chip
+# dryrun, and a bench sanity pass. Usage: scripts/ci.sh [quick]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== unit + integration tests (8-device virtual CPU mesh) =="
+python -m pytest tests/ -x -q
+
+echo "== multi-chip dryrun (dp x tp, dp x sp x tp, pp x dp) =="
+python __graft_entry__.py dryrun 8
+
+if [[ "${1:-}" != "quick" ]]; then
+  echo "== bench sanity (tiny shapes) =="
+  BENCH_STEPS=1 BENCH_BATCH=2 python bench.py
+fi
+
+echo "CI OK"
