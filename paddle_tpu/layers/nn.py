@@ -29,7 +29,7 @@ __all__ = [
     "elementwise_pow", "clip", "clip_by_norm", "scale", "cast", "gather",
     "scatter", "slice", "shape", "maxout", "smooth_l1", "warpctc",
     "label_smooth", "bilinear_interp", "resize_bilinear", "random_crop",
-    "nce", "row_conv", "mean_iou", "bpr_loss", "spp",
+    "nce", "row_conv", "mean_iou", "bpr_loss", "spp", "moe_ffn",
 ]
 
 
@@ -750,3 +750,52 @@ def spp(input, pyramid_height, pool_type="max", name=None):
                      {"pyramid_height": pyramid_height,
                       "pooling_type": pool_type})
     return out
+
+
+def moe_ffn(input, num_experts, hidden_size, top_k=1, capacity_factor=1.25,
+            act="relu", param_attr=None, name=None):
+    """Mixture-of-Experts FFN with expert parallelism (additive — SURVEY
+    §2.4 notes the reference has none). Expert weights are stacked
+    [E, ...] and annotated sharded over the 'ep' mesh axis, so each
+    expert's parameters live on its own devices and GSPMD inserts the
+    dispatch all-to-all. Returns (out, aux_loss); add aux_loss (scaled
+    ~1e-2) to the training loss for load balancing."""
+    import copy
+    helper = LayerHelper(name or "moe", param_attr=param_attr)
+    d = int(input.shape[-1])
+    from ..param_attr import ParamAttr as _PA
+    from ..initializer import XavierInitializer as _Xavier
+
+    def _attr(tag):
+        # fresh copy per parameter: create_parameter fills attr.name in
+        # place, and a user-supplied explicit name must not alias the five
+        # distinct parameters
+        a = copy.copy(_PA.to_attr(param_attr))
+        if a.name is not None:
+            a.name = f"{a.name}.{tag}"
+        return a
+
+    def expert_param(shape, fan_in, fan_out, tag, is_bias=False):
+        p = helper.create_parameter(
+            _attr(tag), [num_experts] + list(shape), "float32",
+            is_bias=is_bias,
+            default_initializer=None if is_bias
+            else _Xavier(fan_in=fan_in, fan_out=fan_out))
+        p.sharding = ("ep",) + (None,) * len(shape)
+        return p
+
+    gate_w = helper.create_parameter(_attr("gate"), [d, num_experts],
+                                     "float32")
+    w1 = expert_param([d, hidden_size], d, hidden_size, "w1")
+    b1 = expert_param([hidden_size], 0, 0, "b1", is_bias=True)
+    w2 = expert_param([hidden_size, d], hidden_size, d, "w2")
+    b2 = expert_param([d], 0, 0, "b2", is_bias=True)
+    out = helper.create_tmp_variable(input.dtype)
+    aux = helper.create_tmp_variable("float32")
+    helper.append_op("moe_ffn",
+                     {"X": input, "GateW": gate_w, "W1": w1, "B1": b1,
+                      "W2": w2, "B2": b2},
+                     {"Out": out, "AuxLoss": aux},
+                     {"top_k": top_k, "capacity_factor": capacity_factor,
+                      "act": act})
+    return out, aux

@@ -1,0 +1,107 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+ADDITIVE capability (SURVEY §2.4 last row: the reference has no expert
+parallelism; designed TPU-first). The classic dense/static MoE
+formulation (Mesh-TensorFlow / Switch Transformer): top-k gating, a
+FIXED per-expert capacity C, and one-hot dispatch/combine einsums — no
+dynamic shapes anywhere, so XLA compiles it like any other op. The
+stacked expert weights [E, ...] are sharded over the 'ep' mesh axis
+(annotated by the layer); GSPMD turns the dispatch einsum into the
+all-to-all that routes tokens to their expert's devices.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _moe_infer(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape, out.dtype = x.shape, x.dtype
+    aux = block.var(op.output("AuxLoss")[0])
+    aux.shape, aux.dtype = (), "float32"
+
+
+@register_op("moe_ffn", infer_shape=_moe_infer)
+def moe_ffn(ctx, ins, attrs):
+    """X [..., D]; GateW [D, E]; W1 [E, D, H]; B1 [E, H]; W2 [E, H, D];
+    B2 [E, D] -> Out [..., D], AuxLoss [] (load-balancing, Switch
+    Transformer eq. 4: E * sum_e f_e * p_e).
+
+    top_k=1 (switch) or 2; capacity_factor bounds per-expert tokens at
+    C = ceil(top_k * N / E * capacity_factor); overflow tokens pass
+    through unchanged for their dropped slot (residual-friendly).
+    """
+    x = ins["X"][0]
+    gate_w = ins["GateW"][0]
+    w1, b1 = ins["W1"][0], ins["B1"][0]
+    w2, b2 = ins["W2"][0], ins["B2"][0]
+    top_k = int(attrs.get("top_k", 1))
+    cap_f = float(attrs.get("capacity_factor", 1.25))
+    act = attrs.get("act", "relu")
+
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    xt = x.reshape(-1, d)                                   # [N, D]
+    n = xt.shape[0]
+    e = gate_w.shape[-1]
+    c = max(int(math.ceil(top_k * n / e * cap_f)), 1)
+
+    logits = (xt @ gate_w.astype(xt.dtype)).astype(jnp.float32)   # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    combine = jnp.zeros((n, e, c), jnp.float32)
+    # iterative top-k assignment (k is 1 or 2: unrolled python loop)
+    masked = probs
+    counts = jnp.zeros((e,), jnp.int32)
+    for _ in range(top_k):
+        choice = jnp.argmax(masked, axis=-1)                # [N]
+        gate = jnp.take_along_axis(masked, choice[:, None], 1)[:, 0]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)  # [N, E]
+        # position of each token within its chosen expert (cumsum order)
+        pos = (jnp.cumsum(onehot, axis=0) - 1) + counts[None, :]  # [N, E]
+        pos_tok = jnp.sum(pos * onehot, axis=1)             # [N]
+        keep = pos_tok < c
+        slot = jax.nn.one_hot(pos_tok, c, dtype=jnp.float32)     # [N, C]
+        contrib = (gate * keep)[:, None, None] \
+            * onehot.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+        combine = combine + contrib
+        counts = counts + jnp.sum(onehot, axis=0)
+        masked = masked * (1.0 - onehot.astype(jnp.float32))
+
+    if top_k > 1:
+        # GShard-style: top-k gates renormalized over the kept set (their
+        # RELATIVE weights stay differentiable w.r.t. the router)
+        denom = jnp.maximum(jnp.sum(combine, axis=(1, 2), keepdims=True),
+                            1e-9)
+        combine = combine / denom
+    # top_k == 1 keeps the RAW gate probability (Switch Transformer:
+    # out = p_i * expert_i(x)) — normalizing would make the weight
+    # identically 1 and cut the router off from the task gradient
+    dispatch = (combine > 0).astype(x.dtype)                # [N, E, C]
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, xt)     # [E, C, D]
+    h = jnp.einsum("ecd,edh->ech", expert_in,
+                   w1.astype(x.dtype)) + b1[:, None, :].astype(x.dtype)
+    h = jnp.maximum(h, 0) if act == "relu" else jax.nn.gelu(h)
+    expert_out = jnp.einsum("ech,ehd->ecd", h,
+                            w2.astype(x.dtype)) + b2[:, None, :].astype(x.dtype)
+    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
+
+    # dropped tokens (no kept slot) pass through unchanged
+    routed = jnp.sum(combine, axis=(1, 2)) > 0              # [N]
+    out = jnp.where(routed[:, None], out, xt)
+
+    # load-balancing aux loss: E * sum_e (fraction routed_e * mean prob_e)
+    top1 = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32)
+    f_e = jnp.mean(top1, axis=0)
+    p_e = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(f_e * p_e)
+
+    return {"Out": [out.reshape(lead + (d,))], "AuxLoss": [aux]}
