@@ -24,6 +24,27 @@ from .parallel import ParallelExecutor
 from .data_feeder import DataFeeder
 from . import io as io_mod
 
+
+def _shape_chunks(batches, n: int):
+    """Group consecutive feed dicts into windows of <= n with identical
+    array shapes/dtypes (a shape change — e.g. a new length bucket —
+    flushes the window so run_loop's stacked feed stays rectangular)."""
+    def sig(feed):
+        return tuple(sorted((k, np.shape(v), str(np.asarray(v).dtype)
+                             if not hasattr(v, "dtype") else str(v.dtype))
+                            for k, v in feed.items()))
+
+    window, cur = [], None
+    for feed in batches:
+        s = sig(feed)
+        if window and (s != cur or len(window) == n):
+            yield window
+            window = []
+        window.append(feed)
+        cur = s
+    if window:
+        yield window
+
 __all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
            "EndStepEvent", "CheckpointConfig", "Trainer", "Inferencer"]
 
@@ -132,11 +153,17 @@ class Trainer:
     # -- train loop ---------------------------------------------------------
     def train(self, num_epochs: int, event_handler: Callable,
               reader: Callable, feed_order: Optional[list] = None,
-              double_buffer: bool = True):
+              double_buffer: bool = True, steps_per_loop: int = 1):
         """double_buffer=True uploads the next batch to the device while
         the current one computes (≙ layers/io.py:556 double_buffer +
         create_double_buffer_reader_op.cc) — the host→device transfer is
-        the usual bottleneck of a feed-based loop."""
+        the usual bottleneck of a feed-based loop.
+
+        steps_per_loop>1 runs that many batches in ONE device dispatch
+        (Executor.run_loop over stacked feeds) — the TPU fast path when
+        host dispatch dominates. Events then fire once per window with
+        metrics stacked to [n, ...]; consecutive batches are grouped only
+        while their shapes match (bucketed readers chunk per bucket)."""
         from .reader.prefetch import DeviceFeeder
         with scope_guard(self.scope):
             feed_vars = self._feed_vars(feed_order)
@@ -147,11 +174,50 @@ class Trainer:
                         if self.parallel else self.exe)
             start_epoch = (self.checkpoint_cfg.epoch_id
                            if self.checkpoint_cfg else 0)
+            use_loop = steps_per_loop > 1 and not self.parallel
+            if steps_per_loop > 1 and self.parallel:
+                import warnings
+                warnings.warn(
+                    "steps_per_loop>1 is not supported under the "
+                    "ParallelExecutor path yet; training per-step")
             for epoch_id in range(start_epoch, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
                 batches = (DeviceFeeder(feeder, reader)
                            if double_buffer and not self.parallel
+                           and not use_loop
                            else (feeder.feed(d) for d in reader()))
+                if use_loop:
+                    step_id = 0
+                    for window in _shape_chunks(batches, steps_per_loop):
+                        begin = BeginStepEvent(epoch_id, step_id)
+                        event_handler(begin)
+                        fetch = (self.train_func_outputs
+                                 if begin.fetch_metrics else [])
+                        if len(window) == steps_per_loop:
+                            stacked = {k: np.stack([f[k] for f in window])
+                                       for k in window[0]}
+                            metrics = executor.run_loop(
+                                self.train_program, feed=stacked,
+                                fetch_list=fetch, n_steps=len(window),
+                                per_step_feeds=True)
+                        else:
+                            # fragment windows (shape-change flush, epoch
+                            # tail) run per-step: one compiled loop variant
+                            # only, no per-length recompiles
+                            per = [executor.run(self.train_program, feed=f,
+                                                fetch_list=fetch)
+                                   for f in window]
+                            metrics = [np.stack(ms) for ms in zip(*per)] \
+                                if per and fetch else []
+                        event_handler(EndStepEvent(epoch_id, step_id,
+                                                   metrics))
+                        prev_step, step_id = step_id, step_id + len(window)
+                        iv = (self.checkpoint_cfg.step_interval
+                              if self.checkpoint_cfg else 0)
+                        if iv and prev_step // iv != step_id // iv:
+                            self._save_checkpoint(epoch_id, step_id)
+                    event_handler(EndEpochEvent(epoch_id))
+                    continue
                 for step_id, feed in enumerate(batches):
                     begin = BeginStepEvent(epoch_id, step_id)
                     event_handler(begin)

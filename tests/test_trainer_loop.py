@@ -1,0 +1,57 @@
+"""Trainer steps_per_loop: windows of batches in one device dispatch
+must train identically to per-step dispatch."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _train_func():
+    x = pt.layers.data("x", [8])
+    y = pt.layers.data("y", [1])
+    pred = pt.layers.fc(input=x, size=1)
+    loss = pt.layers.mean(pt.layers.square_error_cost(input=pred, label=y))
+    return [loss]
+
+
+def _reader(seed, n=12, batch=4):
+    rng = np.random.RandomState(seed)
+
+    def r():
+        for _ in range(n):
+            x = rng.rand(batch, 8).astype("float32")
+            yield list(zip(x, (x.sum(1, keepdims=True) * 0.3)))
+
+    return r
+
+
+def _run(steps_per_loop, seed=7):
+    losses = []
+
+    def handler(ev):
+        if isinstance(ev, pt.EndStepEvent) and ev.metrics:
+            losses.extend(np.ravel(np.asarray(ev.metrics[0])).tolist())
+
+    tr = pt.Trainer(train_func=_train_func,
+                    optimizer_func=lambda: pt.optimizer.SGDOptimizer(
+                        learning_rate=0.1))
+    tr.train(num_epochs=2, event_handler=handler, reader=_reader(seed),
+             feed_order=["x", "y"], steps_per_loop=steps_per_loop)
+    return losses
+
+
+class TestStepsPerLoop:
+    def test_matches_per_step_training(self):
+        base = _run(1)
+        windowed = _run(4)
+        assert len(base) == len(windowed) == 24
+        np.testing.assert_allclose(base, windowed, rtol=2e-4)
+
+    def test_shape_change_flushes_window(self):
+        from paddle_tpu.trainer import _shape_chunks
+        feeds = [{"x": np.zeros((4, 8))}] * 3 \
+            + [{"x": np.zeros((2, 8))}] * 2 \
+            + [{"x": np.zeros((4, 8))}] * 5
+        chunks = list(_shape_chunks(iter(feeds), 4))
+        assert [len(c) for c in chunks] == [3, 2, 4, 1]
