@@ -20,5 +20,6 @@ from . import detection_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import pipeline_ops  # noqa: F401
 from . import moe_ops  # noqa: F401
+from . import volumetric_ops  # noqa: F401
 
 from ..core.registry import registered_ops  # noqa: F401

@@ -123,7 +123,8 @@ def dynamic_gru(ctx, ins, attrs):
         if bias is not None:
             gc = gc + bias[2 * H:]
         cand = cact(gc)
-        h_new = u * h + (1.0 - u) * cand
+        # gru_kernel.h:62: out = prev - u*prev + u*cand = (1-u)*prev + u*cand
+        h_new = u * cand + (1.0 - u) * h
         m1 = m[:, None]
         h_new = m1 * h_new + (1 - m1) * h
         return h_new, h_new * m1

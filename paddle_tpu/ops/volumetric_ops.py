@@ -1,0 +1,276 @@
+"""3-D conv/pool family + unpool + remaining small ops.
+
+≙ reference paddle/fluid/operators/{conv3d via conv_op.cc, conv3d_transpose,
+pool3d + max_pool3d_with_index via pool_op/pool_with_index, unpool_op,
+bilinear_tensor_product_op, conv_shift_op, cos_sim_op, l1_norm_op, norm_op,
+margin_rank_loss_op, minus_op, modified_huber_loss_op, fill_op, print_op,
+gru_unit_op, lstm_unit_op}.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op, same_shape
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+@register_op("conv3d")
+def conv3d(ctx, ins, attrs):
+    """NCDHW conv (conv_op.cc 3-D path) → XLA conv_general_dilated."""
+    from .math_ops import harmonize
+    x, w = ins["Input"][0], ins["Filter"][0]
+    w = harmonize(x, w)
+    s = _triple(attrs.get("strides", 1))
+    p = _triple(attrs.get("paddings", 0))
+    d = _triple(attrs.get("dilations", 1))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=s, padding=[(pi, pi) for pi in p],
+        rhs_dilation=d, dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=attrs.get("groups", 1) or 1)
+    return {"Output": [out]}
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(ctx, ins, attrs):
+    from .math_ops import harmonize
+    x, w = ins["Input"][0], ins["Filter"][0]
+    w = harmonize(x, w)
+    s = _triple(attrs.get("strides", 1))
+    p = _triple(attrs.get("paddings", 0))
+    d = _triple(attrs.get("dilations", 1))
+    k = w.shape[2:]
+    pad = [(d[i] * (k[i] - 1) - p[i],) * 2 for i in range(3)]
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(w, (2, 3, 4)), window_strides=(1, 1, 1),
+        padding=pad, lhs_dilation=s, rhs_dilation=d,
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+        feature_group_count=attrs.get("groups", 1) or 1)
+    return {"Output": [out]}
+
+
+@register_op("pool3d")
+def pool3d(ctx, ins, attrs):
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [red(x, axis=(2, 3, 4), keepdims=True)]}
+    k = _triple(attrs["ksize"])
+    s = _triple(attrs.get("strides", 1))
+    p = _triple(attrs.get("paddings", 0))
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides,
+                                    pads)
+    else:
+        ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                     pads)
+        if attrs.get("exclusive", True):
+            cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                        dims, strides, pads)
+            out = ssum / cnt
+        else:
+            out = ssum / float(k[0] * k[1] * k[2])
+    return {"Out": [out]}
+
+
+@register_op("max_pool3d_with_index")
+def max_pool3d_with_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = _triple(attrs["ksize"])
+    s = _triple(attrs.get("strides", k))
+    p = _triple(attrs.get("paddings", 0))
+    B, C, D, H, W = x.shape
+    od = (D + 2 * p[0] - k[0]) // s[0] + 1
+    oh = (H + 2 * p[1] - k[1]) // s[1] + 1
+    ow = (W + 2 * p[2] - k[2]) // s[2] + 1
+    pad = jnp.pad(x, ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p),
+                  constant_values=-jnp.inf)
+    iz = jnp.arange(od)[:, None] * s[0] + jnp.arange(k[0])[None, :]
+    iy = jnp.arange(oh)[:, None] * s[1] + jnp.arange(k[1])[None, :]
+    ix = jnp.arange(ow)[:, None] * s[2] + jnp.arange(k[2])[None, :]
+    win = pad[:, :, iz[:, None, None, :, None, None],
+              iy[None, :, None, None, :, None],
+              ix[None, None, :, None, None, :]]
+    flat = win.reshape(B, C, od, oh, ow, -1)
+    arg = jnp.argmax(flat, axis=-1)
+    out = jnp.max(flat, axis=-1)
+    kz = arg // (k[1] * k[2])
+    ky = (arg // k[2]) % k[1]
+    kx = arg % k[2]
+    gz = jnp.arange(od)[None, None, :, None, None] * s[0] + kz - p[0]
+    gy = jnp.arange(oh)[None, None, None, :, None] * s[1] + ky - p[1]
+    gx = jnp.arange(ow)[None, None, None, None, :] * s[2] + kx - p[2]
+    idx = (gz * H + gy) * W + gx
+    return {"Out": [out], "Mask": [idx.astype(jnp.int32)]}
+
+
+@register_op("unpool")
+def unpool(ctx, ins, attrs):
+    """unpool_op.cc: scatter pooled values back to the argmax positions
+    recorded by max_pool2d_with_index (flat H*W indices)."""
+    x, mask = ins["X"][0], ins["Indices"][0]
+    B, C, oh, ow = x.shape
+    uh, uw = attrs["unpooled_height"], attrs["unpooled_width"]
+    flat_idx = mask.reshape(B, C, -1).astype(jnp.int32)
+    vals = x.reshape(B, C, -1)
+    out = jnp.zeros((B, C, uh * uw), x.dtype)
+
+    def one(o, i, v):
+        # ASSIGN like unpool_op.cc (duplicate indices from overlapping
+        # pooling windows must not sum)
+        return o.at[i].set(v, mode="drop")
+
+    out = jax.vmap(jax.vmap(one))(out, flat_idx, vals)
+    return {"Out": [out.reshape(B, C, uh, uw)]}
+
+
+# ---------------------------------------------------------------------------
+# small math / loss stragglers
+# ---------------------------------------------------------------------------
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ctx, ins, attrs):
+    """out[:, k] = x W_k y^T (+ bias) — bilinear_tensor_product_op.cc."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}
+
+
+@register_op("conv_shift", infer_shape=same_shape())
+def conv_shift(ctx, ins, attrs):
+    """conv_shift_op.cc: circular correlation (NTM attention shift).
+    X [B, N], Y [B, M] (M odd, M <= N): out[i] = sum_j y[j] * x[(i + j -
+    M//2) mod N]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    n, m = x.shape[1], y.shape[1]
+    half = m // 2
+    idx = (jnp.arange(n)[:, None] + jnp.arange(m)[None, :] - half) % n
+    return {"Out": [jnp.einsum("bnm,bm->bn", x[:, idx], y)]}
+
+
+@register_op("cos_sim")
+def cos_sim(ctx, ins, attrs):
+    """cos_sim_op.cc; Y may be [1, D] (broadcast) or [B, D]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), -1, keepdims=True))
+    out = jnp.sum(x * y, -1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("l1_norm")
+def l1_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0])).reshape(())]}
+
+
+@register_op("norm")
+def norm(ctx, ins, attrs):
+    """norm_op.cc: l2-normalize along `axis`."""
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    eps = attrs.get("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / n], "Norm": [n]}
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(ctx, ins, attrs):
+    """margin_rank_loss_op.cc: max(0, -label*(x1-x2)+margin)."""
+    label, x1, x2 = ins["Label"][0], ins["X1"][0], ins["X2"][0]
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("minus", infer_shape=same_shape())
+def minus(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register_op("modified_huber_loss")
+def modified_huber_loss(ctx, ins, attrs):
+    """modified_huber_loss_op.cc: labels in {0,1} -> y in {-1,1};
+    quadratic inside the margin, linear beyond."""
+    x, label = ins["X"][0], ins["Y"][0]
+    y = 2.0 * label - 1.0
+    z = x * y
+    out = jnp.where(z < -1.0, -4.0 * z,
+                    jnp.where(z < 1.0, jnp.square(1.0 - z), 0.0))
+    return {"Out": [out], "IntermediateVal": [z]}
+
+
+@register_op("fill")
+def fill(ctx, ins, attrs):
+    """fill_op.cc: constant tensor from attr data."""
+    from .tensor_ops import _dev_dtype
+    shape = tuple(attrs["shape"])
+    data = jnp.asarray(attrs["value"],
+                       _dev_dtype(attrs.get("dtype", "float32")))
+    return {"Out": [jnp.broadcast_to(data.reshape(-1)[: int(np.prod(shape))]
+                                     .reshape(shape), shape)
+                    if jnp.size(data) > 1 else jnp.full(shape, data)]}
+
+
+@register_op("print", infer_shape=same_shape("In", "Out"))
+def print_op(ctx, ins, attrs):
+    """print_op.cc → jax.debug.print (runs on every execution, even under
+    jit; ≙ the reference printing at op-execution time)."""
+    x = ins["In"][0]
+    msg = attrs.get("message", "")
+    safe = msg.replace("{", "{{").replace("}", "}}")  # free-text message
+    jax.debug.print(safe + "{x}", x=x)
+    return {"Out": [x]}
+
+
+# ---------------------------------------------------------------------------
+# RNN unit cells (single-step; the scan wrappers live in rnn_ops.py)
+# ---------------------------------------------------------------------------
+
+@register_op("gru_unit")
+def gru_unit(ctx, ins, attrs):
+    """gru_unit_op.cc: one GRU step. Input [B, 3D] (pre-projected x),
+    HiddenPrev [B, D], Weight [D, 3D] layout (update|reset|cand)."""
+    x, h_prev, w = ins["Input"][0], ins["HiddenPrev"][0], ins["Weight"][0]
+    d = h_prev.shape[-1]
+    bias = ins["Bias"][0] if ins.get("Bias") else 0.0
+    xs = x + bias
+    xu, xr, xc = xs[:, :d], xs[:, d:2 * d], xs[:, 2 * d:]
+    wu, wr, wc = w[:, :d], w[:, d:2 * d], w[:, 2 * d:]
+    u = jax.nn.sigmoid(xu + h_prev @ wu)
+    r = jax.nn.sigmoid(xr + h_prev @ wr)
+    c = jnp.tanh(xc + (r * h_prev) @ wc)
+    # gru_unit_op.h:116: h = u * (c - h_prev) + h_prev = u*c + (1-u)*h_prev
+    h = u * c + (1.0 - u) * h_prev
+    return {"Hidden": [h], "Gate": [jnp.concatenate([u, r, c], -1)],
+            "ResetHiddenPrev": [r * h_prev]}
+
+
+@register_op("lstm_unit")
+def lstm_unit(ctx, ins, attrs):
+    """lstm_unit_op.h:63-66: one LSTM step from pre-computed gate pre-
+    activations X [B, 4D] in the reference's i|f|o|g layout, C_prev
+    [B, D]."""
+    x, c_prev = ins["X"][0], ins["C_prev"][0]
+    d = c_prev.shape[-1]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i = jax.nn.sigmoid(x[:, :d])
+    f = jax.nn.sigmoid(x[:, d:2 * d] + forget_bias)
+    o = jax.nn.sigmoid(x[:, 2 * d:3 * d])
+    g = jnp.tanh(x[:, 3 * d:])
+    c = f * c_prev + i * g
+    return {"C": [c], "H": [o * jnp.tanh(c)]}

@@ -1,0 +1,229 @@
+"""3-D conv/pool family, unpool, RNN units, small-op stragglers vs numpy
+goldens (≙ reference test_conv3d_op, test_pool3d_op, test_unpool_op,
+test_cos_sim_op, test_margin_rank_loss_op, test_modified_huber_loss_op,
+test_gru_unit_op, test_lstm_unit_op, ...).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from op_test import OpTest
+
+
+class TestConv3d(OpTest):
+    def test_golden_and_grad(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 2, 4, 4, 4).astype(np.float32)
+        w = rng.rand(3, 2, 2, 2, 2).astype(np.float32)
+        want = np.zeros((1, 3, 3, 3, 3), np.float32)
+        for oc in range(3):
+            for z in range(3):
+                for y in range(3):
+                    for xx in range(3):
+                        want[0, oc, z, y, xx] = np.sum(
+                            x[0, :, z:z + 2, y:y + 2, xx:xx + 2] * w[oc])
+        self.op_type = "conv3d"
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1, 1], "paddings": [0, 0, 0]}
+        self.outputs = {"Output": want}
+        self.check_output(atol=1e-4)
+        self.check_grad(["in_Input", "in_Filter"], "Output")
+
+
+class TestPool3d(OpTest):
+    def test_max_golden(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(1, 1, 4, 4, 4).astype(np.float32)
+        want = x.reshape(1, 1, 2, 2, 2, 2, 2, 2).transpose(
+            0, 1, 2, 4, 6, 3, 5, 7).reshape(1, 1, 2, 2, 2, 8).max(-1)
+        self.op_type = "pool3d"
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                      "pooling_type": "max"}
+        self.outputs = {"Out": want}
+        self.check_output()
+
+
+class TestUnpool(OpTest):
+    def test_round_trip_with_pool_indices(self):
+        import jax
+        from paddle_tpu.core.registry import require_op, ExecContext
+        rng = np.random.RandomState(2)
+        x = rng.rand(1, 1, 4, 4).astype(np.float32)
+        pool = require_op("max_pool2d_with_index").compute(
+            ExecContext(jax.random.PRNGKey(0)), {"X": [x]},
+            {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]})
+        pooled, mask = np.asarray(pool["Out"][0]), np.asarray(
+            pool["Mask"][0])
+        self.op_type = "unpool"
+        self.inputs = {"X": pooled, "Indices": mask}
+        self.attrs = {"unpooled_height": 4, "unpooled_width": 4}
+        want = np.zeros((1, 1, 4, 4), np.float32)
+        for oy in range(2):
+            for ox in range(2):
+                flat = mask[0, 0, oy, ox]
+                want[0, 0, flat // 4, flat % 4] = pooled[0, 0, oy, ox]
+        self.outputs = {"Out": want}
+        self.check_output()
+
+
+class TestSmallOps(OpTest):
+    def test_cos_sim(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(4, 8).astype(np.float32)
+        y = rng.rand(4, 8).astype(np.float32)
+        want = (np.sum(x * y, -1, keepdims=True)
+                / (np.linalg.norm(x, axis=-1, keepdims=True)
+                   * np.linalg.norm(y, axis=-1, keepdims=True)))
+        self.op_type = "cos_sim"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": want.astype(np.float32)}
+        self.check_output(no_check_set=("out_XNorm", "out_YNorm"))
+        self.check_grad(["in_X", "in_Y"], "Out")
+
+    def test_norm(self):
+        rng = np.random.RandomState(4)
+        x = rng.rand(3, 5).astype(np.float32) + 0.1
+        n = np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
+        self.op_type = "norm"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": (x / n).astype(np.float32)}
+        self.check_output(no_check_set=("out_Norm",))
+
+    def test_margin_rank_loss(self):
+        rng = np.random.RandomState(5)
+        x1 = rng.rand(6, 1).astype(np.float32)
+        x2 = rng.rand(6, 1).astype(np.float32)
+        label = np.where(rng.rand(6, 1) > 0.5, 1.0, -1.0).astype(np.float32)
+        want = np.maximum(0, -label * (x1 - x2) + 0.1).astype(np.float32)
+        self.op_type = "margin_rank_loss"
+        self.inputs = {"Label": label, "X1": x1, "X2": x2}
+        self.attrs = {"margin": 0.1}
+        self.outputs = {"Out": want}
+        self.check_output(no_check_set=("out_Activated",))
+
+    def test_modified_huber(self):
+        x = np.array([[-2.0], [-0.5], [0.5], [2.0]], np.float32)
+        label = np.array([[1.0], [1.0], [1.0], [1.0]], np.float32)
+        z = x  # y=1
+        want = np.where(z < -1, -4 * z,
+                        np.where(z < 1, (1 - z) ** 2, 0)).astype(np.float32)
+        self.op_type = "modified_huber_loss"
+        self.inputs = {"X": x, "Y": label}
+        self.outputs = {"Out": want}
+        self.check_output(no_check_set=("out_IntermediateVal",))
+
+    def test_minus(self):
+        x = np.array([3.0, 2.0], np.float32)
+        y = np.array([1.0, 5.0], np.float32)
+        self.op_type = "minus"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+        self.check_output()
+        self.check_grad(["in_X", "in_Y"], "Out")
+
+    def test_conv_shift(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(2, 6).astype(np.float32)
+        y = rng.rand(2, 3).astype(np.float32)
+        want = np.zeros_like(x)
+        for b in range(2):
+            for i in range(6):
+                for j in range(3):
+                    want[b, i] += y[b, j] * x[b, (i + j - 1) % 6]
+        self.op_type = "conv_shift"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": want}
+        self.check_output(atol=1e-5)
+
+    def test_bilinear_tensor_product(self):
+        rng = np.random.RandomState(7)
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(3, 5).astype(np.float32)
+        w = rng.rand(2, 4, 5).astype(np.float32)
+        want = np.einsum("bi,kij,bj->bk", x, w, y).astype(np.float32)
+        self.op_type = "bilinear_tensor_product"
+        self.inputs = {"X": x, "Y": y, "Weight": w}
+        self.outputs = {"Out": want}
+        self.check_output(atol=1e-5)
+        self.check_grad(["in_X", "in_Y", "in_Weight"], "Out")
+
+
+class TestDynamicGruGolden:
+    def test_numeric_golden(self):
+        """Step-by-step numpy golden with the REFERENCE update rule
+        (gru_kernel.h:62: h = (1-u)*prev + u*cand)."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        rng = np.random.RandomState(10)
+        B, T, H = 2, 3, 4
+        x = rng.rand(B, T, 3 * H).astype(np.float32)
+        lens = np.array([3, 3], np.int32)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            d = layers.data("x", [3 * H], lod_level=1)
+            out = layers.dynamic_gru(d, size=H)
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            (got,) = exe.run(main, feed={"x": x, "x@SEQ_LEN": lens},
+                             fetch_list=[out])
+            w = np.asarray(scope.find_var(
+                [p.name for p in main.all_parameters()
+                 if len(p.shape) == 2][0]))
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        h = np.zeros((B, H), np.float32)
+        for t in range(T):
+            xt = x[:, t]
+            gur = xt[:, :2 * H] + h @ w[:, :2 * H]
+            u, r = sig(gur[:, :H]), sig(gur[:, H:])
+            cand = np.tanh(xt[:, 2 * H:] + (r * h) @ w[:, 2 * H:])
+            h = u * cand + (1 - u) * h
+            np.testing.assert_allclose(got[:, t], h, rtol=1e-4, atol=1e-5)
+
+
+class TestRnnUnits(OpTest):
+    def test_lstm_unit_golden(self):
+        rng = np.random.RandomState(8)
+        d = 4
+        x = rng.randn(2, 4 * d).astype(np.float32)
+        c_prev = rng.randn(2, d).astype(np.float32)
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        i, f = sig(x[:, :d]), sig(x[:, d:2 * d] + 0.5)
+        o, g = sig(x[:, 2 * d:3 * d]), np.tanh(x[:, 3 * d:])
+        c = f * c_prev + i * g
+        self.op_type = "lstm_unit"
+        self.inputs = {"X": x, "C_prev": c_prev}
+        self.attrs = {"forget_bias": 0.5}
+        self.outputs = {"C": c.astype(np.float32),
+                        "H": (o * np.tanh(c)).astype(np.float32)}
+        self.check_output(atol=1e-5)
+
+    def test_gru_unit_golden(self):
+        rng = np.random.RandomState(9)
+        d = 3
+        x = rng.randn(2, 3 * d).astype(np.float32)
+        h_prev = rng.randn(2, d).astype(np.float32)
+        w = rng.randn(d, 3 * d).astype(np.float32)
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        u = sig(x[:, :d] + h_prev @ w[:, :d])
+        r = sig(x[:, d:2 * d] + h_prev @ w[:, d:2 * d])
+        c = np.tanh(x[:, 2 * d:] + (r * h_prev) @ w[:, 2 * d:])
+        h = u * c + (1 - u) * h_prev  # gru_unit_op.h:116
+        self.op_type = "gru_unit"
+        self.inputs = {"Input": x, "HiddenPrev": h_prev, "Weight": w}
+        self.outputs = {"Hidden": h.astype(np.float32)}
+        self.check_output(atol=1e-5,
+                          no_check_set=("out_Gate", "out_ResetHiddenPrev"))
