@@ -200,7 +200,9 @@ def _conv2d_transpose_infer(op, block):
 
 @register_op("conv2d_transpose", infer_shape=_conv2d_transpose_infer)
 def conv2d_transpose(ctx, ins, attrs):
-    """conv_transpose_op.cc → gradient-style dilated conv (IOHW filter)."""
+    """conv_transpose_op.cc → gradient-style dilated conv (IOHW filter).
+    Grouped transpose runs per-group channel blocks (the flipped-kernel
+    trick cannot express groups via feature_group_count)."""
     x, w = ins["Input"][0], ins["Filter"][0]
     w = _harmonize_w(x, w)
     s = _pair(attrs.get("strides", 1))
@@ -209,12 +211,20 @@ def conv2d_transpose(ctx, ins, attrs):
     kh, kw = w.shape[2], w.shape[3]
     pad_h = d[0] * (kh - 1) - p[0]
     pad_w = d[1] * (kw - 1) - p[1]
-    out = jax.lax.conv_general_dilated(
-        x, jnp.flip(w, (2, 3)), window_strides=(1, 1),
-        padding=[(pad_h, pad_h), (pad_w, pad_w)], lhs_dilation=s, rhs_dilation=d,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        feature_group_count=attrs.get("groups", 1) or 1)
-    return {"Output": [out]}
+    g = attrs.get("groups", 1) or 1
+
+    def one(xg, wg):
+        return jax.lax.conv_general_dilated(
+            xg, jnp.flip(wg, (2, 3)), window_strides=(1, 1),
+            padding=[(pad_h, pad_h), (pad_w, pad_w)], lhs_dilation=s,
+            rhs_dilation=d, dimension_numbers=("NCHW", "IOHW", "NCHW"))
+
+    if g == 1:
+        return {"Output": [one(x, w)]}
+    cin = x.shape[1] // g
+    outs = [one(x[:, i * cin:(i + 1) * cin], w[i * cin:(i + 1) * cin])
+            for i in range(g)]
+    return {"Output": [jnp.concatenate(outs, axis=1)]}
 
 
 def _pool2d_infer(op, block):

@@ -48,12 +48,22 @@ def conv3d_transpose(ctx, ins, attrs):
     d = _triple(attrs.get("dilations", 1))
     k = w.shape[2:]
     pad = [(d[i] * (k[i] - 1) - p[i],) * 2 for i in range(3)]
-    out = jax.lax.conv_general_dilated(
-        x, jnp.flip(w, (2, 3, 4)), window_strides=(1, 1, 1),
-        padding=pad, lhs_dilation=s, rhs_dilation=d,
-        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
-        feature_group_count=attrs.get("groups", 1) or 1)
-    return {"Output": [out]}
+    g = attrs.get("groups", 1) or 1
+
+    def one(xg, wg):
+        return jax.lax.conv_general_dilated(
+            xg, jnp.flip(wg, (2, 3, 4)), window_strides=(1, 1, 1),
+            padding=pad, lhs_dilation=s, rhs_dilation=d,
+            dimension_numbers=("NCDHW", "IODHW", "NCDHW"))
+
+    if g == 1:
+        return {"Output": [one(x, w)]}
+    # grouped transpose: per-group channel blocks (the flipped-kernel
+    # trick cannot express groups via feature_group_count)
+    cin = x.shape[1] // g
+    outs = [one(x[:, i * cin:(i + 1) * cin], w[i * cin:(i + 1) * cin])
+            for i in range(g)]
+    return {"Output": [jnp.concatenate(outs, axis=1)]}
 
 
 @register_op("pool3d")
@@ -78,8 +88,10 @@ def pool3d(ctx, ins, attrs):
         ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
                                      pads)
         if attrs.get("exclusive", True):
-            cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
-                                        dims, strides, pads)
+            ones = jnp.ones(x.shape[2:], x.dtype)  # spatial-only, once
+            cnt = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, k, s,
+                tuple((pi, pi) for pi in p))
             out = ssum / cnt
         else:
             out = ssum / float(k[0] * k[1] * k[2])
@@ -175,7 +187,7 @@ def cos_sim(ctx, ins, attrs):
 
 @register_op("l1_norm")
 def l1_norm(ctx, ins, attrs):
-    return {"Out": [jnp.sum(jnp.abs(ins["X"][0])).reshape(())]}
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0])).reshape((1,))]}
 
 
 @register_op("norm")
@@ -214,7 +226,10 @@ def modified_huber_loss(ctx, ins, attrs):
     return {"Out": [out], "IntermediateVal": [z]}
 
 
-@register_op("fill")
+from .tensor_ops import _fill_infer
+
+
+@register_op("fill", infer_shape=_fill_infer)
 def fill(ctx, ins, attrs):
     """fill_op.cc: constant tensor from attr data."""
     from .tensor_ops import _dev_dtype

@@ -1,0 +1,169 @@
+"""Book-model end-to-end tests (≙ reference tests/book/: train 8 real
+models to a loss threshold then round-trip save/load_inference_model —
+SURVEY §4.4). mnist (recognize_digits), image_classification (resnet/
+vgg), machine_translation, and understand_sentiment-style LSTM already
+train in their own suites; this file covers the remaining book models on
+synthetic data shaped like the real datasets.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _train(main, startup, loss, feeds, steps_hint=None):
+    scope = pt.Scope()
+    losses = []
+    with pt.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        for f in feeds:
+            (l,) = exe.run(main, feed=f, fetch_list=[loss])
+            losses.append(float(np.ravel(l)[0]))
+    return losses, scope
+
+
+class TestFitALine:
+    """book/fit_a_line: linear regression on uci_housing-shaped data."""
+
+    def test_trains_below_threshold_and_exports(self, tmp_path):
+        rng = np.random.RandomState(0)
+        true_w = rng.randn(13, 1).astype(np.float32)
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = 1
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [13])
+            y = layers.data("y", [1])
+            pred = layers.fc(input=x, size=1)
+            loss = layers.mean(
+                layers.square_error_cost(input=pred, label=y))
+            pt.optimizer.AdamOptimizer(learning_rate=0.1).minimize(loss)
+        feeds = []
+        for _ in range(150):
+            xb = rng.rand(20, 13).astype(np.float32)
+            feeds.append({"x": xb, "y": xb @ true_w})
+        losses, scope = _train(main, startup, loss, feeds)
+        assert losses[-1] < 0.05, losses[-1]
+        # inference round-trip (≙ the book tests' save/load cycle)
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            d = str(tmp_path / "fit_a_line")
+            pt.io.save_inference_model(d, ["x"], [pred], exe, main)
+            prog, feed_names, fetches = pt.io.load_inference_model(
+                d, exe, scope=scope)
+            xb = rng.rand(4, 13).astype(np.float32)
+            (got,) = exe.run(prog, feed={"x": xb}, fetch_list=fetches)
+        np.testing.assert_allclose(got, xb @ true_w, atol=0.6)
+
+
+class TestWord2Vec:
+    """book/word2vec: N-gram LM with concatenated context embeddings."""
+
+    def test_trains(self):
+        rng = np.random.RandomState(1)
+        vocab, emb = 40, 16
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = 2
+        with pt.program_guard(main, startup):
+            words = [layers.data(f"w{i}", [1], dtype="int64")
+                     for i in range(4)]
+            embs = [layers.embedding(w, size=[vocab, emb],
+                                     param_attr=pt.ParamAttr(
+                                         name="shared_emb"))
+                    for w in words]
+            concat = layers.concat(embs, axis=1)
+            hidden = layers.fc(input=concat, size=64, act="sigmoid")
+            predict = layers.fc(input=hidden, size=vocab, act="softmax")
+            target = layers.data("target", [1], dtype="int64")
+            loss = layers.mean(
+                layers.cross_entropy(input=predict, label=target))
+            pt.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(loss)
+        # deterministic fake corpus (fixed batches cycled over epochs):
+        # target = (sum of context) mod vocab — memorizable
+        base = []
+        for _ in range(10):
+            ctx = rng.randint(0, vocab, (32, 4)).astype("int64")
+            base.append({**{f"w{i}": ctx[:, i:i + 1] for i in range(4)},
+                         "target": (ctx.sum(1, keepdims=True) % vocab)})
+        losses, _ = _train(main, startup, loss, base * 10)
+        assert losses[-1] < losses[0] * 0.8
+
+
+class TestRecommenderSystem:
+    """book/recommender_system: user/item embedding towers + cos_sim."""
+
+    def test_trains(self):
+        rng = np.random.RandomState(2)
+        n_users, n_items = 30, 50
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = 3
+        with pt.program_guard(main, startup):
+            uid = layers.data("uid", [1], dtype="int64")
+            mid = layers.data("mid", [1], dtype="int64")
+            score = layers.data("score", [1])
+            uvec = layers.fc(input=layers.embedding(uid, [n_users, 16]),
+                             size=16)
+            ivec = layers.fc(input=layers.embedding(mid, [n_items, 16]),
+                             size=16)
+            blk = main.global_block
+            out = blk.create_var("simv", shape=(-1, 1), dtype="float32")
+            blk.append_op("cos_sim", {"X": uvec, "Y": ivec},
+                          {"Out": out,
+                           "XNorm": blk.create_var("xn", shape=(-1, 1),
+                                                   dtype="float32"),
+                           "YNorm": blk.create_var("yn", shape=(-1, 1),
+                                                   dtype="float32")}, {})
+            pred = layers.scale(out, scale=5.0)
+            loss = layers.mean(
+                layers.square_error_cost(input=pred, label=score))
+            pt.optimizer.AdamOptimizer(learning_rate=0.02).minimize(loss)
+        # synthetic ratings with user/item structure
+        u_lat = rng.randn(n_users, 4)
+        i_lat = rng.randn(n_items, 4)
+        feeds = []
+        for _ in range(50):
+            u = rng.randint(0, n_users, (32, 1))
+            m = rng.randint(0, n_items, (32, 1))
+            r = np.clip((u_lat[u[:, 0]] * i_lat[m[:, 0]]).sum(
+                1, keepdims=True) + 2.5, 0, 5).astype(np.float32)
+            feeds.append({"uid": u.astype("int64"),
+                          "mid": m.astype("int64"), "score": r})
+        losses, _ = _train(main, startup, loss, feeds)
+        assert losses[-1] < losses[0] * 0.7
+
+
+class TestUnderstandSentiment:
+    """book/understand_sentiment: sequence_conv_pool text classifier."""
+
+    def test_trains(self):
+        from paddle_tpu import nets
+        rng = np.random.RandomState(3)
+        vocab = 60
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = 4
+        with pt.program_guard(main, startup):
+            words = layers.data("words", [1], dtype="int64", lod_level=1)
+            label = layers.data("label", [1], dtype="int64")
+            emb = layers.embedding(words, size=[vocab, 16])
+            conv = nets.sequence_conv_pool(emb, num_filters=24,
+                                           filter_size=3, act="tanh",
+                                           pool_type="max")
+            predict = layers.fc(input=conv, size=2, act="softmax")
+            loss = layers.mean(
+                layers.cross_entropy(input=predict, label=label))
+            pt.optimizer.AdamOptimizer(learning_rate=0.01).minimize(loss)
+        # label = whether token 7 appears in the sequence
+        feeds = []
+        for _ in range(40):
+            seqs, labels = [], []
+            for _ in range(16):
+                L = int(rng.randint(4, 12))
+                s = rng.randint(0, vocab, (L, 1)).astype("int64")
+                seqs.append(s)
+                labels.append([int((s == 7).any())])
+            feeds.append({"words": seqs,
+                          "label": np.asarray(labels, "int64")})
+        losses, _ = _train(main, startup, loss, feeds)
+        assert losses[-1] < losses[0] * 0.8

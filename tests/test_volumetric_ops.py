@@ -68,6 +68,29 @@ class TestUnpool(OpTest):
         self.check_output()
 
 
+class TestGroupedTranspose(OpTest):
+    def test_conv2d_transpose_groups(self):
+        rng = np.random.RandomState(11)
+        g, cin_g, cout_g = 2, 2, 3
+        x = rng.rand(1, g * cin_g, 3, 3).astype(np.float32)
+        w = rng.rand(g * cin_g, cout_g, 2, 2).astype(np.float32)
+        # numpy golden: per group, full-correlation transpose (stride 1)
+        want = np.zeros((1, g * cout_g, 4, 4), np.float32)
+        for gi in range(g):
+            for ci in range(cin_g):
+                for co in range(cout_g):
+                    for y in range(3):
+                        for xx in range(3):
+                            want[0, gi * cout_g + co, y:y + 2, xx:xx + 2] \
+                                += x[0, gi * cin_g + ci, y, xx] \
+                                * w[gi * cin_g + ci, co]
+        self.op_type = "conv2d_transpose"
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0], "groups": g}
+        self.outputs = {"Output": want}
+        self.check_output(atol=1e-4)
+
+
 class TestSmallOps(OpTest):
     def test_cos_sim(self):
         rng = np.random.RandomState(3)
