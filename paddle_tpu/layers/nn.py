@@ -30,6 +30,7 @@ __all__ = [
     "scatter", "slice", "shape", "maxout", "smooth_l1", "warpctc",
     "label_smooth", "bilinear_interp", "resize_bilinear", "random_crop",
     "nce", "row_conv", "mean_iou", "bpr_loss", "spp", "moe_ffn",
+    "conv3d", "pool3d",
 ]
 
 
@@ -799,3 +800,46 @@ def moe_ffn(input, num_experts, hidden_size, top_k=1, capacity_factor=1.25,
                      {"top_k": top_k, "capacity_factor": capacity_factor,
                       "act": act})
     return out, aux
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, act=None,
+           name=None):
+    """NCDHW 3-D convolution (conv_op.cc 3-D path)."""
+    helper = LayerHelper("conv3d", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c_in = input.shape[1]
+    k = (filter_size,) * 3 if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    g = groups or 1
+    w = helper.create_parameter(
+        helper.param_attr, [num_filters, c_in // g] + list(k), "float32")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("conv3d", {"Input": input, "Filter": w},
+                     {"Output": out},
+                     {"strides": [stride] * 3 if isinstance(stride, int)
+                      else list(stride),
+                      "paddings": [padding] * 3 if isinstance(padding, int)
+                      else list(padding),
+                      "dilations": [dilation] * 3
+                      if isinstance(dilation, int) else list(dilation),
+                      "groups": g})
+    if bias_attr is not False:
+        out = helper.append_bias_op(out, dim_start=1, dim_end=2,
+                                    size=[num_filters])
+    return helper.append_activation(out)
+
+
+def pool3d(input, pool_size, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, exclusive=True, name=None):
+    """NCDHW 3-D pooling (pool_op.cc 3-D path)."""
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    tri = lambda v: [v] * 3 if isinstance(v, int) else list(v)
+    helper.append_op("pool3d", {"X": input}, {"Out": out},
+                     {"ksize": tri(pool_size), "strides": tri(pool_stride),
+                      "paddings": tri(pool_padding),
+                      "pooling_type": pool_type,
+                      "global_pooling": global_pooling,
+                      "exclusive": exclusive})
+    return out

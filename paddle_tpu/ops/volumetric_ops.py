@@ -22,7 +22,21 @@ def _triple(v):
     return (int(v),) * 3
 
 
-@register_op("conv3d")
+def _conv3d_infer(op, block):
+    x = block.var(op.input("Input")[0])
+    w = block.var(op.input("Filter")[0])
+    out = block.var(op.output("Output")[0])
+    st = _triple(op.attrs.get("strides", 1))
+    pd = _triple(op.attrs.get("paddings", 0))
+    dl = _triple(op.attrs.get("dilations", 1))
+    dims = tuple((x.shape[2 + i] + 2 * pd[i]
+                  - (dl[i] * (w.shape[2 + i] - 1) + 1)) // st[i] + 1
+                 for i in range(3))
+    out.shape = (x.shape[0], w.shape[0]) + dims
+    out.dtype = x.dtype
+
+
+@register_op("conv3d", infer_shape=_conv3d_infer)
 def conv3d(ctx, ins, attrs):
     """NCDHW conv (conv_op.cc 3-D path) → XLA conv_general_dilated."""
     from .math_ops import harmonize
@@ -66,7 +80,22 @@ def conv3d_transpose(ctx, ins, attrs):
     return {"Output": [jnp.concatenate(outs, axis=1)]}
 
 
-@register_op("pool3d")
+def _pool3d_infer(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    if op.attrs.get("global_pooling", False):
+        out.shape = tuple(x.shape[:2]) + (1, 1, 1)
+    else:
+        k = _triple(op.attrs["ksize"])
+        st = _triple(op.attrs.get("strides", 1))
+        pd = _triple(op.attrs.get("paddings", 0))
+        dims = tuple((x.shape[2 + i] + 2 * pd[i] - k[i]) // st[i] + 1
+                     for i in range(3))
+        out.shape = tuple(x.shape[:2]) + dims
+    out.dtype = x.dtype
+
+
+@register_op("pool3d", infer_shape=_pool3d_infer)
 def pool3d(ctx, ins, attrs):
     x = ins["X"][0]
     ptype = attrs.get("pooling_type", "max")
