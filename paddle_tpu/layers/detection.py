@@ -15,7 +15,8 @@ from ..layer_helper import LayerHelper
 __all__ = ["prior_box", "anchor_generator", "box_coder", "box_clip",
            "bipartite_match", "target_assign", "mine_hard_examples",
            "multiclass_nms", "detection_output", "ssd_loss", "roi_pool",
-           "roi_align", "iou_similarity"]
+           "roi_align", "iou_similarity", "polygon_box_transform",
+           "detection_map"]
 
 
 def iou_similarity(x, y, name=None):
@@ -194,4 +195,36 @@ def roi_align(input, rois, pooled_height=1, pooled_width=1,
                      {"pooled_height": pooled_height,
                       "pooled_width": pooled_width,
                       "spatial_scale": spatial_scale})
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    """≙ layers/detection.py polygon_box_transform: decode EAST geometry
+    maps [N, geo_ch, H, W] into absolute vertex coordinates."""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("polygon_box_transform", {"Input": input},
+                     {"Output": out}, {})
+    return out
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral", name=None):
+    """≙ layers/detection.py detection_map (detection_map_op.cc). Dense
+    inputs: detect_res [B, D, 6] (label, score, x0, y0, x1, y1; label -1
+    pads — multiclass_nms output format), label [B, G, 6] (label,
+    is_difficult, x0, y0, x1, y1; label -1 pads) or [B, G, 5] without the
+    difficult column. Returns the batch mAP scalar [1]; streaming
+    accumulation across batches lives in metrics.DetectionMAP."""
+    helper = LayerHelper("detection_map", name=name)
+    out = helper.create_tmp_variable("float32")
+    helper.append_op("detection_map",
+                     {"DetectRes": detect_res, "Label": label},
+                     {"MAP": out},
+                     {"class_num": class_num,
+                      "background_label": background_label,
+                      "overlap_threshold": overlap_threshold,
+                      "evaluate_difficult": evaluate_difficult,
+                      "ap_type": ap_version})
     return out

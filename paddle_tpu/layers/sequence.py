@@ -17,7 +17,8 @@ __all__ = [
     "sequence_pool", "sequence_softmax", "sequence_first_step",
     "sequence_last_step", "sequence_expand", "sequence_conv",
     "sequence_reshape", "sequence_concat", "sequence_erase",
-    "sequence_enumerate", "dynamic_lstm", "dynamic_gru", "edit_distance",
+    "sequence_enumerate", "dynamic_lstm", "dynamic_lstmp", "dynamic_gru",
+    "edit_distance",
 ]
 
 
@@ -196,6 +197,50 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
     _mark_seq(hidden, input.seq_len_var)
     _mark_seq(cell, input.seq_len_var)
     return hidden, cell
+
+
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
+                  param_attr=None, bias_attr=None, use_peepholes=True,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", dtype="float32", name=None):
+    """≙ layers/nn.py dynamic_lstmp (lstmp_op.cc): LSTM with recurrent
+    projection. `size` = 4×hidden; returns (projection [B,T,P], cell
+    [B,T,H])."""
+    import copy
+    helper = LayerHelper("lstmp", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    hidden_size = size // 4
+    # separate ParamAttr copies: create_parameter binds attr.name in place,
+    # so sharing one attr would collide the two weights
+    weight = helper.create_parameter(copy.copy(helper.param_attr),
+                                     [proj_size, 4 * hidden_size], dtype)
+    proj_weight = helper.create_parameter(copy.copy(helper.param_attr),
+                                          [hidden_size, proj_size], dtype)
+    bias_size = 4 * hidden_size + (3 * hidden_size if use_peepholes else 0)
+    bias = helper.create_parameter(helper.bias_attr, [1, bias_size], dtype,
+                                   is_bias=True)
+    proj = helper.create_tmp_variable(dtype)
+    cell = helper.create_tmp_variable(dtype)
+    inputs = {"Input": input, "Weight": weight, "ProjWeight": proj_weight,
+              "Bias": bias, "SeqLen": _seq_len_of(input, helper)}
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
+    helper.append_op("lstmp", inputs,
+                     {"Projection": proj, "Cell": cell},
+                     {"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+                      "gate_activation": gate_activation,
+                      "cell_activation": cell_activation,
+                      "candidate_activation": candidate_activation,
+                      "proj_activation": proj_activation})
+    proj.shape = tuple(input.shape[:2]) + (proj_size,)
+    cell.shape = tuple(input.shape[:2]) + (hidden_size,)
+    proj.dtype = cell.dtype = dtype
+    _mark_seq(proj, input.seq_len_var)
+    _mark_seq(cell, input.seq_len_var)
+    return proj, cell
 
 
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,

@@ -14,7 +14,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..core.registry import register_op
+from ..core.registry import register_op, same_shape
 
 
 # ---------------------------------------------------------------------------
@@ -550,3 +550,136 @@ def roi_align(ctx, ins, attrs):
         return v
 
     return {"Out": [jax.vmap(one_roi)(rois.astype(jnp.float32))]}
+
+
+# ---------------------------------------------------------------------------
+# EAST geometry-map decoding + detection mAP
+# ---------------------------------------------------------------------------
+
+@register_op("polygon_box_transform",
+             infer_shape=same_shape("Input", "Output"))
+def polygon_box_transform(ctx, ins, attrs):
+    """detection/polygon_box_transform_op.cc: decode an EAST-style geometry
+    map [N, geo_ch, H, W] of per-pixel offsets into absolute vertex
+    coordinates: x-offset channels become col_idx - in, y-offset channels
+    row_idx - in. The reference's parity test is on the FLATTENED
+    batch*channel index ((n*G + g) % 2, polygon_box_transform_op.cc:43-46),
+    so with an odd channel count the x/y role alternates per batch item —
+    reproduced exactly."""
+    x = ins["Input"][0]
+    n, g, h, w = x.shape
+    cols = jnp.broadcast_to(jnp.arange(w, dtype=x.dtype), (h, w))
+    rows = jnp.broadcast_to(jnp.arange(h, dtype=x.dtype)[:, None], (h, w))
+    flat_idx = (jnp.arange(n)[:, None] * g + jnp.arange(g)[None, :])
+    is_x = (flat_idx % 2 == 0)[:, :, None, None]
+    grid = jnp.where(is_x, cols[None, None], rows[None, None])
+    return {"Output": [grid.astype(x.dtype) - x]}
+
+
+def _detection_map_infer(op, block):
+    out = block.var(op.output("MAP")[0])
+    out.shape = (1,)
+    out.dtype = "float32"
+
+
+@register_op("detection_map", infer_shape=_detection_map_infer)
+def detection_map(ctx, ins, attrs):
+    """detection_map_op.h: mean average precision over a batch of
+    detections. Dense redesign of the LoD kernel: DetectRes [B, D, 6] =
+    (label, score, xmin, ymin, xmax, ymax) with label==-1 padding rows;
+    Label (ground truth) [B, G, 6] = (label, is_difficult, xmin, ymin,
+    xmax, ymax) (or [B, G, 5] without the difficult column), label==-1
+    padding. Greedy score-ordered matching (visited-once per gt,
+    CalcTrueAndFalsePositive), then per-class AP by 'integral' or
+    '11point' (CalcMAP). The reference's streaming Accum* state is played
+    by metrics.DetectionMAP host-side; this op scores one batch.
+
+    mAP averages classes that have >=1 countable gt box AND >=1 scored
+    detection — the reference's behavior (classes absent from its
+    true_pos map are skipped, detection_map_op.h:421-424)."""
+    det = ins["DetectRes"][0].astype(jnp.float32)     # [B, D, 6]
+    gt = ins["Label"][0].astype(jnp.float32)          # [B, G, 5|6]
+    thresh = float(attrs.get("overlap_threshold", 0.5))
+    eval_difficult = bool(attrs.get("evaluate_difficult", True))
+    ap_type = attrs.get("ap_type", "integral")
+    class_num = int(attrs["class_num"])
+    background = int(attrs.get("background_label", 0))
+    B, D, _ = det.shape
+    G = gt.shape[1]
+
+    if gt.shape[2] == 6:
+        g_label, g_diff, g_box = gt[..., 0], gt[..., 1], gt[..., 2:6]
+    else:
+        g_label, g_box = gt[..., 0], gt[..., 1:5]
+        g_diff = jnp.zeros_like(g_label)
+    g_valid = g_label >= 0
+    # a gt box is countable toward npos unless (difficult and not evaluated)
+    g_count = g_valid & (eval_difficult | (g_diff < 0.5))
+    # npos[c]: countable gt boxes per class
+    g_onehot = jax.nn.one_hot(g_label.astype(jnp.int32), class_num)  # [B,G,C]
+    npos = jnp.einsum("bg,bgc->c", g_count.astype(jnp.float32), g_onehot)
+
+    d_label, d_score, d_box = det[..., 0], det[..., 1], det[..., 2:6]
+    d_valid = d_label >= 0
+    d_box = jnp.clip(d_box, 0.0, 1.0)  # ClipBBox
+
+    def one_image(dl, ds, db, dv, gl, gd, gb, gv):
+        # process detections in descending-score order (greedy matching)
+        order = jnp.argsort(jnp.where(dv, -ds, jnp.inf))
+        dl, ds, db, dv = dl[order], ds[order], db[order], dv[order]
+        ious = _iou_matrix(db, gb)                    # [D, G]
+
+        def body(visited, i):
+            same = (gl == dl[i]) & gv
+            iou_i = jnp.where(same, ious[i], -1.0)
+            j = jnp.argmax(iou_i)
+            max_iou = iou_i[j]
+            matched = max_iou > thresh
+            if eval_difficult:          # static attr: difficult gt count too
+                diff_skip = jnp.zeros((), bool)
+            else:
+                diff_skip = matched & (gd[j] >= 0.5)
+            tp = matched & (~diff_skip) & (~visited[j])
+            fp = (~matched) | (matched & (~diff_skip) & visited[j])
+            counted = dv[i] & (~diff_skip)
+            visited = visited.at[j].set(visited[j] | (tp & dv[i]))
+            return visited, (tp & counted, fp & counted, counted)
+
+        _, (tp, fp, counted) = jax.lax.scan(
+            body, jnp.zeros((G,), bool), jnp.arange(D))
+        return dl, ds, tp, fp, counted
+
+    dl, ds, tp, fp, counted = jax.vmap(one_image)(
+        d_label, d_score, d_box, d_valid, g_label, g_diff, g_box, g_valid)
+    dl, ds = dl.reshape(-1), ds.reshape(-1)           # [B*D]
+    tp = tp.reshape(-1).astype(jnp.float32)
+    fp = fp.reshape(-1).astype(jnp.float32)
+    counted = counted.reshape(-1)
+
+    # global sort by score desc; per-class cumulative TP/FP along it
+    order = jnp.argsort(jnp.where(counted, -ds, jnp.inf))
+    dl, tp, fp, counted = dl[order], tp[order], fp[order], counted[order]
+    cls_mask = jax.nn.one_hot(dl.astype(jnp.int32), class_num) \
+        * counted[:, None].astype(jnp.float32)        # [N, C]
+    tp_cum = jnp.cumsum(tp[:, None] * cls_mask, axis=0)
+    fp_cum = jnp.cumsum(fp[:, None] * cls_mask, axis=0)
+    npos_safe = jnp.maximum(npos, 1.0)
+    prec = tp_cum / jnp.maximum(tp_cum + fp_cum, 1e-9)   # [N, C]
+    rec = tp_cum / npos_safe[None, :]
+
+    has_det = cls_mask.sum(0) > 0
+    scored = (npos > 0) & has_det                        # classes in the mean
+    if 0 <= background < class_num:
+        # the background class never enters the mean (its rows still consume
+        # gt matches exactly as in the sibling ops, multiclass_nms/ssd_loss)
+        scored = scored & (jnp.arange(class_num) != background)
+    if ap_type == "11point":
+        pts = jnp.arange(11, dtype=jnp.float32) / 10.0   # [11]
+        at_pt = rec[:, :, None] >= pts[None, None, :]    # [N, C, 11]
+        max_prec = jnp.max(jnp.where(at_pt, prec[:, :, None], 0.0), axis=0)
+        ap = max_prec.mean(-1)                           # [C]
+    else:  # integral: each TP adds precision-at-it * (1/npos)
+        ap = jnp.sum(prec * tp[:, None] * cls_mask, axis=0) / npos_safe
+    mean_ap = jnp.sum(jnp.where(scored, ap, 0.0)) / jnp.maximum(
+        scored.sum().astype(jnp.float32), 1.0)
+    return {"MAP": [mean_ap.reshape(1)]}

@@ -178,3 +178,13 @@ def array_read(ctx, ins, attrs):
     idx = jnp.reshape(i, ()).astype(jnp.int32)
     return {"Out": [jax.lax.dynamic_index_in_dim(arr, idx, 0,
                                                  keepdims=False)]}
+
+
+@register_op("array_length")
+def array_length(ctx, ins, attrs):
+    """lod_array_length_op.cc. Dense tensor arrays are fixed-capacity
+    [max_len, ...] buffers (see array_write), so the runtime length is the
+    write cursor the loop carries — the buffer's own length is its static
+    capacity, returned here. While-loops that need the dynamic cursor
+    already carry it as a loop var (layers/control_flow.py While)."""
+    return {"Out": [jnp.asarray(ins["X"][0].shape[0], jnp.int32)]}

@@ -26,17 +26,19 @@ _ACT = {
 }
 
 
-@register_op("dynamic_lstm")
-def dynamic_lstm(ctx, ins, attrs):
-    """lstm_op.cc. Input [B,T,4H] (pre-projected x·W_x), Weight [H,4H]
-    recurrent, Bias [1,4H] (+[1,3H] peephole tail when use_peepholes).
-    Gate layout i,c,f,o per the reference kernel
-    (operators/math/detail/lstm_kernel.h). Outputs Hidden/Cell [B,T,H]."""
+def _lstm_scan(ins, attrs, w_proj=None, pact=None):
+    """Shared fused-LSTM scan (lstm_op.cc / lstmp_op.h): one lax.scan whose
+    carry is (recurrent_state, cell). For plain LSTM the recurrent state is
+    the hidden h [B,H]; for LSTMP it is the projection r = pact(h @ w_proj)
+    [B,P] (Sak et al. 2014). Gate layout i,c,f,o per the reference kernel
+    (operators/math/detail/lstm_kernel.h); rows past each sequence's length
+    hold their last valid state (stacked outputs are zero-masked)."""
     x = ins["Input"][0]
-    w = ins["Weight"][0].astype(x.dtype)
+    w = ins["Weight"][0].astype(x.dtype)   # [H,4H] | [P,4H]
     seq_len = ins["SeqLen"][0]
     B, T, H4 = x.shape
     H = H4 // 4
+    R = H if w_proj is None else w_proj.shape[1]   # recurrent-state width
     use_peep = attrs.get("use_peepholes", False)
     bias = ins["Bias"][0].astype(x.dtype) if ins.get("Bias") else None
     if bias is not None:
@@ -55,13 +57,19 @@ def dynamic_lstm(ctx, ins, attrs):
         xs = jnp.flip(xs, 0)
         mask = jnp.flip(mask, 0)
 
-    h0 = ins["H0"][0].astype(x.dtype) if ins.get("H0") else jnp.zeros((B, H), x.dtype)
+    if ins.get("H0"):
+        r0 = ins["H0"][0].astype(x.dtype)          # [B,H] (ref convention)
+        if w_proj is not None:
+            # lstmp_op.h:174-183: project the initial hidden state
+            r0 = pact(r0 @ w_proj)
+    else:
+        r0 = jnp.zeros((B, R), x.dtype)
     c0 = ins["C0"][0].astype(x.dtype) if ins.get("C0") else jnp.zeros((B, H), x.dtype)
 
     def step(carry, inp):
-        h, c = carry
+        r, c = carry
         xt, m = inp
-        gates = xt + h @ w
+        gates = xt + r @ w
         if b_gate is not None:
             gates = gates + b_gate
         gi, gc, gf, go = jnp.split(gates, 4, axis=-1)
@@ -76,16 +84,42 @@ def dynamic_lstm(ctx, ins, attrs):
         if use_peep:
             go = go + woc * c_new
         o = gact(go)
-        h_new = o * hact(c_new)
+        r_new = o * hact(c_new)
+        if w_proj is not None:
+            r_new = pact(r_new @ w_proj)
         m1 = m[:, None]
-        h_new = m1 * h_new + (1 - m1) * h
+        r_new = m1 * r_new + (1 - m1) * r
         c_new = m1 * c_new + (1 - m1) * c
-        return (h_new, c_new), (h_new * m1, c_new * m1)
+        return (r_new, c_new), (r_new * m1, c_new * m1)
 
-    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, mask))
+    (_, _), (rs, cs) = jax.lax.scan(step, (r0, c0), (xs, mask))
     if reverse:
-        hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
-    return {"Hidden": [jnp.moveaxis(hs, 0, 1)], "Cell": [jnp.moveaxis(cs, 0, 1)]}
+        rs, cs = jnp.flip(rs, 0), jnp.flip(cs, 0)
+    return jnp.moveaxis(rs, 0, 1), jnp.moveaxis(cs, 0, 1)
+
+
+@register_op("dynamic_lstm")
+def dynamic_lstm(ctx, ins, attrs):
+    """lstm_op.cc. Input [B,T,4H] (pre-projected x*W_x), Weight [H,4H]
+    recurrent, Bias [1,4H] (+[1,3H] peephole tail when use_peepholes).
+    Outputs Hidden/Cell [B,T,H]."""
+    hs, cs = _lstm_scan(ins, attrs)
+    return {"Hidden": [hs], "Cell": [cs]}
+
+
+@register_op("lstmp")
+def lstmp(ctx, ins, attrs):
+    """lstmp_op.cc/.h: LSTM with a recurrent projection layer (LSTMP, Sak
+    et al. 2014). Input [B,T,4H] pre-projected; recurrent Weight [P,4H]
+    acts on the PROJECTED state r; ProjWeight [H,P] maps cell-output h to
+    r = proj_act(h @ ProjWeight). H0 follows the reference convention of a
+    HIDDEN state [B,H], projected before the first step (lstmp_op.h:174).
+    Outputs Projection [B,T,P] and Cell [B,T,H]."""
+    x = ins["Input"][0]
+    w_proj = ins["ProjWeight"][0].astype(x.dtype)   # [H, P]
+    pact = _ACT[attrs.get("proj_activation", "tanh")]
+    rs, cs = _lstm_scan(ins, attrs, w_proj=w_proj, pact=pact)
+    return {"Projection": [rs], "Cell": [cs]}
 
 
 @register_op("dynamic_gru")

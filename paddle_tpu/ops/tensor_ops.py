@@ -479,3 +479,48 @@ def random_crop(ctx, ins, attrs):
     full_starts = [jnp.zeros((), jnp.int32)] * (ndim - crop_dims) + starts
     sizes = list(x.shape[:ndim - crop_dims]) + list(shape)
     return {"Out": [jax.lax.dynamic_slice(x, full_starts, sizes)]}
+
+
+def _pad_constant_like_infer(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape, out.dtype = x.shape, block.var(op.input("Y")[0]).dtype
+
+
+@register_op("pad_constant_like", infer_shape=_pad_constant_like_infer)
+def pad_constant_like(ctx, ins, attrs):
+    """pad_constant_like_op.cc: pad Y up to X's (larger) shape with
+    pad_value; a shape-driven variant of pad used by seq2seq decoders."""
+    x, y = ins["X"][0], ins["Y"][0]
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads,
+                            constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("split_ids")
+def split_ids(ctx, ins, attrs):
+    """split_ids_op.cc: route each id to shard id%N (the distributed
+    lookup-table dispatcher, distribute_transpiler.py:120-180). The
+    reference emits N variable-length LoD outputs; the dense redesign
+    keeps each output the full id shape with non-owned slots masked to
+    -1 — shard k's lookup gathers only rows it owns, matching the
+    vocab-sharded embedding design (docs/distributed_embedding.md)."""
+    ids = ins["Ids"][0]
+    n = int(attrs["num_shards"])
+    outs = [jnp.where(ids % n == k, ids, -1) for k in range(n)]
+    return {"Out": outs}
+
+
+@register_op("merge_ids")
+def merge_ids(ctx, ins, attrs):
+    """merge_ids_op: inverse of split_ids — merge per-shard embedding rows
+    back into the original id order. Ids is the original [N] id tensor;
+    Rows is the per-shard stack [num_shards, N, D] where shard k filled
+    only the slots it owns (others zero); output [N, D] sums the slots."""
+    if len(ins["Rows"]) > 1:
+        rows = jnp.stack(ins["Rows"], axis=0)      # N separate [N,D] shards
+    elif ins["Rows"][0].ndim == 3:
+        rows = ins["Rows"][0]                      # already-stacked [S, N, D]
+    else:
+        return {"Out": [ins["Rows"][0]]}           # single shard owns all ids
+    return {"Out": [rows.sum(axis=0)]}

@@ -1,0 +1,382 @@
+"""Straggler ops vs numpy goldens: lstmp, detection_map,
+polygon_box_transform, pad_constant_like, split_ids/merge_ids,
+array_length (≙ reference test_lstmp_op.py, test_detection_map_op.py,
+test_polygon_box_transform.py, test_pad_constant_like.py,
+test_split_ids_op.py, test_merge_ids_op.py — goldens re-derived for the
+dense-shape conventions).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(build, feed):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        outs = build()
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+    exe = pt.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=list(outs))
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestLSTMP:
+    def test_vs_numpy_loop(self):
+        rng = np.random.RandomState(7)
+        B, T, H, P = 2, 5, 4, 3
+        x = rng.randn(B, T, 4 * H).astype(np.float32) * 0.5
+        lens = np.full((B,), T, np.int32)
+
+        def build():
+            inp = layers.data("x", [4 * H], lod_level=1)
+            proj, cell = layers.dynamic_lstmp(inp, size=4 * H, proj_size=P,
+                                              use_peepholes=False)
+            return proj, cell
+
+        proj, cell = _run(build, {"x": x, "x@SEQ_LEN": lens})
+        assert proj.shape == (B, T, P) and cell.shape == (B, T, H)
+
+        # pull the initialized weights back out to drive the numpy loop
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            inp = layers.data("x", [4 * H], lod_level=1)
+            pvar, cvar = layers.dynamic_lstmp(inp, size=4 * H, proj_size=P,
+                                              use_peepholes=False)
+        params = [v for v in main.global_block.vars.values()
+                  if getattr(v, "is_parameter", False)]
+        w_name = next(v.name for v in params if tuple(v.shape) == (P, 4 * H))
+        wp_name = next(v.name for v in params if tuple(v.shape) == (H, P))
+        b_name = next(v.name for v in params if tuple(v.shape) == (1, 4 * H))
+        exe = pt.Executor()
+        exe.run(startup)
+        proj, w, wp, b = exe.run(main, feed={"x": x, "x@SEQ_LEN": lens},
+                                 fetch_list=[pvar, w_name, wp_name, b_name])
+
+        r = np.zeros((B, P), np.float32)
+        c = np.zeros((B, H), np.float32)
+        want = np.zeros((B, T, P), np.float32)
+        for t in range(T):
+            gates = x[:, t] + r @ w + b.reshape(-1)
+            gi, gc, gf, go = np.split(gates, 4, axis=-1)
+            i, f, o = _sigmoid(gi), _sigmoid(gf), _sigmoid(go)
+            c = f * c + i * np.tanh(gc)
+            h = o * np.tanh(c)
+            r = np.tanh(h @ wp)
+            want[:, t] = r
+        np.testing.assert_allclose(proj, want, rtol=2e-5, atol=2e-5)
+
+    def test_h0_is_hidden_sized_and_projected(self):
+        # reference convention (lstmp_op.h:174): H0 is [B, H] and is run
+        # through proj_act(H0 @ ProjWeight) before the first step
+        rng = np.random.RandomState(9)
+        B, T, H, P = 2, 3, 4, 3
+        x = rng.randn(B, T, 4 * H).astype(np.float32) * 0.3
+        lens = np.full((B,), T, np.int32)
+        h0 = rng.randn(B, H).astype(np.float32)
+
+        def build(with_h0):
+            inp = layers.data("x", [4 * H], lod_level=1)
+            kw = {}
+            if with_h0:
+                h = layers.data("h0", [H])
+                h.stop_gradient = True
+                kw["h_0"] = h
+            proj, _ = layers.dynamic_lstmp(inp, size=4 * H, proj_size=P,
+                                           use_peepholes=False, **kw)
+            return proj
+
+        feed = {"x": x, "x@SEQ_LEN": lens, "h0": h0}
+        (with_h0,) = _run(lambda: build(True), feed)
+        (without,) = _run(lambda: build(False), {"x": x, "x@SEQ_LEN": lens})
+        assert with_h0.shape == (B, T, P)
+        assert np.abs(with_h0 - without).max() > 1e-4
+
+    def test_trains(self):
+        rng = np.random.RandomState(0)
+        B, T, H, P = 4, 6, 8, 5
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            inp = layers.data("x", [4 * H], lod_level=1)
+            label = layers.data("y", [1], dtype="int64")
+            proj, _ = layers.dynamic_lstmp(inp, size=4 * H, proj_size=P)
+            last = layers.sequence_last_step(proj)
+            logits = layers.fc(last, size=2)
+            loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = {"x": rng.randn(B, T, 4 * H).astype(np.float32),
+                "x@SEQ_LEN": np.full((B,), T, np.int32),
+                "y": rng.randint(0, 2, (B, 1)).astype(np.int64)}
+        losses = [exe.run(main, feed=feed, fetch_list=[loss])[0] for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+
+class TestPolygonBoxTransform:
+    def test_golden(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 8, 3, 4).astype(np.float32)
+
+        def build():
+            inp = layers.data("x", [8, 3, 4])
+            return layers.polygon_box_transform(inp)
+
+        (out,) = _run(build, {"x": x})
+        want = np.empty_like(x)
+        for n in range(2):
+            for ch in range(8):
+                for r in range(3):
+                    for cl in range(4):
+                        base = cl if (n * 8 + ch) % 2 == 0 else r
+                        want[n, ch, r, cl] = base - x[n, ch, r, cl]
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    def test_odd_channel_parity(self):
+        # odd geo_channel count: the reference's (n*G+g)%2 parity flips the
+        # x/y role between consecutive batch items
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 3, 2, 5).astype(np.float32)
+
+        def build():
+            inp = layers.data("x", [3, 2, 5])
+            return layers.polygon_box_transform(inp)
+
+        (out,) = _run(build, {"x": x})
+        want = np.empty_like(x)
+        for n in range(2):
+            for ch in range(3):
+                for r in range(2):
+                    for cl in range(5):
+                        base = cl if (n * 3 + ch) % 2 == 0 else r
+                        want[n, ch, r, cl] = base - x[n, ch, r, cl]
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def _np_detection_map(det, gt, class_num, thresh=0.5, eval_difficult=True,
+                      ap_type="integral"):
+    """Brute-force reference-semantics mAP (detection_map_op.h)."""
+    B = det.shape[0]
+    npos = np.zeros(class_num)
+    per_class = {c: [] for c in range(class_num)}
+    for b in range(B):
+        g = gt[b]
+        gv = g[:, 0] >= 0
+        for j in np.where(gv)[0]:
+            if eval_difficult or g[j, 1] < 0.5:
+                npos[int(g[j, 0])] += 1
+        d = det[b]
+        rows = [i for i in range(d.shape[0]) if d[i, 0] >= 0]
+        rows.sort(key=lambda i: -d[i, 1])
+        visited = np.zeros(g.shape[0], bool)
+        for i in rows:
+            c = int(d[i, 0])
+            box = np.clip(d[i, 2:6], 0.0, 1.0)
+            best, bj = -1.0, -1
+            for j in np.where(gv & (g[:, 0] == c))[0]:
+                gb = g[j, 2:6]
+                ix0, iy0 = max(box[0], gb[0]), max(box[1], gb[1])
+                ix1, iy1 = min(box[2], gb[2]), min(box[3], gb[3])
+                if ix1 < ix0 or iy1 < iy0:
+                    iou = 0.0
+                else:
+                    inter = (ix1 - ix0) * (iy1 - iy0)
+                    a1 = (box[2] - box[0]) * (box[3] - box[1])
+                    a2 = (gb[2] - gb[0]) * (gb[3] - gb[1])
+                    iou = inter / (a1 + a2 - inter)
+                if iou > best:
+                    best, bj = iou, j
+            if best > thresh:
+                if not eval_difficult and g[bj, 1] >= 0.5:
+                    continue  # skipped entirely
+                if not visited[bj]:
+                    per_class[c].append((d[i, 1], 1))
+                    visited[bj] = True
+                else:
+                    per_class[c].append((d[i, 1], 0))
+            else:
+                per_class[c].append((d[i, 1], 0))
+    aps = []
+    for c in range(class_num):
+        if npos[c] == 0 or not per_class[c]:
+            continue
+        rows = sorted(per_class[c], key=lambda p: -p[0])
+        tp = np.cumsum([r[1] for r in rows])
+        fp = np.cumsum([1 - r[1] for r in rows])
+        prec = tp / np.maximum(tp + fp, 1e-9)
+        rec = tp / npos[c]
+        if ap_type == "11point":
+            ap = np.mean([prec[rec >= t].max() if (rec >= t).any() else 0.0
+                          for t in np.linspace(0, 1, 11)])
+        else:
+            ap, prev = 0.0, 0.0
+            for p, r in zip(prec, rec):
+                ap += p * (r - prev)
+                prev = r
+        aps.append(ap)
+    return float(np.mean(aps)) if aps else 0.0
+
+
+class TestDetectionMAP:
+    def _case(self, seed, class_num=3, B=2, D=6, G=4):
+        rng = np.random.RandomState(seed)
+        det = np.zeros((B, D, 6), np.float32)
+        gt = np.zeros((B, G, 6), np.float32)
+        for b in range(B):
+            nd = rng.randint(1, D + 1)
+            ng = rng.randint(1, G + 1)
+            det[b, :, 0] = -1
+            gt[b, :, 0] = -1
+            for i in range(nd):
+                x0, y0 = rng.rand(2) * 0.6
+                det[b, i] = [rng.randint(0, class_num), rng.rand(),
+                             x0, y0, x0 + 0.1 + rng.rand() * 0.3,
+                             y0 + 0.1 + rng.rand() * 0.3]
+            for j in range(ng):
+                x0, y0 = rng.rand(2) * 0.6
+                gt[b, j] = [rng.randint(0, class_num), rng.rand() < 0.3,
+                            x0, y0, x0 + 0.1 + rng.rand() * 0.3,
+                            y0 + 0.1 + rng.rand() * 0.3]
+        return det, gt
+
+    @pytest.mark.parametrize("ap_type", ["integral", "11point"])
+    @pytest.mark.parametrize("eval_difficult", [True, False])
+    def test_vs_bruteforce(self, ap_type, eval_difficult):
+        class_num = 3
+        det, gt = self._case(11, class_num)
+
+        def build():
+            d = layers.data("det", list(det.shape[1:]))
+            g = layers.data("gt", list(gt.shape[1:]))
+            return layers.detection_map(d, g, class_num,
+                                        background_label=-1,
+                                        evaluate_difficult=eval_difficult,
+                                        ap_version=ap_type)
+
+        (got,) = _run(build, {"det": det, "gt": gt})
+        want = _np_detection_map(det, gt, class_num,
+                                 eval_difficult=eval_difficult,
+                                 ap_type=ap_type)
+        np.testing.assert_allclose(got, [want], rtol=1e-5, atol=1e-6)
+
+    def test_perfect_detections(self):
+        class_num = 2
+        gt = np.zeros((1, 2, 6), np.float32)
+        gt[0, 0] = [0, 0, 0.1, 0.1, 0.4, 0.4]
+        gt[0, 1] = [1, 0, 0.5, 0.5, 0.9, 0.9]
+        det = np.zeros((1, 2, 6), np.float32)
+        det[0, 0] = [0, 0.9, 0.1, 0.1, 0.4, 0.4]
+        det[0, 1] = [1, 0.8, 0.5, 0.5, 0.9, 0.9]
+
+        def build():
+            d = layers.data("det", [2, 6])
+            g = layers.data("gt", [2, 6])
+            return layers.detection_map(d, g, class_num,
+                                        background_label=-1)
+
+        (got,) = _run(build, {"det": det, "gt": gt})
+        np.testing.assert_allclose(got, [1.0], atol=1e-6)
+
+    def test_background_label_excluded(self):
+        # class 0 = background: a wrong class-0 detection must not drag
+        # the mAP down once background_label=0 (the default) excludes it
+        class_num = 2
+        gt = np.zeros((1, 2, 6), np.float32)
+        gt[0, 0] = [0, 0, 0.1, 0.1, 0.4, 0.4]
+        gt[0, 1] = [1, 0, 0.5, 0.5, 0.9, 0.9]
+        det = np.zeros((1, 2, 6), np.float32)
+        det[0, 0] = [0, 0.9, 0.6, 0.6, 0.8, 0.8]   # class-0 FP
+        det[0, 1] = [1, 0.8, 0.5, 0.5, 0.9, 0.9]   # class-1 perfect
+
+        def build():
+            d = layers.data("det", [2, 6])
+            g = layers.data("gt", [2, 6])
+            return layers.detection_map(d, g, class_num, background_label=0)
+
+        (got,) = _run(build, {"det": det, "gt": gt})
+        np.testing.assert_allclose(got, [1.0], atol=1e-6)
+
+
+class TestPadConstantLike:
+    def test_golden(self):
+        x = np.zeros((2, 5, 4), np.float32)
+        y = np.arange(2 * 3 * 2, dtype=np.float32).reshape(2, 3, 2)
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            xv = layers.data("x", [5, 4])
+            yv = layers.data("y", [3, 2])
+            helper = pt.LayerHelper("pad_constant_like")
+            out = helper.create_tmp_variable("float32")
+            helper.append_op("pad_constant_like", {"X": xv, "Y": yv},
+                             {"Out": out}, {"pad_value": 7.0})
+        exe = pt.Executor()
+        exe.run(startup)
+        (got,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=[out])
+        want = np.full((2, 5, 4), 7.0, np.float32)
+        want[:, :3, :2] = y
+        np.testing.assert_allclose(got, want)
+
+
+class TestSplitMergeIds:
+    def test_round_trip(self):
+        rng = np.random.RandomState(5)
+        n_shards, N, D = 3, 8, 4
+        ids = rng.randint(0, 30, (N,)).astype(np.int64)
+        table = rng.randn(30, D).astype(np.float32)
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            iv = layers.data("ids", [N], dtype="int64",
+                             append_batch_size=False)
+            helper = pt.LayerHelper("split_ids")
+            shards = [helper.create_tmp_variable("int64")
+                      for _ in range(n_shards)]
+            helper.append_op("split_ids", {"Ids": iv}, {"Out": shards},
+                             {"num_shards": n_shards})
+        exe = pt.Executor()
+        exe.run(startup)
+        outs = exe.run(main, feed={"ids": ids}, fetch_list=list(shards))
+        # each shard holds exactly the ids it owns, -1 elsewhere
+        for k, got in enumerate(outs):
+            want = np.where(ids % n_shards == k, ids, -1)
+            np.testing.assert_array_equal(got, want)
+
+        # merge: per-shard gathered rows (zeros for non-owned) sum back
+        rows = np.stack([np.where((ids % n_shards == k)[:, None],
+                                  table[ids], 0.0)
+                         for k in range(n_shards)])
+        main2, startup2 = pt.Program(), pt.Program()
+        with pt.program_guard(main2, startup2):
+            rv = layers.data("rows", [n_shards, N, D],
+                             append_batch_size=False)
+            helper = pt.LayerHelper("merge_ids")
+            merged = helper.create_tmp_variable("float32")
+            helper.append_op("merge_ids", {"Rows": rv}, {"Out": merged}, {})
+        exe2 = pt.Executor()
+        exe2.run(startup2)
+        (got,) = exe2.run(main2, feed={"rows": rows.astype(np.float32)},
+                          fetch_list=[merged])
+        np.testing.assert_allclose(got, table[ids], rtol=1e-6)
+
+
+class TestArrayLength:
+    def test_capacity(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            arr = layers.create_array("float32", max_len=7,
+                                      element_shape=(2,))
+            helper = pt.LayerHelper("array_length")
+            n = helper.create_tmp_variable("int32")
+            helper.append_op("array_length", {"X": arr}, {"Out": n}, {})
+        exe = pt.Executor()
+        exe.run(startup)
+        (got,) = exe.run(main, feed={}, fetch_list=[n])
+        assert int(np.asarray(got).reshape(())) == 7
