@@ -16,9 +16,11 @@ Measurement notes (evidence gathered on the v5e-via-tunnel rig, round 2):
     unroll=2 halves it.
   * device→host bandwidth is ~15 MB/s: fetch scalars only.
   * ResNet-50 bs128 bf16 is HBM-bandwidth-bound on one chip (XLA cost
-    analysis: 42 GB accessed/step ÷ 819 GB/s ≈ 51 ms floor; measured 46 ms
-    device time), so its MFU ceiling is ~17-18%, not the 45% north star —
-    NCHW vs NHWC was measured a wash (XLA canonicalizes conv layouts).
+    analysis: 42 GB accessed/step ÷ 819 GB/s ≈ 51 ms floor; measured ~54 ms
+    device time at 300-step windows), so its MFU ceiling is ~17-18%, not
+    the 45% north star — NCHW vs NHWC was measured a wash (XLA
+    canonicalizes conv layouts). The compute-bound MFU story is the
+    transformer config below (41.8% measured on the same chip).
 """
 
 from __future__ import annotations
@@ -77,7 +79,10 @@ def bench_resnet(on_tpu):
     from paddle_tpu.models import resnet
     batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 4))
     image = 224 if on_tpu else 32
-    steps = int(os.environ.get("BENCH_STEPS", 100 if on_tpu else 2))
+    # 300-step windows: the ~1.5 s fixed window cost (dispatch + fetch sync
+    # on this fabric) drops from ~15 ms/step at 100 steps to ~5 ms/step
+    # (measured 69.3 -> 59.3 ms/batch, 11.5% -> 13.5% MFU)
+    steps = int(os.environ.get("BENCH_STEPS", 300 if on_tpu else 2))
     dtype = "bfloat16" if on_tpu else "float32"
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):

@@ -78,6 +78,13 @@ def sums(input, out=None):
     if out is None:
         out = helper.create_tmp_variable(input[0].dtype)
     helper.append_op("sum", {"X": list(input)}, {"Out": out})
+    # summing per-timestep features keeps raggedness (reference: sum_op
+    # shares the inputs' LoD) — propagate the @SEQ_LEN companion
+    from .sequence import propagate_seq
+    for x in input:
+        if getattr(x, "seq_len_var", None):
+            propagate_seq(x, out)
+            break
     return out
 
 

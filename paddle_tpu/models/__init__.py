@@ -1,7 +1,9 @@
-"""Benchmark model zoo.
+"""Benchmark + book model zoo.
 
 ≙ reference benchmark/fluid/models/{mnist,resnet,vgg,stacked_dynamic_lstm,
-machine_translation}.py — the five north-star configs (BASELINE.md).
+machine_translation}.py — the five north-star configs (BASELINE.md) — plus
+book models with no benchmark config (label_semantic_roles) and the
+transformer LM showpiece.
 """
 
 from . import mnist, resnet, vgg

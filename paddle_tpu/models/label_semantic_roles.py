@@ -1,0 +1,95 @@
+"""Semantic role labeling: 8-feature deep bidirectional LSTM + CRF.
+
+≙ reference tests/book/test_label_semantic_roles.py (db_lstm, :51-115):
+six context-window word slots + predicate + mark are embedded (the six
+word slots SHARE one embedding table, param 'emb'), mixed into a hidden
+layer by per-slot tanh fc's summed together, then an 8-deep stack of
+alternating forward/backward LSTMs with direct edges (each level sums a
+projection of the previous mix and the previous LSTM), ending in a
+linear-chain CRF over the label vocabulary (conll05 data).
+
+All sequence slots are ragged (lod_level=1); every LSTM runs as one
+lax.scan over the padded [B, T, ...] batch with length masking.
+"""
+
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+WORD_SLOTS = ["word_data", "ctx_n2_data", "ctx_n1_data", "ctx_0_data",
+              "ctx_p1_data", "ctx_p2_data"]
+# feeder slot order ≙ the reference's feed_list
+# (test_label_semantic_roles.py:170-173)
+FEED_ORDER = WORD_SLOTS + ["verb_data", "mark_data", "target"]
+
+
+def db_lstm(word_dict_len, label_dict_len, pred_dict_len, word_dim=32,
+            mark_dim=5, hidden_dim=512, depth=8, mark_dict_len=2,
+            embedding_trainable=False):
+    """Build the feature network; returns the CRF-input emission scores
+    [B, T, label_dict_len] (≙ db_lstm, test_label_semantic_roles.py:51)."""
+    word_slots = [layers.data(n, [1], dtype="int64", lod_level=1)
+                  for n in WORD_SLOTS]
+    predicate = layers.data("verb_data", [1], dtype="int64", lod_level=1)
+    mark = layers.data("mark_data", [1], dtype="int64", lod_level=1)
+
+    predicate_emb = layers.embedding(predicate, [pred_dict_len, word_dim],
+                                     param_attr=ParamAttr(name="vemb"))
+    mark_emb = layers.embedding(mark, [mark_dict_len, mark_dim])
+    # the six word-feature slots share one table (param 'emb'), frozen by
+    # default as in the reference (it is loaded from pretrained wordvecs)
+    emb_layers = [layers.embedding(
+        w, [word_dict_len, word_dim],
+        param_attr=ParamAttr(name="emb", trainable=embedding_trainable))
+        for w in word_slots]
+    emb_layers += [predicate_emb, mark_emb]
+
+    hidden_0 = layers.sums([layers.fc(emb, size=hidden_dim, act="tanh")
+                            for emb in emb_layers])
+    # size = 4*units (fluid convention): the reference passes
+    # size=hidden_dim, so each LSTM has hidden_dim/4 units
+    lstm_0, _ = layers.dynamic_lstm(hidden_0, size=hidden_dim,
+                                    candidate_activation="relu",
+                                    gate_activation="sigmoid",
+                                    cell_activation="sigmoid",
+                                    use_peepholes=True)
+
+    # stacked L/R LSTMs with direct edges
+    input_tmp = [hidden_0, lstm_0]
+    for i in range(1, depth):
+        mix_hidden = layers.sums([
+            layers.fc(input_tmp[0], size=hidden_dim, act="tanh"),
+            layers.fc(input_tmp[1], size=hidden_dim, act="tanh")])
+        lstm, _ = layers.dynamic_lstm(mix_hidden, size=hidden_dim,
+                                      candidate_activation="relu",
+                                      gate_activation="sigmoid",
+                                      cell_activation="sigmoid",
+                                      use_peepholes=True,
+                                      is_reverse=(i % 2 == 1))
+        input_tmp = [mix_hidden, lstm]
+
+    feature_out = layers.sums([
+        layers.fc(input_tmp[0], size=label_dict_len, act="tanh"),
+        layers.fc(input_tmp[1], size=label_dict_len, act="tanh")])
+    return feature_out
+
+
+def train_net(word_dict_len, label_dict_len, pred_dict_len, word_dim=32,
+              mark_dim=5, hidden_dim=512, depth=8, mix_hidden_lr=1e-3,
+              embedding_trainable=False):
+    """≙ train() topology (test_label_semantic_roles.py:119-146): db_lstm
+    emissions + linear_chain_crf cost, sharing the 'crfw' transition with
+    crf_decoding. Returns (avg_cost, crf_decode path)."""
+    feature_out = db_lstm(word_dict_len, label_dict_len, pred_dict_len,
+                          word_dim=word_dim, mark_dim=mark_dim,
+                          hidden_dim=hidden_dim, depth=depth,
+                          embedding_trainable=embedding_trainable)
+    target = layers.data("target", [1], dtype="int64", lod_level=1)
+    crf_cost = layers.linear_chain_crf(
+        feature_out, target,
+        param_attr=ParamAttr(name="crfw", learning_rate=mix_hidden_lr))
+    avg_cost = layers.mean(crf_cost)
+    crf_decode = layers.crf_decoding(feature_out,
+                                     param_attr=ParamAttr(name="crfw"))
+    return avg_cost, crf_decode

@@ -1,0 +1,100 @@
+"""SE-ResNeXt: grouped-convolution ResNeXt bottlenecks with
+squeeze-excitation channel gating.
+
+≙ reference test_parallel_executor_seresnext.py (SE_ResNeXt50Small,
+squeeze_excitation :21, bottleneck_block :66) — the second model named in
+the BASELINE north-star metric ("images/sec/chip + MFU on
+ResNet-50/SE-ResNeXt"). Grouped 3x3 convs lower to XLA's
+feature_group_count path (one MXU-batched conv, no per-group loop); the
+SE gate is two tiny fc's on globally-pooled channels.
+"""
+
+from __future__ import annotations
+
+from .. import layers
+
+
+def squeeze_excitation(input, num_channels, reduction_ratio):
+    """test_parallel_executor_seresnext.py:21: global-avg-pool the spatial
+    dims, bottleneck fc (relu) then expand fc (sigmoid), scale channels."""
+    shape = input.shape
+    reshaped = layers.reshape(input, [-1, shape[1], shape[2] * shape[3]])
+    pool = layers.reduce_mean(reshaped, dim=2)          # [B, C]
+    squeeze = layers.fc(pool, size=max(num_channels // reduction_ratio, 1),
+                        act="relu")
+    excitation = layers.fc(squeeze, size=num_channels, act="sigmoid")
+    return layers.elementwise_mul(input, excitation, axis=0)
+
+
+def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
+                  act=None):
+    conv = layers.conv2d(input, num_filters=num_filters,
+                         filter_size=filter_size, stride=stride,
+                         padding=(filter_size - 1) // 2, groups=groups,
+                         bias_attr=False)
+    return layers.batch_norm(conv, act=act, momentum=0.1)
+
+
+def shortcut(input, ch_out, stride):
+    ch_in = input.shape[1]
+    if ch_in != ch_out:
+        filter_size = 1 if stride == 1 else 3
+        return conv_bn_layer(input, ch_out, filter_size, stride)
+    return input
+
+
+def bottleneck_block(input, num_filters, stride, cardinality,
+                     reduction_ratio):
+    """1x1 reduce -> grouped 3x3 -> 1x1 -> SE gate, residual add.
+    The reference halves the first 1x1's width to cut compute
+    (test_parallel_executor_seresnext.py:66)."""
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu")
+    conv1 = conv_bn_layer(conv0, num_filters * 2, 3, stride=stride,
+                          groups=cardinality, act="relu")
+    conv2 = conv_bn_layer(conv1, num_filters * 2, 1, act=None)
+    scale = squeeze_excitation(conv2, num_filters * 2, reduction_ratio)
+    short = shortcut(input, num_filters * 2, stride)
+    return layers.elementwise_add(short, scale, act="relu")
+
+
+def se_resnext_net(img, class_dim=1000, cardinality=32, reduction_ratio=16,
+                   depth=(3, 4, 6, 3), num_filters=(128, 256, 512, 1024),
+                   stem_filters=16, dropout_prob=0.2):
+    """The SE_ResNeXt-50 trunk (small stem variant, per the reference
+    test model). Returns softmax predictions [B, class_dim]."""
+    conv = conv_bn_layer(img, stem_filters, 3, stride=2, act="relu")
+    conv = conv_bn_layer(conv, stem_filters, 3, stride=1, act="relu")
+    conv = conv_bn_layer(conv, stem_filters, 3, stride=1, act="relu")
+    conv = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1,
+                         pool_type="max")
+    for block, d in enumerate(depth):
+        for i in range(d):
+            conv = bottleneck_block(
+                conv, num_filters[block],
+                stride=2 if i == 0 and block != 0 else 1,
+                cardinality=cardinality, reduction_ratio=reduction_ratio)
+    shape = conv.shape
+    reshaped = layers.reshape(conv, [-1, shape[1], shape[2] * shape[3]])
+    pool = layers.reduce_mean(reshaped, dim=2)
+    dropped = layers.dropout(pool, dropout_prob=dropout_prob)
+    return layers.fc(dropped, size=class_dim, act="softmax")
+
+
+def get_model(batch_size=None, class_dim=1000, image_size=224,
+              cardinality=32, reduction_ratio=16, depth=(3, 4, 6, 3),
+              num_filters=(128, 256, 512, 1024), dropout_prob=0.2,
+              dtype="float32"):
+    """Feedable training net (the reference test hardwires fill_constant
+    inputs; real feeds are strictly more capable). Returns
+    (avg_cost, accuracy, predictions, feed names)."""
+    img = layers.data("data", [3, image_size, image_size], dtype=dtype)
+    label = layers.data("label", [1], dtype="int64")
+    predict = se_resnext_net(img, class_dim=class_dim,
+                             cardinality=cardinality,
+                             reduction_ratio=reduction_ratio, depth=depth,
+                             num_filters=num_filters,
+                             dropout_prob=dropout_prob)
+    cost = layers.cross_entropy(predict, label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(predict, label)
+    return avg_cost, acc, predict, ["data", "label"]

@@ -596,7 +596,17 @@ def detection_map(ctx, ins, attrs):
 
     mAP averages classes that have >=1 countable gt box AND >=1 scored
     detection — the reference's behavior (classes absent from its
-    true_pos map are skipped, detection_map_op.h:421-424)."""
+    true_pos map are skipped, detection_map_op.h:422-424).
+
+    DELIBERATE DIVERGENCE (recorded in docs/design_decisions.md): the
+    background class is excluded from the mean by INDEX. The reference's
+    background check compares a class's positive COUNT to the label id
+    (`label_num_pos == background_label`, detection_map_op.h:421) — a
+    comparison that can never fire for classes in its map — so it
+    effectively never excludes background. Here background_label behaves
+    as it does in the sibling ops (multiclass_nms, ssd_loss): class ==
+    background_label never enters the mean; pass background_label=-1 for
+    the reference's include-everything behavior."""
     det = ins["DetectRes"][0].astype(jnp.float32)     # [B, D, 6]
     gt = ins["Label"][0].astype(jnp.float32)          # [B, G, 5|6]
     thresh = float(attrs.get("overlap_threshold", 0.5))

@@ -1,0 +1,61 @@
+"""label_semantic_roles book model e2e (≙ reference
+tests/book/test_label_semantic_roles.py): 8 ragged feature slots ->
+shared-table embeddings -> 8-deep alternating-direction LSTM stack ->
+linear-chain CRF; trains until the cost falls, then decodes."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models import label_semantic_roles as srl
+
+WORD_DICT, LABEL_DICT, PRED_DICT = 60, 7, 12
+
+
+def _batch(rng, n=4, tmax=6):
+    lens = rng.randint(2, tmax + 1, size=n)
+    feed = {}
+    for slot in srl.WORD_SLOTS:
+        feed[slot] = [rng.randint(0, WORD_DICT, (t, 1)).astype(np.int64)
+                      for t in lens]
+    feed["verb_data"] = [rng.randint(0, PRED_DICT, (t, 1)).astype(np.int64)
+                         for t in lens]
+    feed["mark_data"] = [rng.randint(0, 2, (t, 1)).astype(np.int64)
+                         for t in lens]
+    feed["target"] = [rng.randint(0, LABEL_DICT, (t, 1)).astype(np.int64)
+                      for t in lens]
+    return feed
+
+
+class TestLabelSemanticRoles:
+    def test_trains_and_decodes(self):
+        rng = np.random.RandomState(0)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            avg_cost, crf_decode = srl.train_net(
+                WORD_DICT, LABEL_DICT, PRED_DICT, word_dim=8, mark_dim=4,
+                hidden_dim=16, depth=8, embedding_trainable=True)
+            opt = pt.optimizer.SGDOptimizer(
+                learning_rate=pt.layers.exponential_decay(
+                    learning_rate=0.01, decay_steps=100000, decay_rate=0.5,
+                    staircase=True))
+            opt.minimize(avg_cost)
+
+        # the six word slots share ONE embedding table named 'emb'
+        emb_params = [v for v in main.global_block.vars.values()
+                      if v.name == "emb"]
+        assert len(emb_params) == 1
+
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = _batch(rng)
+        costs = [float(np.asarray(
+            exe.run(main, feed=feed, fetch_list=[avg_cost])[0]).reshape(()))
+            for _ in range(12)]
+        assert np.isfinite(costs).all()
+        assert costs[-1] < costs[0]
+
+        # decode path shares the trained 'crfw' transition
+        (path,) = exe.run(main, feed=feed, fetch_list=[crf_decode])
+        assert path.shape[0] == 4
+        assert (np.asarray(path) >= 0).all()
+        assert (np.asarray(path) < LABEL_DICT).all()
