@@ -145,11 +145,58 @@ class LoDTensor:
                 _round_up(int(lens.max() if len(lens) else 1), pad_multiple))
         B = len(sequences)
         tail = sequences[0].shape[1:] if B else ()
-        out = np.full((B, T) + tuple(tail), pad_value,
-                      sequences[0].dtype if B else np.float32)
+        dtype = sequences[0].dtype if B else np.float32
+        native = _pack_rows_native(sequences, lens, T, tail, dtype, pad_value)
+        if native is not None:
+            return native
+        out = np.full((B, T) + tuple(tail), pad_value, dtype)
         for i, s in enumerate(sequences):
             out[i, :len(s)] = s
         return out, lens
+
+
+def _pack_rows_native(sequences, lens, T, tail, dtype, pad_value):
+    """One-call native pack (native/batcher.cpp pack_rows, ≙ the
+    reference's native sequence2batch host layer). Returns (out, lens) or
+    None to fall back to the Python loop (no toolchain, or rows that are
+    not plain contiguous same-dtype arrays)."""
+    import ctypes
+    if not sequences:
+        return None
+    from .native import batcher_lib
+    lib = batcher_lib()
+    if lib is None:
+        return None
+    dtype = np.dtype(dtype)
+    pad_elem = np.asarray(pad_value, dtype)
+    if dtype == object or pad_elem.ndim != 0:
+        return None  # non-scalar pad patterns: np.full broadcast semantics
+    tail = tuple(tail)
+    for s in sequences:
+        # the C side memcpys len*step_bytes straight from each row buffer:
+        # every guarantee (dtype, tail shape, contiguity) must hold here,
+        # anything else takes the Python loop
+        if (not isinstance(s, np.ndarray) or s.dtype != dtype
+                or s.shape[1:] != tail
+                or not s.flags["C_CONTIGUOUS"]):
+            return None
+    step_bytes = int(np.prod(tail, dtype=np.int64)) * dtype.itemsize
+    if step_bytes <= 0:
+        return None
+    B = len(sequences)
+    out = np.empty((B, T) + tail, dtype)
+    out_lens = np.empty((B,), np.int32)
+    row_ptrs = (ctypes.c_void_p * B)(
+        *[s.ctypes.data_as(ctypes.c_void_p).value for s in sequences])
+    lens64 = np.asarray(lens, np.int64)
+    rc = lib.pack_rows(
+        row_ptrs, lens64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        B, T, step_bytes, pad_elem.ctypes.data_as(ctypes.c_void_p),
+        dtype.itemsize, out.ctypes.data_as(ctypes.c_void_p),
+        out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        return None
+    return out, out_lens
 
 
 def pad_sequences(seqs: Sequence, dtype=None, pad_multiple: int = 8,

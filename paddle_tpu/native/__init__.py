@@ -72,3 +72,19 @@ def recordio_lib():
         lib.rio_scanner_close.argtypes = [ctypes.c_void_p]
         lib._rio_configured = True
     return lib
+
+def batcher_lib():
+    lib = load_library("batcher")
+    if lib is not None and not getattr(lib, "_batcher_configured", False):
+        lib.pack_rows.restype = ctypes.c_int
+        lib.pack_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),        # rows
+            ctypes.POINTER(ctypes.c_int64),         # lens
+            ctypes.c_int64, ctypes.c_int64,         # n, t_max
+            ctypes.c_int64,                         # step_bytes
+            ctypes.c_void_p, ctypes.c_int64,        # pad, pad_bytes
+            ctypes.c_void_p,                        # out
+            ctypes.POINTER(ctypes.c_int32),         # out_lens
+        ]
+        lib._batcher_configured = True
+    return lib

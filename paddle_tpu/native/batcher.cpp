@@ -1,0 +1,55 @@
+// Ragged-sequence batcher: pack N variable-length rows into one padded
+// [B, T, ...] buffer + a lengths vector, in a single native call.
+//
+// ≙ the reference's native sequence packing layer
+// (operators/math/sequence2batch.h CopyMatrixRowsFunctor,
+// lod_tensor.cc SplitLoDTensor/MergeLoDTensor): the host-side step that
+// turns LoD-ragged user data into device-shaped batches. The TPU data
+// plane keeps batch-major padded layout (scan kernels mask by length,
+// ops/rnn_ops.py) so there is no time-major reorder here — just the pack,
+// which on the feed hot path (executor._prep_feed -> lod.to_padded) is
+// one C call instead of a Python loop of numpy slice assignments.
+//
+// Flat C API via ctypes (see native/__init__.py batcher_lib).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// rows:      n pointers, row i holds lens[i] contiguous timesteps
+// lens:      timestep counts per row
+// step_bytes: bytes per timestep (product of trailing dims * itemsize)
+// t_max:     padded timestep capacity (caller rounds up / buckets)
+// pad:       pad pattern of pad_bytes (repeated to fill the tail);
+//            pad_bytes must divide step_bytes; NULL -> zero fill
+// out:       n * t_max * step_bytes destination
+// out_lens:  n int32 lengths (written)
+// returns 0 on success, -1 if any lens[i] > t_max or pad_bytes invalid
+int pack_rows(const void** rows, const int64_t* lens, int64_t n,
+              int64_t t_max, int64_t step_bytes, const void* pad,
+              int64_t pad_bytes, void* out, int32_t* out_lens) {
+  if (pad != nullptr && (pad_bytes <= 0 || step_bytes % pad_bytes != 0))
+    return -1;
+  char* dst = static_cast<char*>(out);
+  const int64_t row_cap = t_max * step_bytes;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t len = lens[i];
+    if (len > t_max) return -1;
+    const int64_t used = len * step_bytes;
+    std::memcpy(dst, rows[i], used);
+    char* tail = dst + used;
+    const int64_t tail_bytes = row_cap - used;
+    if (pad == nullptr) {
+      std::memset(tail, 0, tail_bytes);
+    } else {
+      for (int64_t off = 0; off < tail_bytes; off += pad_bytes)
+        std::memcpy(tail + off, pad, pad_bytes);
+    }
+    out_lens[i] = static_cast<int32_t>(len);
+    dst += row_cap;
+  }
+  return 0;
+}
+
+}  // extern "C"

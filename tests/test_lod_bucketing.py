@@ -155,3 +155,53 @@ class TestBucketing:
         # executor cache: one compile per distinct (bucket, batch-size)
         # pair; full batches come from <=3 buckets (+ tail batches)
         assert len(exe._cache) <= 7, len(exe._cache)
+
+class TestNativeBatcher:
+    """native/batcher.cpp pack_rows vs the Python padding loop (≙ the
+    reference's native sequence2batch host layer)."""
+
+    def _python_pad(self, seqs, T, pad_value):
+        B = len(seqs)
+        tail = seqs[0].shape[1:]
+        out = np.full((B, T) + tail, pad_value, seqs[0].dtype)
+        for i, s in enumerate(seqs):
+            out[i, :len(s)] = s
+        return out
+
+    @pytest.mark.parametrize("dtype,pad", [("int64", -1), ("float32", 0.0),
+                                           ("float32", 3.5)])
+    def test_matches_python_loop(self, dtype, pad):
+        from paddle_tpu.native import batcher_lib
+        if batcher_lib() is None:
+            pytest.skip("no native toolchain")
+        from paddle_tpu.lod import pad_sequences
+        rng = np.random.RandomState(0)
+        for tail in [(), (3,), (2, 2)]:
+            seqs = [np.asarray(
+                rng.randint(0, 50, (t,) + tail) if dtype == "int64"
+                else rng.rand(*((t,) + tail)), dtype=dtype)
+                for t in (5, 2, 7, 1)]
+            got, lens = pad_sequences(seqs, dtype=dtype, pad_value=pad)
+            want = self._python_pad(seqs, got.shape[1], pad)
+            np.testing.assert_array_equal(got, want)
+            np.testing.assert_array_equal(lens, [5, 2, 7, 1])
+
+    def test_non_contiguous_rows_fall_back(self):
+        # strided views take the Python loop (the C pack memcpys raw row
+        # buffers) — results must be identical either way
+        from paddle_tpu.lod import pad_sequences
+        base = np.arange(40, dtype=np.float32).reshape(10, 4)
+        seqs = [base[::2, :2], base[1:4, 1:3]]   # strided views
+        got, lens = pad_sequences(seqs)
+        assert got.shape == (2, 8, 2)
+        np.testing.assert_array_equal(got[0, :5], base[::2, :2])
+        np.testing.assert_array_equal(got[1, :3], base[1:4, 1:3])
+        np.testing.assert_array_equal(got[0, 5:], 0)
+
+    def test_mismatched_tails_raise(self):
+        # rows whose trailing dims disagree must error (never read past a
+        # row buffer), exactly like the Python broadcast path
+        from paddle_tpu.lod import pad_sequences
+        with pytest.raises(ValueError):
+            pad_sequences([np.zeros((2, 4), np.float32),
+                           np.zeros((3, 2), np.float32)])
