@@ -243,7 +243,7 @@ def bench_transformer(on_tpu, peak):
         d_model = int(os.environ.get("BENCH_TFM_DMODEL", d_model))
         d_ff = int(os.environ.get("BENCH_TFM_DFF", d_ff))
         batch = int(os.environ.get("BENCH_TFM_BATCH", batch))
-        steps = int(os.environ.get("BENCH_STEPS", 50))
+        steps = int(os.environ.get("BENCH_TFM_STEPS", 50))
     else:
         batch, seqlen, d_model, n_layers, n_heads, d_ff, vocab = \
             2, 64, 64, 2, 2, 128, 1000
