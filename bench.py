@@ -243,7 +243,10 @@ def bench_transformer(on_tpu, peak):
         d_model = int(os.environ.get("BENCH_TFM_DMODEL", d_model))
         d_ff = int(os.environ.get("BENCH_TFM_DFF", d_ff))
         batch = int(os.environ.get("BENCH_TFM_BATCH", batch))
-        steps = int(os.environ.get("BENCH_TFM_STEPS", 50))
+        # BENCH_TFM_STEPS overrides just this config; BENCH_STEPS still
+        # scales everything (the ci.sh quick-sanity recipe relies on it)
+        steps = int(os.environ.get("BENCH_TFM_STEPS",
+                                   os.environ.get("BENCH_STEPS", 50)))
     else:
         batch, seqlen, d_model, n_layers, n_heads, d_ff, vocab = \
             2, 64, 64, 2, 2, 128, 1000

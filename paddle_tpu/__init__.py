@@ -25,6 +25,7 @@ from .layer_helper import LayerHelper
 from . import nets
 from . import io
 from . import metrics
+from . import evaluator
 from . import parallel
 from .parallel import ParallelExecutor, BuildStrategy, ExecutionStrategy
 from . import reader

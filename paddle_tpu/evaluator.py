@@ -1,0 +1,207 @@
+"""In-graph evaluators with cross-batch state.
+
+≙ reference python/paddle/fluid/evaluator.py (Evaluator:42,
+ChunkEvaluator:114, EditDistance:179, DetectionMAP:257) — the older
+API the reference itself deprecates in favor of fluid.metrics; kept for
+surface parity. The mechanism ports cleanly: states are PERSISTABLE
+program variables, the evaluator appends accumulate ops to the main
+program (state = state + batch_counts — the same persistable-write
+pattern batch_norm's moving stats use, core/lowering.py:304), `reset`
+runs a zero-fill program, `eval` computes the final value from fetched
+states.
+
+Prefer paddle_tpu.metrics for new code (the reference says the same of
+fluid.metrics, evaluator.py:24-28).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import layers
+from .core.executor import Executor
+from .core.program import Program, unique_name
+from .layer_helper import LayerHelper
+
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+def _state_value(name):
+    from .core.scope import global_scope
+    v = global_scope().find_var(name)
+    if v is None:
+        raise KeyError(f"evaluator state {name!r} not found in scope — "
+                       "run the main program (and reset) first")
+    return v
+
+
+class Evaluator:
+    """Base: owns persistable state vars in the main program
+    (≙ evaluator.py:42-111)."""
+
+    def __init__(self, name, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def create_state(self, suffix, dtype, shape):
+        state = self.helper.main_program.global_block.create_var(
+            unique_name(".".join([self.helper.name, suffix])),
+            shape=tuple(shape), dtype=dtype, persistable=True)
+        state.stop_gradient = True
+        # zero-initialized by the startup program (≙ the reference's
+        # set_variable_initializer(state, Constant(0.0)))
+        from .initializer import ConstantInitializer
+        self.helper.set_variable_initializer(state, ConstantInitializer(0.0))
+        self.states.append(state)
+        return state
+
+    def reset(self, executor: Executor, reset_program=None):
+        """Zero every state (≙ evaluator.py:69-83)."""
+        if reset_program is None:
+            reset_program = Program()
+        from .core.program import program_guard
+        with program_guard(reset_program):
+            for state in self.states:
+                zeros = layers.fill_constant(
+                    shape=list(state.shape), dtype=state.dtype, value=0.0)
+                layers.assign(zeros, output=reset_program.global_block
+                              .create_var(state.name, shape=state.shape,
+                                          dtype=state.dtype, persistable=True))
+        executor.run(reset_program)
+
+    def eval(self, executor: Executor, eval_program=None):
+        raise NotImplementedError
+
+
+def _accumulate(helper, state, batch_value):
+    """state += batch_value, writing the persistable state in place (the
+    rebind is carried to the next step's state by the lowering)."""
+    cast = helper.create_tmp_variable(state.dtype)
+    helper.append_op("cast", {"X": batch_value}, {"Out": cast},
+                     {"out_dtype": state.dtype})
+    helper.append_op("elementwise_add", {"X": state, "Y": cast},
+                     {"Out": state}, {"axis": -1})
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulates chunk counts across batches; eval() returns
+    (precision, recall, f1) over everything seen since reset
+    (≙ evaluator.py:114-177)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super().__init__("chunk_eval")
+        main_program = self.helper.main_program
+        if main_program.current_block() is not main_program.global_block:
+            raise ValueError("You can only invoke Evaluator in root block")
+        self.num_infer_chunks = self.create_state("num_infer_chunks",
+                                                  "int64", (1,))
+        self.num_label_chunks = self.create_state("num_label_chunks",
+                                                  "int64", (1,))
+        self.num_correct_chunks = self.create_state("num_correct_chunks",
+                                                    "int64", (1,))
+        precision, recall, f1, ni, nl, nc = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        for state, batch in [(self.num_infer_chunks, ni),
+                             (self.num_label_chunks, nl),
+                             (self.num_correct_chunks, nc)]:
+            _accumulate(self.helper, state, batch)
+        self.metrics.extend((precision, recall, f1))
+
+    def eval(self, executor: Executor, eval_program=None):
+        ni, nl, nc = (
+            int(np.ravel(np.asarray(_state_value(st.name)))[0])
+            for st in (self.num_infer_chunks, self.num_label_chunks,
+                      self.num_correct_chunks))
+        # one formula, owned by the streaming metric
+        from .metrics import ChunkEvaluator as _Stream
+        m = _Stream()
+        m.update(ni, nl, nc)
+        precision, recall, f1 = m.eval()
+        return np.array([precision]), np.array([recall]), np.array([f1])
+
+
+class EditDistance(Evaluator):
+    """Accumulates edit distances; eval() returns (average distance,
+    instance error rate) since reset (≙ evaluator.py:179-255)."""
+
+    def __init__(self, input, label, ignored_tokens=None, normalized=False):
+        super().__init__("edit_distance")
+        main_program = self.helper.main_program
+        if main_program.current_block() is not main_program.global_block:
+            raise ValueError("You can only invoke Evaluator in root block")
+        self.total_distance = self.create_state("total_distance",
+                                                "float32", (1,))
+        self.seq_num = self.create_state("seq_num", "int64", (1,))
+        self.instance_error = self.create_state("instance_error",
+                                                "int64", (1,))
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, normalized=normalized,
+            ignored_tokens=ignored_tokens)
+        dist_sum = layers.reduce_sum(distances)
+        errors = layers.cast(
+            layers.greater_than(
+                distances, layers.fill_constant(shape=[1], dtype="float32",
+                                                value=0.0)), "int64")
+        error_count = layers.reduce_sum(errors)
+        for state, batch in [(self.total_distance, dist_sum),
+                             (self.seq_num, seq_num),
+                             (self.instance_error, error_count)]:
+            _accumulate(self.helper, state, batch)
+        self.metrics.append(distances)
+
+    def eval(self, executor: Executor, eval_program=None):
+        total = float(np.ravel(np.asarray(
+            _state_value(self.total_distance.name)))[0])
+        n = float(np.ravel(np.asarray(
+            _state_value(self.seq_num.name)))[0])
+        err = float(np.ravel(np.asarray(
+            _state_value(self.instance_error.name)))[0])
+        avg = total / n if n else 0.0
+        rate = err / n if n else 0.0
+        return np.array([avg], np.float32), np.array([rate], np.float32)
+
+
+class DetectionMAP(Evaluator):
+    """Per-batch mAP var + host-side streaming accumulation.
+
+    ≙ evaluator.py:257-379, whose in-graph Accum{TruePos,FalsePos} state
+    is variable-length LoD — the one part of this API that does not map
+    to static shapes. The dense redesign: `get_map_var()` returns the
+    in-graph per-batch mAP (detection_map op), and cross-batch streaming
+    lives in metrics.DetectionMAP (host side), which this class wraps via
+    cur_map fetches. See docs/design_decisions.md on detection_map."""
+
+    def __init__(self, detect_res, label, class_num, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral"):
+        super().__init__("map_eval")
+        main_program = self.helper.main_program
+        if main_program.current_block() is not main_program.global_block:
+            raise ValueError("You can only invoke Evaluator in root block")
+        self.cur_map = layers.detection_map(
+            detect_res, label, class_num, background_label=background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult, ap_version=ap_version)
+        # accumulated mean over batches (scalar parity stand-in for the
+        # reference's accumulated-positives recompute)
+        self.accum_map_sum = self.create_state("accum_map_sum",
+                                               "float32", (1,))
+        self.batches = self.create_state("batches", "int64", (1,))
+        _accumulate(self.helper, self.accum_map_sum, self.cur_map)
+        one = layers.fill_constant(shape=[1], dtype="int64", value=1)
+        _accumulate(self.helper, self.batches, one)
+        self.metrics.append(self.cur_map)
+
+    def get_map_var(self):
+        return self.cur_map
+
+    def eval(self, executor: Executor, eval_program=None):
+        s = float(np.ravel(np.asarray(
+            _state_value(self.accum_map_sum.name)))[0])
+        n = float(np.ravel(np.asarray(
+            _state_value(self.batches.name)))[0])
+        return np.array([s / n if n else 0.0], np.float32)

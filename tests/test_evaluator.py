@@ -1,0 +1,105 @@
+"""In-graph evaluators (≙ reference fluid/evaluator.py + its
+test_chunk_eval_op/test_edit_distance usage): states accumulate across
+batches inside the program, reset zeroes them, eval() aggregates."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu import evaluator as ev
+from paddle_tpu import metrics
+
+
+def _chunk_batch(rng, n=3, tmax=6, num_types=2):
+    # IOB tags over `num_types` chunk types: label ids in [0, 2*types]
+    lens = rng.randint(2, tmax + 1, size=n)
+    mk = lambda: [rng.randint(0, 2 * num_types + 1, (t, 1)).astype(np.int64)
+                  for t in lens]
+    return mk(), mk()
+
+
+class TestChunkEvaluator:
+    def test_accumulates_like_streaming_metric(self):
+        rng = np.random.RandomState(0)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            inf = layers.data("inf", [1], dtype="int64", lod_level=1)
+            lab = layers.data("lab", [1], dtype="int64", lod_level=1)
+            chunk = ev.ChunkEvaluator(inf, lab, chunk_scheme="IOB",
+                                      num_chunk_types=2)
+            # in-graph per-batch counts to feed the streaming comparator
+            _, _, _, ni, nl, nc = layers.chunk_eval(
+                inf, lab, chunk_scheme="IOB", num_chunk_types=2)
+        exe = pt.Executor()
+        exe.run(startup)
+        stream = metrics.ChunkEvaluator()
+        for _ in range(3):
+            hyp, ref = _chunk_batch(rng)
+            got = exe.run(main, feed={"inf": hyp, "lab": ref},
+                          fetch_list=[ni, nl, nc])
+            stream.update(*(int(np.ravel(g)[0]) for g in got))
+        p, r, f1 = chunk.eval(exe)
+        sp, sr, sf1 = stream.eval()
+        np.testing.assert_allclose([p[0], r[0], f1[0]], [sp, sr, sf1],
+                                   atol=1e-6)
+
+        # reset zeroes the accumulated state
+        chunk.reset(exe)
+        p, r, f1 = chunk.eval(exe)
+        assert (p[0], r[0], f1[0]) == (0.0, 0.0, 0.0)
+
+
+class TestEditDistanceEvaluator:
+    def test_accumulates(self):
+        rng = np.random.RandomState(1)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            hyp = layers.data("hyp", [1], dtype="int64", lod_level=1)
+            ref = layers.data("ref", [1], dtype="int64", lod_level=1)
+            dist_ev = ev.EditDistance(hyp, ref)
+        exe = pt.Executor()
+        exe.run(startup)
+
+        total, n, errs = 0.0, 0, 0
+        for _ in range(2):
+            lens_h = rng.randint(1, 5, size=3)
+            lens_r = rng.randint(1, 5, size=3)
+            hyps = [rng.randint(0, 5, (t, 1)).astype(np.int64) for t in lens_h]
+            refs = [rng.randint(0, 5, (t, 1)).astype(np.int64) for t in lens_r]
+            (d,) = exe.run(main, feed={"hyp": hyps, "ref": refs},
+                           fetch_list=[dist_ev.metrics[0]])
+            d = np.ravel(np.asarray(d))[:3]
+            total += float(d.sum())
+            n += 3
+            errs += int((d > 0).sum())
+        avg, rate = dist_ev.eval(exe)
+        np.testing.assert_allclose(avg, [total / n], rtol=1e-5)
+        np.testing.assert_allclose(rate, [errs / n], rtol=1e-5)
+
+
+class TestDetectionMAPEvaluator:
+    def test_batch_map_and_mean(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            det = layers.data("det", [2, 6])
+            gt = layers.data("gt", [2, 6])
+            m = ev.DetectionMAP(det, gt, class_num=2, background_label=-1)
+        exe = pt.Executor()
+        exe.run(startup)
+        gt_np = np.zeros((1, 2, 6), np.float32)
+        gt_np[0, 0] = [0, 0, 0.1, 0.1, 0.4, 0.4]
+        gt_np[0, 1] = [1, 0, 0.5, 0.5, 0.9, 0.9]
+        perfect = np.zeros((1, 2, 6), np.float32)
+        perfect[0, 0] = [0, 0.9, 0.1, 0.1, 0.4, 0.4]
+        perfect[0, 1] = [1, 0.8, 0.5, 0.5, 0.9, 0.9]
+        wrong = np.zeros((1, 2, 6), np.float32)
+        wrong[0, 0] = [0, 0.9, 0.6, 0.6, 0.8, 0.8]
+        wrong[0, 1] = [1, 0.8, 0.1, 0.1, 0.2, 0.2]
+
+        (m1,) = exe.run(main, feed={"det": perfect, "gt": gt_np},
+                        fetch_list=[m.get_map_var()])
+        (m2,) = exe.run(main, feed={"det": wrong, "gt": gt_np},
+                        fetch_list=[m.get_map_var()])
+        np.testing.assert_allclose(m1, [1.0], atol=1e-6)
+        np.testing.assert_allclose(m2, [0.0], atol=1e-6)
+        np.testing.assert_allclose(m.eval(exe), [0.5], atol=1e-6)
