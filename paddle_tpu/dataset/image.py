@@ -82,3 +82,38 @@ def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
         mean = np.array(mean, np.float32)
         im -= mean if mean.ndim >= 2 else mean[:, None, None]
     return im
+
+
+def dequantize(raw: "np.ndarray", scale: float = 1.0 / 255.0,
+               shift: float = -0.5, out: "np.ndarray" = None,
+               dtype="float32") -> "np.ndarray":
+    """uint8 bytes -> float `raw * scale + shift`, the image feed-decode
+    hot loop. Uses the native one-pass kernel (native/batcher.cpp
+    dequantize_u8 / dequantize_u8_bf16 — GIL-released, one pass; the
+    bf16 variant also halves write traffic and upload bytes) with a
+    numpy fallback. `dtype`: "float32" or "bfloat16" (ignored when `out`
+    is given — its dtype rules)."""
+    import ml_dtypes
+    raw = np.ascontiguousarray(raw, np.uint8)
+    if out is None:
+        out = np.empty(raw.shape,
+                       ml_dtypes.bfloat16 if dtype == "bfloat16"
+                       else np.float32)
+    bf16 = out.dtype == ml_dtypes.bfloat16
+    from ..native import batcher_lib
+    lib = batcher_lib()
+    # the native kernels write raw.size elements straight through the out
+    # pointer: only a right-sized, contiguous float32/bfloat16 buffer is
+    # eligible; anything else goes through numpy's checked assignment
+    native_ok = (lib is not None and (bf16 or out.dtype == np.float32)
+                 and out.size == raw.size
+                 and out.flags["C_CONTIGUOUS"])
+    if not native_ok:
+        tmp = raw * np.float32(scale) + np.float32(shift)
+        out[...] = tmp.astype(out.dtype).reshape(out.shape)
+        return out
+    import ctypes
+    fn = lib.dequantize_u8_bf16 if bf16 else lib.dequantize_u8
+    fn(raw.ctypes.data_as(ctypes.c_void_p),
+       out.ctypes.data_as(ctypes.c_void_p), raw.size, scale, shift)
+    return out

@@ -74,7 +74,7 @@ def recordio_lib():
     return lib
 
 def batcher_lib():
-    lib = load_library("batcher")
+    lib = load_library("batcher", extra_flags=["-O3"])
     if lib is not None and not getattr(lib, "_batcher_configured", False):
         lib.pack_rows.restype = ctypes.c_int
         lib.pack_rows.argtypes = [
@@ -86,5 +86,15 @@ def batcher_lib():
             ctypes.c_void_p,                        # out
             ctypes.POINTER(ctypes.c_int32),         # out_lens
         ]
+        _configure_dequantize(lib)
         lib._batcher_configured = True
     return lib
+
+
+def _configure_dequantize(lib):
+    lib.dequantize_u8.restype = None
+    lib.dequantize_u8.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_int64, ctypes.c_float,
+                                  ctypes.c_float]
+    lib.dequantize_u8_bf16.restype = None
+    lib.dequantize_u8_bf16.argtypes = lib.dequantize_u8.argtypes

@@ -282,3 +282,38 @@ class TestTrainerPipeline:
                       feed_order=["pixel", "label"])
         assert len(losses) == 12  # 4 batches x 3 epochs
         assert losses[-1] < losses[0]
+
+
+class TestNativeDequantize:
+    """dataset.image.dequantize (native/batcher.cpp dequantize_u8[_bf16])
+    vs the numpy three-pass decode."""
+
+    def test_f32_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        raw = rng.randint(0, 256, 10000).astype(np.uint8)
+        from paddle_tpu.dataset.image import dequantize
+        got = dequantize(raw)
+        want = raw.astype(np.float32) / 255.0 - 0.5
+        np.testing.assert_allclose(got, want, rtol=0, atol=1.2e-7)
+
+    def test_bf16_within_one_ulp(self):
+        import ml_dtypes
+        rng = np.random.RandomState(1)
+        raw = rng.randint(0, 256, 10000).astype(np.uint8)
+        from paddle_tpu.dataset.image import dequantize
+        got = dequantize(raw, dtype="bfloat16")
+        assert got.dtype == ml_dtypes.bfloat16
+        want = (raw.astype(np.float32) / 255.0 - 0.5).astype(ml_dtypes.bfloat16)
+        # fused mul+add can round differently from the two-pass numpy
+        # decode right at a bf16 boundary: allow 1 ulp
+        g16 = got.view(np.uint16).astype(np.int32)
+        w16 = want.view(np.uint16).astype(np.int32)
+        assert np.abs(g16 - w16).max() <= 1
+
+    def test_out_buffer_reused(self):
+        from paddle_tpu.dataset.image import dequantize
+        raw = np.arange(256, dtype=np.uint8)
+        out = np.empty(256, np.float32)
+        ret = dequantize(raw, out=out)
+        assert ret is out
+        np.testing.assert_allclose(out[255], 0.5, atol=1e-6)
