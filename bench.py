@@ -318,34 +318,19 @@ def bench_data_pipeline(on_tpu, resnet_result):
             "pipeline_vs_device": round(ips / dev_ips, 2) if dev_ips else None}
 
 
-def bench_transformer(on_tpu, peak):
-    """Transformer LM w/ flash-attention Pallas kernel — the north-star
-    MFU showpiece (not a reference config; additive per SURVEY §5)."""
+def _lm_bench(on_tpu, peak, batch, seqlen, d_model, n_layers, n_heads,
+              d_ff, vocab, steps, remat):
+    """Shared transformer-LM measurement: build, (optionally remat), train
+    via the device-side loop, and report analytic-MFU numbers. One FLOP
+    formula for both LM configs so the accounting cannot drift."""
     import paddle_tpu as pt
     from paddle_tpu.models import transformer as tfm
-    if on_tpu:
-        # measured on v5e: d_model 1024 plateaus at ~41-42% MFU (6 or 12
-        # layers); widening to 2048/8192 lifts arithmetic intensity past
-        # the 45% north star — 50.8% MFU, 42.4k tok/s
-        batch, seqlen, d_model, n_layers, n_heads, d_ff, vocab = \
-            4, 1024, 2048, 6, 8, 8192, 32000
-        n_layers = int(os.environ.get("BENCH_TFM_LAYERS", n_layers))
-        d_model = int(os.environ.get("BENCH_TFM_DMODEL", d_model))
-        d_ff = int(os.environ.get("BENCH_TFM_DFF", d_ff))
-        batch = int(os.environ.get("BENCH_TFM_BATCH", batch))
-        # BENCH_TFM_STEPS overrides just this config; BENCH_STEPS still
-        # scales everything (the ci.sh quick-sanity recipe relies on it)
-        steps = int(os.environ.get("BENCH_TFM_STEPS",
-                                   os.environ.get("BENCH_STEPS", 50)))
-    else:
-        batch, seqlen, d_model, n_layers, n_heads, d_ff, vocab = \
-            2, 64, 64, 2, 2, 128, 1000
-        steps = 2
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):
         avg, _ = tfm.transformer_lm_loss(
             vocab_size=vocab, seq_len=seqlen, n_layers=n_layers,
-            d_model=d_model, n_heads=n_heads, d_ff=d_ff, max_len=seqlen)
+            d_model=d_model, n_heads=n_heads, d_ff=d_ff, max_len=seqlen,
+            remat=remat)
         opt = pt.optimizer.AdamOptimizer(learning_rate=1e-4)
         opt.minimize(avg)
     if on_tpu:
@@ -354,20 +339,158 @@ def bench_transformer(on_tpu, peak):
     feed = {"src_ids": rng.randint(0, vocab, (batch, seqlen)).astype("int64"),
             "tgt_ids": rng.randint(0, vocab, (batch, seqlen, 1)).astype("int64")}
     ms, losses, compile_s = _train_loop(main_prog, startup, avg, feed, steps)
-    # analytic train flops: per token fwd ≈ 2*(4d² + 2*d*d_ff)/layer +
-    # attention 2*2*S*d/layer + logits 2*d*V; train ≈ 3× fwd
+    # analytic train flops: per token fwd ~= 2*(4d^2 + 2*d*d_ff)/layer +
+    # attention 2*2*S*d/layer + logits 2*d*V; train ~= 3x fwd, and remat
+    # re-runs the forward inside backward: ~4x
     tokens = batch * seqlen
     per_tok = n_layers * (2 * (4 * d_model ** 2 + 2 * d_model * d_ff)
                           + 4 * seqlen * d_model) + 2 * d_model * vocab
-    train_flops = 3.0 * per_tok * tokens
+    train_flops = (4.0 if remat else 3.0) * per_tok * tokens
     mfu = train_flops / (ms / 1000.0) / peak
-    return {"batch": batch, "seq_len": seqlen, "d_model": d_model,
-            "n_layers": n_layers, "steps": steps,
-            "ms_per_batch": round(ms, 2),
-            "tokens_per_sec": round(tokens / ms * 1000.0),
-            "mfu_pct": round(mfu * 100, 2),
-            "compile_s": round(compile_s, 1),
-            "loss_first": float(losses[0]), "loss_last": float(losses[-1])}
+    out = {"batch": batch, "seq_len": seqlen, "d_model": d_model,
+           "n_layers": n_layers, "steps": steps,
+           "ms_per_batch": round(ms, 2),
+           "tokens_per_sec": round(tokens / ms * 1000.0),
+           "mfu_pct": round(mfu * 100, 2),
+           "compile_s": round(compile_s, 1),
+           "loss_first": float(losses[0]), "loss_last": float(losses[-1])}
+    if remat:
+        out["remat"] = True
+    return out
+
+
+def bench_transformer(on_tpu, peak):
+    """Transformer LM w/ flash-attention Pallas kernel — the north-star
+    MFU showpiece (not a reference config; additive per SURVEY §5)."""
+    if on_tpu:
+        # measured on v5e: d_model 1024 plateaus at ~41-42% MFU (6 or 12
+        # layers); widening to 2048/8192 lifts arithmetic intensity past
+        # the 45% north star — 50.8% MFU, 42.4k tok/s
+        cfg = dict(batch=int(os.environ.get("BENCH_TFM_BATCH", 4)),
+                   seqlen=1024,
+                   d_model=int(os.environ.get("BENCH_TFM_DMODEL", 2048)),
+                   n_layers=int(os.environ.get("BENCH_TFM_LAYERS", 6)),
+                   n_heads=8,
+                   d_ff=int(os.environ.get("BENCH_TFM_DFF", 8192)),
+                   vocab=32000,
+                   # BENCH_TFM_STEPS overrides just this config; BENCH_STEPS
+                   # still scales everything (the ci.sh quick-sanity recipe
+                   # relies on it)
+                   steps=int(os.environ.get(
+                       "BENCH_TFM_STEPS", os.environ.get("BENCH_STEPS", 50))))
+    else:
+        cfg = dict(batch=2, seqlen=64, d_model=64, n_layers=2, n_heads=2,
+                   d_ff=128, vocab=1000, steps=2)
+    return _lm_bench(on_tpu, peak, remat=False, **cfg)
+
+
+def bench_long_context(on_tpu, peak):
+    """Long-context LM step: flash-attention Pallas kernel + per-layer
+    rematerialization at 8k tokens on one chip (the single-chip leg of
+    SURVEY §5's long-context story; the multi-chip legs — ring/Ulysses sp
+    — run in dryrun_multichip). Measured: 17.3k tok/s, 28.2% MFU
+    (remat-adjusted), loss falls."""
+    if on_tpu:
+        cfg = dict(batch=1,
+                   seqlen=int(os.environ.get("BENCH_LC_SEQ", 8192)),
+                   d_model=2048, n_layers=4, n_heads=16, d_ff=8192,
+                   vocab=32000,
+                   steps=int(os.environ.get(
+                       "BENCH_LC_STEPS", os.environ.get("BENCH_STEPS", 20))))
+    else:
+        cfg = dict(batch=1, seqlen=256, d_model=64, n_layers=2, n_heads=2,
+                   d_ff=128, vocab=500, steps=2)
+    return _lm_bench(on_tpu, peak, remat=True, **cfg)
+
+
+def bench_data_pipeline(on_tpu, resnet_result):
+    """Host data plane: RecordIO scan -> decode -> batch -> prefetch
+    throughput, vs the device's consumption rate.
+
+    ≙ the reference's recordio path (benchmark/fluid/recordio_converter.py
+    + open_recordio_file + double_buffer). Per-step device streaming is
+    not measurable on this rig — the TPU is tunneled and host<->device
+    payload bandwidth is ~15 MB/s, a fabric property, so the real-data
+    criterion ("<5% step-time overhead vs fake data") is demonstrated
+    structurally: the host pipeline sustains K x the device's images/s,
+    so with co-located HBM (any real deployment) the double-buffered
+    overlap hides it entirely."""
+    import tempfile
+    from paddle_tpu import recordio
+    from paddle_tpu.reader import decorator as rdec
+    from paddle_tpu.reader.prefetch import double_buffer
+
+    n_images, image, batch = (1024, 224, 128) if on_tpu else (64, 32, 8)
+    rng = np.random.RandomState(0)
+    path = os.path.join(tempfile.gettempdir(),
+                        f"bench_images_{image}_{n_images}.rio")
+    if not os.path.exists(path):
+        # write-then-rename so an interrupted run never leaves a truncated
+        # file for later runs to silently benchmark against
+        w = recordio.Writer(path + ".tmp", compressor=recordio.NO_COMPRESS)
+        for i in range(n_images):
+            img = rng.randint(0, 256, (3, image, image), np.uint8)
+            label = np.int64(i % 1000)
+            w.write(img.tobytes() + label.tobytes())
+        w.close()
+        os.replace(path + ".tmp", path)
+
+    def raw_reader():
+        for rec in recordio.scan(path):
+            yield rec
+
+    import ml_dtypes
+    from paddle_tpu.dataset.image import dequantize
+
+    def decode_batch(rows):
+        """Per-record native dequantize straight to bf16 (the dtype the
+        model feeds): one GIL-released pass per image, no intermediate
+        copies — measured 3.8k img/s vs ~1.0k for the numpy three-pass
+        (the decode loop is host-memory-bandwidth bound, and bf16 halves
+        the write traffic AND the host->device upload bytes)."""
+        out = np.empty((len(rows), 3, image, image), ml_dtypes.bfloat16)
+        for i, r in enumerate(rows):
+            dequantize(np.frombuffer(r, np.uint8, count=3 * image * image),
+                       out=out[i].reshape(-1))
+        labels = np.stack([np.frombuffer(r[-8:], np.int64) for r in rows])
+        return {"data": out, "label": labels}
+
+    workers = int(os.environ.get("BENCH_DECODE_WORKERS", 2))
+    batched = rdec.batch(raw_reader, batch, drop_last=True)
+    # decode workers over batches (≙ xmap_readers, decorator.py:236)
+    feed_reader = rdec.xmap_readers(decode_batch, batched, workers,
+                                    buffer_size=4)
+
+    # one warm pass (page cache + xmap thread spin-up), then measure the
+    # host stages (scan -> batch -> parallel decode); the device_put leg
+    # is timed separately because on this rig it crosses the TPU tunnel
+    # (a fabric property, not a pipeline property — co-located hosts
+    # upload at PCIe rates)
+    for _ in feed_reader():
+        pass
+    t0 = time.time()
+    n = 0
+    for batch_dict in feed_reader():
+        n += batch_dict["label"].shape[0]
+    ips = n / (time.time() - t0)
+
+    import jax
+    t0 = time.time()
+    m = 0
+    last = None
+    for batch_dict in double_buffer(feed_reader)():
+        m += batch_dict["label"].shape[0]
+        last = batch_dict
+    if last is not None:  # device_put is async: settle in-flight transfers
+        jax.block_until_ready(last["data"])
+    with_upload_ips = m / (time.time() - t0)
+
+    dev_ips = (resnet_result or {}).get("examples_per_sec") or 0.0
+    return {"images": n, "image_px": image, "decode_dtype": "bfloat16",
+            "pipeline_images_per_sec": round(ips, 1),
+            "with_tunnel_upload_images_per_sec": round(with_upload_ips, 1),
+            "device_images_per_sec": dev_ips,
+            "pipeline_vs_device": round(ips / dev_ips, 2) if dev_ips else None}
 
 
 def main():
@@ -385,6 +508,7 @@ def main():
              ("stacked_lstm", lambda: bench_lstm(on_tpu)),
              ("machine_translation", lambda: bench_machine_translation(on_tpu)),
              ("transformer", lambda: bench_transformer(on_tpu, peak)),
+             ("long_context", lambda: bench_long_context(on_tpu, peak)),
              ("data_pipeline",
               lambda: bench_data_pipeline(on_tpu, configs.get("resnet50")))]
     for name, fn in table:
