@@ -228,96 +228,6 @@ def bench_machine_translation(on_tpu):
             "loss_first": float(losses[0]), "loss_last": float(losses[-1])}
 
 
-def bench_data_pipeline(on_tpu, resnet_result):
-    """Host data plane: RecordIO scan -> decode -> batch -> prefetch
-    throughput, vs the device's consumption rate.
-
-    ≙ the reference's recordio path (benchmark/fluid/recordio_converter.py
-    + open_recordio_file + double_buffer). Per-step device streaming is
-    not measurable on this rig — the TPU is tunneled and host<->device
-    payload bandwidth is ~15 MB/s, a fabric property, so the real-data
-    criterion ("<5% step-time overhead vs fake data") is demonstrated
-    structurally: the host pipeline sustains K x the device's images/s,
-    so with co-located HBM (any real deployment) the double-buffered
-    overlap hides it entirely."""
-    import tempfile
-    from paddle_tpu import recordio
-    from paddle_tpu.reader import decorator as rdec
-    from paddle_tpu.reader.prefetch import double_buffer
-
-    n_images, image, batch = (1024, 224, 128) if on_tpu else (64, 32, 8)
-    rng = np.random.RandomState(0)
-    path = os.path.join(tempfile.gettempdir(),
-                        f"bench_images_{image}_{n_images}.rio")
-    if not os.path.exists(path):
-        # write-then-rename so an interrupted run never leaves a truncated
-        # file for later runs to silently benchmark against
-        w = recordio.Writer(path + ".tmp", compressor=recordio.NO_COMPRESS)
-        for i in range(n_images):
-            img = rng.randint(0, 256, (3, image, image), np.uint8)
-            label = np.int64(i % 1000)
-            w.write(img.tobytes() + label.tobytes())
-        w.close()
-        os.replace(path + ".tmp", path)
-
-    def raw_reader():
-        for rec in recordio.scan(path):
-            yield rec
-
-    import ml_dtypes
-    from paddle_tpu.dataset.image import dequantize
-
-    def decode_batch(rows):
-        """Per-record native dequantize straight to bf16 (the dtype the
-        model feeds): one GIL-released pass per image, no intermediate
-        copies — measured 3.8k img/s vs ~1.0k for the numpy three-pass
-        (the decode loop is host-memory-bandwidth bound, and bf16 halves
-        the write traffic AND the host->device upload bytes)."""
-        out = np.empty((len(rows), 3, image, image), ml_dtypes.bfloat16)
-        for i, r in enumerate(rows):
-            dequantize(np.frombuffer(r, np.uint8, count=3 * image * image),
-                       out=out[i].reshape(-1))
-        labels = np.stack([np.frombuffer(r[-8:], np.int64) for r in rows])
-        return {"data": out, "label": labels}
-
-    workers = int(os.environ.get("BENCH_DECODE_WORKERS", 2))
-    batched = rdec.batch(raw_reader, batch, drop_last=True)
-    # decode workers over batches (≙ xmap_readers, decorator.py:236)
-    feed_reader = rdec.xmap_readers(decode_batch, batched, workers,
-                                    buffer_size=4)
-
-    # one warm pass (page cache + xmap thread spin-up), then measure the
-    # host stages (scan -> batch -> parallel decode); the device_put leg
-    # is timed separately because on this rig it crosses the TPU tunnel
-    # (a fabric property, not a pipeline property — co-located hosts
-    # upload at PCIe rates)
-    for _ in feed_reader():
-        pass
-    t0 = time.time()
-    n = 0
-    for batch_dict in feed_reader():
-        n += batch_dict["label"].shape[0]
-    ips = n / (time.time() - t0)
-
-    import jax
-    t0 = time.time()
-    m = 0
-    last = None
-    for batch_dict in double_buffer(feed_reader)():
-        m += batch_dict["label"].shape[0]
-        last = batch_dict
-    if last is not None:  # device_put is async: settle in-flight transfers
-        jax.block_until_ready(last["data"])
-    with_upload_ips = m / (time.time() - t0)
-
-    dev_ips = (resnet_result or {}).get("examples_per_sec") or 0.0
-    return {"images": n, "image_px": image, "decode_dtype": "bfloat16",
-            "pipeline_images_per_sec": round(ips, 1),
-            "with_tunnel_upload_images_per_sec": round(with_upload_ips, 1),
-            "device_images_per_sec": dev_ips,
-            "pipeline_vs_device": round(ips / dev_ips, 2) if dev_ips else None}
-
-
 def _lm_bench(on_tpu, peak, batch, seqlen, d_model, n_layers, n_heads,
               d_ff, vocab, steps, remat):
     """Shared transformer-LM measurement: build, (optionally remat), train

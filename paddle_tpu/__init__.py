@@ -29,6 +29,7 @@ from . import evaluator
 from . import parallel
 from .parallel import ParallelExecutor, BuildStrategy, ExecutionStrategy
 from . import reader
+from .reader import batch  # ≙ top-level paddle.batch (python/paddle/batch.py)
 from . import recordio
 from . import dataset
 from . import transpiler

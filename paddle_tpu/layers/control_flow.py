@@ -18,6 +18,7 @@ from .sequence import _mark_seq
 __all__ = ["DynamicRNN", "StaticRNN", "While", "Switch", "IfElse",
            "Pipeline",
            "increment", "array_write", "array_read", "create_array",
+           "array_length", "max_sequence_len", "Print",
            "less_than", "less_equal", "greater_than", "greater_equal",
            "equal", "not_equal", "logical_and", "logical_or", "logical_not"]
 
@@ -640,3 +641,41 @@ class Pipeline:
              "n_microbatches": self.num_microbatches,
              "num_stages": self.num_stages})
         return out
+
+
+def array_length(array):
+    """lod_array_length_op.cc: the array's (static) capacity — dense
+    tensor arrays are fixed [max_len, ...] buffers; see ops/flow_ops.py
+    array_length for the design note."""
+    helper = LayerHelper("array_length")
+    out = helper.create_tmp_variable("int32")
+    out.stop_gradient = True
+    helper.append_op("array_length", {"X": array}, {"Out": out}, {})
+    out.shape = ()
+    return out
+
+
+def max_sequence_len(x):
+    """max_sequence_len_op.cc re-read for the padded+lengths design: the
+    longest sequence length in a ragged batch (reduce_max over the
+    @SEQ_LEN companion)."""
+    from .sequence import _seq_len_of
+    from . import nn
+    helper = LayerHelper("max_sequence_len")
+    seq_len = helper.main_program.current_block().var(_seq_len_of(x, helper))
+    return nn.reduce_max(seq_len)
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """≙ layers.Print (print_op.cc): print the tensor at every execution
+    — lowered to jax.debug.print, which fires even under jit. Returns the
+    input (the op is an identity in the dataflow)."""
+    helper = LayerHelper("print")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("print", {"In": input}, {"Out": out},
+                     {"message": message or ""})
+    out.shape, out.dtype = input.shape, input.dtype
+    return out

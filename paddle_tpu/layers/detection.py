@@ -16,7 +16,7 @@ __all__ = ["prior_box", "anchor_generator", "box_coder", "box_clip",
            "bipartite_match", "target_assign", "mine_hard_examples",
            "multiclass_nms", "detection_output", "ssd_loss", "roi_pool",
            "roi_align", "iou_similarity", "polygon_box_transform",
-           "detection_map"]
+           "detection_map", "multi_box_head"]
 
 
 def iou_similarity(x, y, name=None):
@@ -228,3 +228,70 @@ def detection_map(detect_res, label, class_num, background_label=0,
                       "evaluate_difficult": evaluate_difficult,
                       "ap_type": ap_version})
     return out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None):
+    """≙ layers/detection.py multi_box_head: the SSD prediction head.
+    Per feature map: prior boxes + a loc conv ([N, HWP, 4]) + a conf conv
+    ([N, HWP, C]); results concatenate across maps. min/max sizes derive
+    from min_ratio/max_ratio when not given (>2 maps, SSD paper §2.2)."""
+    import math
+    from . import nn
+
+    num_layer = len(inputs)
+    if num_layer <= 2:
+        assert min_sizes is not None and max_sizes is not None
+    elif min_sizes is None and max_sizes is None:
+        min_sizes, max_sizes = [], []
+        step = int(math.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+    if steps:
+        step_w = step_h = steps
+
+    mbox_locs, mbox_confs, box_results, var_results = [], [], [], []
+    for i, inp in enumerate(inputs):
+        min_size = min_sizes[i]
+        max_size = max_sizes[i]
+        if not isinstance(min_size, (list, tuple)):
+            min_size = [min_size]
+        if not isinstance(max_size, (list, tuple)):
+            max_size = [max_size]
+        ar = aspect_ratios[i] if aspect_ratios is not None else []
+        if not isinstance(ar, (list, tuple)):
+            ar = [ar]
+        box, var = prior_box(
+            inp, image, min_size, max_size, ar, list(variance), flip, clip,
+            steps=(step_w[i] if step_w else 0.0,
+                   step_h[i] if step_h else 0.0), offset=offset)
+        box_results.append(box)
+        var_results.append(var)
+        num_boxes = box.shape[2]
+
+        loc = nn.conv2d(inp, num_filters=num_boxes * 4,
+                        filter_size=kernel_size, padding=pad, stride=stride)
+        loc = nn.transpose(loc, perm=[0, 2, 3, 1])
+        mbox_locs.append(nn.reshape(
+            loc, [-1, (loc.shape[1] * loc.shape[2] * loc.shape[3]) // 4, 4]))
+
+        conf = nn.conv2d(inp, num_filters=num_boxes * num_classes,
+                         filter_size=kernel_size, padding=pad, stride=stride)
+        conf = nn.transpose(conf, perm=[0, 2, 3, 1])
+        mbox_confs.append(nn.reshape(
+            conf, [-1, (conf.shape[1] * conf.shape[2] * conf.shape[3])
+                   // num_classes, num_classes]))
+
+    if num_layer == 1:
+        return mbox_locs[0], mbox_confs[0], box_results[0], var_results[0]
+    boxes = nn.concat([nn.reshape(b, [-1, 4]) for b in box_results], axis=0)
+    vars_ = nn.concat([nn.reshape(v, [-1, 4]) for v in var_results], axis=0)
+    locs = nn.concat(mbox_locs, axis=1)
+    confs = nn.concat(mbox_confs, axis=1)
+    return locs, confs, boxes, vars_

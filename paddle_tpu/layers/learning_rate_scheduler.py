@@ -17,7 +17,7 @@ from . import tensor, nn, ops
 
 __all__ = ["exponential_decay", "natural_exp_decay", "inverse_time_decay",
            "polynomial_decay", "piecewise_decay", "noam_decay",
-           "global_step_counter"]
+           "global_step_counter", "autoincreased_step_counter"]
 
 _COUNTER_NAME = "@LR_DECAY_COUNTER@"
 
@@ -126,3 +126,7 @@ def noam_decay(d_model, warmup_steps):
     b = step * (warmup_steps ** -1.5)
     lr = nn.elementwise_min(a, b)
     return lr * (d_model ** -0.5)
+
+
+# ≙ layers.autoincreased_step_counter (the fluid name for the same op)
+autoincreased_step_counter = global_step_counter

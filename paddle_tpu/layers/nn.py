@@ -30,7 +30,10 @@ __all__ = [
     "scatter", "slice", "shape", "maxout", "smooth_l1", "warpctc",
     "label_smooth", "bilinear_interp", "resize_bilinear", "random_crop",
     "nce", "row_conv", "mean_iou", "bpr_loss", "spp", "moe_ffn",
-    "conv3d", "pool3d",
+    "conv3d", "pool3d", "cos_sim", "multiplex", "dice_loss", "image_resize",
+    "image_resize_short", "gru_unit", "lstm_unit", "uniform_random",
+    "uniform_random_batch_size_like", "gaussian_random",
+    "gaussian_random_batch_size_like",
 ]
 
 
@@ -842,4 +845,170 @@ def pool3d(input, pool_size, pool_type="max", pool_stride=1, pool_padding=0,
                       "pooling_type": pool_type,
                       "global_pooling": global_pooling,
                       "exclusive": exclusive})
+    return out
+
+
+def cos_sim(X, Y, name=None):
+    """cos_sim_op.cc: row-wise cosine similarity (Y may broadcast [1, D])."""
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_tmp_variable(X.dtype)
+    xn = helper.create_tmp_variable(X.dtype)
+    yn = helper.create_tmp_variable(X.dtype)
+    helper.append_op("cos_sim", {"X": X, "Y": Y},
+                     {"Out": out, "XNorm": xn, "YNorm": yn}, {})
+    out.shape = tuple(X.shape[:-1]) + (1,)
+    out.dtype = X.dtype
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    """multiplex_op.cc: per-row select among candidate tensors by index."""
+    helper = LayerHelper("multiplex", name=name)
+    out = helper.create_tmp_variable(inputs[0].dtype)
+    helper.append_op("multiplex", {"X": list(inputs), "Ids": index},
+                     {"Out": out}, {})
+    out.shape, out.dtype = inputs[0].shape, inputs[0].dtype
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """≙ layers/nn.py dice_loss: 1 - 2|X∩Y| / (|X|+|Y|), composed from
+    elementwise ops exactly like the reference (no dedicated kernel)."""
+    label = one_hot(label, depth=input.shape[-1])
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = reduce_sum(elementwise_mul(input, label), dim=reduce_dims)
+    dice_denominator = elementwise_add(
+        reduce_sum(input, dim=reduce_dims),
+        reduce_sum(label, dim=reduce_dims))
+    dice_score = scale(elementwise_div(
+        scale(inse, scale=2.0),
+        scale(dice_denominator, bias=epsilon)), scale=-1.0, bias=1.0)
+    return reduce_mean(dice_score)
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 name=None):
+    """≙ layers/nn.py image_resize → bilinear_interp op (NCHW)."""
+    if resample not in ("BILINEAR", "NEAREST"):
+        raise ValueError(f"image_resize: unsupported resample {resample!r}")
+    if out_shape is None:
+        if scale is None:
+            raise ValueError("image_resize: give out_shape or scale")
+        out_shape = [int(input.shape[2] * scale), int(input.shape[3] * scale)]
+    helper = LayerHelper("image_resize", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("bilinear_interp", {"X": input}, {"Out": out},
+                     {"out_h": int(out_shape[0]), "out_w": int(out_shape[1]),
+                      "method": "nearest" if resample == "NEAREST"
+                      else "bilinear"})
+    out.shape = tuple(input.shape[:2]) + (int(out_shape[0]), int(out_shape[1]))
+    out.dtype = input.dtype
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """≙ layers/nn.py image_resize_short: resize keeping aspect ratio so
+    the SHORT side hits out_short_len."""
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    return image_resize(input, [h * out_short_len // short,
+                                w * out_short_len // short], resample=resample)
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    """≙ layers/nn.py gru_unit (gru_unit_op.cc): one GRU step. `size` =
+    3×hidden per the reference convention. Returns (hidden [B, D],
+    reset_hidden_prev, gate)."""
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    d = size // 3
+    weight = helper.create_parameter(helper.param_attr, [d, 3 * d],
+                                     input.dtype)
+    bias = helper.create_parameter(helper.bias_attr, [1, 3 * d], input.dtype,
+                                   is_bias=True)
+    h = helper.create_tmp_variable(input.dtype)
+    gate = helper.create_tmp_variable(input.dtype)
+    reset_h = helper.create_tmp_variable(input.dtype)
+    helper.append_op(
+        "gru_unit",
+        {"Input": input, "HiddenPrev": hidden, "Weight": weight,
+         "Bias": bias},
+        {"Hidden": h, "Gate": gate, "ResetHiddenPrev": reset_h},
+        {"activation": activation, "gate_activation": gate_activation})
+    # (the op reads both attrs; see ops/volumetric_ops.py gru_unit)
+    h.shape = reset_h.shape = tuple(hidden.shape)
+    gate.shape = tuple(hidden.shape[:-1]) + (3 * d,)
+    h.dtype = gate.dtype = reset_h.dtype = input.dtype
+    return h, reset_h, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """≙ layers/nn.py lstm_unit (lstm_unit_op): one LSTM step. Projects
+    [x_t, h_prev] by an fc to 4D gate pre-activations (i|f|o|g layout,
+    lstm_unit_op.h:63-66), then applies the cell. Returns (h, c)."""
+    d = cell_t_prev.shape[-1]
+    gates = fc(input=[x_t, hidden_t_prev], size=4 * d,
+               param_attr=param_attr, bias_attr=bias_attr)
+    helper = LayerHelper("lstm_unit", name=name)
+    h = helper.create_tmp_variable(x_t.dtype)
+    c = helper.create_tmp_variable(x_t.dtype)
+    helper.append_op("lstm_unit", {"X": gates, "C_prev": cell_t_prev},
+                     {"H": h, "C": c}, {"forget_bias": float(forget_bias)})
+    h.shape = c.shape = tuple(cell_t_prev.shape)
+    h.dtype = c.dtype = x_t.dtype
+    return h, c
+
+
+def uniform_random_batch_size_like(input, shape, input_dim_idx=0,
+                                   output_dim_idx=0, min=-1.0, max=1.0,
+                                   dtype="float32", seed=0):
+    """uniform_random_batch_size_like_op.cc: uniform noise whose dim
+    `output_dim_idx` copies `input`'s dim `input_dim_idx`."""
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("uniform_random_batch_size_like", {"Input": input},
+                     {"Out": out},
+                     {"shape": list(shape), "min": min, "max": max,
+                      "dtype": dtype, "seed": seed,
+                      "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, dtype="float32", seed=0):
+    """gaussian_random_op.cc."""
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("gaussian_random", {}, {"Out": out},
+                     {"shape": list(shape), "mean": mean, "std": std,
+                      "dtype": dtype, "seed": seed})
+    out.shape, out.dtype = tuple(shape), dtype
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    dtype="float32", seed=0):
+    """gaussian_random_batch_size_like_op.cc."""
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("gaussian_random_batch_size_like", {"Input": input},
+                     {"Out": out},
+                     {"shape": list(shape), "mean": mean, "std": std,
+                      "dtype": dtype, "seed": seed,
+                      "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx})
+    return out
+
+
+def uniform_random(shape, min=-1.0, max=1.0, dtype="float32", seed=0):
+    """uniform_random_op.cc."""
+    helper = LayerHelper("uniform_random")
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("uniform_random", {}, {"Out": out},
+                     {"shape": list(shape), "min": min, "max": max,
+                      "dtype": dtype, "seed": seed})
+    out.shape, out.dtype = tuple(shape), dtype
     return out

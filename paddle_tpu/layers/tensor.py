@@ -16,7 +16,7 @@ __all__ = [
     "create_tensor", "create_parameter", "create_global_var", "fill_constant",
     "fill_constant_batch_size_like", "ones", "zeros", "sums", "assign",
     "argmin", "argmax", "reverse", "cast", "concat",
-]
+ "sum", "is_empty",]
 
 
 def create_tensor(dtype, name=None, persistable=False):
@@ -130,3 +130,18 @@ def reverse(x, axis):
 
 # re-export from nn to mirror fluid.layers flat namespace
 from .nn import cast, concat  # noqa: E402,F401
+
+
+def sum(input, out=None):
+    """≙ layers.sum (alias of sums; sum_op.cc)."""
+    return sums(input, out=out)
+
+
+def is_empty(x, cond=None):
+    """is_empty_op.cc: scalar bool, true when x has zero elements."""
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_tmp_variable("bool")
+    helper.append_op("is_empty", {"X": x}, {"Out": cond}, {})
+    cond.shape, cond.dtype = (), "bool"
+    return cond

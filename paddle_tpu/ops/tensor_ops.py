@@ -56,6 +56,33 @@ def fill_constant_batch_size_like(ctx, ins, attrs):
                              _dev_dtype(attrs.get("dtype", "float32")))]}
 
 
+@register_op("uniform_random_batch_size_like", infer_shape=_fill_bsl_infer)
+def uniform_random_batch_size_like(ctx, ins, attrs):
+    """uniform_random_batch_size_like_op.cc: runtime batch dim from
+    Input (build-time -1 resolves here, like fill_constant_batch_size_like)."""
+    x = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
+    key = (jax.random.PRNGKey(attrs["seed"]) if attrs.get("seed", 0)
+           else ctx.next_rng_key())
+    return {"Out": [jax.random.uniform(
+        key, tuple(shape), _dev_dtype(attrs.get("dtype", "float32")),
+        attrs.get("min", -1.0), attrs.get("max", 1.0))]}
+
+
+@register_op("gaussian_random_batch_size_like", infer_shape=_fill_bsl_infer)
+def gaussian_random_batch_size_like(ctx, ins, attrs):
+    """gaussian_random_batch_size_like_op.cc."""
+    x = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
+    key = (jax.random.PRNGKey(attrs["seed"]) if attrs.get("seed", 0)
+           else ctx.next_rng_key())
+    dt = _dev_dtype(attrs.get("dtype", "float32"))
+    out = jax.random.normal(key, tuple(shape), dt)
+    return {"Out": [out * attrs.get("std", 1.0) + attrs.get("mean", 0.0)]}
+
+
 @register_op("fill_zeros_like", infer_shape=same_shape())
 def fill_zeros_like(ctx, ins, attrs):
     return {"Out": [jnp.zeros_like(ins["X"][0])]}
@@ -455,11 +482,13 @@ def linspace(ctx, ins, attrs):
 
 @register_op("bilinear_interp")
 def bilinear_interp(ctx, ins, attrs):
-    """bilinear_interp_op.cc: NCHW resize via jax.image."""
+    """bilinear_interp_op.cc: NCHW resize via jax.image (`method` attr
+    also admits "nearest" for layers.image_resize(resample="NEAREST"))."""
     x = ins["X"][0]
     oh = attrs.get("out_h")
     ow = attrs.get("out_w")
-    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="bilinear")
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow),
+                           method=attrs.get("method", "bilinear"))
     return {"Out": [out]}
 
 

@@ -292,12 +292,16 @@ def gru_unit(ctx, ins, attrs):
     x, h_prev, w = ins["Input"][0], ins["HiddenPrev"][0], ins["Weight"][0]
     d = h_prev.shape[-1]
     bias = ins["Bias"][0] if ins.get("Bias") else 0.0
+    acts = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}
+    gact = acts[attrs.get("gate_activation", "sigmoid")]
+    cact = acts[attrs.get("activation", "tanh")]
     xs = x + bias
     xu, xr, xc = xs[:, :d], xs[:, d:2 * d], xs[:, 2 * d:]
     wu, wr, wc = w[:, :d], w[:, d:2 * d], w[:, 2 * d:]
-    u = jax.nn.sigmoid(xu + h_prev @ wu)
-    r = jax.nn.sigmoid(xr + h_prev @ wr)
-    c = jnp.tanh(xc + (r * h_prev) @ wc)
+    u = gact(xu + h_prev @ wu)
+    r = gact(xr + h_prev @ wr)
+    c = cact(xc + (r * h_prev) @ wc)
     # gru_unit_op.h:116: h = u * (c - h_prev) + h_prev = u*c + (1-u)*h_prev
     h = u * c + (1.0 - u) * h_prev
     return {"Hidden": [h], "Gate": [jnp.concatenate([u, r, c], -1)],

@@ -8,7 +8,7 @@ cd "$(dirname "$0")/.."
 echo "== unit + integration tests (8-device virtual CPU mesh) =="
 python -m pytest tests/ -x -q
 
-echo "== multi-chip dryrun (dp x tp, dp x sp x tp, pp x dp) =="
+echo "== multi-chip dryrun (dp x tp, dp x sp x tp, pp x dp, ep x dp) =="
 python __graft_entry__.py dryrun 8
 
 if [[ "${1:-}" != "quick" ]]; then

@@ -1,0 +1,159 @@
+"""Layer-surface stragglers (≙ fluid.layers __all__ parity): cos_sim,
+multiplex, dice_loss, image_resize, gru_unit/lstm_unit, random layers,
+sum/is_empty, Print, array_length, max_sequence_len, multi_box_head."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(build, feed, n=1):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        outs = build()
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+    exe = pt.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=list(outs))
+
+
+def test_cos_sim():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32)
+    y = rng.randn(4, 8).astype(np.float32)
+    (got,) = _run(lambda: layers.cos_sim(layers.data("x", [8]),
+                                         layers.data("y", [8])),
+                  {"x": x, "y": y})
+    want = (x * y).sum(-1) / (np.linalg.norm(x, axis=-1)
+                              * np.linalg.norm(y, axis=-1))
+    np.testing.assert_allclose(np.ravel(got), want, rtol=1e-5)
+
+
+def test_multiplex():
+    rng = np.random.RandomState(1)
+    a, b = rng.randn(2, 5, 3).astype(np.float32)
+    idx = np.array([[1], [0], [1], [1], [0]], np.int32)
+
+    def build():
+        av = layers.data("a", [3], append_batch_size=True)
+        bv = layers.data("b", [3])
+        iv = layers.data("i", [1], dtype="int32")
+        return layers.multiplex([av, bv], iv)
+
+    (got,) = _run(build, {"a": a, "b": b, "i": idx})
+    want = np.where(idx == 0, a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_dice_loss_and_random_layers():
+    rng = np.random.RandomState(2)
+    probs = rng.rand(6, 4).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    label = rng.randint(0, 4, (6, 1)).astype(np.int64)
+
+    def build():
+        p = layers.data("p", [4])
+        y = layers.data("y", [1], dtype="int64")
+        loss = layers.dice_loss(p, y)
+        noise = layers.uniform_random_batch_size_like(p, [-1, 4])
+        g = layers.gaussian_random([3, 2], std=2.0)
+        return loss, noise, g
+
+    loss, noise, g = _run(build, {"p": probs, "y": label}, 3)
+    assert 0.0 <= float(np.ravel(loss)[0]) <= 1.0
+    assert noise.shape == (6, 4) and g.shape == (3, 2)
+    assert np.abs(np.asarray(noise)).max() <= 1.0
+
+
+def test_image_resize():
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 3, 8, 6).astype(np.float32)
+    (got,) = _run(lambda: layers.image_resize(
+        layers.data("x", [3, 8, 6]), out_shape=[16, 12]), {"x": x})
+    assert got.shape == (2, 3, 16, 12)
+    (got2,) = _run(lambda: layers.image_resize_short(
+        layers.data("x", [3, 8, 6]), 12), {"x": x})
+    assert got2.shape == (2, 3, 16, 12)
+
+
+def test_gru_lstm_units_step():
+    rng = np.random.RandomState(4)
+    B, D = 3, 4
+    xg = rng.randn(B, 3 * D).astype(np.float32) * 0.3
+    h0 = rng.randn(B, D).astype(np.float32) * 0.3
+    xt = rng.randn(B, 5).astype(np.float32)
+    c0 = rng.randn(B, D).astype(np.float32) * 0.3
+
+    def build():
+        x = layers.data("xg", [3 * D])
+        h = layers.data("h0", [D])
+        hn, rh, gate = layers.gru_unit(x, h, size=3 * D)
+        xv = layers.data("xt", [5])
+        cv = layers.data("c0", [D])
+        h2, c2 = layers.lstm_unit(xv, h, cv)
+        return hn, h2, c2
+
+    hn, h2, c2 = _run(build, {"xg": xg, "h0": h0, "xt": xt, "c0": c0}, 3)
+    assert hn.shape == (B, D) and h2.shape == (B, D) and c2.shape == (B, D)
+    assert np.isfinite(np.asarray(hn)).all()
+    assert np.isfinite(np.asarray(c2)).all()
+
+
+def test_sum_is_empty_print_array_length(capfd):
+    x = np.ones((2, 3), np.float32)
+
+    def build():
+        xv = layers.data("x", [3])
+        s = layers.sum([xv, xv])
+        e = layers.is_empty(xv)
+        p = layers.Print(s, message="dbg: ")
+        arr = layers.create_array("float32", max_len=5, element_shape=(3,))
+        n = layers.array_length(arr)
+        return s, e, p, n
+
+    s, e, p, n = _run(build, {"x": x}, 4)
+    np.testing.assert_allclose(s, 2 * x)
+    assert bool(np.ravel(e)[0]) is False
+    np.testing.assert_allclose(p, 2 * x)
+    assert int(np.ravel(n)[0]) == 5
+
+
+def test_max_sequence_len():
+    def build():
+        x = layers.data("x", [2], lod_level=1)
+        return layers.max_sequence_len(x)
+
+    seqs = [np.ones((4, 2), np.float32), np.ones((7, 2), np.float32)]
+    (got,) = _run(build, {"x": seqs})
+    assert int(np.ravel(got)[0]) == 7
+
+
+def test_multi_box_head():
+    rng = np.random.RandomState(5)
+    maps = [rng.rand(2, 8, 16, 16).astype(np.float32),
+            rng.rand(2, 8, 8, 8).astype(np.float32),
+            rng.rand(2, 8, 4, 4).astype(np.float32)]
+    img = rng.rand(2, 3, 64, 64).astype(np.float32)
+
+    def build():
+        ins = [layers.data(f"m{i}", list(m.shape[1:]))
+               for i, m in enumerate(maps)]
+        image = layers.data("img", [3, 64, 64])
+        locs, confs, boxes, vars_ = layers.multi_box_head(
+            ins, image, base_size=64, num_classes=5,
+            aspect_ratios=[[2.0]] * 3, min_ratio=20, max_ratio=90,
+            flip=True)
+        return locs, confs, boxes, vars_
+
+    feed = {f"m{i}": m for i, m in enumerate(maps)}
+    feed["img"] = img
+    locs, confs, boxes, vars_ = _run(build, feed, 4)
+    # priors per cell: ars {1, 2, 0.5} x 1 min + 1 max = 4
+    total = 4 * (16 * 16 + 8 * 8 + 4 * 4)
+    assert boxes.shape == (total, 4)
+    assert vars_.shape == (total, 4)
+    assert locs.shape == (2, total, 4)
+    assert confs.shape == (2, total, 5)
