@@ -1,0 +1,29 @@
+"""kube job generator (≙ benchmark/fluid/kube_gen_job.py): manifest
+wires the PADDLE_* env contract that parallel.distributed
+initialize_from_env consumes."""
+
+import pathlib
+import subprocess
+import sys
+
+TOOL = str(pathlib.Path(__file__).resolve().parent.parent
+           / "tools" / "kube_gen_job.py")
+
+
+def test_manifest_wires_env_contract():
+    out = subprocess.run(
+        [sys.executable, TOOL, "--jobname", "tj",
+         "--hosts", "4", "--port", "7001", "--env", "FLAGS_check_nan_inf=1",
+         "--entry", "python -m train"],
+        capture_output=True, text=True, check=True).stdout
+    assert "replicas: 4" in out
+    assert 'name: PADDLE_TRAINERS' in out and '"4"' in out
+    assert '"tj-0.tj-workers:7001"' in out          # coordinator = worker 0
+    assert "PADDLE_TRAINER_ID=${HOSTNAME##*-}" in out  # pod ordinal -> id
+    assert "FLAGS_check_nan_inf" in out
+    assert "kind: StatefulSet" in out and "kind: Service" in out
+    # well-formed YAML documents (parse both)
+    yaml = __import__("pytest").importorskip("yaml")
+    docs = list(yaml.safe_load_all(out))
+    assert len(docs) == 2
+    assert docs[1]["spec"]["replicas"] == 4

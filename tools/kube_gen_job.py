@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Generate Kubernetes job manifests for multi-host training.
+
+≙ reference benchmark/fluid/kube_gen_job.py + kube_templates/: the
+reference wires pserver+trainer StatefulSets with the
+PADDLE_TRAINING_ROLE / PADDLE_PSERVER_IPS env contract. The TPU-native
+deployment has no pserver tier (collectives over ICI/DCN replace it —
+SURVEY §2.3), so this emits ONE indexed Job/StatefulSet of `--hosts`
+workers wired with the contract `parallel/distributed.py
+initialize_from_env` reads:
+
+    PADDLE_TRAINERS     — number of host processes
+    PADDLE_TRAINER_ID   — this host's index (from the pod ordinal)
+    PADDLE_COORDINATOR  — host:port of worker 0 (jax.distributed
+                          rendezvous ≙ gen_nccl_id)
+
+Pure stdlib (no pyyaml needed — manifests are written as YAML text).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="Generate TPU dist job yaml.")
+    p.add_argument("--jobname", default="paddletpu-job")
+    p.add_argument("--image", default="paddle-tpu:latest")
+    p.add_argument("--hosts", type=int, default=2,
+                   help="number of host processes (TPU VM workers)")
+    p.add_argument("--port", type=int, default=6174,
+                   help="coordinator port on worker 0")
+    p.add_argument("--cpu", type=int, default=8)
+    p.add_argument("--memory", default="16Gi")
+    p.add_argument("--tpu-resource", default="google.com/tpu",
+                   help="device resource key (empty string to omit)")
+    p.add_argument("--tpu-count", type=int, default=4)
+    p.add_argument("--entry", default="python train.py")
+    p.add_argument("--env", action="append", default=[],
+                   metavar="K=V", help="extra env vars")
+    args = p.parse_args(argv)
+    for e in args.env:
+        if "=" not in e:
+            p.error(f"--env expects K=V, got {e!r}")
+    return args
+
+
+def gen_job(args) -> str:
+    """One headless Service (stable worker-0 DNS) + one StatefulSet whose
+    pod ordinal becomes PADDLE_TRAINER_ID."""
+    svc = args.jobname + "-workers"
+    coordinator = f"{args.jobname}-0.{svc}:{args.port}"
+    extra_env = "".join(
+        f"""
+        - name: {k}
+          value: {json.dumps(v)}"""
+        for k, v in (e.split("=", 1) for e in args.env))
+    resources = f"""
+            limits:
+              cpu: "{args.cpu}"
+              memory: {args.memory}"""
+    if args.tpu_resource:
+        resources += f"""
+              {args.tpu_resource}: "{args.tpu_count}\""""
+    return f"""apiVersion: v1
+kind: Service
+metadata:
+  name: {svc}
+spec:
+  clusterIP: None
+  selector:
+    app: {args.jobname}
+  ports:
+  - port: {args.port}
+---
+apiVersion: apps/v1
+kind: StatefulSet
+metadata:
+  name: {args.jobname}
+spec:
+  serviceName: {svc}
+  replicas: {args.hosts}
+  podManagementPolicy: Parallel
+  selector:
+    matchLabels:
+      app: {args.jobname}
+  template:
+    metadata:
+      labels:
+        app: {args.jobname}
+    spec:
+      containers:
+      - name: trainer
+        image: {args.image}
+        command: ["/bin/sh", "-c"]
+        args:
+        - >
+          export PADDLE_TRAINER_ID=${{HOSTNAME##*-}} &&
+          exec {args.entry}
+        env:
+        - name: PADDLE_TRAINERS
+          value: "{args.hosts}"
+        - name: PADDLE_COORDINATOR
+          value: {json.dumps(coordinator)}{extra_env}
+        ports:
+        - containerPort: {args.port}
+        resources:{resources}
+"""
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    print(gen_job(args))
+
+
+if __name__ == "__main__":
+    main()
