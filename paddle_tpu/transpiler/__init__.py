@@ -3,7 +3,9 @@
 from .distribute_transpiler import (DistributeTranspiler, TranspileStrategy,
                                     transpile)
 from .memory_optimize import memory_optimize, release_memory
-from .inference_transpiler import InferenceTranspiler
+from .inference_transpiler import (InferenceTranspiler,
+                                    Float16Transpiler)
 
 __all__ = ["DistributeTranspiler", "TranspileStrategy", "transpile",
-           "memory_optimize", "release_memory", "InferenceTranspiler"]
+           "memory_optimize", "release_memory", "InferenceTranspiler",
+           "Float16Transpiler"]

@@ -125,3 +125,62 @@ class InferenceTranspiler:
                      {"X": [conv.outputs["Output"][0]], "Y": [bias_name]},
                      {"Out": [bn.outputs["Y"][0]]}, {"axis": 1})
         return [conv, add]
+
+
+class Float16Transpiler:
+    """Low-precision inference transpiler.
+
+    ≙ reference paddle/contrib/float16/float16_transpiler.py:21-72: that
+    one casts the saved weights to fp16, rewrites kernels to fp16, and
+    inserts cast ops around feed/fetch. The TPU reading: weights in the
+    scope are cast to bfloat16 (the TPU's fast half type — halves weight
+    HBM), the program's vars are re-typed, and `amp_dtype` is set so the
+    whole forward computes in bf16; the executor's per-op dtype
+    harmonization plays the reference's boundary cast ops (any f32 feed
+    is cast down where it meets a bf16 weight, results come back f32 at
+    the fetch if the final op is f32 — no graph surgery needed).
+    """
+
+    #: per-op input slots whose vars stay f32 (normalization statistics —
+    #: cast stats would shift the normalized distribution)
+    _KEEP_SLOTS = {"batch_norm": ("Mean", "Variance")}
+
+    def _stat_names(self, program: Program):
+        keep = set()
+        for block in program.blocks:
+            for op in block.ops:
+                for slot in self._KEEP_SLOTS.get(op.type, ()):
+                    keep.update(op.input(slot))
+        return keep
+
+    def transpile(self, program: Optional[Program] = None,
+                  scope: Optional[Scope] = None,
+                  dtype: str = "bfloat16"):
+        import ml_dtypes
+        if dtype not in ("bfloat16", "float16"):
+            raise ValueError(
+                f"Float16Transpiler: dtype must be 'bfloat16' or 'float16', "
+                f"got {dtype!r}")
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        if any(op.type == "autodiff" for op in program.global_block.ops):
+            raise ValueError(
+                "Float16Transpiler needs an inference program (it would "
+                "quantize the f32 master weights a training program "
+                "updates); clone(for_test=True).prune([target]) first")
+        target = ml_dtypes.bfloat16 if dtype == "bfloat16" else np.float16
+        keep = self._stat_names(program)
+        for block in program.blocks:
+            for var in block.vars.values():
+                if not var.persistable or var.dtype != "float32":
+                    continue
+                if var.name in keep:
+                    continue
+                val = scope.find_var(var.name)
+                if val is None:
+                    continue
+                scope.set_var(var.name, np.asarray(val).astype(target))
+                var.dtype = dtype
+        program.amp_dtype = dtype
+        program.invalidate_cache()
+        return program

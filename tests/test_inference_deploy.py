@@ -145,3 +145,49 @@ class TestServingExport:
                            capture_output=True, text=True, timeout=120)
         assert r.returncode == 0, r.stdout + r.stderr
         assert "SERVED (1, 2)" in r.stdout
+
+
+class TestFloat16Transpiler:
+    """≙ contrib/float16/float16_transpiler.py: weights cast to bf16,
+    forward computes low-precision, outputs track f32 within bf16
+    tolerance."""
+
+    def test_bf16_inference_close_to_f32(self, tmp_path):
+        import ml_dtypes
+        from paddle_tpu.transpiler.inference_transpiler import (
+            Float16Transpiler)
+        rng = np.random.RandomState(0)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", [3, 16, 16])
+            h = layers.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                              act="relu")
+            h = layers.batch_norm(h, act="relu", is_test=True)
+            pred = layers.fc(h, size=10, act="softmax")
+        exe = pt.Executor()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            feed = {"img": rng.rand(2, 3, 16, 16).astype(np.float32)}
+            (want,) = exe.run(main, feed=feed, fetch_list=[pred])
+
+            Float16Transpiler().transpile(main, scope)
+            # weights really are bf16 now; BN stats stay f32
+            params = [v for v in main.global_block.vars.values()
+                      if v.persistable]
+            cast = [v for v in params if v.dtype == "bfloat16"]
+            assert cast, "no parameter was cast"
+            for v in cast:
+                assert np.asarray(scope.find_var(v.name)).dtype == \
+                    ml_dtypes.bfloat16
+            bn_ops = [op for op in main.global_block.ops
+                      if op.type == "batch_norm"]
+            stat_names = {n for op in bn_ops
+                          for n in op.input("Mean") + op.input("Variance")}
+            kept = [v for v in params if v.name in stat_names]
+            assert kept and all(v.dtype == "float32" for v in kept)
+
+            (got,) = exe.run(main, feed=feed, fetch_list=[pred])
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   atol=2e-2, rtol=2e-2)

@@ -16,14 +16,17 @@ def test_manifest_wires_env_contract():
          "--hosts", "4", "--port", "7001", "--env", "FLAGS_check_nan_inf=1",
          "--entry", "python -m train"],
         capture_output=True, text=True, check=True).stdout
-    assert "replicas: 4" in out
+    assert "completions: 4" in out and "parallelism: 4" in out
     assert 'name: PADDLE_TRAINERS' in out and '"4"' in out
     assert '"tj-0.tj-workers:7001"' in out          # coordinator = worker 0
-    assert "PADDLE_TRAINER_ID=${HOSTNAME##*-}" in out  # pod ordinal -> id
+    assert "PADDLE_TRAINER_ID=${JOB_COMPLETION_INDEX}" in out
     assert "FLAGS_check_nan_inf" in out
-    assert "kind: StatefulSet" in out and "kind: Service" in out
+    assert "kind: Job" in out and "kind: Service" in out
+    assert "completionMode: Indexed" in out
+    assert "publishNotReadyAddresses: true" in out
+    assert "restartPolicy: Never" in out
     # well-formed YAML documents (parse both)
     yaml = __import__("pytest").importorskip("yaml")
     docs = list(yaml.safe_load_all(out))
     assert len(docs) == 2
-    assert docs[1]["spec"]["replicas"] == 4
+    assert docs[1]["spec"]["completions"] == 4

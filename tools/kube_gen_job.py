@@ -47,8 +47,13 @@ def parse_args(argv=None):
 
 
 def gen_job(args) -> str:
-    """One headless Service (stable worker-0 DNS) + one StatefulSet whose
-    pod ordinal becomes PADDLE_TRAINER_ID."""
+    """One headless Service (stable worker-0 DNS; publishes not-ready
+    addresses so the rendezvous name resolves before worker 0 is Ready)
+    + one Indexed Job: the completion index becomes PADDLE_TRAINER_ID and
+    the job TERMINATES when training completes (a StatefulSet's mandatory
+    restartPolicy Always would re-run training forever). The Job
+    controller sets each pod's hostname to <job>-<index>, so with
+    `subdomain` pointing at the Service, worker 0 is <job>-0.<svc>."""
     svc = args.jobname + "-workers"
     coordinator = f"{args.jobname}-0.{svc}:{args.port}"
     extra_env = "".join(
@@ -69,34 +74,35 @@ metadata:
   name: {svc}
 spec:
   clusterIP: None
+  publishNotReadyAddresses: true
   selector:
     app: {args.jobname}
   ports:
   - port: {args.port}
 ---
-apiVersion: apps/v1
-kind: StatefulSet
+apiVersion: batch/v1
+kind: Job
 metadata:
   name: {args.jobname}
 spec:
-  serviceName: {svc}
-  replicas: {args.hosts}
-  podManagementPolicy: Parallel
-  selector:
-    matchLabels:
-      app: {args.jobname}
+  completionMode: Indexed
+  completions: {args.hosts}
+  parallelism: {args.hosts}
+  backoffLimit: 0
   template:
     metadata:
       labels:
         app: {args.jobname}
     spec:
+      subdomain: {svc}
+      restartPolicy: Never
       containers:
       - name: trainer
         image: {args.image}
         command: ["/bin/sh", "-c"]
         args:
         - >
-          export PADDLE_TRAINER_ID=${{HOSTNAME##*-}} &&
+          export PADDLE_TRAINER_ID=${{JOB_COMPLETION_INDEX}} &&
           exec {args.entry}
         env:
         - name: PADDLE_TRAINERS
