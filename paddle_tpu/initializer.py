@@ -152,3 +152,30 @@ TruncatedNormal = TruncatedNormalInitializer
 Xavier = XavierInitializer
 MSRA = MSRAInitializer
 Bilinear = BilinearInitializer
+
+
+_force_init_on_cpu = False
+
+
+def force_init_on_cpu() -> bool:
+    """≙ initializer.py force_init_on_cpu flag. On this runtime XLA owns
+    placement — initializer ops run wherever the startup program is
+    dispatched — so the flag is recorded for API parity and read by
+    nothing (the reference used it to keep large inits off the GPU)."""
+    return _force_init_on_cpu
+
+
+class init_on_cpu:
+    """≙ initializer.py init_on_cpu() context guard (API parity; see
+    force_init_on_cpu)."""
+
+    def __enter__(self):
+        global _force_init_on_cpu
+        self._prev = _force_init_on_cpu
+        _force_init_on_cpu = True
+        return self
+
+    def __exit__(self, *exc):
+        global _force_init_on_cpu
+        _force_init_on_cpu = self._prev
+        return False

@@ -57,20 +57,21 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
     vars = [main_program.global_block.var(v) if isinstance(v, str) else v
             for v in vars]
     os.makedirs(dirname, exist_ok=True)
+    absent = [v.name for v in vars if scope.find_var(v.name) is None]
+    if absent:
+        # symmetric with load_vars' strictness: a partial save would only
+        # surface at load time with a misleading error
+        raise ValueError(
+            f"save_vars: {len(absent)} variable(s) have no value in the "
+            f"scope (run the startup program first?): {absent[:5]}"
+            f"{'...' if len(absent) > 5 else ''}")
     if filename is not None:
-        combined = {}
-        for v in vars:
-            val = scope.find_var(v.name)
-            if val is not None:
-                combined[v.name] = np.asarray(val)
+        combined = {v.name: np.asarray(scope.find_var(v.name)) for v in vars}
         np.savez(os.path.join(dirname, filename), **combined)
         return
     for v in vars:
-        val = scope.find_var(v.name)
-        if val is None:
-            continue
         np.save(os.path.join(dirname, v.name.replace("/", "__")),
-                np.asarray(val))
+                np.asarray(scope.find_var(v.name)))
 
 
 def save_params(executor=None, dirname: str = "", main_program=None,
@@ -95,17 +96,34 @@ def load_vars(executor=None, dirname: str = "", main_program=None, vars=None,
     vars = [main_program.global_block.var(v) if isinstance(v, str) else v
             for v in vars]
     if filename is not None:
-        data = np.load(os.path.join(dirname, filename)
-                       if not filename.endswith(".npz")
-                       else os.path.join(dirname, filename), allow_pickle=False)
+        # np.savez appends ".npz" to suffixless names on save: mirror it
+        if not filename.endswith(".npz"):
+            filename = filename + ".npz"
+        data = np.load(os.path.join(dirname, filename), allow_pickle=False)
+        missing = [v.name for v in vars if v.name not in data]
+        if missing:
+            # ≙ load_op.cc PADDLE_ENFORCE on a missing variable: loading
+            # nothing silently would "resume" training from scratch
+            raise FileNotFoundError(
+                f"load_vars: {len(missing)} variable(s) absent from "
+                f"{filename!r}: {missing[:5]}{'...' if len(missing) > 5 else ''}")
         for v in vars:
-            if v.name in data:
-                scope.set_var(v.name, data[v.name])
+            scope.set_var(v.name, data[v.name])
         return
+    missing = []
     for v in vars:
         path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
         if os.path.exists(path):
             scope.set_var(v.name, np.load(path))
+        else:
+            missing.append(v.name)
+    if missing:
+        raise FileNotFoundError(
+            f"load_vars: no saved file for {len(missing)} variable(s) in "
+            f"{dirname!r}: {missing[:5]}{'...' if len(missing) > 5 else ''} "
+            "(wrong dirname, or the program names differ from the saved "
+            "run's — e.g. programs built after others in the same process "
+            "get different unique_name suffixes)")
 
 
 def load_params(executor=None, dirname: str = "", main_program=None,
@@ -327,3 +345,35 @@ def _scroll_delete(checkpoint_dir: str, max_num_checkpoints: int):
     serials.sort(reverse=True)
     for s in serials[max_num_checkpoints:]:
         shutil.rmtree(_serial_dir(checkpoint_dir, s), ignore_errors=True)
+
+
+def _is_checkpoint_var(var) -> bool:
+    """≙ io.py:_is_checkpoint_var — persistable, but not gradients or
+    feed/fetch plumbing (a trainer checkpoints model+optimizer state
+    only)."""
+    name = var.name
+    if not _is_persistable(var):
+        return False
+    return "@GRAD" not in name and name not in ("feed", "fetch")
+
+
+def save_persist_vars_without_grad(executor, dirname, program,
+                                   filename=None, scope=None):
+    """≙ io.py save_persist_vars_without_grad (io.py:545 area): the
+    distributed-checkpoint flavor of save_persistables — every
+    persistable except gradient buffers."""
+    return save_vars(executor, dirname, main_program=program,
+                     predicate=_is_checkpoint_var, filename=filename,
+                     scope=scope)
+
+
+def load_persist_vars_without_grad(executor, dirname, program,
+                                   has_model_dir=False, filename=None,
+                                   scope=None):
+    """≙ io.py load_persist_vars_without_grad:545 (has_model_dir: the
+    checkpoint layout keeps model vars under <dir>/__model__-era
+    subdirectory in the reference; here serial dirs already separate,
+    so it selects the same directory)."""
+    return load_vars(executor, dirname, main_program=program,
+                     predicate=_is_checkpoint_var, filename=filename,
+                     scope=scope)

@@ -150,3 +150,48 @@ def test_metrics_accumulators():
     labels = np.array([1, 0, 1, 0])
     auc.update(preds, labels)
     assert auc.eval() > 0.9
+
+
+class TestPersistVarsWithoutGrad:
+    """≙ reference io.py save/load_persist_vars_without_grad: gradient
+    buffers excluded, model+optimizer state round-trips."""
+
+    def test_round_trip_excludes_grads(self, tmp_path):
+        rng = np.random.RandomState(0)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            y = layers.data("y", [1])
+            pred = layers.fc(x, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.optimizer.MomentumOptimizer(learning_rate=0.1,
+                                           momentum=0.9).minimize(loss)
+        exe = pt.Executor()
+        scope = pt.Scope()
+        feed = {"x": rng.rand(4, 4).astype(np.float32),
+                "y": rng.rand(4, 1).astype(np.float32)}
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            exe.run(main, feed=feed, fetch_list=[loss])
+            pt.io.save_persist_vars_without_grad(exe, str(tmp_path), main,
+                                                 scope=scope)
+            want = {n: np.asarray(scope.find_var(n))
+                    for n in scope.local_var_names()
+                    if "@GRAD" not in n}
+        import os
+        saved = set(os.listdir(str(tmp_path)))
+        assert saved and not any("@GRAD" in n for n in saved)
+
+        scope2 = pt.Scope()
+        with pt.scope_guard(scope2):
+            exe.run(startup)
+            pt.io.load_persist_vars_without_grad(exe, str(tmp_path), main,
+                                                 scope=scope2)
+            compared = 0
+            for n, v in want.items():
+                if scope2.has_var(n) and scope2.find_var(n) is not None:
+                    got = np.asarray(scope2.find_var(n))
+                    assert got.shape == v.shape, n
+                    np.testing.assert_allclose(got, v, rtol=1e-6)
+                    compared += 1
+            assert compared >= len([p for p in want if "w_0" in p or "b_0" in p])
