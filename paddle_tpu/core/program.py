@@ -401,6 +401,32 @@ class Program:
         """
         p = self.clone()
         blk = p.global_block
+
+        def op_block_refs(op):
+            refs = []
+            for key in ("sub_block", "true_block", "false_block"):
+                if key in op.attrs:
+                    refs.append(op.attrs[key])
+            refs.extend(op.attrs.get("sub_blocks", ()))  # Switch cases
+            return refs
+
+        def sub_block_names(op):
+            """Every name any reachable sub-block of `op` touches —
+            sub-block ops read global vars the control-flow op does not
+            declare (parameters created inside rnn.block(), undeclared
+            captures), and their producers must survive pruning
+            (≙ prune.cc keeping sub-block dependencies whole)."""
+            names, todo, seen = set(), op_block_refs(op), set()
+            while todo:
+                bi = todo.pop()
+                if bi in seen or bi >= len(p.blocks):
+                    continue
+                seen.add(bi)
+                for sop in p.blocks[bi].ops:
+                    names |= set(sop.input_names()) | set(sop.output_names())
+                    todo.extend(op_block_refs(sop))
+            return names
+
         needed = set(targets)
         kept: List[OpDesc] = []
         for op in reversed(blk.ops):
@@ -410,11 +436,16 @@ class Program:
             if produces & needed or op.attrs.get("__side_effect__", False):
                 kept.append(op)
                 needed |= set(op.input_names())
+                # keep producers of everything the op's sub-blocks read
+                # (their block-0 producers come LATER in this reversed
+                # walk, so seeding here is sufficient)
+                needed |= sub_block_names(op)
         kept.reverse()
         blk.ops = kept
         used = set(feeds) | set(targets)
         for op in kept:
             used |= set(op.input_names()) | set(op.output_names())
+            used |= sub_block_names(op)
         blk.vars = {n: v for n, v in blk.vars.items() if n in used}
         return p
 

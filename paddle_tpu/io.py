@@ -57,7 +57,8 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
     vars = [main_program.global_block.var(v) if isinstance(v, str) else v
             for v in vars]
     os.makedirs(dirname, exist_ok=True)
-    absent = [v.name for v in vars if scope.find_var(v.name) is None]
+    values = {v.name: scope.find_var(v.name) for v in vars}
+    absent = [n for n, val in values.items() if val is None]
     if absent:
         # symmetric with load_vars' strictness: a partial save would only
         # surface at load time with a misleading error
@@ -66,12 +67,11 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
             f"scope (run the startup program first?): {absent[:5]}"
             f"{'...' if len(absent) > 5 else ''}")
     if filename is not None:
-        combined = {v.name: np.asarray(scope.find_var(v.name)) for v in vars}
-        np.savez(os.path.join(dirname, filename), **combined)
+        np.savez(os.path.join(dirname, filename),
+                 **{n: np.asarray(v) for n, v in values.items()})
         return
-    for v in vars:
-        np.save(os.path.join(dirname, v.name.replace("/", "__")),
-                np.asarray(scope.find_var(v.name)))
+    for n, val in values.items():
+        np.save(os.path.join(dirname, n.replace("/", "__")), np.asarray(val))
 
 
 def save_params(executor=None, dirname: str = "", main_program=None,

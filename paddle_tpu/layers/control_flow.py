@@ -354,6 +354,7 @@ class DynamicRNN:
         self.sub_block = self.main_program.create_block(parent_idx)
         self.parent_block = self.main_program.block(parent_idx)
         self.step_outer: List[VarDesc] = []
+        self.static_outer: List[VarDesc] = []
         self.step_inner: List[VarDesc] = []
         self.memories: List[VarDesc] = []
         self.mem_init_vars: List[Optional[VarDesc]] = []
@@ -433,8 +434,13 @@ class DynamicRNN:
         reference copies/reorders a parent-scope LoDTensor into each step
         scope; here sub-block ops read outer vars directly from the
         enclosing trace environment (ops/rnn_ops.py dynamic_rnn `outer_env`),
-        so the full [B, T, ...] tensor is visible at every step as-is."""
+        so the full [B, T, ...] tensor is visible at every step as-is.
+        The var is also DECLARED as a dynamic_rnn input ("Statics") so
+        program pruning (io.get_inference_program) keeps its producer —
+        an undeclared capture would be dead-code-eliminated."""
         self._assert_in_rnn("static_input")
+        if x not in self.static_outer:
+            self.static_outer.append(x)
         return x
 
     def update_memory(self, ex_mem: VarDesc, new_mem: VarDesc):
@@ -472,6 +478,8 @@ class DynamicRNN:
                   "SeqLen": [self.seq_len_name],
                   "InitMems": [v.name for v in self.mem_init_vars
                                if v is not None]}
+        if self.static_outer:
+            inputs["Statics"] = [v.name for v in self.static_outer]
         block.append_op(
             "dynamic_rnn", inputs,
             {"Out": [o.name for o in outs],

@@ -1,0 +1,90 @@
+"""Seq2seq WITHOUT attention: bi-LSTM encoder, plain LSTM decoder.
+
+≙ reference tests/book/test_rnn_encoder_decoder.py (bi_lstm_encoder :40,
+lstm_step :62, lstm_decoder_without_attention :85, seq_to_seq_net :115):
+the encoder's last-forward/first-backward states concatenate into one
+fixed context vector fed to every decoder step (no attention — the
+attention variant is models/machine_translation.py). The decoder is a
+hand-built LSTM cell inside DynamicRNN (per-step fc gates), exercising
+the sub-block-to-lax.scan lowering rather than the fused kernel.
+"""
+
+from __future__ import annotations
+
+from .. import layers
+
+USE_PEEPHOLES = False
+
+
+def bi_lstm_encoder(input_seq, hidden_size):
+    """:40 — returns (forward last step, backward first step)."""
+    fwd_proj = layers.fc(input=input_seq, size=hidden_size * 4,
+                         bias_attr=True)
+    forward, _ = layers.dynamic_lstm(fwd_proj, size=hidden_size * 4,
+                                     use_peepholes=USE_PEEPHOLES)
+    bwd_proj = layers.fc(input=input_seq, size=hidden_size * 4,
+                         bias_attr=True)
+    backward, _ = layers.dynamic_lstm(bwd_proj, size=hidden_size * 4,
+                                      is_reverse=True,
+                                      use_peepholes=USE_PEEPHOLES)
+    return (layers.sequence_last_step(forward),
+            layers.sequence_first_step(backward))
+
+
+def lstm_step(x_t, hidden_t_prev, cell_t_prev, size):
+    """:62 — an LSTM cell composed from fc gates (the reference notes it
+    predates lstm_unit_op; kept composed for book parity)."""
+    def linear(inputs):
+        return layers.fc(input=inputs, size=size, bias_attr=True)
+
+    forget_gate = layers.sigmoid(linear([hidden_t_prev, x_t]))
+    input_gate = layers.sigmoid(linear([hidden_t_prev, x_t]))
+    output_gate = layers.sigmoid(linear([hidden_t_prev, x_t]))
+    cell_tilde = layers.tanh(linear([hidden_t_prev, x_t]))
+    cell_t = layers.sums([layers.elementwise_mul(forget_gate, cell_t_prev),
+                          layers.elementwise_mul(input_gate, cell_tilde)])
+    hidden_t = layers.elementwise_mul(output_gate, layers.tanh(cell_t))
+    return hidden_t, cell_t
+
+
+def lstm_decoder_without_attention(target_embedding, decoder_boot, context,
+                                   decoder_size, target_dict_dim):
+    """:85 — every step sees the SAME encoder context (static input)."""
+    rnn = layers.DynamicRNN()
+    cell_init = layers.fill_constant_batch_size_like(
+        input=decoder_boot, value=0.0, shape=[-1, decoder_size],
+        dtype="float32")
+    cell_init.stop_gradient = False
+    with rnn.block():
+        current_word = rnn.step_input(target_embedding)
+        ctx = rnn.static_input(context)
+        hidden_mem = rnn.memory(init=decoder_boot)
+        cell_mem = rnn.memory(init=cell_init)
+        decoder_inputs = layers.concat([ctx, current_word], axis=1)
+        h, c = lstm_step(decoder_inputs, hidden_mem, cell_mem, decoder_size)
+        rnn.update_memory(hidden_mem, h)
+        rnn.update_memory(cell_mem, c)
+        out = layers.fc(input=h, size=target_dict_dim, bias_attr=True,
+                        act="softmax")
+        rnn.output(out)
+    return rnn()
+
+
+def seq_to_seq_net(source_dict_dim=30000, target_dict_dim=30000,
+                   embedding_dim=512, encoder_size=512, decoder_size=512):
+    """:115 — returns (avg_cost, prediction); feeds: source_sequence,
+    target_sequence, label_sequence (all ragged int64)."""
+    src = layers.data("source_sequence", [1], dtype="int64", lod_level=1)
+    src_emb = layers.embedding(src, [source_dict_dim, embedding_dim])
+    fwd_last, bwd_first = bi_lstm_encoder(src_emb, encoder_size)
+    encoded = layers.concat([fwd_last, bwd_first], axis=1)
+    decoder_boot = layers.fc(input=bwd_first, size=decoder_size,
+                             bias_attr=False, act="tanh")
+    trg = layers.data("target_sequence", [1], dtype="int64", lod_level=1)
+    trg_emb = layers.embedding(trg, [target_dict_dim, embedding_dim])
+    prediction = lstm_decoder_without_attention(
+        trg_emb, decoder_boot, encoded, decoder_size, target_dict_dim)
+    label = layers.data("label_sequence", [1], dtype="int64", lod_level=1)
+    cost = layers.cross_entropy(input=prediction, label=label)
+    avg_cost = layers.mean(cost)
+    return avg_cost, prediction

@@ -173,6 +173,13 @@ class TestPersistVarsWithoutGrad:
         with pt.scope_guard(scope):
             exe.run(startup)
             exe.run(main, feed=feed, fetch_list=[loss])
+            # a persistable gradient buffer MUST be excluded by the
+            # predicate (grads are non-persistable by default, so force
+            # one to actually exercise the exclusion)
+            gvar = main.global_block.create_var("fc_x.w_0@GRAD",
+                                                shape=(1,), dtype="float32",
+                                                persistable=True)
+            scope.set_var(gvar.name, np.zeros(1, np.float32))
             pt.io.save_persist_vars_without_grad(exe, str(tmp_path), main,
                                                  scope=scope)
             want = {n: np.asarray(scope.find_var(n))

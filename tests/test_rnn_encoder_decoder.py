@@ -1,0 +1,62 @@
+"""rnn_encoder_decoder book model e2e (≙ reference
+tests/book/test_rnn_encoder_decoder.py): no-attention seq2seq trains to
+a falling cost with Adagrad, ragged feeds, save/load round trip."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models import rnn_encoder_decoder as red
+
+DIMS = dict(source_dict_dim=40, target_dict_dim=40, embedding_dim=16,
+            encoder_size=16, decoder_size=16)
+
+
+def _batch(rng, n=4):
+    src_lens = rng.randint(2, 6, size=n)
+    trg_lens = rng.randint(2, 5, size=n)
+    return {
+        "source_sequence": [rng.randint(1, 40, (t, 1)).astype(np.int64)
+                            for t in src_lens],
+        "target_sequence": [rng.randint(1, 40, (t, 1)).astype(np.int64)
+                            for t in trg_lens],
+        "label_sequence": [rng.randint(1, 40, (t, 1)).astype(np.int64)
+                           for t in trg_lens],
+    }
+
+
+class TestRnnEncoderDecoder:
+    def test_trains(self, tmp_path):
+        rng = np.random.RandomState(0)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            avg_cost, prediction = red.seq_to_seq_net(**DIMS)
+            pt.optimizer.AdagradOptimizer(learning_rate=0.1).minimize(avg_cost)
+        exe = pt.Executor()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            feed = _batch(rng)
+            costs = [float(np.ravel(np.asarray(
+                exe.run(main, feed=feed, fetch_list=[avg_cost])[0]))[0])
+                for _ in range(10)]
+            assert np.isfinite(costs).all()
+            assert costs[-1] < costs[0]
+
+            # inference export round trip (≙ the book test's
+            # save_inference_model leg)
+            pt.io.save_inference_model(
+                str(tmp_path), ["source_sequence", "target_sequence"],
+                [prediction], exe, main, scope=scope)
+        with pt.scope_guard(pt.Scope()):
+            prog, feeds, fetches = pt.io.load_inference_model(str(tmp_path),
+                                                              exe)
+            feed = _batch(rng)
+            (pred,) = exe.run(prog, feed={
+                "source_sequence": feed["source_sequence"],
+                "target_sequence": feed["target_sequence"]},
+                fetch_list=fetches)
+        pred = np.asarray(pred)
+        assert pred.shape[0] == 4 and pred.shape[-1] == 40
+        # softmax rows sum to one where steps are valid
+        sums = pred.sum(-1)
+        assert ((np.abs(sums - 1.0) < 1e-3) | (np.abs(sums) < 1e-3)).all()
