@@ -429,6 +429,7 @@ class Program:
 
         needed = set(targets)
         kept: List[OpDesc] = []
+        sub_names_union: set = set()
         for op in reversed(blk.ops):
             if op.type in ("feed", "fetch"):
                 continue
@@ -439,13 +440,14 @@ class Program:
                 # keep producers of everything the op's sub-blocks read
                 # (their block-0 producers come LATER in this reversed
                 # walk, so seeding here is sufficient)
-                needed |= sub_block_names(op)
+                names = sub_block_names(op)
+                needed |= names
+                sub_names_union |= names
         kept.reverse()
         blk.ops = kept
-        used = set(feeds) | set(targets)
+        used = set(feeds) | set(targets) | sub_names_union
         for op in kept:
             used |= set(op.input_names()) | set(op.output_names())
-            used |= sub_block_names(op)
         blk.vars = {n: v for n, v in blk.vars.items() if n in used}
         return p
 

@@ -11,6 +11,8 @@ from __future__ import annotations
 import ctypes
 import struct
 import zlib
+
+import numpy as np
 from typing import Iterator, Optional
 
 from .native import recordio_lib
@@ -151,4 +153,55 @@ def reader_creator(path: str):
     """Reader-protocol adapter (≙ open_recordio_file, layers/io.py:295)."""
     def reader():
         return scan(path)
+    return reader
+
+
+def _sample_to_bytes(sample) -> bytes:
+    """One training sample (tuple/list of arrays-or-scalars, or a single
+    array) -> npz bytes. A `__tuple__` marker records the container kind
+    so 1-tuples round-trip as 1-tuples. ≙ the reference's DataFeeder
+    serialization inside convert_reader_to_recordio_file
+    (recordio_writer.py)."""
+    import io as _io
+    buf = _io.BytesIO()
+    is_tuple = isinstance(sample, (tuple, list))
+    arrs = sample if is_tuple else (sample,)
+    np.savez(buf, *[np.asarray(a) for a in arrs],
+             __tuple__=np.bool_(is_tuple))
+    return buf.getvalue()
+
+
+def _sample_from_bytes(raw: bytes):
+    import io as _io
+    with np.load(_io.BytesIO(raw), allow_pickle=False) as data:
+        arrs = [data[k] for k in sorted(
+            (n for n in data.files if n.startswith("arr_")),
+            key=lambda n: int(n.split("_")[1]))]
+        is_tuple = bool(data["__tuple__"])
+    return tuple(arrs) if is_tuple else arrs[0]
+
+
+def convert_reader_to_recordio_file(path: str, reader,
+                                    compressor: int = ZLIB_COMPRESS,
+                                    force_python: bool = False) -> int:
+    """≙ fluid.recordio_writer.convert_reader_to_recordio_file: drain a
+    sample reader into a RecordIO file; returns the record count."""
+    n = 0
+    w = Writer(path, compressor=compressor, force_python=force_python)
+    try:
+        for sample in reader():
+            w.write(_sample_to_bytes(sample))
+            n += 1
+    finally:
+        w.close()
+    return n
+
+
+def sample_reader_creator(path: str):
+    """Reader over a file written by convert_reader_to_recordio_file:
+    yields the original sample tuples (≙ open_recordio_file +
+    DataFeeder deserialization)."""
+    def reader():
+        for raw in scan(path):
+            yield _sample_from_bytes(raw)
     return reader

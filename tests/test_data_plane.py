@@ -317,3 +317,63 @@ class TestNativeDequantize:
         ret = dequantize(raw, out=out)
         assert ret is out
         np.testing.assert_allclose(out[255], 0.5, atol=1e-6)
+
+
+class TestSampleRecordIO:
+    """convert_reader_to_recordio_file / sample_reader_creator round trip
+    (≙ fluid.recordio_writer.convert_reader_to_recordio_file +
+    benchmark/fluid/recordio_converter.py)."""
+
+    def test_round_trip(self, tmp_path):
+        from paddle_tpu import recordio
+        rng = np.random.RandomState(0)
+        samples = [(rng.rand(3, 4).astype(np.float32),
+                    np.int64(i % 7)) for i in range(11)]
+        path = str(tmp_path / "ds.recordio")
+        n = recordio.convert_reader_to_recordio_file(path, lambda: iter(samples))
+        assert n == 11
+        back = list(recordio.sample_reader_creator(path)())
+        assert len(back) == 11
+        for (img, lbl), (gi, gl) in zip(samples, back):
+            np.testing.assert_array_equal(gi, img)
+            assert int(gl) == int(lbl)
+
+    def test_single_array_samples(self, tmp_path):
+        from paddle_tpu import recordio
+        path = str(tmp_path / "flat.recordio")
+        recordio.convert_reader_to_recordio_file(
+            path, lambda: iter([np.arange(4), np.arange(3)]))
+        back = list(recordio.sample_reader_creator(path)())
+        np.testing.assert_array_equal(back[0], np.arange(4))
+        np.testing.assert_array_equal(back[1], np.arange(3))
+
+    def test_feeds_training_through_decorators(self, tmp_path):
+        # the converter's output plugs into batch + DataFeeder like any
+        # dataset reader (the reference's whole point)
+        from paddle_tpu import recordio
+        from paddle_tpu.reader import decorator as rdec
+        rng = np.random.RandomState(1)
+        samples = [(rng.rand(4).astype(np.float32),
+                    rng.rand(1).astype(np.float32)) for _ in range(12)]
+        path = str(tmp_path / "train.recordio")
+        recordio.convert_reader_to_recordio_file(path, lambda: iter(samples))
+
+        from paddle_tpu import layers
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            y = layers.data("y", [1])
+            loss = layers.mean(layers.square_error_cost(
+                layers.fc(x, size=1), y))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        exe = pt.Executor()
+        exe.run(startup)
+        batched = rdec.batch(recordio.sample_reader_creator(path), 4)
+        losses = []
+        for _ in range(3):
+            for rows in batched():
+                feed = {"x": np.stack([r[0] for r in rows]),
+                        "y": np.stack([r[1] for r in rows])}
+                losses.append(float(np.ravel(np.asarray(
+                    exe.run(main, feed=feed, fetch_list=[loss])[0]))[0]))
+        assert losses[-1] < losses[0]

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Convert dataset readers to RecordIO files for the benchmark data plane.
+
+≙ reference benchmark/fluid/recordio_converter.py (prepare_mnist /
+prepare_cifar10 / prepare_flowers): drains a paddle_tpu.dataset sample
+reader into a RecordIO file via
+recordio.convert_reader_to_recordio_file; training reads it back with
+recordio.sample_reader_creator (+ reader decorators + double_buffer).
+
+Usage: python tools/recordio_converter.py --dataset mnist --out /data
+(dataset loaders download on first use, like the reference's).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _reader(name: str):
+    """Returns the dataset's sample READER (a nullary callable yielding
+    samples) — dataset.X.train() is a reader factory, so it is invoked
+    here exactly once."""
+    from paddle_tpu import dataset
+    table = {
+        "mnist": lambda: dataset.mnist.train(),
+        "cifar10": lambda: dataset.cifar.train10(),
+        "flowers": lambda: dataset.flowers.train(),
+        "imdb": lambda: dataset.imdb.train(
+            dataset.imdb.word_dict()),
+        "uci_housing": lambda: dataset.uci_housing.train(),
+    }
+    if name not in table:
+        raise SystemExit(f"unknown dataset {name!r}; have {sorted(table)}")
+    return table[name]()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="dataset -> RecordIO")
+    p.add_argument("--dataset", required=True,
+                   help="mnist|cifar10|flowers|imdb|uci_housing")
+    p.add_argument("--out", required=True, help="output directory")
+    p.add_argument("--limit", type=int, default=0,
+                   help="stop after N samples (0 = all)")
+    args = p.parse_args(argv)
+
+    from paddle_tpu import recordio
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, f"{args.dataset}.recordio")
+    reader = _reader(args.dataset)
+
+    if args.limit:
+        base = reader
+
+        def reader():
+            for i, s in enumerate(base()):
+                if i >= args.limit:
+                    return
+                yield s
+
+    n = recordio.convert_reader_to_recordio_file(path, reader)
+    print(f"{path}: {n} records")
+
+
+if __name__ == "__main__":
+    main()
