@@ -424,11 +424,21 @@ def main():
     for name, fn in table:
         if only and name not in only:
             continue
-        try:
-            configs[name] = fn()
-        except Exception as e:  # keep the bench line coming no matter what
-            traceback.print_exc()
-            configs[name] = {"error": f"{type(e).__name__}: {e}"}
+        for attempt in (0, 1):
+            try:
+                configs[name] = fn()
+                break
+            except Exception as e:  # keep the bench line coming no matter what
+                traceback.print_exc()
+                configs[name] = {"error": f"{type(e).__name__}: {e}"}
+                # the tunneled remote-compile service occasionally drops a
+                # response mid-read; one retry rides out the transient
+                transient = any(t in str(e) for t in
+                                ("remote_compile", "response body closed",
+                                 "DEADLINE_EXCEEDED", "UNAVAILABLE"))
+                if not (transient and attempt == 0):
+                    break
+                time.sleep(5.0)
 
     rn = configs.get("resnet50", {})
     if "ms_per_batch" in rn:
