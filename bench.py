@@ -1,4 +1,5 @@
-"""Benchmark harness: all 5 BASELINE configs + transformer, one JSON line.
+"""Benchmark harness: all 5 BASELINE configs + SE-ResNeXt, transformer,
+long-context, and the host data pipeline — one JSON line.
 
 ≙ reference benchmark/fluid/fluid_benchmark.py (5 models × executors ×
 modes; print_train_time :297). Every config trains with fake data (≙
