@@ -23,13 +23,8 @@ import numpy as np
 
 from .program import Program, VarDesc, default_main_program
 from .scope import Scope, global_scope
-from .types import np_dtype
+from .types import device_dtype, np_dtype
 from . import lowering
-
-
-def _device_dtype(dtype: str) -> str:
-    """64-bit host dtypes narrow to 32-bit on device (TPU-native widths)."""
-    return {"int64": "int32", "float64": "float32", "uint8": "uint8"}.get(dtype, dtype)
 
 
 class Place:
@@ -106,7 +101,7 @@ class Executor:
                 if seq_len_name:
                     out[seq_len_name] = jnp.asarray(lens)
             elif seq_len_name and isinstance(val, (list, tuple)):
-                dt = np_dtype(_device_dtype(var.dtype)) if var else None
+                dt = np_dtype(device_dtype(var.dtype)) if var else None
                 padded, lens = pad_sequences(val, dtype=dt)
                 val = padded
                 out[seq_len_name] = jnp.asarray(lens)
@@ -125,7 +120,7 @@ class Executor:
             if isinstance(val, jax.Array):
                 # already on device (double-buffer prefetch, reader/prefetch
                 # .py) — never round-trip through host numpy
-                want = (np_dtype(_device_dtype(var.dtype))
+                want = (np_dtype(device_dtype(var.dtype))
                         if var is not None else None)
                 out[name] = (val if want is None
                              or val.dtype == jnp.dtype(want)
@@ -133,7 +128,7 @@ class Executor:
                 continue
             arr = np.asarray(val)
             if var is not None:
-                want = np_dtype(_device_dtype(var.dtype))
+                want = np_dtype(device_dtype(var.dtype))
                 if arr.dtype != want:
                     arr = arr.astype(want)
             out[name] = jnp.asarray(arr)

@@ -45,6 +45,13 @@ def normalize_dtype(dtype) -> str:
     return name
 
 
+def device_dtype(dtype: str) -> str:
+    """64-bit host dtypes narrow to 32-bit on device (TPU-native widths).
+    The single owner of the narrowing policy — executor feeds, op kernels,
+    and memory init all route through here."""
+    return {"int64": "int32", "float64": "float32"}.get(dtype, dtype)
+
+
 def np_dtype(dtype: str):
     """Canonical dtype string -> numpy dtype (bfloat16 via ml_dtypes)."""
     if dtype == "bfloat16":

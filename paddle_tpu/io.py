@@ -203,7 +203,7 @@ def export_serving_model(dirname: str, feeded_var_names: Sequence[str],
     import jax
     import jax.numpy as jnp
     from .core import lowering
-    from .core.executor import _device_dtype
+    from .core.types import device_dtype
     from .core.types import np_dtype
 
     main_program = main_program or default_main_program()
@@ -244,7 +244,7 @@ def export_serving_model(dirname: str, feeded_var_names: Sequence[str],
                 f"export_serving_model: feed {name!r} has symbolic dims "
                 f"{dims}; AOT export needs fully static shapes — pad or "
                 "declare the feed with concrete sizes")
-        dt = np_dtype(_device_dtype(var.dtype))
+        dt = np_dtype(device_dtype(var.dtype))
         example.append(jax.ShapeDtypeStruct(shape, dt))
         feed_meta.append({"name": name, "shape": list(shape),
                           "dtype": np.dtype(dt).name})

@@ -215,7 +215,12 @@ def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
     # so sharing one attr would collide the two weights
     weight = helper.create_parameter(copy.copy(helper.param_attr),
                                      [proj_size, 4 * hidden_size], dtype)
-    proj_weight = helper.create_parameter(copy.copy(helper.param_attr),
+    proj_attr = copy.copy(helper.param_attr)
+    if getattr(proj_attr, "name", None):
+        # an explicit ParamAttr(name=...) would otherwise bind both weights
+        # to the same parameter; give the projection weight its own name
+        proj_attr.name = proj_attr.name + "_proj"
+    proj_weight = helper.create_parameter(proj_attr,
                                           [hidden_size, proj_size], dtype)
     bias_size = 4 * hidden_size + (3 * hidden_size if use_peepholes else 0)
     bias = helper.create_parameter(helper.bias_attr, [1, bias_size], dtype,

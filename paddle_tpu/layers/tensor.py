@@ -15,8 +15,8 @@ from ..param_attr import ParamAttr
 __all__ = [
     "create_tensor", "create_parameter", "create_global_var", "fill_constant",
     "fill_constant_batch_size_like", "ones", "zeros", "sums", "assign",
-    "argmin", "argmax", "reverse", "cast", "concat",
- "sum", "is_empty",]
+    "argmin", "argmax", "reverse", "cast", "concat", "sum", "is_empty",
+]
 
 
 def create_tensor(dtype, name=None, persistable=False):
@@ -132,11 +132,6 @@ def reverse(x, axis):
 from .nn import cast, concat  # noqa: E402,F401
 
 
-def sum(input, out=None):
-    """≙ layers.sum (alias of sums; sum_op.cc)."""
-    return sums(input, out=out)
-
-
 def is_empty(x, cond=None):
     """is_empty_op.cc: scalar bool, true when x has zero elements."""
     helper = LayerHelper("is_empty")
@@ -145,3 +140,9 @@ def is_empty(x, cond=None):
     helper.append_op("is_empty", {"X": x}, {"Out": cond}, {})
     cond.shape, cond.dtype = (), "bool"
     return cond
+
+
+# public alias for fluid.layers.sum (sum_op.cc). NOTE: this shadows the
+# builtin `sum` for ALL code in this module (globals resolve at call time) —
+# any future helper here must use builtins.sum explicitly.
+sum = sums

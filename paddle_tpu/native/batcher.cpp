@@ -35,7 +35,7 @@ int pack_rows(const void** rows, const int64_t* lens, int64_t n,
   const int64_t row_cap = t_max * step_bytes;
   for (int64_t i = 0; i < n; ++i) {
     const int64_t len = lens[i];
-    if (len > t_max) return -1;
+    if (len < 0 || len > t_max) return -1;
     const int64_t used = len * step_bytes;
     std::memcpy(dst, rows[i], used);
     char* tail = dst + used;

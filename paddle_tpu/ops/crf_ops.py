@@ -112,14 +112,17 @@ def crf_decoding(ctx, ins, attrs):
 
     first_tag, tags_rev = jax.lax.scan(back, last_tag, backptr[::-1])
     path = jnp.concatenate([first_tag[None], tags_rev[::-1]], axis=0).T  # [B,T]
-    path = (path * mask.astype(path.dtype)).astype(jnp.int64)
+    # int32 on device: tag ids / hit flags never approach 2^31, and JAX
+    # without x64 would silently truncate int64 anyway (executor feeds are
+    # canonicalized the same way in core/executor.py).
+    path = (path * mask.astype(path.dtype)).astype(jnp.int32)
 
     if ins.get("Label"):
         label = ins["Label"][0]
         if label.ndim == 3:
             label = label.reshape(label.shape[:2])
         hit = (path == label.astype(path.dtype)) & (mask > 0)
-        return {"ViterbiPath": [hit.astype(jnp.int64)]}
+        return {"ViterbiPath": [hit.astype(jnp.int32)]}
     return {"ViterbiPath": [path]}
 
 
@@ -299,7 +302,9 @@ def chunk_eval(ctx, ins, attrs):
     r = correct / jnp.maximum(num_lab, 1)
     f1 = jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
     as_f = lambda v: jnp.asarray(v, jnp.float32).reshape(1)
-    as_i = lambda v: jnp.asarray(v, jnp.int64).reshape(1)
+    # int32 chosen explicitly: per-batch chunk counts are bounded by B*T
+    # (far below 2^31); jnp.int64 without x64 truncates with a warning.
+    as_i = lambda v: jnp.asarray(v, jnp.int32).reshape(1)
     return {"Precision": [as_f(p)], "Recall": [as_f(r)],
             "F1-Score": [as_f(f1)],
             "NumInferChunks": [as_i(num_inf)],

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.registry import register_op
+from ..core.types import device_dtype
 from .sequence_ops import time_mask
 
 _ACT = {
@@ -207,6 +208,9 @@ def dynamic_rnn(ctx, ins, attrs):
         else:
             shape = (B,) + tuple(s for s in mem_shapes[i] if s != -1)
             mdt = mem_dtypes[i] if i < len(mem_dtypes) and mem_dtypes[i] else dtype
+            # device dtypes are 32-bit (same canonicalization as the executor
+            # feed path); jnp.full with "int64" would truncate with a warning
+            mdt = device_dtype(str(mdt)) if isinstance(mdt, str) else mdt
             init.append(jnp.full(shape, mem_init_values[i], mdt))
 
     xs_tm = [jnp.moveaxis(x, 1, 0) for x in xs_list]

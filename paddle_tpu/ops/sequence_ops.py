@@ -238,4 +238,4 @@ def edit_distance(ctx, ins, attrs):
     if attrs.get("normalized", True):
         d = d / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
     return {"Out": [d.reshape(-1, 1)],
-            "SequenceNum": [jnp.asarray([B], jnp.int64)]}
+            "SequenceNum": [jnp.asarray([B], jnp.int32)]}

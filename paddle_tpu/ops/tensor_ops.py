@@ -13,12 +13,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.registry import register_op, same_shape
-from ..core.types import np_dtype
+from ..core.types import device_dtype, np_dtype
 
 
 def _dev_dtype(dtype: str):
-    dtype = {"int64": "int32", "float64": "float32"}.get(dtype, dtype)
-    return np_dtype(dtype)
+    return np_dtype(device_dtype(dtype))
 
 
 # -- creation ---------------------------------------------------------------

@@ -25,6 +25,20 @@ Derivations (strategy-gated):
 
 Every sharded dim is checked divisible by the mesh axis size; otherwise
 that var stays replicated (≙ slice_variable's block rounding).
+
+Scope limits (v1 contract — what the pass will NOT shard):
+  * Only block 0 is traced; params created inside sub-blocks (While/IfElse
+    bodies, DynamicRNN steps) stay replicated.
+  * Matmuls with a transposed weight operand (transpose_Y etc.) are skipped
+    by the Megatron pairing — the column/row split would need the transpose
+    folded first.
+  * Conv filters are never tensor-parallel; conv models distribute via 'dp'
+    (and optionally ZeRO-1 in ParallelExecutor).
+  * sp_mode assumes the program expresses attention through the
+    scaled_dot_product_attention op; hand-rolled matmul+softmax attention
+    is not pattern-matched and runs unsharded over 'sp'.
+A var outside these bounds is silently replicated — correct, just not
+distributed. The same limits are recorded in PARITY.md.
 """
 
 from __future__ import annotations

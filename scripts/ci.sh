@@ -6,7 +6,10 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== unit + integration tests (8-device virtual CPU mesh) =="
-python -m pytest tests/ -x -q
+# jax's "Explicitly requested dtype int64 ... truncated" warning is promoted
+# to an error: device dtypes must be chosen explicitly (32-bit), never left
+# to silent truncation.
+python -m pytest tests/ -x -q -W "error:Explicitly requested dtype"
 
 echo "== multi-chip dryrun (dp x tp, dp x sp x tp, pp x dp, ep x dp) =="
 python __graft_entry__.py dryrun 8
