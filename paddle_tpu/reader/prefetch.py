@@ -91,6 +91,8 @@ class DeviceFeeder:
     def __iter__(self):
         def feed_reader():
             for data in self._reader():
-                yield self._feeder.feed(data)
+                # dict batches are already feed-shaped (pre-batched readers,
+                # e.g. RecordIO -> native batcher); rows go through the feeder
+                yield data if isinstance(data, dict) else self._feeder.feed(data)
 
         yield from double_buffer(feed_reader, capacity=self._capacity)()

@@ -185,20 +185,40 @@ class Trainer:
                 batches = (DeviceFeeder(feeder, reader)
                            if double_buffer and not self.parallel
                            and not use_loop
-                           else (feeder.feed(d) for d in reader()))
+                           else (d if isinstance(d, dict) else feeder.feed(d)
+                                 for d in reader()))
                 if use_loop:
+                    # full windows are stacked host-side to [n, ...]; with
+                    # double_buffer the stacked upload overlaps the previous
+                    # window's device loop (windows are the unit of transfer,
+                    # ≙ double_buffer composing with the C++ batch reader)
+                    def _stacked_windows(batches=batches):
+                        # a dict is a full stacked window; a list is a
+                        # fragment (shape-change flush / epoch tail)
+                        for window in _shape_chunks(batches, steps_per_loop):
+                            if len(window) == steps_per_loop:
+                                yield {k: np.stack([f[k] for f in window])
+                                       for k in window[0]}
+                            else:
+                                yield window
+                    windows = _stacked_windows()
+                    if double_buffer:
+                        from .reader import prefetch as _prefetch
+                        windows = _prefetch.double_buffer(
+                            lambda: _stacked_windows())()
                     step_id = 0
-                    for window in _shape_chunks(batches, steps_per_loop):
+                    for window in windows:
+                        n_in_window = (steps_per_loop
+                                       if isinstance(window, dict)
+                                       else len(window))
                         begin = BeginStepEvent(epoch_id, step_id)
                         event_handler(begin)
                         fetch = (self.train_func_outputs
                                  if begin.fetch_metrics else [])
-                        if len(window) == steps_per_loop:
-                            stacked = {k: np.stack([f[k] for f in window])
-                                       for k in window[0]}
+                        if isinstance(window, dict):
                             metrics = executor.run_loop(
-                                self.train_program, feed=stacked,
-                                fetch_list=fetch, n_steps=len(window),
+                                self.train_program, feed=window,
+                                fetch_list=fetch, n_steps=n_in_window,
                                 per_step_feeds=True)
                         else:
                             # fragment windows (shape-change flush, epoch
@@ -211,7 +231,7 @@ class Trainer:
                                 if per and fetch else []
                         event_handler(EndStepEvent(epoch_id, step_id,
                                                    metrics))
-                        prev_step, step_id = step_id, step_id + len(window)
+                        prev_step, step_id = step_id, step_id + n_in_window
                         iv = (self.checkpoint_cfg.step_interval
                               if self.checkpoint_cfg else 0)
                         if iv and prev_step // iv != step_id // iv:
