@@ -43,6 +43,106 @@ def _is_parameter(var: VarDesc) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# multi-host sharded array pieces
+#
+# ≙ the reference's per-pserver checkpoint shards (go/pserver/service.go:346
+# saves only the rows that pserver owns; the trainer side reassembles via
+# load_persist_vars_without_grad, io.py:545). TPU-native: a var's value can
+# be a jax.Array laid out by GSPMD across processes; each process persists
+# exactly its addressable, replica-0 shards as `<name>.shard.<slices>.npy`
+# plus one `<name>.meta.json` (global shape/dtype), and the loader
+# reassembles the global value from whatever pieces the dir holds.
+# ---------------------------------------------------------------------------
+
+def _shard_slices(val, sh):
+    """Normalize a Shard.index into ((start, stop), ...) over global dims."""
+    out = []
+    for dim, sl in zip(val.shape, sh.index):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _atomic_save(path: str, arr) -> None:
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.save(f, arr)
+    os.replace(tmp, path)
+
+
+def _save_sharded(dirname: str, base: str, val) -> None:
+    # meta is identical on every process; atomic replace makes the
+    # concurrent writes idempotent and refreshes any stale file
+    meta = {"shape": list(val.shape), "dtype": str(val.dtype)}
+    meta_path = os.path.join(dirname, base + ".meta.json")
+    tmp = meta_path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, meta_path)
+    for sh in val.addressable_shards:
+        if sh.replica_id != 0:  # exactly one owner per distinct slice
+            continue
+        spans = _shard_slices(val, sh)
+        tag = "x".join(f"{a}_{b}" for a, b in spans) or "scalar"
+        _atomic_save(os.path.join(dirname, f"{base}.shard.{tag}.npy"),
+                     np.asarray(sh.data))
+
+
+def _load_sharded(dirname: str, base: str):
+    meta_path = os.path.join(dirname, base + ".meta.json")
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        meta = json.load(f)
+    from .core.types import np_dtype
+    shape = tuple(meta["shape"])
+    out = np.zeros(shape, np_dtype(meta["dtype"]))
+    prefix = base + ".shard."
+    found = 0
+    filled = 0
+    for name in sorted(os.listdir(dirname)):
+        if not (name.startswith(prefix) and name.endswith(".npy")):
+            continue
+        tag = name[len(prefix):-len(".npy")]
+        piece = np.load(os.path.join(dirname, name))
+        if tag == "scalar":
+            idx = ()
+            extents = shape
+        else:
+            spans = [tuple(int(x) for x in p.split("_"))
+                     for p in tag.split("x")]
+            idx = tuple(slice(a, b) for a, b in spans)
+            extents = tuple(b - a for a, b in spans)
+        if tuple(piece.shape) != tuple(extents):
+            raise IOError(
+                f"load_vars: shard piece {name!r} has shape {piece.shape}, "
+                f"expected {extents} — the directory mixes saves from "
+                "different runs/layouts; re-save into a fresh directory")
+        out[idx] = piece
+        found += 1
+        filled += int(piece.size)
+    if not found:
+        return None
+    # pieces are disjoint by construction (one replica-0 owner per slice),
+    # so element counting detects both missing pieces and stale extras
+    # from a different process layout without a full-shape bool mask
+    total = int(np.prod(shape)) if shape else 1
+    if filled != total:
+        raise FileNotFoundError(
+            f"load_vars: sharded var {base!r} in {dirname!r} covers "
+            f"{filled}/{total} elements — missing pieces (were all "
+            "processes' shard files gathered into this directory?) or "
+            "stale pieces from an older save with a different layout")
+    return out
+
+
+def _is_cross_process(val) -> bool:
+    import jax
+    return isinstance(val, jax.Array) and not val.is_fully_addressable
+
+
+# ---------------------------------------------------------------------------
 # save/load vars
 # ---------------------------------------------------------------------------
 
@@ -67,11 +167,42 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
             f"scope (run the startup program first?): {absent[:5]}"
             f"{'...' if len(absent) > 5 else ''}")
     if filename is not None:
+        cross = [n for n, v in values.items() if _is_cross_process(v)]
+        if cross:
+            raise ValueError(
+                "save_vars(filename=...): combined-file saves need fully "
+                f"addressable values, but {cross[:3]} are sharded across "
+                "processes — use the per-var layout (filename=None), which "
+                "persists each process's own shards")
         np.savez(os.path.join(dirname, filename),
                  **{n: np.asarray(v) for n, v in values.items()})
         return
+    import jax
+    multi = jax.process_count() > 1
+    existing = os.listdir(dirname) if not multi else []
     for n, val in values.items():
-        np.save(os.path.join(dirname, n.replace("/", "__")), np.asarray(val))
+        base = n.replace("/", "__")
+        if not multi:
+            # refresh the layout: a leftover .npy from an earlier
+            # differently-sharded save would otherwise shadow new pieces
+            # at load time (multi-process saves get dir-level cleaning
+            # from save_checkpoint instead — unsynchronized deletes would
+            # race other writers)
+            for stale in existing:
+                if (stale == base + ".npy" or stale == base + ".meta.json"
+                        or stale.startswith(base + ".shard.")):
+                    try:
+                        os.remove(os.path.join(dirname, stale))
+                    except FileNotFoundError:
+                        pass
+        if _is_cross_process(val):
+            _save_sharded(dirname, base, val)
+        elif not multi or jax.process_index() == 0:
+            # fully-addressable values are replicated across processes by
+            # construction (the sharded route owns everything GSPMD laid
+            # out); process 0 is the single writer, atomically
+            _atomic_save(os.path.join(dirname, base + ".npy"),
+                         np.asarray(val))
 
 
 def save_params(executor=None, dirname: str = "", main_program=None,
@@ -112,11 +243,16 @@ def load_vars(executor=None, dirname: str = "", main_program=None, vars=None,
         return
     missing = []
     for v in vars:
-        path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
+        base = v.name.replace("/", "__")
+        path = os.path.join(dirname, base + ".npy")
         if os.path.exists(path):
             scope.set_var(v.name, np.load(path))
         else:
-            missing.append(v.name)
+            assembled = _load_sharded(dirname, base)
+            if assembled is not None:
+                scope.set_var(v.name, assembled)
+            else:
+                missing.append(v.name)
     if missing:
         raise FileNotFoundError(
             f"load_vars: no saved file for {len(missing)} variable(s) in "
@@ -298,17 +434,41 @@ def get_latest_checkpoint_serial(checkpoint_dir: str) -> int:
 def save_checkpoint(executor=None, checkpoint_dir: str = "", trainer_id: int = 0,
                     trainer_args: Optional[dict] = None, main_program=None,
                     max_num_checkpoints: int = 3, scope=None):
-    """io.py:466: write serial dir, then _SUCCESS marker, then scroll old."""
+    """io.py:466: write serial dir, then _SUCCESS marker, then scroll old.
+
+    Multi-host safe (≙ each pserver checkpointing only its own shard,
+    go/pserver/service.go:346): process 0 picks the serial and broadcasts
+    it (ranks reading _SUCCESS markers themselves could diverge — only
+    rank 0 writes markers), clears any uncommitted leftovers at that
+    serial, all ranks barrier, every process writes just its addressable
+    shards via save_persistables, all ranks barrier again, and only
+    process 0 commits the _SUCCESS marker and scrolls old serials — a
+    half-written multi-host checkpoint is never marked live, and a crashed
+    attempt's files can never blend into the next one."""
+    import jax
+    multi = jax.process_count() > 1
     serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
+    if multi:
+        from jax.experimental import multihost_utils
+        serial = int(multihost_utils.broadcast_one_to_all(
+            np.int32(serial)))
+        cur = _serial_dir(checkpoint_dir, serial)
+        if jax.process_index() == 0 and os.path.isdir(cur):
+            shutil.rmtree(cur, ignore_errors=True)  # uncommitted leftovers
+        multihost_utils.sync_global_devices(f"paddle_tpu_ckpt_pre_{serial}")
     cur = _serial_dir(checkpoint_dir, serial)
     os.makedirs(cur, exist_ok=True)
     save_persistables(executor, cur, main_program, scope=scope)
     if trainer_args:
         with open(os.path.join(cur, f"trainer_{trainer_id}.json"), "w") as f:
             json.dump(trainer_args, f)
-    with open(os.path.join(cur, SUCCESS_MARK_FILENAME), "w") as f:
-        f.write("")
-    _scroll_delete(checkpoint_dir, max_num_checkpoints)
+    if multi:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"paddle_tpu_ckpt_{serial}")
+    if not multi or jax.process_index() == 0:
+        with open(os.path.join(cur, SUCCESS_MARK_FILENAME), "w") as f:
+            f.write("")
+        _scroll_delete(checkpoint_dir, max_num_checkpoints)
     return serial
 
 

@@ -127,9 +127,11 @@ class Trainer:
                     self.checkpoint_cfg.checkpoint_dir)
                 if serial >= 0:
                     self.checkpoint_cfg.load_serial = serial
+                    import jax
                     args = io_mod.load_checkpoint(
                         self.exe, self.checkpoint_cfg.checkpoint_dir, serial,
-                        self.train_program, scope=self.scope)
+                        self.train_program, trainer_id=jax.process_index(),
+                        scope=self.scope)
                     if args:
                         self.checkpoint_cfg.epoch_id = args.get("epoch_id", 0)
                         self.checkpoint_cfg.step_id = args.get("step_id", 0)
@@ -237,6 +239,7 @@ class Trainer:
                         if iv and prev_step // iv != step_id // iv:
                             self._save_checkpoint(epoch_id, step_id)
                     event_handler(EndEpochEvent(epoch_id))
+                    self._epoch_checkpoint(epoch_id)
                     continue
                 for step_id, feed in enumerate(batches):
                     begin = BeginStepEvent(epoch_id, step_id)
@@ -252,6 +255,7 @@ class Trainer:
                             step_id % self.checkpoint_cfg.step_interval == 0):
                         self._save_checkpoint(epoch_id, step_id)
                 event_handler(EndEpochEvent(epoch_id))
+                self._epoch_checkpoint(epoch_id)
 
     def test(self, reader: Callable, feed_order: Optional[list] = None):
         test_program = self.train_program.clone(for_test=True)
@@ -294,9 +298,20 @@ class Trainer:
             feed_vars = [block.var(n) for n in feed_order]
         return feed_vars
 
+    def _epoch_checkpoint(self, epoch_id):
+        """End-of-epoch checkpoint (CheckpointConfig.epoch_interval). Saved
+        with epoch_id+1 so auto-resume continues at the NEXT epoch — an
+        epoch-boundary resume replays nothing and matches an uninterrupted
+        run exactly (mid-epoch step checkpoints replay their epoch)."""
+        if (self.checkpoint_cfg and
+                (epoch_id + 1) % self.checkpoint_cfg.epoch_interval == 0):
+            self._save_checkpoint(epoch_id + 1, 0)
+
     def _save_checkpoint(self, epoch_id, step_id):
+        import jax
         io_mod.save_checkpoint(
             self.exe, self.checkpoint_cfg.checkpoint_dir,
+            trainer_id=jax.process_index(),
             trainer_args={"epoch_id": epoch_id, "step_id": step_id},
             main_program=self.train_program,
             max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,

@@ -14,6 +14,8 @@ import subprocess
 import sys
 import textwrap
 
+import numpy as np
+
 import pytest
 
 import paddle_tpu  # noqa: F401 — ensures the package imports in this env
@@ -120,3 +122,219 @@ class TestEnvContractParsing:
         monkeypatch.setenv("PADDLE_PSERVER_IPS", "ignored")
         distributed.initialize_from_env()
         assert seen["c"] == "coord:1234"
+
+
+_CKPT_WORKER = textwrap.dedent("""
+    import json
+    import os
+    import sys
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from paddle_tpu.parallel import distributed
+    distributed.initialize_from_env()
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    ckpt_dir, epochs_str, out_path = sys.argv[1], sys.argv[2], sys.argv[3]
+
+    def train_func():
+        x = layers.data("x", [8])
+        y = layers.data("y", [1])
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        return [layers.mean(layers.square_error_cost(pred, y))]
+
+    def reader():
+        rng = np.random.RandomState(42)  # same data every epoch/process
+        w = rng.rand(8, 1).astype("float32")
+        for _ in range(4):
+            xb = rng.rand(4, 8).astype("float32")
+            yield {"x": xb, "y": xb @ w}
+
+    cfg = (pt.CheckpointConfig(ckpt_dir, max_num_checkpoints=2,
+                               epoch_interval=1, step_interval=10**9)
+           if ckpt_dir != "none" else None)
+    pt.core.program.reset_unique_names()
+    trainer = pt.Trainer(train_func, lambda: pt.optimizer.SGDOptimizer(0.1),
+                         parallel=True, checkpoint_config=cfg)
+
+    losses = []
+    def handler(event):
+        if isinstance(event, pt.EndStepEvent) and event.metrics:
+            losses.append(float(np.ravel(np.asarray(event.metrics[0]))[0]))
+
+    trainer.train(num_epochs=int(epochs_str), event_handler=handler,
+                  reader=reader, double_buffer=False)
+    with open(out_path + f".rank{distributed.process_index()}", "w") as f:
+        json.dump({"losses": losses}, f)
+    print("CKPT-WORKER OK", len(losses))
+""")
+
+
+class TestTwoProcessCheckpointResume:
+    """VERDICT r2 next #3: checkpoint mid-train across two REAL processes
+    (each writing only its addressable shards), restart, auto-resume, and
+    match an uninterrupted run's losses exactly."""
+
+    def _launch(self, tmp_path, ckpt_dir, epochs, out_name, port):
+        worker = tmp_path / "ckpt_worker.py"
+        worker.write_text(_CKPT_WORKER)
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env.pop("JAX_PLATFORMS", None)
+            env["PADDLE_TRAINERS"] = "2"
+            env["PADDLE_TRAINER_ID"] = str(rank)
+            env["PADDLE_COORDINATOR"] = f"127.0.0.1:{port}"
+            env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            procs.append(subprocess.Popen(
+                [sys.executable, str(worker), ckpt_dir, str(epochs),
+                 str(tmp_path / out_name)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("checkpoint worker timed out")
+            outs.append(out)
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        import json
+        return [json.load(open(str(tmp_path / out_name) + f".rank{r}"))
+                for r in range(2)]
+
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        import json
+        ckpt = str(tmp_path / "ckpt")
+        # uninterrupted 4-epoch run (no checkpointing)
+        full = self._launch(tmp_path, "none", 4, "full", _free_port())
+        # interrupted: 2 epochs with end-of-epoch checkpoints, then a fresh
+        # pair of processes auto-resumes from the serial dir for epochs 2-3
+        part1 = self._launch(tmp_path, ckpt, 2, "part1", _free_port())
+        serial_dirs = [d for d in os.listdir(ckpt)
+                       if d.startswith("checkpoint_")]
+        assert serial_dirs, "no checkpoint serial dirs written"
+        part2 = self._launch(tmp_path, ckpt, 4, "part2", _free_port())
+
+        full_losses = full[0]["losses"]
+        resumed = part1[0]["losses"] + part2[0]["losses"]
+        assert len(full_losses) == 16  # 4 epochs x 4 steps
+        assert len(resumed) == 16, (len(part1[0]["losses"]),
+                                    len(part2[0]["losses"]))
+        np.testing.assert_allclose(full_losses, resumed, rtol=1e-5)
+        # both ranks observe identical (replicated) losses
+        np.testing.assert_allclose(full[0]["losses"], full[1]["losses"],
+                                   rtol=1e-6)
+
+
+_SHARD_WORKER = textwrap.dedent("""
+    import os
+    import sys
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from paddle_tpu.parallel import distributed
+    distributed.initialize_from_env()
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, io
+    from paddle_tpu.parallel.parallel_executor import (BuildStrategy,
+                                                       ParallelExecutor,
+                                                       ReduceStrategy)
+
+    save_dir = sys.argv[1]
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8])
+        y = layers.data("y", [1])
+        pred = layers.fc(layers.fc(x, size=16, act="relu"), size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        pt.Executor().run(startup)
+        bs = BuildStrategy()
+        bs.reduce_strategy = ReduceStrategy.Reduce  # ZeRO-1: dp-sharded accums
+        pexe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                                scope=scope, build_strategy=bs)
+        rng = np.random.RandomState(3)
+        feed = {"x": rng.rand(4, 8).astype("float32"),
+                "y": rng.rand(4, 1).astype("float32")}
+        for _ in range(3):
+            pexe.run(fetch_list=[loss], feed=feed)
+
+        vel = [n for n in list(scope.local_var_names()) if "velocity" in n]
+        assert vel, "no velocity accumulators found"
+        partitioned = [n for n in vel
+                       if any(s.data.shape != scope.find_var(n).shape
+                              for s in scope.find_var(n).addressable_shards)]
+        assert partitioned, f"no dp-partitioned accumulator among {vel}"
+
+        io.save_persistables(dirname=save_dir, main_program=main, scope=scope)
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("saved")
+
+        fresh = pt.Scope()
+        io.load_persistables(dirname=save_dir, main_program=main, scope=fresh)
+        for n in list(scope.local_var_names()):
+            v = scope.find_var(n)
+            if not hasattr(v, "addressable_shards"):
+                continue
+            assembled = np.asarray(fresh.find_var(n))
+            assert assembled.shape == v.shape, (n, assembled.shape, v.shape)
+            for sh in v.addressable_shards:
+                np.testing.assert_allclose(assembled[sh.index],
+                                           np.asarray(sh.data), rtol=1e-6)
+    print("SHARD-WORKER OK", len(partitioned))
+""")
+
+
+class TestTwoProcessShardedSaveLoad:
+    """Partitioned (ZeRO-1) optimizer state: each process persists only the
+    shard pieces it owns; load reassembles the full value and every
+    process's addressable slice matches (≙ per-pserver shard checkpoints,
+    go/pserver/service.go:346)."""
+
+    def test_zero1_accumulators_roundtrip(self, tmp_path):
+        port = _free_port()
+        worker = tmp_path / "shard_worker.py"
+        worker.write_text(_SHARD_WORKER)
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env.pop("JAX_PLATFORMS", None)
+            env["PADDLE_TRAINERS"] = "2"
+            env["PADDLE_TRAINER_ID"] = str(rank)
+            env["PADDLE_COORDINATOR"] = f"127.0.0.1:{port}"
+            env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            procs.append(subprocess.Popen(
+                [sys.executable, str(worker), str(tmp_path / "vars")],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        for rank, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("shard worker timed out")
+            assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+            assert "SHARD-WORKER OK" in out, out
+        # the partitioned accumulators left multiple distinct piece files
+        import glob
+        pieces = glob.glob(str(tmp_path / "vars" / "*velocity*.shard.*.npy"))
+        starts = {os.path.basename(p).split(".shard.")[1] for p in pieces}
+        assert len(starts) >= 2, pieces
