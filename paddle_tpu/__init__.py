@@ -42,5 +42,6 @@ from . import debugger
 from .utils import profiler
 from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent)
+from .host_table import HostEmbeddingTable, host_embedding
 
 __version__ = "0.2.0"

@@ -60,6 +60,25 @@ def append_backward(loss: VarDesc, parameter_list: Optional[Sequence[str]] = Non
                                  dtype=loss.dtype)
     loss_grad.stop_gradient = True
 
+    # a block differentiates once: a second append_backward (e.g.
+    # calc_gradient after minimize, or host-table row grads) merges its
+    # parameter list into the existing autodiff op instead of appending a
+    # second one — the lowering expands exactly one value_and_grad
+    existing = next((op for op in block.ops
+                     if op.type == AUTODIFF_OP
+                     and op.attrs.get("loss") == loss.name), None)
+    if existing is not None:
+        merged_p = list(existing.attrs["params"])
+        merged_g = list(existing.attrs["grad_names"])
+        for p, g in zip(param_names, grad_names):
+            if p not in merged_p:
+                merged_p.append(p)
+                merged_g.append(g)
+        existing.attrs["params"] = merged_p
+        existing.attrs["grad_names"] = merged_g
+        existing.outputs["Grads"] = list(merged_g)
+        return pairs
+
     block.append_op(
         AUTODIFF_OP,
         inputs={}, outputs={"Grads": grad_names},

@@ -1,0 +1,227 @@
+"""Host-RAM embedding tables: the pserver *capacity* story.
+
+≙ reference distributed lookup table — `lookup_sparse_table_op.cc` pulling
+rows from a pserver-hosted table that is bigger than any single device's
+memory, with the prefetch rewrite in
+`python/paddle/fluid/transpiler/distribute_transpiler.py:120-180` and the
+pserver-side sparse optimizer blocks (`listen_and_serv_op.cc:73-360`).
+
+TPU-native reading: there is no parameter-server process — the table lives
+in THIS host's RAM as numpy, and only the rows a batch actually touches are
+shipped to the device:
+
+  1. host: `prepare(ids)` uniquifies the batch's ids, gathers
+     `table[uniq]` into a fixed-`capacity` rows block (static shapes keep
+     XLA happy), and remaps ids to local row indices;
+  2. device: the model looks the rows block up like any embedding
+     (`host_embedding` emits a plain lookup_table over the rows feed) —
+     forward+backward compile as one XLA program, HBM only ever holds
+     `capacity x dim`, never `vocab x dim`;
+  3. host: the fetched rows-gradient is applied back to the table by the
+     numpy mirror of the sparse optimizer kernels (optimizer.py's
+     SelectedRows sgd/adagrad paths — same math, host memory).
+
+`prepare` output is a plain feed dict, so it rides the existing
+double-buffer prefetch (reader/prefetch.py) unchanged: row gather for batch
+N+1 overlaps the device step for batch N, exactly the reference's prefetch
+pipelining.
+
+Gradient plumbing: after `optimizer.minimize(loss)`, `table.grad_var(loss)`
+requests d(loss)/d(rows) — backward.append_backward merges the rows var
+into the block's single autodiff op, so the rows cotangent falls out of the
+same value_and_grad that computes the parameter grads.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from . import backward
+from .core.program import default_main_program
+from .core.types import np_dtype
+
+__all__ = ["HostEmbeddingTable", "HostBatch", "host_embedding"]
+
+
+class HostBatch(NamedTuple):
+    """Which table rows a prepared batch touches (pass to apply_grad)."""
+    uniq: np.ndarray     # [n_valid] distinct vocabulary ids
+    n_valid: int         # valid prefix length of the capacity block
+
+
+class HostEmbeddingTable:
+    """A vocab x dim table resident in host RAM (never on device whole).
+
+    capacity: max distinct ids per batch (static row-block size). The
+    reference's pserver table is similarly touched only through the rows a
+    minibatch requests (lookup_sparse_table_op.cc).
+    """
+
+    def __init__(self, name: str, size: int, dim: int, capacity: int,
+                 optimizer: str = "sgd", learning_rate: float = 0.1,
+                 dtype: str = "float32", initial_value: Optional[np.ndarray] = None,
+                 init_scale: float = 0.1, seed: int = 0, epsilon: float = 1e-6):
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"unsupported host-table optimizer {optimizer!r}"
+                             " (sgd | adagrad)")
+        self.name = name
+        self.size, self.dim, self.capacity = size, dim, capacity
+        self.dtype = np_dtype(dtype)
+        if initial_value is not None:
+            assert initial_value.shape == (size, dim)
+            self.table = np.asarray(initial_value, self.dtype).copy()
+        else:
+            rng = np.random.RandomState(seed)
+            self.table = rng.uniform(-init_scale, init_scale,
+                                     (size, dim)).astype(self.dtype)
+        self.optimizer = optimizer
+        self.learning_rate = learning_rate
+        self.epsilon = epsilon
+        # per-element accumulator, same shape contract as the device
+        # sparse adagrad kernel (optimizer.py SelectedRows path)
+        self.moment = (np.zeros((size, dim), np.float32)
+                       if optimizer == "adagrad" else None)
+        # FIFO of prepared-but-unapplied batches: under double-buffer
+        # prefetch the worker thread prepares batch N+1 while batch N is
+        # still on device, so apply_grad must pop the OLDEST pending batch,
+        # never "the last prepared one"
+        self._pending: "collections.deque[HostBatch]" = collections.deque()
+        self._lock = threading.Lock()
+
+    # -- program-side names -------------------------------------------------
+    @property
+    def rows_name(self) -> str:
+        return f"{self.name}@ROWS"
+
+    @property
+    def local_ids_name(self) -> str:
+        return f"{self.name}@LOCAL_IDS"
+
+    def grad_var(self, loss):
+        """Request d(loss)/d(rows); call AFTER optimizer.minimize. Returns
+        the grad var to put in fetch_list each step."""
+        program = default_main_program()
+        rows_var = program.global_block.var(self.rows_name)
+        (pair,) = backward.append_backward(loss,
+                                           parameter_list=[rows_var.name])
+        return pair[1]
+
+    # -- host side: feed preparation and sparse update ----------------------
+    def prepare(self, ids: np.ndarray):
+        """ids (any int shape) -> ({rows feed, remapped local ids}, batch).
+
+        Pass the HostBatch back to apply_grad with that batch's fetched
+        gradient. The feed's local-ids key is namespaced per table
+        (`<name>@LOCAL_IDS`) so multiple host tables coexist in one feed."""
+        ids = np.asarray(ids)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        if uniq.size > self.capacity:
+            raise ValueError(
+                f"host table {self.name!r}: batch touches {uniq.size} "
+                f"distinct ids > capacity {self.capacity}; raise capacity "
+                "or shrink the batch")
+        # pad slots point at row 0 but no local id maps to them, so their
+        # gradient is exactly zero; apply_grad only ever writes the valid
+        # prefix (writing the padded block would clobber row 0's update
+        # with the stale pad copies whenever id 0 is in the batch)
+        uniq_padded = np.zeros((self.capacity,), np.int64)
+        uniq_padded[:uniq.size] = uniq
+        batch = HostBatch(uniq=uniq.copy(), n_valid=int(uniq.size))
+        feed = {self.rows_name: self.table[uniq_padded],
+                self.local_ids_name:
+                    inv.reshape(ids.shape).astype(np.int64)}
+        return feed, batch
+
+    def apply_grad(self, grad_rows: np.ndarray,
+                   batch: Optional[HostBatch] = None) -> None:
+        """Scatter a fetched rows-gradient back into the host table —
+        numpy mirror of the device sparse optimizer kernels. `batch` is
+        the HostBatch prepare() returned for THIS gradient's feed; when
+        omitted, the oldest wrap_reader-prepared batch is popped (FIFO —
+        correct as long as gradients are applied in feed order)."""
+        if batch is None:
+            with self._lock:
+                if not self._pending:
+                    raise ValueError(
+                        "apply_grad without a HostBatch: nothing pending — "
+                        "pass prepare()'s batch explicitly")
+                batch = self._pending.popleft()
+        n = batch.n_valid
+        uniq = batch.uniq[:n]
+        g = np.asarray(grad_rows, np.float32)[:n]
+        rows = self.table[uniq].astype(np.float32)
+        if self.optimizer == "sgd":
+            rows -= self.learning_rate * g
+        else:  # adagrad (≙ sparse adagrad: per-element accumulator)
+            m = self.moment[uniq] + g * g
+            self.moment[uniq] = m
+            rows -= self.learning_rate * g / (np.sqrt(m) + self.epsilon)
+        self.table[uniq] = rows.astype(self.dtype)
+
+    def wrap_reader(self, reader, ids_key: str,
+                    local_ids_key: Optional[str] = None,
+                    training: bool = True):
+        """Decorate a feed-dict reader so each batch ships prepared rows +
+        remapped ids instead of raw vocabulary ids (rides double_buffer —
+        the gather for batch N+1 overlaps batch N's device step).
+
+        training=True queues each prepared HostBatch; apply_grad() pops
+        them in FIFO order, one per step. Use training=False for eval/test
+        readers on the same table — they must not touch the pending queue
+        (an eval pass mid-epoch would otherwise drop the training batch's
+        pending entry and misroute its gradient). At most ONE training
+        reader per table may be active at a time."""
+        local_ids_key = local_ids_key or self.local_ids_name
+
+        def wrapped():
+            if training:
+                with self._lock:
+                    self._pending.clear()  # leftovers of an abandoned epoch
+            for feed in reader():
+                feed = dict(feed)
+                prep, batch = self.prepare(feed.pop(ids_key))
+                feed[self.rows_name] = prep[self.rows_name]
+                feed[local_ids_key] = prep[self.local_ids_name]
+                if training:
+                    with self._lock:
+                        self._pending.append(batch)
+                yield feed
+        return wrapped
+
+    def device_bytes(self) -> int:
+        """HBM the table contributes per step: the rows block, not vocab."""
+        return int(self.capacity * self.dim * self.table.dtype.itemsize)
+
+    def host_bytes(self) -> int:
+        b = int(self.table.nbytes)
+        if self.moment is not None:
+            b += int(self.moment.nbytes)
+        return b
+
+
+def host_embedding(input, table: HostEmbeddingTable):
+    """Look `input` (local ids, remapped by table.prepare) up in the
+    shipped rows block. ≙ lookup_sparse_table_op.cc device side."""
+    from .layer_helper import LayerHelper
+    helper = LayerHelper("host_embedding")
+    block = default_main_program().global_block
+    try:
+        rows = block.var(table.rows_name)
+    except KeyError:
+        rows = block.create_var(table.rows_name,
+                                shape=(table.capacity, table.dim),
+                                dtype=str(np.dtype(table.table.dtype))
+                                if table.table.dtype != np_dtype("bfloat16")
+                                else "bfloat16")
+        rows.is_data = True
+        rows.stop_gradient = False  # the whole point: we want d(loss)/d(rows)
+    out = helper.create_tmp_variable("float32")
+    helper.append_op("lookup_table", {"W": rows, "Ids": input},
+                     {"Out": out}, {"is_sparse": False})
+    out.shape = tuple(input.shape) + (table.dim,)
+    out.dtype = rows.dtype
+    return out

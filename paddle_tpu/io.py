@@ -179,28 +179,36 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
         return
     import jax
     multi = jax.process_count() > 1
-    existing = os.listdir(dirname) if not multi else []
+    rank0 = not multi or jax.process_index() == 0
+    existing = os.listdir(dirname) if rank0 else []
+
+    def clean(base, keep_layout):
+        # refresh the layout: a leftover file from an earlier save with a
+        # different sharding would otherwise shadow (".npy" wins at load)
+        # or blend with ("shard.*" all consumed) the new files. Only rank 0
+        # deletes, and only the OTHER layout's files — every rank agrees on
+        # each var's layout this run, so no writer is raced.
+        for stale in existing:
+            other = (stale == base + ".npy") if keep_layout == "sharded" \
+                else (stale == base + ".meta.json"
+                      or stale.startswith(base + ".shard."))
+            if other:
+                try:
+                    os.remove(os.path.join(dirname, stale))
+                except FileNotFoundError:
+                    pass
+
     for n, val in values.items():
         base = n.replace("/", "__")
-        if not multi:
-            # refresh the layout: a leftover .npy from an earlier
-            # differently-sharded save would otherwise shadow new pieces
-            # at load time (multi-process saves get dir-level cleaning
-            # from save_checkpoint instead — unsynchronized deletes would
-            # race other writers)
-            for stale in existing:
-                if (stale == base + ".npy" or stale == base + ".meta.json"
-                        or stale.startswith(base + ".shard.")):
-                    try:
-                        os.remove(os.path.join(dirname, stale))
-                    except FileNotFoundError:
-                        pass
         if _is_cross_process(val):
+            if rank0:
+                clean(base, "sharded")
             _save_sharded(dirname, base, val)
-        elif not multi or jax.process_index() == 0:
+        elif rank0:
             # fully-addressable values are replicated across processes by
             # construction (the sharded route owns everything GSPMD laid
             # out); process 0 is the single writer, atomically
+            clean(base, "npy")
             _atomic_save(os.path.join(dirname, base + ".npy"),
                          np.asarray(val))
 
@@ -245,7 +253,18 @@ def load_vars(executor=None, dirname: str = "", main_program=None, vars=None,
     for v in vars:
         base = v.name.replace("/", "__")
         path = os.path.join(dirname, base + ".npy")
-        if os.path.exists(path):
+        has_npy = os.path.exists(path)
+        has_shards = os.path.exists(os.path.join(dirname,
+                                                 base + ".meta.json"))
+        if has_npy and has_shards:
+            # both layouts present = an interrupted re-save with a changed
+            # sharding; guessing which is current would silently restore
+            # stale values (save_vars cleans the other layout on success)
+            raise IOError(
+                f"load_vars: {v.name!r} has BOTH a full .npy and shard "
+                f"pieces in {dirname!r} — the directory mixes saves with "
+                "different layouts; delete the stale layout or re-save")
+        if has_npy:
             scope.set_var(v.name, np.load(path))
         else:
             assembled = _load_sharded(dirname, base)

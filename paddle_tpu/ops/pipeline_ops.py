@@ -29,31 +29,46 @@ def pipeline_op(ctx, ins, attrs):
     out_inner = attrs["out_var"]
     m = int(attrs["n_microbatches"])
 
-    stacked = list(ins["Params"])              # [S, ...] per param
+    stacked = list(ins["Params"])              # [S*ls, ...] per param
     x = ins["X"][0]                            # [B, ...]
     if not stacked:
         raise ValueError(
             "pipeline: the stage declared no stage_param()s — per-stage "
             "parameters must come from pipe.stage_param (ordinary layers "
             "create unstacked globals the schedule cannot slice)")
-    s = stacked[0].shape[0]
-    want = int(attrs.get("num_stages", s))
-    if s != want:
-        raise ValueError(f"pipeline: stacked params have {s} stages, "
-                         f"layer declared {want}")
+    total = stacked[0].shape[0]
+    ls = int(attrs.get("layers_per_stage", 1))  # >1: auto-pp packs layers
+    want = int(attrs.get("num_stages", total // ls))
+    if total != want * ls:
+        raise ValueError(f"pipeline: stacked params have {total} layers, "
+                         f"expected {want} stages x {ls} layers/stage")
+    s = want
+    # leaves become [S, ls, ...]: gpipe/sequential slice over stages, the
+    # stage body scans its ls layer slices
+    stacked = [a.reshape((s, ls) + tuple(a.shape[1:])) for a in stacked]
     b = x.shape[0]
     if b % m:
         raise ValueError(f"pipeline: batch {b} not divisible by "
                          f"n_microbatches {m}")
-    xs = x.reshape((m, b // m) + tuple(x.shape[1:]))
     outer_env = dict(ctx.env)
 
-    def stage_fn(p_slices, xmb):
+    def one_layer(xin, p_layer):
         env = dict(outer_env)
-        env[x_inner] = xmb
-        env.update(zip(param_inner, p_slices))
+        env[x_inner] = xin
+        env.update(zip(param_inner, p_layer))
         lowering.run_op_range(sub.ops, 0, len(sub.ops), env, ctx, sub)
         return env[out_inner]
+
+    def stage_fn(p_slices, xmb):
+        # p_slices: tuple of [ls, ...] leaves (this stage's layer params)
+        if ls == 1:
+            return one_layer(xmb, tuple(p[0] for p in p_slices))
+
+        def body(carry, p_layer):
+            return one_layer(carry, tuple(p_layer)), None
+
+        out, _ = jax.lax.scan(body, xmb, tuple(p_slices))
+        return out
 
     mesh = ctx.mesh
     params = tuple(stacked)
@@ -62,9 +77,15 @@ def pipeline_op(ctx, ins, attrs):
         pp = int(mesh.shape["pp"])
         if pp != s:
             raise ValueError(f"pipeline: {s} stages but pp axis size {pp}")
+        xs = x.reshape((m, b // m) + tuple(x.shape[1:]))
         out = gpipe(lambda p, xmb: stage_fn(tuple(p), xmb), params, xs,
                     mesh=mesh)
+        out = out.reshape((b,) + tuple(out.shape[2:]))
     else:
+        # no pp axis: run the stages sequentially on the FULL batch — the
+        # microbatch split only exists to fill the pipeline, and keeping
+        # the original rank keeps rank-sensitive stage ops (layer_norm
+        # begin_norm_axis, reshapes) identical to the unpartitioned program
         out = sequential_stages(lambda p, xmb: stage_fn(tuple(p), xmb),
-                                params, xs)
-    return {"Out": [out.reshape((b,) + tuple(out.shape[2:]))]}
+                                params, x)
+    return {"Out": [out]}

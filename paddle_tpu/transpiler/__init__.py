@@ -3,9 +3,11 @@
 from .distribute_transpiler import (DistributeTranspiler, TranspileStrategy,
                                     transpile)
 from .memory_optimize import memory_optimize, release_memory
+from .pipeline_transpiler import pipeline_transpile, find_repeated_region
 from .inference_transpiler import (InferenceTranspiler,
                                     Float16Transpiler)
 
 __all__ = ["DistributeTranspiler", "TranspileStrategy", "transpile",
+           "pipeline_transpile", "find_repeated_region",
            "memory_optimize", "release_memory", "InferenceTranspiler",
            "Float16Transpiler"]

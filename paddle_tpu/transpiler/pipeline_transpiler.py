@@ -1,0 +1,310 @@
+"""Automatic pipeline-stage partitioning for unmodified programs.
+
+The reference has no pipeline parallelism; this is north-star TPU-first
+work (SURVEY §2.4 last row). Round 2's `layers.Pipeline` required the
+model author to restructure their network around `stage_param`; this pass
+removes that requirement: it finds the repeated layer structure already
+present in a program's op stream (a transformer's n_layers blocks emitted
+by an ordinary Python loop), hoists one copy into a sub-block, stacks the
+per-layer parameters into `[L, ...]` vars sharded over 'pp', and replaces
+the whole region with a single `pipeline` op — the same GPipe
+ppermute-in-scan schedule (parallel/pipeline.py) the explicit layer uses.
+
+Role ≙ the reference DistributeTranspiler rewriting a single-device
+program into its distributed form with zero model changes
+(transpiler/distribute_transpiler.py:244) — the axis here is pipeline
+stages instead of pserver shards.
+
+Detection: the longest run of r>=2 consecutive op windows with identical
+type sequences, validated structurally — a consistent var rename maps
+occurrence 0 onto occurrence k; exactly one carried tensor crosses
+occurrence boundaries (the residual stream); per-occurrence params agree
+in shape; shared vars (same name everywhere: masks, scales, tied weights)
+stay outer and reach the stage body through the interpreter environment.
+
+Contract (documented limits):
+  * call BEFORE optimizer.minimize — the stacked vars become the
+    parameters the optimizer sees, so accumulators stack/shard for free;
+  * n_layers % pp == 0 (layers_per_stage an integer), batch % microbatches
+    == 0;
+  * occurrences containing sub-block ops (control flow) are not matched;
+  * per-layer params must be layer-private; weights shared across layers
+    stay replicated (correct, just not stage-resident).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..core.program import Program, default_main_program, unique_name
+
+__all__ = ["pipeline_transpile", "find_repeated_region"]
+
+
+def _op_sig(op) -> Tuple:
+    """Type + attrs (minus nothing var-named; sub-block ops are rejected
+    separately) — occurrences must agree on this."""
+    items = []
+    for k, v in sorted((op.attrs or {}).items()):
+        items.append((k, tuple(v) if isinstance(v, list) else v))
+    return (op.type, tuple(items))
+
+
+def _occurrence_map(block, ops, start: int, w: int, k: int,
+                    params_ok) -> Optional[Dict[str, str]]:
+    """Consistent rename occurrence0 -> occurrence k, or None."""
+    ren: Dict[str, str] = {}
+    for j in range(w):
+        a, b = ops[start + j], ops[start + k * w + j]
+        if _op_sig(a) != _op_sig(b):
+            return None
+        for slot_map in ("inputs", "outputs"):
+            sa, sb = getattr(a, slot_map), getattr(b, slot_map)
+            if set(sa) != set(sb):
+                return None
+            for slot in sa:
+                na, nb = sa[slot], sb[slot]
+                if len(na) != len(nb):
+                    return None
+                for x, y in zip(na, nb):
+                    if x == y:
+                        continue  # shared var (mask, scale, tied weight)
+                    if ren.setdefault(x, y) != y:
+                        return None
+                    if not params_ok(x, y):
+                        return None
+    return ren
+
+
+def find_repeated_region(block) -> Optional[dict]:
+    """Find the best (start, width, reps) repeated layer region in block.
+
+    Returns dict(start, w, r, renames, carry_in, carry_out, param_roles)
+    or None. Best = maximal coverage r*w with r >= 2.
+    """
+    ops = block.ops
+    n = len(ops)
+    types = [op.type for op in ops]
+
+    def var(name):
+        try:
+            return block.var(name)
+        except KeyError:
+            return None
+
+    def params_ok(x, y):
+        vx, vy = var(x), var(y)
+        if vx is None or vy is None:
+            return True  # plain intermediates
+        if vx.is_parameter != vy.is_parameter:
+            return False
+        if vx.is_parameter and tuple(vx.shape) != tuple(vy.shape):
+            return False
+        return True
+
+    # periodicity scan: for each width w, match[i] = types[i]==types[i+w];
+    # a run of matches of length `run` starting at i is a region of
+    # r = run//w + 1 occurrences. O(n^2) comparisons total (vs the naive
+    # O(n^3) slice-compare), so a 1500-op block costs ~1e6 equality checks.
+    has_sub = ["sub_block" in (op.attrs or {}) for op in ops]
+    candidates = []  # (coverage, start, w, r)
+    for w in range(2, n // 2 + 1):
+        m = n - w
+        match = [types[i] == types[i + w] for i in range(m)]
+        i = 0
+        while i < m:
+            if not match[i]:
+                i += 1
+                continue
+            j = i
+            while j < m and match[j]:
+                j += 1
+            run = j - i
+            r = run // w + 1
+            if r >= 2:
+                # every alignment s in [i, i + run % w] fits r occurrences;
+                # enumerate them (bounded by w) so validation can skip a
+                # boundary-straddling earliest alignment
+                for s in range(i, i + run % w + 1):
+                    candidates.append((r * w, s, w, r))
+            i = j + 1
+    candidates.sort(key=lambda t: (-t[0], t[2], t[1]))
+    for _, start, w, r in candidates:
+        if any(has_sub[start:start + r * w]):
+            continue
+        renames = []
+        ok = True
+        for k in range(1, r):
+            mp = _occurrence_map(block, ops, start, w, k, params_ok)
+            if mp is None:
+                ok = False
+                break
+            renames.append(mp)
+        if not ok:
+            continue
+        region = _carry_analysis(block, ops, start, w, r, renames)
+        if region is not None:
+            return region
+    return None
+
+
+def _carry_analysis(block, ops, start: int, w: int, r: int,
+                    renames: List[Dict[str, str]]) -> Optional[dict]:
+    """Identify the single carried tensor + per-role param lists."""
+    def var(name):
+        try:
+            return block.var(name)
+        except KeyError:
+            return None
+
+    occ0 = ops[start:start + w]
+    produced0 = {n for op in occ0 for n in op.output_names()}
+    produced_before = {n for op in ops[:start] for n in op.output_names()}
+    ren1 = renames[0] if renames else {}
+
+    carries = []
+    param_names: List[str] = []
+    for op in occ0:
+        for name in op.input_names():
+            v = var(name)
+            if v is not None and v.is_parameter and name in ren1:
+                if name not in param_names:
+                    param_names.append(name)
+                continue
+            if name in produced0 or name not in ren1:
+                continue  # intermediate or shared
+            # renamed non-param input produced outside occurrence 0: the
+            # carry. occurrence k's image must be occurrence k-1's output.
+            if name not in carries:
+                carries.append(name)
+    if len(carries) != 1:
+        return None
+    carry_in = carries[0]
+    if carry_in not in produced_before:
+        return None
+    # occurrence k's carry must come from occurrence k-1
+    prev_map = {}
+    for k in range(1, r):
+        image = renames[k - 1][carry_in]
+        prev_outs = ({n for op in ops[start + (k - 1) * w:start + k * w]
+                      for n in op.output_names()} if k > 1
+                     else produced0)
+        if image not in prev_outs:
+            return None
+        prev_map[k] = image
+    # carry_out role: the occ0 output that occurrence 1 consumes as carry
+    carry_out = prev_map.get(1)
+    if carry_out is None or carry_out not in produced0:
+        return None
+    cv_in, cv_out = block.var(carry_in), block.var(carry_out)
+    if tuple(cv_in.shape) != tuple(cv_out.shape):
+        return None
+    # stacked param roles: [name in occ0, occ1, ..., occ r-1]
+    roles = []
+    for p in param_names:
+        chain = [p] + [ren[p] for ren in renames]
+        if len(set(chain)) != len(chain):
+            return None
+        roles.append(chain)
+    out_name = carry_out if r == 1 else renames[r - 2][carry_out]
+    return {"start": start, "w": w, "r": r, "renames": renames,
+            "carry_in": carry_in, "carry_out": carry_out,
+            "out_name": out_name, "param_roles": roles}
+
+
+def pipeline_transpile(program: Optional[Program] = None,
+                       startup_program: Optional[Program] = None,
+                       num_stages: int = 1, num_microbatches: int = 4):
+    """Rewrite `program`'s repeated layer region into a `pipeline` op.
+
+    Call BEFORE optimizer.minimize (the stacked params become the
+    trainables). Returns the region summary dict (for tests/logging).
+    """
+    program = program if program is not None else default_main_program()
+    block = program.global_block
+    region = find_repeated_region(block)
+    if region is None:
+        raise ValueError(
+            "pipeline_transpile: no repeated layer region found in block 0 "
+            "(needs >= 2 structurally identical consecutive layer blocks)")
+    start, w, r = region["start"], region["w"], region["r"]
+    if r % num_stages:
+        raise ValueError(
+            f"pipeline_transpile: {r} layers do not divide into "
+            f"{num_stages} stages")
+    ops = block.ops
+    occ0 = ops[start:start + w]
+
+    # -- build the stage sub-block from occurrence 0 -----------------------
+    sub = program.create_block(block.idx)
+    x_inner = unique_name("pipe_x")
+    cv = block.var(region["carry_in"])
+    sub.create_var(x_inner, shape=tuple(cv.shape), dtype=cv.dtype)
+    param_inner = []
+    rename0 = {region["carry_in"]: x_inner}
+    for chain in region["param_roles"]:
+        pv = block.var(chain[0])
+        inner = unique_name("pipe_p")
+        sub.create_var(inner, shape=tuple(pv.shape), dtype=pv.dtype)
+        rename0[chain[0]] = inner
+        param_inner.append(inner)
+    for op in occ0:
+        new_inputs = {s: [rename0.get(n, n) for n in ns]
+                      for s, ns in op.inputs.items()}
+        new_outputs = {s: [rename0.get(n, n) for n in ns]
+                       for s, ns in op.outputs.items()}
+        # mirror each output var's desc into the sub-block (intermediates
+        # keep their occurrence-0 names, so the original desc is the source)
+        for s, ns in op.outputs.items():
+            for orig, new in zip(ns, new_outputs[s]):
+                if new not in sub.vars and orig in block.vars:
+                    src = block.var(orig)
+                    sub.create_var(new, shape=tuple(src.shape),
+                                   dtype=src.dtype)
+        sub.append_op(op.type, new_inputs, new_outputs, dict(op.attrs or {}))
+
+    # -- stacked parameters + startup rewrite ------------------------------
+    stacked_names = []
+    for chain in region["param_roles"]:
+        pv = block.var(chain[0])
+        stacked = block.create_var(chain[0] + "@pp_stack",
+                                   shape=(r,) + tuple(pv.shape),
+                                   dtype=pv.dtype, persistable=True)
+        stacked.is_parameter = True
+        stacked.trainable = getattr(pv, "trainable", True)
+        stacked.sharding = ("pp",) + (None,) * len(pv.shape)
+        stacked_names.append(stacked.name)
+        if startup_program is not None:
+            sblock = startup_program.global_block
+            sv = sblock.create_var(stacked.name,
+                                   shape=(r,) + tuple(pv.shape),
+                                   dtype=pv.dtype, persistable=True)
+            sv.is_parameter = True
+            sblock.append_op("stack", {"X": list(chain)}, {"Y": sv},
+                             {"axis": 0})
+            for name in chain:  # demote the per-layer originals
+                if name in sblock.vars:
+                    sblock.vars[name].persistable = False
+                    sblock.vars[name].is_parameter = False
+        for name in chain:
+            if name in block.vars:
+                block.vars[name].persistable = False
+                block.vars[name].is_parameter = False
+
+    # -- replace the region with one pipeline op ---------------------------
+    out_var = block.var(region["out_name"])
+    from ..core.program import OpDesc
+    pipe_op = OpDesc(
+        "pipeline",
+        inputs={"X": [region["carry_in"]], "Params": list(stacked_names)},
+        outputs={"Out": [out_var.name]},
+        attrs={"sub_block": sub.idx, "x_var": x_inner,
+               "param_vars": param_inner,
+               "out_var": rename0.get(region["carry_out"],
+                                      region["carry_out"]),
+               "n_microbatches": int(num_microbatches),
+               "num_stages": int(num_stages),
+               "layers_per_stage": r // int(num_stages)})
+    block.ops[start:start + r * w] = [pipe_op]
+    program.invalidate_cache()
+    return region

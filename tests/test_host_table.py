@@ -1,0 +1,202 @@
+"""Host-RAM offloaded embedding tables (the pserver capacity story).
+
+≙ reference distributed lookup table: lookup_sparse_table_op.cc +
+distribute_transpiler.py:120-180 prefetch flow — tables bigger than device
+memory live off-accelerator and batches pull only the rows they touch.
+Here: table in host numpy, rows block shipped per batch, rows-gradient
+fetched and applied host-side (paddle_tpu/host_table.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.host_table import HostEmbeddingTable, host_embedding
+from paddle_tpu.parallel.parallel_executor import ParallelExecutor
+
+VOCAB, DIM, CAP, NCTX, NCLS = 4096, 64, 128, 8, 16
+HBM_BUDGET = 512 * 1024  # bytes/device the test "allows"; the full table
+TABLE_BYTES = VOCAB * DIM * 4  # (1 MB) deliberately exceeds it
+LR = 0.5
+
+
+def _init_table():
+    rng = np.random.RandomState(7)
+    return rng.uniform(-0.05, 0.05, (VOCAB, DIM)).astype(np.float32)
+
+
+def _tail(emb):
+    """Shared model tail so both paths build identical fc params."""
+    avg = layers.reduce_mean(emb, dim=1)
+    label = layers.data("label", [1], dtype="int64")
+    logits = layers.fc(input=avg, size=NCLS)
+    return layers.mean(layers.softmax_with_cross_entropy(logits, label))
+
+
+def _batches(n=12, batch=16, seed=123):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.randint(0, VOCAB, (batch, NCTX)).astype("int64")
+        # learnable labels (a function of the ids) so the loss falls
+        label = (ids.sum(axis=1, keepdims=True) % NCLS).astype("int64")
+        out.append({"ids": ids, "label": label})
+    return out
+
+
+def _train_host_table(batches):
+    table = HostEmbeddingTable("emb", VOCAB, DIM, capacity=CAP,
+                               optimizer="sgd", learning_rate=LR,
+                               initial_value=_init_table())
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 9
+    pt.core.program.reset_unique_names()
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", [NCTX], dtype="int64")
+        emb = host_embedding(ids, table)
+        loss = _tail(emb)
+        pt.optimizer.SGDOptimizer(LR).minimize(loss)
+        grad = table.grad_var(loss)
+
+    scope = pt.Scope()
+    losses = []
+    with pt.scope_guard(scope):
+        pt.Executor().run(startup)
+        pexe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                                scope=scope)
+        for b in batches:
+            prep, hb = table.prepare(b["ids"])
+            feed = {"ids": prep[table.local_ids_name],
+                    table.rows_name: prep[table.rows_name],
+                    "label": b["label"]}
+            l, g = pexe.run(fetch_list=[loss, grad], feed=feed)
+            table.apply_grad(np.asarray(g), hb)
+            losses.append(float(np.ravel(l)[0]))
+        device_state_bytes = sum(
+            np.asarray(scope.find_var(n)).nbytes
+            for n in scope.local_var_names())
+        feed_bytes = (CAP * DIM * 4  # rows block
+                      + batches[0]["ids"].nbytes + batches[0]["label"].nbytes)
+    return losses, table, device_state_bytes + feed_bytes
+
+
+def _train_in_mesh(batches):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 9
+    pt.core.program.reset_unique_names()
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", [NCTX], dtype="int64")
+        emb = layers.embedding(
+            ids, size=[VOCAB, DIM], is_distributed=True,
+            param_attr=pt.ParamAttr(
+                name="emb_table",
+                initializer=pt.initializer.NumpyArrayInitializer(
+                    _init_table())))
+        loss = _tail(emb)
+        pt.optimizer.SGDOptimizer(LR).minimize(loss)
+
+    scope = pt.Scope()
+    losses = []
+    with pt.scope_guard(scope):
+        pt.Executor().run(startup)
+        pexe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                                scope=scope)
+        for b in batches:
+            (l,) = pexe.run(fetch_list=[loss], feed=b)
+            losses.append(float(np.ravel(l)[0]))
+        table = np.asarray(scope.find_var("emb_table"))
+        device_param_bytes = sum(
+            np.asarray(scope.find_var(n)).nbytes
+            for n in scope.local_var_names())
+    return losses, table, device_param_bytes
+
+
+class TestHostTableTraining:
+    def test_capacity_contract(self):
+        t = HostEmbeddingTable("t", 100, 4, capacity=4)
+        with pytest.raises(ValueError):
+            t.prepare(np.arange(8))
+
+    def test_pad_slots_are_noops(self):
+        init = np.ones((10, 2), np.float32)
+        t = HostEmbeddingTable("t", 10, 2, capacity=6, learning_rate=1.0,
+                               initial_value=init.copy())
+        _, hb = t.prepare(np.asarray([[3, 4, 3]]))
+        g = np.zeros((6, 2), np.float32)
+        g[0] = 1.0  # grad for uniq[0]=3 only
+        t.apply_grad(g, hb)
+        assert t.table[3, 0] == 0.0  # updated
+        np.testing.assert_array_equal(t.table[0], init[0])  # pad target
+        np.testing.assert_array_equal(t.table[4], init[4])  # zero grad
+
+    def test_row0_update_not_clobbered_by_pad_slots(self):
+        """Pad slots alias row 0; an underfilled batch containing id 0
+        must still apply row 0's gradient (regression: stale pad copies
+        used to win the duplicate-index write)."""
+        init = np.ones((10, 2), np.float32)
+        t = HostEmbeddingTable("t", 10, 2, capacity=6, learning_rate=1.0,
+                               initial_value=init.copy())
+        _, hb = t.prepare(np.asarray([[0, 4]]))
+        g = np.zeros((6, 2), np.float32)
+        g[0] = 1.0  # grad for uniq[0] = id 0
+        t.apply_grad(g, hb)
+        assert t.table[0, 0] == 0.0, t.table[0]
+
+    def test_fifo_matches_prefetched_order(self):
+        """Under double_buffer the worker prepares ahead; implicit
+        apply_grad must pop the OLDEST pending batch, not the newest."""
+        from paddle_tpu.reader.prefetch import double_buffer
+        t = HostEmbeddingTable("t", 50, 2, capacity=4, learning_rate=1.0,
+                               initial_value=np.zeros((50, 2), np.float32))
+        id_seq = [np.asarray([i]) for i in (7, 11, 13, 17)]
+
+        def reader():
+            return iter({"ids": i} for i in id_seq)
+
+        grads = np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)
+        for k, feed in enumerate(double_buffer(t.wrap_reader(reader, "ids"))()):
+            g = np.zeros((4, 2), np.float32)
+            g[0] = grads[k]
+            t.apply_grad(g)  # implicit FIFO pop
+        for k, i in enumerate((7, 11, 13, 17)):
+            assert t.table[i, 0] == -grads[k], (i, t.table[i])
+
+    def test_matches_in_mesh_sharded_path_and_fits_budget(self):
+        """VERDICT r2 next #2 'done' criteria: a table larger than the
+        per-device HBM budget trains on the virtual mesh, loss-matching the
+        in-mesh vocab-sharded path, with HBM-resident bytes under budget."""
+        batches = _batches()
+        host_losses, host_table, host_dev_bytes = _train_host_table(batches)
+        mesh_losses, mesh_table, mesh_dev_bytes = _train_in_mesh(batches)
+
+        # training happened and the two paths agree step-for-step
+        assert host_losses[-1] < host_losses[0]
+        np.testing.assert_allclose(host_losses, mesh_losses, rtol=2e-4)
+        # the tables themselves agree after all updates
+        np.testing.assert_allclose(host_table.table, mesh_table, atol=2e-5)
+
+        # capacity story: the table exceeds the budget, the in-mesh path
+        # keeps it device-resident, the host path stays under budget
+        assert TABLE_BYTES > HBM_BUDGET
+        assert host_table.host_bytes() >= TABLE_BYTES
+        assert mesh_dev_bytes > HBM_BUDGET, mesh_dev_bytes
+        assert host_dev_bytes < HBM_BUDGET, host_dev_bytes
+
+    def test_wrap_reader_rides_double_buffer(self):
+        from paddle_tpu.reader.prefetch import double_buffer
+        table = HostEmbeddingTable("emb", VOCAB, DIM, capacity=CAP,
+                                   initial_value=_init_table())
+        batches = _batches(n=4)
+
+        def reader():
+            return iter(batches)
+
+        wrapped = table.wrap_reader(reader, ids_key="ids",
+                                    local_ids_key="ids")
+        got = list(double_buffer(wrapped)())
+        assert len(got) == 4
+        for feed in got:
+            assert set(feed) == {"ids", "label", table.rows_name}
+            assert tuple(feed[table.rows_name].shape) == (CAP, DIM)
+            assert int(np.max(np.asarray(feed["ids"]))) < CAP

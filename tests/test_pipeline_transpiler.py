@@ -1,0 +1,158 @@
+"""Automatic pipeline-stage partitioning (transpiler/pipeline_transpiler.py).
+
+VERDICT r2 next #4: an UNMODIFIED transformer program — no layers.Pipeline,
+no stage_param — is partitioned into GPipe stages by the transpiler and
+trains on a pp x dp mesh, loss-matching the single-chip run.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.models.transformer import transformer_lm_loss
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.parallel_executor import ParallelExecutor
+from paddle_tpu.transpiler import find_repeated_region, pipeline_transpile
+
+N_LAYERS, D, SEQ, VOCAB, BATCH = 4, 16, 16, 64, 8
+
+
+def _build(auto_pp, num_stages=2, microbatches=4):
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 5
+    with pt.program_guard(main, startup):
+        avg, _ = transformer_lm_loss(vocab_size=VOCAB, seq_len=SEQ,
+                                     n_layers=N_LAYERS, d_model=D,
+                                     n_heads=2, d_ff=2 * D)
+        if auto_pp:
+            pipeline_transpile(main, startup, num_stages=num_stages,
+                               num_microbatches=microbatches)
+        pt.optimizer.SGDOptimizer(0.1).minimize(avg)
+    return main, startup, avg
+
+
+def _feed():
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, VOCAB, (BATCH, SEQ)).astype("int64")
+    return {"src_ids": ids,
+            "tgt_ids": np.roll(ids, -1, 1).reshape(BATCH, SEQ, 1)}
+
+
+def _run_single(auto_pp, steps=4, num_stages=2):
+    main, startup, avg = _build(auto_pp, num_stages=num_stages)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        return [float(np.ravel(exe.run(main, feed=_feed(),
+                                       fetch_list=[avg])[0])[0])
+                for _ in range(steps)]
+
+
+def _run_mesh(pp, dp, steps=4, num_stages=None):
+    num_stages = num_stages or pp
+    main, startup, avg = _build(True, num_stages=num_stages)
+    mesh = make_mesh({"pp": pp, "dp": dp}, devices=jax.devices()[:pp * dp])
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        pt.Executor().run(startup)
+        pexe = ParallelExecutor(loss_name=avg.name, main_program=main,
+                                mesh=mesh, scope=scope)
+        return [float(np.ravel(pexe.run([avg], feed=_feed())[0])[0])
+                for _ in range(steps)]
+
+
+class TestRegionDetection:
+    def test_finds_transformer_layers(self):
+        pt.core.program.reset_unique_names()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            transformer_lm_loss(vocab_size=VOCAB, seq_len=SEQ,
+                                n_layers=N_LAYERS, d_model=D, n_heads=2,
+                                d_ff=2 * D)
+        region = find_repeated_region(main.global_block)
+        assert region is not None
+        assert region["r"] == N_LAYERS
+        # 6 matmuls (q,k,v,out,ff1,ff2) x (w,b) + 2 layer_norms x (g,b)
+        assert len(region["param_roles"]) == 16
+        # carried tensor: the residual stream [B, S, D]
+        assert tuple(main.global_block.var(region["carry_in"]).shape) \
+            == (-1, SEQ, D)
+
+    def test_no_region_in_flat_program(self):
+        pt.core.program.reset_unique_names()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            y = layers.fc(x, size=3, act="relu")
+            layers.mean(y)
+        with pytest.raises(ValueError, match="no repeated layer region"):
+            pipeline_transpile(main, startup, num_stages=2)
+
+    def test_indivisible_stages_rejected(self):
+        pt.core.program.reset_unique_names()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            transformer_lm_loss(vocab_size=VOCAB, seq_len=SEQ, n_layers=4,
+                                d_model=D, n_heads=2, d_ff=2 * D)
+        with pytest.raises(ValueError, match="do not divide"):
+            pipeline_transpile(main, startup, num_stages=3)
+
+
+class TestAutoPipelineParity:
+    def test_single_chip_parity_one_layer_per_stage(self):
+        base = _run_single(False)
+        auto = _run_single(True, num_stages=N_LAYERS)
+        np.testing.assert_allclose(base, auto, rtol=2e-5)
+
+    def test_single_chip_parity_multi_layer_stages(self):
+        base = _run_single(False)
+        auto = _run_single(True, num_stages=2)  # 2 layers per stage
+        np.testing.assert_allclose(base, auto, rtol=2e-5)
+
+    def test_trains_on_pp4_dp2_mesh_matching_single_chip(self):
+        """The VERDICT 'done' bar: pp=4 x dp=2, unmodified model, losses
+        match the single-chip run while training (params update)."""
+        base = _run_single(False, steps=4)
+        mesh_losses = _run_mesh(pp=4, dp=2, steps=4)
+        assert mesh_losses[-1] < mesh_losses[0]
+        np.testing.assert_allclose(base, mesh_losses, rtol=1e-4)
+
+    def test_trains_on_pp2_dp2_two_layers_per_stage(self):
+        base = _run_single(False, steps=3)
+        mesh_losses = _run_mesh(pp=2, dp=2, steps=3, num_stages=2)
+        np.testing.assert_allclose(base, mesh_losses, rtol=1e-4)
+
+
+class TestStackedParams:
+    def test_stacked_params_replace_per_layer_params(self):
+        main, startup, avg = _build(True, num_stages=2)
+        params = [p.name for p in main.global_block.all_parameters()]
+        stacked = [p for p in params if p.endswith("@pp_stack")]
+        assert len(stacked) == 16
+        # per-layer originals are no longer parameters
+        assert not any("fc" in p and "@pp_stack" not in p and
+                       main.global_block.var(p).is_parameter is False
+                       for p in params)
+        for p in stacked:
+            v = main.global_block.var(p)
+            assert v.shape[0] == N_LAYERS
+            assert v.sharding[0] == "pp"
+
+    def test_optimizer_state_stacks_too(self):
+        pt.core.program.reset_unique_names()
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = 5
+        with pt.program_guard(main, startup):
+            avg, _ = transformer_lm_loss(vocab_size=VOCAB, seq_len=SEQ,
+                                         n_layers=N_LAYERS, d_model=D,
+                                         n_heads=2, d_ff=2 * D)
+            pipeline_transpile(main, startup, num_stages=2)
+            pt.optimizer.MomentumOptimizer(0.1, 0.9).minimize(avg)
+        vel = [n for n in main.global_block.vars
+               if "velocity" in n and "@pp_stack" in n]
+        assert len(vel) == 16, len(vel)
