@@ -16,13 +16,15 @@ Measurement notes (evidence gathered on the v5e-via-tunnel rig, round 2):
   * each lax.scan iteration adds ~2 ms of control overhead; run_loop's
     unroll=2 halves it.
   * device→host bandwidth is ~15 MB/s: fetch scalars only.
-  * ResNet-50 bs128 bf16 is HBM-bandwidth-bound on one chip (XLA cost
-    analysis: 42 GB accessed/step ÷ 819 GB/s ≈ 51 ms floor; measured ~54 ms
-    device time at 300-step windows), so its MFU ceiling is ~17-18%, not
-    the 45% north star — NCHW vs NHWC was measured a wash (XLA
-    canonicalizes conv layouts). The compute-bound MFU story is the
-    transformer config below (50.8% measured on the same chip at
-    d_model 2048 — past the 45% north-star bar).
+  * ResNet-50 bs128 bf16 is HBM-bandwidth-bound on one chip — anchored in
+    round 3 by a raw-JAX control (tools/resnet50_control.py, artifact in
+    docs/artifacts/resnet50_control.json): paddle_tpu 49.69 ms/batch vs
+    hand-written raw JAX 49.25 ms (+0.9%), both ~16% MFU; XLA cost
+    analysis 44.2 GB accessed/step ÷ 819 GB/s ≈ 54 ms bound. The ~17%
+    ceiling is the model's arithmetic intensity, not framework overhead —
+    NCHW vs NHWC measured a wash (XLA canonicalizes conv layouts). The
+    compute-bound MFU story is the transformer config below (50.8%
+    measured on the same chip at d_model 2048 — past the 45% bar).
 """
 
 from __future__ import annotations
@@ -267,19 +269,31 @@ def _lm_bench(on_tpu, peak, batch, seqlen, d_model, n_layers, n_heads,
     # attention 2*2*S*d/layer + logits 2*d*V; train ~= 3x fwd, and remat
     # re-runs the forward inside backward: ~4x
     tokens = batch * seqlen
-    per_tok = n_layers * (2 * (4 * d_model ** 2 + 2 * d_model * d_ff)
-                          + 4 * seqlen * d_model) + 2 * d_model * vocab
-    train_flops = (4.0 if remat else 3.0) * per_tok * tokens
-    mfu = train_flops / (ms / 1000.0) / peak
+    per_tok_mm = n_layers * 2 * (4 * d_model ** 2 + 2 * d_model * d_ff)
+    per_tok_attn = n_layers * 4 * seqlen * d_model
+    per_tok = per_tok_mm + per_tok_attn + 2 * d_model * vocab
+    # model-flops basis (standard MFU: recompute is not useful work);
+    # the recompute-inclusive multiplier (HFU-style) depends on the remat
+    # policy. Remat scopes wrap the LAYER bodies only, so the logits
+    # projection is never recomputed under any policy: full-layer remat
+    # re-runs matmuls+attention (3 + (mm+attn)/total), save_attn skips the
+    # attention recompute too (3 + mm/total)
+    mult = {False: 3.0,
+            True: 3.0 + (per_tok_mm + per_tok_attn) / per_tok,
+            "save_attn": 3.0 + per_tok_mm / per_tok,
+            "dots": 3.0}.get(remat, 4.0)
+    mfu = 3.0 * per_tok * tokens / (ms / 1000.0) / peak
+    hfu = mult * per_tok * tokens / (ms / 1000.0) / peak
     out = {"batch": batch, "seq_len": seqlen, "d_model": d_model,
            "n_layers": n_layers, "steps": steps,
            "ms_per_batch": round(ms, 2),
            "tokens_per_sec": round(tokens / ms * 1000.0),
            "mfu_pct": round(mfu * 100, 2),
+           "hfu_pct": round(hfu * 100, 2),
            "compile_s": round(compile_s, 1),
            "loss_first": float(losses[0]), "loss_last": float(losses[-1])}
     if remat:
-        out["remat"] = True
+        out["remat"] = remat if isinstance(remat, str) else True
     return out
 
 
@@ -324,7 +338,12 @@ def bench_long_context(on_tpu, peak):
     else:
         cfg = dict(batch=1, seqlen=256, d_model=64, n_layers=2, n_heads=2,
                    d_ff=128, vocab=500, steps=2)
-    return _lm_bench(on_tpu, peak, remat=True, **cfg)
+    # full per-layer remat: save_attn measured SLOWER at 8k (saving the
+    # attention outputs costs more HBM traffic than the recompute saves —
+    # docs/artifacts/long_context_tuning.json)
+    policy = os.environ.get("BENCH_LC_POLICY", "full")
+    remat = True if policy in ("full", "true") else policy
+    return _lm_bench(on_tpu, peak, remat=remat, **cfg)
 
 
 def bench_data_pipeline(on_tpu, resnet_result):

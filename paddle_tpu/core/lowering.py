@@ -29,6 +29,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Sequence, Tuple
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -127,7 +129,23 @@ def _run_remat_segment(ops, start: int, stop: int, range_stop: int, env,
             run_op(op, e, ctx, block)
         return tuple(e[n] for n in written)
 
-    outs = jax.checkpoint(seg_fn)(tuple(env[n] for n in read))
+    # remat_policy (remat_scope(tag, policy=...)): "save_attn" keeps the
+    # flash-attention outputs (tagged via checkpoint_name in
+    # ops/attention_ops.py) as saved primals so the backward recomputes
+    # only the cheap elementwise/matmul parts; "dots" = checkpoint_dots.
+    pol_name = seg[0].attrs.get("remat_policy")
+    policy = None
+    if pol_name == "save_attn":
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "flash_attn_out")
+    elif pol_name == "dots":
+        policy = jax.checkpoint_policies.checkpoint_dots
+    elif pol_name is not None:
+        raise ValueError(f"unknown remat_policy {pol_name!r} "
+                         "(save_attn | dots)")
+    ckpt = (jax.checkpoint if policy is None
+            else functools.partial(jax.checkpoint, policy=policy))
+    outs = ckpt(seg_fn)(tuple(env[n] for n in read))
     env.update(zip(written, outs))
 
 

@@ -63,15 +63,23 @@ def reset_unique_names():
 # their activations are recomputed in the backward instead of stored.
 # ---------------------------------------------------------------------------
 
-_remat_stack: List[str] = []
+_remat_stack: List[tuple] = []
 
 
 class remat_scope:
-    def __init__(self, tag: str):
+    """policy: None = recompute everything in the segment's backward;
+    "save_attn" = save values tagged checkpoint_name("flash_attn_out")
+    (the flash-attention outputs) and recompute only the rest — the
+    attention forward is the most expensive thing a layer recomputes, and
+    its saved output is small (O(S·D), not O(S²)); "dots" = XLA
+    checkpoint_dots policy (save matmul results generally)."""
+
+    def __init__(self, tag: str, policy: Optional[str] = None):
         self.tag = tag
+        self.policy = policy
 
     def __enter__(self):
-        _remat_stack.append(self.tag)
+        _remat_stack.append((self.tag, self.policy))
         return self
 
     def __exit__(self, *exc):
@@ -80,7 +88,11 @@ class remat_scope:
 
 
 def current_remat_scope() -> Optional[str]:
-    return _remat_stack[-1] if _remat_stack else None
+    return _remat_stack[-1][0] if _remat_stack else None
+
+
+def current_remat_policy() -> Optional[str]:
+    return _remat_stack[-1][1] if _remat_stack else None
 
 
 def iter_optimizer_state_inputs(block) -> Iterator[tuple]:
@@ -278,6 +290,9 @@ class Block:
         scope_tag = current_remat_scope()
         if scope_tag is not None:
             op.attrs.setdefault("remat_scope", scope_tag)
+            pol = current_remat_policy()
+            if pol is not None:
+                op.attrs.setdefault("remat_policy", pol)
         self.ops.append(op)
         self.program.invalidate_cache()
         from .registry import get_op  # local import to avoid cycle

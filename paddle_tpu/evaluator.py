@@ -195,11 +195,42 @@ class DetectionMAP(Evaluator):
         one = layers.fill_constant(shape=[1], dtype="int64", value=1)
         _accumulate(self.helper, self.batches, one)
         self.metrics.append(self.cur_map)
+        # reference-faithful accumulation: per-detection TP/FP matched
+        # against the full GT pool, AP recomputed at eval (≙ the
+        # Accum{TruePos,FalsePos} recompute, evaluator.py:257-379). Feed
+        # per-batch fetches through update(); eval() prefers this and
+        # falls back to the batch-mean scalar when update was never called.
+        from . import metrics as metrics_mod
+        self.streaming = metrics_mod.DetectionMAP(
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult,
+            ap_version="11point" if ap_version == "11point" else "integral")
 
     def get_map_var(self):
         return self.cur_map
 
+    def reset(self, executor: Executor, reset_program=None):
+        """Also clears the host-side streaming pool — otherwise a second
+        epoch's eval() would pool the first epoch's detections."""
+        super().reset(executor, reset_program)
+        self.streaming.reset()
+
+    def update(self, detections, gts):
+        """Accumulate one image's fetched tensors, in the SAME layouts the
+        in-graph inputs use: detections [N,6] = (label, score, x0,y0,x1,y1)
+        (the detect_res / multiclass_nms layout) and gts [G,6] =
+        (label, is_difficult, x0,y0,x1,y1) (the detection_map label
+        layout; [G,5] = no difficult flag). Rows are reordered here to
+        metrics.DetectionMAP's (label, box..., difficult) convention, so
+        per-batch fetches can be fed straight in."""
+        gts = np.asarray(gts, np.float64)
+        if gts.ndim == 2 and gts.shape[1] == 6:
+            gts = gts[:, [0, 2, 3, 4, 5, 1]]  # difficult column to the end
+        self.streaming.update(detections, gts)
+
     def eval(self, executor: Executor, eval_program=None):
+        if self.streaming._dets or self.streaming._n_gt:
+            return np.array([self.streaming.eval()], np.float32)
         s = float(np.ravel(np.asarray(
             _state_value(self.accum_map_sum.name)))[0])
         n = float(np.ravel(np.asarray(

@@ -182,33 +182,36 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
     rank0 = not multi or jax.process_index() == 0
     existing = os.listdir(dirname) if rank0 else []
 
-    def clean(base, keep_layout):
-        # refresh the layout: a leftover file from an earlier save with a
-        # different sharding would otherwise shadow (".npy" wins at load)
-        # or blend with ("shard.*" all consumed) the new files. Only rank 0
-        # deletes, and only the OTHER layout's files — every rank agrees on
-        # each var's layout this run, so no writer is raced.
+    def clean(base):
+        # refresh EVERY layout file for the var: a leftover from an
+        # earlier save with a different sharding (or process count) would
+        # otherwise shadow (".npy" wins at load) or blend with
+        # ("shard.*" all consumed) the files written now
         for stale in existing:
-            other = (stale == base + ".npy") if keep_layout == "sharded" \
-                else (stale == base + ".meta.json"
-                      or stale.startswith(base + ".shard."))
-            if other:
+            if (stale == base + ".npy" or stale == base + ".meta.json"
+                    or stale.startswith(base + ".shard.")):
                 try:
                     os.remove(os.path.join(dirname, stale))
                 except FileNotFoundError:
                     pass
 
+    if rank0:
+        for n in values:
+            clean(n.replace("/", "__"))
+    if multi:
+        # nobody writes until rank 0 finished deleting — otherwise a
+        # faster rank's fresh shard piece could be swept as "stale"
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_save_vars_clean")
+
     for n, val in values.items():
         base = n.replace("/", "__")
         if _is_cross_process(val):
-            if rank0:
-                clean(base, "sharded")
             _save_sharded(dirname, base, val)
         elif rank0:
             # fully-addressable values are replicated across processes by
             # construction (the sharded route owns everything GSPMD laid
             # out); process 0 is the single writer, atomically
-            clean(base, "npy")
             _atomic_save(os.path.join(dirname, base + ".npy"),
                          np.asarray(val))
 

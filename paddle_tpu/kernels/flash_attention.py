@@ -296,5 +296,30 @@ def dot_product_attention(q, k, v, bias=None, *, causal: bool = False,
     block-skip.
     """
     if bias is None and _tpu_ok(q, k, causal):
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        import os
+        # measured on v5e (docs/artifacts/long_context_tuning.json):
+        # 512x512 best at seq 1024 (53.6% vs 51.5% MFU at 128x128),
+        # 1024x1024 best at seq 8192 (465 -> 275 ms/step with remat —
+        # the block also sets the backward's q-chunk, so bigger blocks
+        # cut the dk/dv scan length 8x). The kernel has no ragged-block
+        # masking, so a block is only eligible when it DIVIDES its seq dim
+        # (128 always does — _tpu_ok guarantees seq % 128 == 0); bq and bk
+        # follow their own dims so cross-attention picks safely too.
+        sq, sk = q.shape[1], k.shape[1]
+        cap = 512 if max(sq, sk) <= 4096 else 1024
+
+        def pick(s):
+            for b in (1024, 512, 256):
+                if b <= cap and s % b == 0:
+                    return b
+            return 128
+        bq = int(os.environ.get("FLASH_BLOCK_Q", 0)) or pick(sq)
+        bk = int(os.environ.get("FLASH_BLOCK_K", 0)) or pick(sk)
+        if sq % bq or sk % bk:
+            raise ValueError(
+                f"flash block sizes must divide the sequence dims: "
+                f"block_q={bq} vs sq={sq}, block_k={bk} vs sk={sk} "
+                "(FLASH_BLOCK_Q/FLASH_BLOCK_K override)")
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=bq, block_k=bk)
     return mha_reference(q, k, v, bias, causal=causal, scale=scale)

@@ -65,8 +65,12 @@ def transformer_lm(src_ids, vocab_size, n_layers=2, d_model=128, n_heads=4,
 
     for i in range(n_layers):
         # remat: each transformer layer becomes one jax.checkpoint segment
-        # (activation memory ~O(n_layers) -> O(1) per layer boundary)
-        scope = remat_scope(f"tfm_layer_{i}") if remat \
+        # (activation memory ~O(n_layers) -> O(1) per layer boundary).
+        # remat may be a policy string ("save_attn" | "dots") — see
+        # core.program.remat_scope: save_attn keeps the flash-attention
+        # outputs so the backward skips the attention recompute.
+        policy = remat if isinstance(remat, str) else None
+        scope = remat_scope(f"tfm_layer_{i}", policy=policy) if remat \
             else contextlib.nullcontext()
         with scope:
             ln1 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln1_{i}",

@@ -74,6 +74,11 @@ def scaled_dot_product_attention(ctx, ins, attrs):
     if not use_sp:
         out = dot_product_attention(q, k, v, bias, causal=causal,
                                     scale=scale)
+        # name the output so remat_scope(policy="save_attn") can keep it
+        # as a saved primal (the expensive flash forward is then NOT
+        # recomputed in the backward; the saved value is O(S·D))
+        from jax.ad_checkpoint import checkpoint_name
+        out = checkpoint_name(out, "flash_attn_out")
         return {"Out": [out]}
 
     dp = mesh.shape.get(DP, 1)
@@ -87,4 +92,8 @@ def scaled_dot_product_attention(ctx, ins, attrs):
 
     fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
-    return {"Out": [fn(q, k, v)]}
+    # same tag as the single-chip path so remat_scope(policy="save_attn")
+    # keeps the (ring/ulysses) attention output instead of silently
+    # degrading to full recompute under sp
+    from jax.ad_checkpoint import checkpoint_name
+    return {"Out": [checkpoint_name(fn(q, k, v), "flash_attn_out")]}

@@ -41,11 +41,20 @@ from ..core.program import Program, default_main_program, unique_name
 __all__ = ["pipeline_transpile", "find_repeated_region"]
 
 
+_SEGMENTATION_ATTRS = ("remat_scope", "remat_policy")
+
+
 def _op_sig(op) -> Tuple:
-    """Type + attrs (minus nothing var-named; sub-block ops are rejected
-    separately) — occurrences must agree on this."""
+    """Type + attrs (sub-block ops are rejected separately) — occurrences
+    must agree on this. Remat segmentation attrs are excluded: they are
+    per-layer tags ("tfm_layer_0" vs "tfm_layer_1"), not op semantics, and
+    keeping them would make auto-pp and activation remat mutually
+    exclusive. The sub-block copy keeps occurrence 0's tags, so each
+    pipeline stage still checkpoints its layer bodies."""
     items = []
     for k, v in sorted((op.attrs or {}).items()):
+        if k in _SEGMENTATION_ATTRS:
+            continue
         items.append((k, tuple(v) if isinstance(v, list) else v))
     return (op.type, tuple(items))
 

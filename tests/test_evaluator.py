@@ -103,3 +103,29 @@ class TestDetectionMAPEvaluator:
         np.testing.assert_allclose(m1, [1.0], atol=1e-6)
         np.testing.assert_allclose(m2, [0.0], atol=1e-6)
         np.testing.assert_allclose(m.eval(exe), [0.5], atol=1e-6)
+
+    def test_streaming_update_recomputes_ap_across_batches(self):
+        """update() accumulates per-detection TP/FP over ALL batches and
+        eval() recomputes AP from the pooled pool (≙ the reference's
+        AccumTruePos recompute) — a cross-batch score ordering that the
+        mean-of-batch-mAPs fallback cannot represent."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            det = layers.data("det", [2, 6])
+            gt = layers.data("gt", [2, 6])
+            m = ev.DetectionMAP(det, gt, class_num=1, background_label=-1)
+        exe = pt.Executor()
+        exe.run(startup)
+        box = [0.1, 0.1, 0.4, 0.4]
+        off = [0.6, 0.6, 0.9, 0.9]
+        # gts use the IN-GRAPH layout (label, is_difficult, box);
+        # batch 1: a high-score FP and a low-score TP; batch 2: one TP
+        m.update(np.array([[0, 0.95] + off, [0, 0.5] + box], np.float32),
+                 np.array([[0, 0] + box], np.float32))
+        m.update(np.array([[0, 0.9] + box], np.float32),
+                 np.array([[0, 0] + box], np.float32))
+        pooled = float(m.eval(exe)[0])
+        # pooled ranking: FP(.95) then TP(.9) p=1/2 r=1/2, TP(.5) p=2/3
+        # r=1  ->  integral AP = .5*.5 + (2/3)*.5 = 0.5833; the batch-mean
+        # would give (0.5 + 1.0)/2 = 0.75
+        np.testing.assert_allclose(pooled, 0.5833, atol=2e-3)

@@ -65,6 +65,16 @@ class TestRematParity:
         remat = _run_steps(*_tfm_program(remat=True))
         np.testing.assert_allclose(base, remat, rtol=1e-5)
 
+    @pytest.mark.parametrize("policy", ["save_attn", "dots"])
+    def test_remat_policies_match_baseline(self, policy):
+        """remat_scope(policy=...): save_attn keeps flash-attention outputs
+        as saved primals (backward skips the attention recompute), dots is
+        XLA's checkpoint_dots — both purely memory/speed tradeoffs, with
+        identical numerics."""
+        base = _run_steps(*_tfm_program(remat=False))
+        got = _run_steps(*_tfm_program(remat=policy))
+        np.testing.assert_allclose(base, got, rtol=1e-5)
+
     def test_memory_optimize_pass_matches_baseline(self):
         base = _run_steps(*_tfm_program())
         opt = _run_steps(*_tfm_program(memopt=True))

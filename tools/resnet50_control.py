@@ -1,0 +1,264 @@
+"""Raw-JAX ResNet-50 control experiment (VERDICT r2 next #1).
+
+Question: is paddle_tpu's ResNet-50 bs128 bf16 step time a framework loss
+or the chip's HBM-bandwidth ceiling? Control: a hand-written raw JAX
+ResNet-50 v1.5 train step — no paddle_tpu anywhere — benchmarked with the
+IDENTICAL window method (two scan windows, unroll=2, timing from the
+second), plus XLA cost-analysis / memory-analysis tables for BOTH programs
+committed as docs/artifacts/resnet50_control.json.
+
+≙ the reference publishing its per-config tables in benchmark/README.md:33-38.
+
+Usage:  python tools/resnet50_control.py          (real chip, bs128)
+        BENCH_BATCH=4 BENCH_STEPS=2 python tools/resnet50_control.py
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DT = jnp.bfloat16
+STAGES = (3, 4, 6, 3)
+
+
+# --------------------------- raw JAX ResNet-50 -----------------------------
+
+def conv(x, w, stride, pad):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), [(pad, pad), (pad, pad)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def bn(x, p, eps=1e-5):
+    """Training-mode BN: batch stats normalize, moving stats update."""
+    xf = x.astype(jnp.float32)
+    mean = xf.mean((0, 2, 3))
+    var = xf.var((0, 2, 3))
+    y = (xf - mean[None, :, None, None]) * jax.lax.rsqrt(
+        var[None, :, None, None] + eps)
+    y = y * p["scale"][None, :, None, None] + p["bias"][None, :, None, None]
+    new_stats = {"mean": 0.9 * p["mean"] + 0.1 * mean,
+                 "var": 0.9 * p["var"] + 0.1 * var}
+    return y.astype(DT), new_stats
+
+
+def init_conv(key, cout, cin, k):
+    fan = cin * k * k
+    return (jax.random.normal(key, (cout, cin, k, k), jnp.float32)
+            * np.sqrt(2.0 / fan)).astype(DT)
+
+
+def init_bn(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32),
+            "mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def make_model(key, class_dim=1000):
+    """Returns (params pytree, static per-block strides list)."""
+    keys = iter(jax.random.split(key, 128))
+    p = {"conv1": init_conv(next(keys), 64, 3, 7), "bn1": init_bn(64),
+         "blocks": []}
+    strides = []
+    cin = 64
+    for si, n in enumerate(STAGES):
+        ch = 64 * (2 ** si)
+        for bi in range(n):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            blk = {"c1": init_conv(next(keys), ch, cin, 1), "b1": init_bn(ch),
+                   "c2": init_conv(next(keys), ch, ch, 3), "b2": init_bn(ch),
+                   "c3": init_conv(next(keys), ch * 4, ch, 1),
+                   "b3": init_bn(ch * 4)}
+            if cin != ch * 4:
+                blk["sc"] = init_conv(next(keys), ch * 4, cin, 1)
+                blk["sb"] = init_bn(ch * 4)
+            p["blocks"].append(blk)
+            strides.append(stride)
+            cin = ch * 4
+    p["fc_w"] = (jax.random.normal(next(keys), (cin, class_dim), jnp.float32)
+                 * np.sqrt(1.0 / cin)).astype(DT)
+    p["fc_b"] = jnp.zeros((class_dim,), DT)
+    return p, tuple(strides)
+
+
+def forward(p, x, strides):
+    h, s1 = bn(conv(x, p["conv1"], 2, 3), p["bn1"])
+    h = jax.nn.relu(h)
+    h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max, (1, 1, 3, 3),
+                              (1, 1, 2, 2), [(0, 0), (0, 0), (1, 1), (1, 1)])
+    stats = {"bn1": s1, "blocks": []}
+    for blk, st in zip(p["blocks"], strides):
+        if "sc" in blk:
+            sc, sb_stats = bn(conv(h, blk["sc"], st, 0), blk["sb"])
+        else:
+            sc, sb_stats = h, {}
+        y, s_1 = bn(conv(h, blk["c1"], st, 0), blk["b1"])
+        y = jax.nn.relu(y)
+        y, s_2 = bn(conv(y, blk["c2"], 1, 1), blk["b2"])
+        y = jax.nn.relu(y)
+        y, s_3 = bn(conv(y, blk["c3"], 1, 0), blk["b3"])
+        h = jax.nn.relu(sc + y)
+        stats["blocks"].append({"b1": s_1, "b2": s_2, "b3": s_3,
+                                "sb": sb_stats})
+    h = h.astype(jnp.float32).mean((2, 3)).astype(DT)  # global avg pool
+    return h @ p["fc_w"] + p["fc_b"], stats
+
+
+def loss_fn(p, x, labels, strides):
+    logits, stats = forward(p, x, strides)
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.take_along_axis(lp, labels, axis=1).mean(), stats
+
+
+def train_step(state, batch, strides, lr=0.01, mu=0.9):
+    p, m = state
+    (loss, stats), g = jax.value_and_grad(loss_fn, has_aux=True)(
+        p, batch["x"], batch["y"], strides)
+    new_m = jax.tree.map(lambda mv, gv: mu * mv + gv.astype(jnp.float32),
+                         m, g)
+    new_p = jax.tree.map(lambda pv, mv: (pv.astype(jnp.float32)
+                                         - lr * mv).astype(pv.dtype),
+                         p, new_m)
+    # BN moving stats are carried forward, not SGD-updated (their grads
+    # are zero: training-mode BN normalizes with batch stats)
+    new_p["bn1"].update(stats["bn1"])
+    for blk, s in zip(new_p["blocks"], stats["blocks"]):
+        for k in ("b1", "b2", "b3"):
+            blk[k].update(s[k])
+        if s["sb"]:
+            blk["sb"].update(s["sb"])
+    return (new_p, new_m), loss
+
+
+def loop_fn(state, batch, n_steps, strides):
+    def body(c, _):
+        return train_step(c, batch, strides)
+    return jax.lax.scan(body, state, None, length=n_steps, unroll=2)
+
+
+# ------------------------------ measurement --------------------------------
+
+def analyze(compiled):
+    ca = {}
+    try:
+        ca = compiled.cost_analysis() or {}
+    except Exception:
+        pass
+    ma = compiled.memory_analysis()
+    return {"flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "argument_bytes": int(ma.argument_size_in_bytes)}
+
+
+def bench_raw(batch, steps):
+    p, strides = make_model(jax.random.PRNGKey(0))
+    m = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), p)
+    rng = np.random.RandomState(0)
+    batch_d = {"x": jnp.asarray(rng.rand(batch, 3, 224, 224), DT),
+               "y": jnp.asarray(rng.randint(0, 1000, (batch, 1)))}
+    fn = jax.jit(functools.partial(loop_fn, n_steps=steps, strides=strides),
+                 donate_argnums=(0,))
+    t0 = time.time()
+    state, losses = fn((p, m), batch_d)
+    jax.block_until_ready(losses)
+    first = time.time() - t0
+    t0 = time.time()
+    state, losses = fn(state, batch_d)
+    jax.block_until_ready(losses)
+    window = time.time() - t0
+
+    p2, _ = make_model(jax.random.PRNGKey(0))
+    m2 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), p2)
+    step1 = jax.jit(functools.partial(train_step, strides=strides),
+                    donate_argnums=(0,))
+    compiled = step1.lower((p2, m2), batch_d).compile()
+    return {"ms_per_batch": round(window / steps * 1000.0, 2),
+            "examples_per_sec": round(batch * steps / window, 1),
+            "compile_s": round(max(first - window, 0.0), 1),
+            "loss_first": float(np.asarray(losses, np.float32).ravel()[0]),
+            "loss_last": float(np.asarray(losses, np.float32).ravel()[-1]),
+            **analyze(compiled)}
+
+
+def bench_paddle(batch, steps):
+    import paddle_tpu as pt
+    from paddle_tpu.core import lowering
+    from paddle_tpu.models import resnet
+    import ml_dtypes
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        avg, _, _, _ = resnet.get_model(data_set="imagenet", depth=50,
+                                        dtype="bfloat16", fused_xent=True)
+    rng = np.random.RandomState(0)
+    feed = {"data": rng.rand(batch, 3, 224, 224).astype(ml_dtypes.bfloat16),
+            "label": rng.randint(0, 10, (batch, 1)).astype("int64")}
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        t0 = time.time()
+        exe.run_loop(main, feed=feed, fetch_list=[avg], n_steps=steps,
+                     unroll=2)
+        first = time.time() - t0
+        t0 = time.time()
+        (losses,) = exe.run_loop(main, feed=feed, fetch_list=[avg],
+                                 n_steps=steps, unroll=2)
+        window = time.time() - t0
+        state = exe._state_for(main, scope)
+        fa = exe._prep_feed(main, feed)
+        step, _ = lowering.build_step_fn(main, list(fa), [avg.name],
+                                         sorted(state))
+        compiled = (jax.jit(step, donate_argnums=(0,))
+                    .lower(state, fa, jax.random.PRNGKey(0)).compile())
+    return {"ms_per_batch": round(window / steps * 1000.0, 2),
+            "examples_per_sec": round(batch * steps / window, 1),
+            "compile_s": round(max(first - window, 0.0), 1),
+            "loss_first": float(np.asarray(losses, np.float32).ravel()[0]),
+            "loss_last": float(np.asarray(losses, np.float32).ravel()[-1]),
+            **analyze(compiled)}
+
+
+def main():
+    dev = jax.devices()[0]
+    on_tpu = "tpu" in dev.platform.lower() or "TPU" in dev.device_kind
+    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 4))
+    steps = int(os.environ.get("BENCH_STEPS", 300 if on_tpu else 2))
+    hbm_gbps = 819e9 if on_tpu else 50e9  # v5e spec sheet
+
+    report = {"device": dev.device_kind, "batch": batch, "steps": steps}
+    print("benchmarking raw JAX ...", flush=True)
+    report["raw_jax"] = bench_raw(batch, steps)
+    print(json.dumps(report["raw_jax"]), flush=True)
+    print("benchmarking paddle_tpu ...", flush=True)
+    report["paddle_tpu"] = bench_paddle(batch, steps)
+    print(json.dumps(report["paddle_tpu"]), flush=True)
+
+    r, p = report["raw_jax"], report["paddle_tpu"]
+    report["paddle_vs_raw"] = round(p["ms_per_batch"] / r["ms_per_batch"], 4)
+    for side in ("raw_jax", "paddle_tpu"):
+        s = report[side]
+        if s["bytes_accessed"]:
+            s["bandwidth_floor_ms"] = round(
+                s["bytes_accessed"] / hbm_gbps * 1000.0, 2)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
+                       "docs", "artifacts", "resnet50_control.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps({"paddle_vs_raw": report["paddle_vs_raw"],
+                      "raw_ms": r["ms_per_batch"],
+                      "paddle_ms": p["ms_per_batch"]}))
+
+
+if __name__ == "__main__":
+    main()
