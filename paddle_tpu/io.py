@@ -182,22 +182,32 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
     rank0 = not multi or jax.process_index() == 0
     existing = os.listdir(dirname) if rank0 else []
 
-    def clean(base):
-        # refresh EVERY layout file for the var: a leftover from an
-        # earlier save with a different sharding (or process count) would
-        # otherwise shadow (".npy" wins at load) or blend with
-        # ("shard.*" all consumed) the files written now
+    def clean(base, this_layout):
+        # remove files the coming write will NOT atomically replace: the
+        # other layout entirely (a stale .npy would shadow shards at load;
+        # stale shards would blend into assembly), and — for a sharded
+        # save — old shard pieces whose spans this run's processes may not
+        # overwrite. Same-layout .npy is left for _atomic_save's
+        # os.replace, so a crash mid-save never destroys the previous
+        # good full-array file; a crashed sharded re-save is detectable
+        # (the loader's element-count check fails loudly).
         for stale in existing:
-            if (stale == base + ".npy" or stale == base + ".meta.json"
-                    or stale.startswith(base + ".shard.")):
+            other_layout = (
+                (stale == base + ".npy") if this_layout == "sharded"
+                else (stale == base + ".meta.json"
+                      or stale.startswith(base + ".shard.")))
+            stale_shards = (this_layout == "sharded"
+                            and stale.startswith(base + ".shard."))
+            if other_layout or stale_shards:
                 try:
                     os.remove(os.path.join(dirname, stale))
                 except FileNotFoundError:
                     pass
 
     if rank0:
-        for n in values:
-            clean(n.replace("/", "__"))
+        for n, val in values.items():
+            clean(n.replace("/", "__"),
+                  "sharded" if _is_cross_process(val) else "npy")
     if multi:
         # nobody writes until rank 0 finished deleting — otherwise a
         # faster rank's fresh shard piece could be swept as "stale"

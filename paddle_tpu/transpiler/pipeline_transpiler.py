@@ -244,6 +244,25 @@ def pipeline_transpile(program: Optional[Program] = None,
     ops = block.ops
     occ0 = ops[start:start + w]
 
+    # remat attrs are ignored by region matching (per-layer tags differ by
+    # construction), but the stage body replays occurrence 0's scoping on
+    # EVERY stage — heterogeneous per-layer remat cannot be represented,
+    # so disagreement is surfaced rather than silently normalized
+    for k in range(1, r):
+        hetero = any(
+            ("remat_scope" in (ops[start + j].attrs or {}))
+            != ("remat_scope" in (ops[start + k * w + j].attrs or {}))
+            or (ops[start + j].attrs or {}).get("remat_policy")
+            != (ops[start + k * w + j].attrs or {}).get("remat_policy")
+            for j in range(w))
+        if hetero:
+            import warnings
+            warnings.warn(
+                "pipeline_transpile: layer occurrences disagree on remat "
+                "scoping/policy; occurrence 0's setting is applied to "
+                "every pipeline stage", stacklevel=2)
+            break
+
     # -- build the stage sub-block from occurrence 0 -----------------------
     sub = program.create_block(block.idx)
     x_inner = unique_name("pipe_x")

@@ -186,3 +186,45 @@ def test_sp_precondition_error():
         feed = {n: np.zeros((2, 12, 4, 8), np.float32) for n in "qkv"}
         with pytest.raises(ValueError, match="not divisible by sp"):
             pe.run([out], feed=feed)
+
+
+def test_block_defaults_divide_sequence_dims(rng):
+    """The dispatch's seq-adaptive block defaults must always divide the
+    sequence dims (the kernel has no ragged-block masking): seq lengths
+    that are multiples of 128 but not of 512/1024 fall back to a dividing
+    block, and cross-attention picks bq/bk from their own dims."""
+    import importlib
+    fa = importlib.import_module("paddle_tpu.kernels.flash_attention")
+
+    calls = []
+    orig = fa.flash_attention
+
+    def spy(q, k, v, **kw):
+        calls.append((kw["block_q"], kw["block_k"]))
+        return orig(q, k, v, **dict(kw, interpret=True))
+
+    # force the TPU dispatch path; restore everything afterwards
+    old_ok = fa._tpu_ok
+    fa._tpu_ok = lambda q, k, causal=False: (
+        q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0)
+    fa.flash_attention, orig_fn = spy, fa.flash_attention
+    try:
+        for sq, sk in [(640, 640), (1024, 640), (8192, 8192), (1024, 1024)]:
+            q = jnp.asarray(rng.randn(1, sq, 1, 8).astype(np.float32))
+            k = jnp.asarray(rng.randn(1, sk, 1, 8).astype(np.float32))
+            if sq > 2048:  # keep the 8k case cheap: check choice only
+                cap = 1024
+                pick = lambda s: next((b for b in (1024, 512, 256)
+                                       if b <= cap and s % b == 0), 128)
+                assert pick(sq) == 1024
+                continue
+            out = fa.dot_product_attention(q, k, k)
+            ref = fa.mha_reference(q, k, k)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-3, rtol=2e-3)
+            bq, bk = calls[-1]
+            assert sq % bq == 0 and sk % bk == 0, (sq, sk, bq, bk)
+            assert not np.isnan(np.asarray(out)).any()
+    finally:
+        fa._tpu_ok = old_ok
+        fa.flash_attention = orig_fn

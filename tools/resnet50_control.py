@@ -3,8 +3,8 @@
 Question: is paddle_tpu's ResNet-50 bs128 bf16 step time a framework loss
 or the chip's HBM-bandwidth ceiling? Control: a hand-written raw JAX
 ResNet-50 v1.5 train step — no paddle_tpu anywhere — benchmarked with the
-IDENTICAL window method (two scan windows, unroll=2, timing from the
-second), plus XLA cost-analysis / memory-analysis tables for BOTH programs
+IDENTICAL window method (scan windows, unroll=2, fresh-init losses from
+window 1, timing = min of 3 steady windows), plus XLA cost-analysis / memory-analysis tables for BOTH programs
 committed as docs/artifacts/resnet50_control.json.
 
 ≙ the reference publishing its per-config tables in benchmark/README.md:33-38.
@@ -169,13 +169,17 @@ def bench_raw(batch, steps):
     fn = jax.jit(functools.partial(loop_fn, n_steps=steps, strides=strides),
                  donate_argnums=(0,))
     t0 = time.time()
-    state, losses = fn((p, m), batch_d)
+    state, losses = fn((p, m), batch_d)   # fresh-init window: losses kept
     jax.block_until_ready(losses)
     first = time.time() - t0
-    t0 = time.time()
-    state, losses = fn(state, batch_d)
-    jax.block_until_ready(losses)
-    window = time.time() - t0
+    losses = np.asarray(losses, np.float32)
+    windows = []
+    for _ in range(3):                    # min-of-3: shared-fabric bursts
+        t0 = time.time()
+        state, _l2 = fn(state, batch_d)   # steady-state window: timing
+        jax.block_until_ready(_l2)
+        windows.append(time.time() - t0)
+    window = min(windows)
 
     p2, _ = make_model(jax.random.PRNGKey(0))
     m2 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), p2)
@@ -207,13 +211,17 @@ def bench_paddle(batch, steps):
         exe = pt.Executor()
         exe.run(startup)
         t0 = time.time()
-        exe.run_loop(main, feed=feed, fetch_list=[avg], n_steps=steps,
-                     unroll=2)
-        first = time.time() - t0
-        t0 = time.time()
         (losses,) = exe.run_loop(main, feed=feed, fetch_list=[avg],
-                                 n_steps=steps, unroll=2)
-        window = time.time() - t0
+                                 n_steps=steps, unroll=2)  # fresh-init
+        first = time.time() - t0
+        losses = np.asarray(losses, np.float32)
+        windows = []
+        for _ in range(3):                # min-of-3: shared-fabric bursts
+            t0 = time.time()
+            exe.run_loop(main, feed=feed, fetch_list=[avg], n_steps=steps,
+                         unroll=2)                         # steady timing
+            windows.append(time.time() - t0)
+        window = min(windows)
         state = exe._state_for(main, scope)
         fa = exe._prep_feed(main, feed)
         step, _ = lowering.build_step_fn(main, list(fa), [avg.name],
