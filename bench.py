@@ -18,13 +18,14 @@ Measurement notes (evidence gathered on the v5e-via-tunnel rig, round 2):
   * device→host bandwidth is ~15 MB/s: fetch scalars only.
   * ResNet-50 bs128 bf16 is HBM-bandwidth-bound on one chip — anchored in
     round 3 by a raw-JAX control (tools/resnet50_control.py, artifact in
-    docs/artifacts/resnet50_control.json): paddle_tpu 49.69 ms/batch vs
-    hand-written raw JAX 49.25 ms (+0.9%), both ~16% MFU; XLA cost
+    docs/artifacts/resnet50_control.json): paddle_tpu 50.6 ms/batch vs
+    hand-written raw JAX 49.1 ms (~3%), both ~16% MFU; XLA cost
     analysis 44.2 GB accessed/step ÷ 819 GB/s ≈ 54 ms bound. The ~17%
     ceiling is the model's arithmetic intensity, not framework overhead —
     NCHW vs NHWC measured a wash (XLA canonicalizes conv layouts). The
-    compute-bound MFU story is the transformer config below (50.8%
-    measured on the same chip at d_model 2048 — past the 45% bar).
+    compute-bound MFU story is the transformer + long-context configs
+    below (57.3% at bs8 / 56.0% MFU measured on the same chip with the
+    Pallas flash forward+backward — past the 45% bar).
 """
 
 from __future__ import annotations
@@ -127,7 +128,13 @@ def bench_resnet(on_tpu):
 
 def bench_se_resnext(on_tpu, peak):
     """SE-ResNeXt-50 — the second model in the BASELINE headline metric
-    ("images/sec/chip + MFU on ResNet-50/SE-ResNeXt")."""
+    ("images/sec/chip + MFU on ResNet-50/SE-ResNeXt").
+
+    Its MFU reads far lower than ResNet-50's: cardinality-32 grouped
+    convolutions put 32x fewer channels per MXU pass at the same HBM
+    traffic, so the model is even deeper into the bandwidth-bound regime
+    (same ceiling class as resnet's — see resnet50_control.json — not a
+    framework loss)."""
     import paddle_tpu as pt
     from paddle_tpu.models import se_resnext
     batch = int(os.environ.get("BENCH_BATCH", 64 if on_tpu else 2))
@@ -167,7 +174,7 @@ def bench_mnist(on_tpu):
     import paddle_tpu as pt
     from paddle_tpu.models import mnist
     batch = 128
-    steps = 200 if on_tpu else 2
+    steps = int(os.environ.get("BENCH_STEPS", 200 if on_tpu else 2))
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):
         avg_cost, _, _, _ = mnist.get_model(batch_size=batch)
@@ -186,7 +193,7 @@ def bench_vgg(on_tpu):
     import paddle_tpu as pt
     from paddle_tpu.models import vgg
     batch = 128 if on_tpu else 4
-    steps = 100 if on_tpu else 2
+    steps = int(os.environ.get("BENCH_STEPS", 100 if on_tpu else 2))
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):
         avg_cost, _, _, _ = vgg.get_model(data_set="cifar10")
@@ -210,7 +217,7 @@ def bench_lstm(on_tpu):
     import paddle_tpu as pt
     from paddle_tpu.models import stacked_dynamic_lstm as sdl
     batch, seqlen = (64, 100) if on_tpu else (4, 8)
-    steps = 100 if on_tpu else 2
+    steps = int(os.environ.get("BENCH_STEPS", 100 if on_tpu else 2))
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):
         loss, _, _, _ = sdl.get_model(dict_size=30000, lstm_size=512,
@@ -232,7 +239,7 @@ def bench_machine_translation(on_tpu):
     import paddle_tpu as pt
     from paddle_tpu.models import machine_translation as mt
     batch, seqlen = (64, 30) if on_tpu else (4, 6)
-    steps = 50 if on_tpu else 2
+    steps = int(os.environ.get("BENCH_STEPS", 50 if on_tpu else 2))
     dims = dict(source_dict_dim=30000, target_dict_dim=30000) if on_tpu else \
         dict(source_dict_dim=200, target_dict_dim=200, embedding_dim=32,
              encoder_size=32, decoder_size=32)
@@ -311,8 +318,9 @@ def bench_transformer(on_tpu, peak):
     if on_tpu:
         # measured on v5e: d_model 1024 plateaus at ~41-42% MFU (6 or 12
         # layers); widening to 2048/8192 lifts arithmetic intensity past
-        # the 45% north star — 50.8% MFU, 42.4k tok/s
-        cfg = dict(batch=int(os.environ.get("BENCH_TFM_BATCH", 4)),
+        # the 45% north star. Batch sweep (round 3, Pallas fwd+bwd): bs4
+        # 54.8%, bs8 57.3% (sweet spot), bs16 52.0% — bs8 default
+        cfg = dict(batch=int(os.environ.get("BENCH_TFM_BATCH", 8)),
                    seqlen=1024,
                    d_model=int(os.environ.get("BENCH_TFM_DMODEL", 2048)),
                    n_layers=int(os.environ.get("BENCH_TFM_LAYERS", 6)),
