@@ -361,11 +361,20 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, do):
     """
     q, k, v, o, lse = res
     if _HAS_PLTPU and (interpret or jax.default_backend() == "tpu"):
+        import os
         b, h = q.shape[0], q.shape[2]
+        # the backward kernels hold more VMEM per tile (s, p, dp, ds) than
+        # the forward, so their blocks are tunable independently; defaults
+        # follow the forward's (measured best at 8k)
+        bwd_bq = int(os.environ.get("FLASH_BWD_BLOCK_Q", 0)) or block_q
+        bwd_bk = int(os.environ.get("FLASH_BWD_BLOCK_K", 0)) or block_k
+        if q.shape[1] % min(bwd_bq, q.shape[1]) or \
+                k.shape[1] % min(bwd_bk, k.shape[1]):
+            bwd_bq, bwd_bk = block_q, block_k  # env must divide; else fwd's
         dq3, dk3, dv3 = _flash_bwd_pallas(
             _bshd_to_3d(q), _bshd_to_3d(k), _bshd_to_3d(v), _bshd_to_3d(o),
             lse, _bshd_to_3d(do), scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, interpret=interpret)
+            block_q=bwd_bq, block_k=bwd_bk, interpret=interpret)
         return (_3d_to_bshd(dq3, b, h), _3d_to_bshd(dk3, b, h),
                 _3d_to_bshd(dv3, b, h))
     b, sq, h, d = q.shape

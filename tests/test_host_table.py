@@ -200,3 +200,32 @@ class TestHostTableTraining:
             assert set(feed) == {"ids", "label", table.rows_name}
             assert tuple(feed[table.rows_name].shape) == (CAP, DIM)
             assert int(np.max(np.asarray(feed["ids"]))) < CAP
+
+
+class TestHostTableAdagrad:
+    def test_adagrad_matches_dense_adagrad(self):
+        """Host-side adagrad mirrors the device sparse adagrad kernel:
+        per-element accumulator, update only on touched rows — compare
+        against a dense numpy adagrad over the same id stream."""
+        dim, vocab, lr, eps = 4, 20, 0.5, 1e-6
+        init = np.random.RandomState(0).rand(vocab, dim).astype(np.float32)
+        t = HostEmbeddingTable("t", vocab, dim, capacity=8,
+                               optimizer="adagrad", learning_rate=lr,
+                               epsilon=eps, initial_value=init.copy())
+        ref_table = init.copy().astype(np.float64)
+        ref_moment = np.zeros((vocab, dim), np.float64)
+        rng = np.random.RandomState(1)
+        for _ in range(5):
+            ids = rng.randint(0, vocab, (6,))
+            _, hb = t.prepare(ids)
+            g = np.zeros((8, dim), np.float32)
+            g[:hb.n_valid] = rng.randn(hb.n_valid, dim)
+            t.apply_grad(g, hb)
+            # dense reference over the same unique rows
+            for row, grow in zip(hb.uniq, g[:hb.n_valid].astype(np.float64)):
+                ref_moment[row] += grow * grow
+                ref_table[row] -= lr * grow / (np.sqrt(ref_moment[row]) + eps)
+        np.testing.assert_allclose(t.table, ref_table.astype(np.float32),
+                                   atol=1e-5)
+        np.testing.assert_allclose(t.moment, ref_moment.astype(np.float32),
+                                   atol=1e-5)
