@@ -174,8 +174,14 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
                 f"addressable values, but {cross[:3]} are sharded across "
                 "processes — use the per-var layout (filename=None), which "
                 "persists each process's own shards")
-        np.savez(os.path.join(dirname, filename),
-                 **{n: np.asarray(v) for n, v in values.items()})
+        # every value is fully addressable (checked above), so rank 0's
+        # copy suffices — and in a multi-process run all ranks share the
+        # filesystem: concurrent np.savez of the SAME file would corrupt
+        # the archive. Mirrors the per-var path's rank-0 gating.
+        import jax
+        if jax.process_count() == 1 or jax.process_index() == 0:
+            np.savez(os.path.join(dirname, filename),
+                     **{n: np.asarray(v) for n, v in values.items()})
         return
     import jax
     multi = jax.process_count() > 1

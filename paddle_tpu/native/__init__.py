@@ -98,3 +98,7 @@ def _configure_dequantize(lib):
                                   ctypes.c_float]
     lib.dequantize_u8_bf16.restype = None
     lib.dequantize_u8_bf16.argtypes = lib.dequantize_u8.argtypes
+    lib.decode_rows_u8_bf16.restype = None
+    lib.decode_rows_u8_bf16.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_float, ctypes.c_float]

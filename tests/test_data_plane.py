@@ -318,6 +318,36 @@ class TestNativeDequantize:
         assert ret is out
         np.testing.assert_allclose(out[255], 0.5, atol=1e-6)
 
+    def test_batched_record_decode_matches_per_row(self):
+        """decode_image_records (one native call per batch) ==
+        per-row dequantize + trailing int64 label, bit-exact."""
+        import ml_dtypes
+        from paddle_tpu.dataset.image import (decode_image_records,
+                                              dequantize)
+        rng = np.random.RandomState(2)
+        elems = 3 * 7 * 7
+        rows = [rng.randint(0, 256, elems).astype(np.uint8).tobytes()
+                + np.int64(3 * i - 1).tobytes() for i in range(9)]
+        out, labels = decode_image_records(rows, elems)
+        want = np.empty((9, elems), ml_dtypes.bfloat16)
+        for i, r in enumerate(rows):
+            dequantize(np.frombuffer(r, np.uint8, count=elems), out=want[i])
+        assert np.array_equal(out, want)
+        assert list(labels) == [3 * i - 1 for i in range(9)]
+
+    def test_batched_record_decode_reuses_buffers(self):
+        import ml_dtypes
+        from paddle_tpu.dataset.image import decode_image_records
+        rng = np.random.RandomState(3)
+        elems = 12
+        rows = [rng.randint(0, 256, elems).astype(np.uint8).tobytes()
+                + np.int64(i).tobytes() for i in range(4)]
+        out = np.empty((4, elems), ml_dtypes.bfloat16)
+        labels = np.empty((4,), np.int64)
+        o2, l2 = decode_image_records(rows, elems, out=out, labels=labels)
+        assert o2 is out and l2 is labels
+        assert list(labels) == [0, 1, 2, 3]
+
 
 class TestSampleRecordIO:
     """convert_reader_to_recordio_file / sample_reader_creator round trip
