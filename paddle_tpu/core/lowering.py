@@ -33,6 +33,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .program import Block, OpDesc, Program
 from .registry import ExecContext, require_op
@@ -40,13 +41,46 @@ from .registry import ExecContext, require_op
 AUTODIFF_OP = "autodiff"
 
 
-def _apply_stop_gradient(block: Block, name: str, val):
+def _apply_var_marks(block: Block, name: str, val, ctx):
+    """Post-op output adjustments driven by VarDesc marks: stop_gradient,
+    and — under a mesh — activation sharding constraints.
+
+    A sharding annotation on a NON-persistable intermediate is a layout
+    constraint on the activation (the transpiler's sp pass uses this to
+    pin the residual stream seq-sharded). Feeds/params get their layout
+    from jit in_shardings, but GSPMD will not reliably propagate a feed
+    sharding through embedding/reshape chains on its own — measured on
+    the virtual mesh: without constraints the sp transformer all-gathers
+    every [B, S, D] activation (tests/test_collectives_emitted.py)."""
     try:
         var = block.var(name)
     except KeyError:
         return val
     if var.stop_gradient and jnp.issubdtype(jnp.asarray(val).dtype, jnp.floating):
-        return jax.lax.stop_gradient(val)
+        val = jax.lax.stop_gradient(val)
+    mesh = getattr(ctx, "mesh", None)
+    if var.sharding and not var.persistable and mesh is not None:
+        from ..parallel.mesh import spec_for
+        from jax.sharding import NamedSharding
+        spec = spec_for(var.sharding, mesh)
+        if tuple(spec):
+            shape = jnp.shape(val)
+            sizes_ok = True
+            for i, axes in enumerate(tuple(spec)):
+                if i >= len(shape):
+                    # recorded VarDesc rank exceeds the runtime rank: the
+                    # spec cannot apply at all — drop the constraint
+                    sizes_ok = False
+                    break
+                if axes is None:
+                    continue
+                ax = axes if isinstance(axes, tuple) else (axes,)
+                size = int(np.prod([mesh.shape[a] for a in ax]))
+                if size == 0 or shape[i] % size:
+                    sizes_ok = False
+            if sizes_ok:
+                val = jax.lax.with_sharding_constraint(
+                    val, NamedSharding(mesh, spec))
     return val
 
 
@@ -76,7 +110,7 @@ def run_op(op: OpDesc, env: Dict[str, object], ctx: ExecContext, block: Block):
                 f"op {op.type}: slot {slot} produced {len(vals)} values for "
                 f"{len(names)} names {names}")
         for n, v in zip(names, vals):
-            env[n] = _apply_stop_gradient(block, n, v)
+            env[n] = _apply_var_marks(block, n, v, ctx)
 
 
 def _run_remat_segment(ops, start: int, stop: int, range_stop: int, env,

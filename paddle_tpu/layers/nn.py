@@ -258,14 +258,19 @@ def batch_norm(input, act=None, is_test: bool = False, momentum: float = 0.9,
     saved_mean = helper.create_tmp_variable("float32", stop_gradient=True)
     saved_var = helper.create_tmp_variable("float32", stop_gradient=True)
     out = helper.create_tmp_variable(dtype)
+    # a relu activation folds into the op itself (≙ the reference op's
+    # fuse_with_relu attr): the op's custom VJP then recomputes the mask in
+    # backward instead of keeping a separate relu residual chain
+    fuse_relu = act == "relu"
     helper.append_op("batch_norm",
                      {"X": input, "Scale": scale, "Bias": bias,
                       "Mean": mean, "Variance": variance},
                      {"Y": out, "MeanOut": mean, "VarianceOut": variance,
                       "SavedMean": saved_mean, "SavedVariance": saved_var},
                      {"momentum": momentum, "epsilon": epsilon,
-                      "is_test": is_test, "data_layout": data_layout})
-    return helper.append_activation(out)
+                      "is_test": is_test, "data_layout": data_layout,
+                      "fuse_with_relu": fuse_relu})
+    return out if fuse_relu else helper.append_activation(out)
 
 
 def layer_norm(input, scale: bool = True, shift: bool = True,

@@ -169,12 +169,24 @@ def _mul_infer(op, block):
 @register_op("mul", infer_shape=_mul_infer)
 def mul(ctx, ins, attrs):
     """mul_op.cc: flatten X to 2-D at x_num_col_dims, Y at y_num_col_dims,
-    GEMM, then restore leading dims. This is the core of layers.fc."""
+    GEMM, then restore leading dims. This is the core of layers.fc.
+
+    When Y is consumed whole (yn == 1, the fc/matmul-weight case) the
+    flatten-GEMM-restore collapses to one dot_general contracting X's
+    trailing dims — bit-identical results, but WITHOUT the B*S reshape:
+    a reshape that merges a (dp, sp)-sharded batch/seq pair forces GSPMD
+    to all-gather the full sequence on every matmul (measured on the
+    virtual mesh: one [B, S, D] gather per mul before this, none after —
+    tests/test_collectives_emitted.py)."""
     x, y = ins["X"][0], ins["Y"][0]
     y = harmonize(x, y)
     xn = attrs.get("x_num_col_dims", 1)
     yn = attrs.get("y_num_col_dims", 1)
     xshape, yshape = x.shape, y.shape
+    if yn == 1 and len(xshape) - xn == 1 and xshape[-1] == yshape[0]:
+        out = jax.lax.dot_general(
+            x, y, (((len(xshape) - 1,), (0,)), ((), ())))
+        return {"Out": [out]}
     # explicit sizes, no -1: jax.export's shape checks reject inferred dims
     x2 = jnp.reshape(x, (int(np.prod(xshape[:xn]) or 1),
                          int(np.prod(xshape[xn:]) or 1)))

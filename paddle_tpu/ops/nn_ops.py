@@ -10,6 +10,8 @@ needed; it is accepted and ignored for API parity.
 
 from __future__ import annotations
 
+import functools
+import os
 from typing import Sequence
 
 import jax
@@ -292,36 +294,129 @@ def _bn_infer(op, block):
             v.shape, v.dtype = (c,), "float32"
 
 
+def _bn_apply(x, mean, inv, scale, bias):
+    """The normalize-scale-shift pass, kept byte-identical between forward
+    and the backward's recompute (the ReLU mask must see the same y)."""
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean.reshape(bshape).astype(x.dtype)) * \
+        (inv * scale).reshape(bshape).astype(x.dtype) + \
+        bias.reshape(bshape).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _bn_train(x, scale, bias, mean_in, var_in, eps, momentum, relu):
+    """Training-mode batch norm with a memory-lean hand-written VJP.
+
+    JAX's default AD of the naive formulation keeps the FLOAT32 cast of
+    the whole activation (and the normalized x-hat) alive from forward to
+    backward — for ResNet-50 bs128 that is gigabytes of extra HBM traffic
+    per step (the round-3 control measured 44 GB moved vs a ~15 GB
+    analytic floor). This VJP saves only the bf16 conv output plus two
+    per-channel vectors and recomputes x-hat (elementwise, fuses into the
+    backward reduces). `relu` additionally folds the activation into the
+    same op (≙ the reference batch_norm op's fuse_with_relu attr,
+    batch_norm_op.cc); the mask is recomputed from the residuals, never
+    stored."""
+    out, _ = _bn_train_fwd(x, scale, bias, mean_in, var_in, eps, momentum,
+                           relu)
+    return out
+
+
+def _bn_train_stats(x, eps):
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+    inv = jax.lax.rsqrt(var + eps)
+    return mean, var, inv
+
+
+def _bn_train_fwd(x, scale, bias, mean_in, var_in, eps, momentum, relu):
+    mean, var, inv = _bn_train_stats(x, eps)
+    new_mean = momentum * mean_in + (1 - momentum) * mean
+    new_var = momentum * var_in + (1 - momentum) * var
+    y = _bn_apply(x, mean, inv, scale, bias)
+    if relu:
+        y = jnp.maximum(y, 0)
+    out = (y, new_mean, new_var, mean, var)
+    return out, (x, scale, bias, mean, inv)
+
+
+def _bn_train_bwd(eps, momentum, relu, res, cts):
+    x, scale, bias, mean, inv = res
+    gy, g_new_mean, g_new_var, g_saved_mean, g_saved_var = cts
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    m = 1
+    for i in axes:
+        m *= x.shape[i]
+    if relu:
+        y = _bn_apply(x, mean, inv, scale, bias)
+        gy = jnp.where(y > 0, gy, jnp.zeros_like(gy))
+    gyf = gy.astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    xhat = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
+    dbeta = jnp.sum(gyf, axis=axes)
+    dgamma = jnp.sum(gyf * xhat, axis=axes)
+    sf = scale.astype(jnp.float32)
+    dx = (sf * inv).reshape(bshape) * (
+        gyf - (dbeta / m).reshape(bshape) - xhat * (dgamma / m).reshape(bshape))
+    # direct cotangents on the emitted batch statistics (zero in normal
+    # training — MeanOut/SavedMean feed state, not the loss — but custom_vjp
+    # must be exact for any caller): d mean/dx = 1/m, d var/dx = 2(x-mu)/m
+    g_mean_tot = (1 - momentum) * g_new_mean + g_saved_mean
+    g_var_tot = (1 - momentum) * g_new_var + g_saved_var
+    dx = dx + (g_mean_tot / m).reshape(bshape) \
+        + (xf - mean.reshape(bshape)) * (2.0 * g_var_tot / m).reshape(bshape)
+    return (dx.astype(x.dtype), dgamma.astype(scale.dtype),
+            dbeta.astype(bias.dtype), momentum * g_new_mean,
+            momentum * g_new_var)
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 @register_op("batch_norm", infer_shape=_bn_infer)
 def batch_norm(ctx, ins, attrs):
     """batch_norm_op.cc/.cu. NCHW; running stats are persistable state vars
     threaded functionally (MeanOut/VarianceOut rebind the same names, exactly
-    like the reference's in-place variable reuse)."""
+    like the reference's in-place variable reuse). Training mode routes
+    through the memory-lean custom-VJP kernel (see _bn_train; disable with
+    PT_BN_PLAIN_VJP=1 for A/B measurement); fuse_with_relu folds the
+    activation in (≙ the reference attr of the same name)."""
     x = ins["X"][0]
     scale, bias = ins["Scale"][0], ins["Bias"][0]
     mean_in, var_in = ins["Mean"][0], ins["Variance"][0]
     eps = attrs.get("epsilon", 1e-5)
     momentum = attrs.get("momentum", 0.9)
     is_test = attrs.get("is_test", False)
+    relu = bool(attrs.get("fuse_with_relu", False))
     axes = tuple(i for i in range(x.ndim) if i != 1)
     bshape = (1, -1) + (1,) * (x.ndim - 2)
 
     if is_test or attrs.get("use_global_stats", False):
-        mean, var = mean_in, var_in
-        new_mean, new_var = mean_in, var_in
-        saved_mean, saved_var = mean_in, var_in
-    else:
+        inv = jax.lax.rsqrt(var_in + eps)
+        y = _bn_apply(x, mean_in, inv, scale, bias)
+        if relu:
+            y = jnp.maximum(y, 0)
+        return {"Y": [y], "MeanOut": [mean_in], "VarianceOut": [var_in],
+                "SavedMean": [mean_in], "SavedVariance": [var_in]}
+    if os.environ.get("PT_BN_PLAIN_VJP"):
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=axes)
         var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
         new_mean = momentum * mean_in + (1 - momentum) * mean
         new_var = momentum * var_in + (1 - momentum) * var
-        saved_mean, saved_var = mean, var
-    inv = jax.lax.rsqrt(var + eps)
-    y = (x - mean.reshape(bshape).astype(x.dtype)) * \
-        (inv * scale).reshape(bshape).astype(x.dtype) + bias.reshape(bshape).astype(x.dtype)
+        inv = jax.lax.rsqrt(var + eps)
+        y = _bn_apply(x, mean, inv, scale, bias)
+        if relu:
+            y = jnp.maximum(y, 0)
+        return {"Y": [y], "MeanOut": [new_mean], "VarianceOut": [new_var],
+                "SavedMean": [mean], "SavedVariance": [var]}
+    y, new_mean, new_var, mean, var = _bn_train(
+        x, scale, bias, mean_in, var_in, eps, momentum, relu)
     return {"Y": [y], "MeanOut": [new_mean], "VarianceOut": [new_var],
-            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+            "SavedMean": [mean], "SavedVariance": [var]}
 
 
 def _ln_infer(op, block):

@@ -190,9 +190,51 @@ def transpile(program: Optional[Program] = None, mesh=None,
 
     # -- sequence parallelism: actual op rewrite ---------------------------
     if strategy.sp_mode and sp_size > 1:
+        seq_lens = set()
         for op in block.ops:
             if op.type == "scaled_dot_product_attention":
                 op.attrs["sp_mode"] = strategy.sp_mode
+                q = var(op.inputs["Q"][0])
+                if q is not None and len(q.shape) >= 2:
+                    seq_lens.add(int(q.shape[1]))
+        # thread the sequence sharding through the WHOLE program, not just
+        # the attention op: annotate every data var whose dim 1 matches an
+        # attention sequence length with (dp, sp) so GSPMD propagates
+        # seq-sharded activations end to end. Without this the layers
+        # around attention stay seq-replicated and GSPMD all-gathers the
+        # full sequence at the shard_map boundary — measured on the
+        # 8-device virtual mesh: four full-seq all-gathers per layer,
+        # exactly the O(S) HBM profile sp exists to avoid
+        # (tests/test_collectives_emitted.py pins their absence).
+        for v in block.vars.values():
+            if (getattr(v, "is_data", False) and v.sharding is None
+                    and len(v.shape) >= 2 and int(v.shape[1]) in seq_lens
+                    and v.shape[1] % sp_size == 0):
+                v.sharding = ("dp", "sp") + (None,) * (len(v.shape) - 2)
+        # ... and pin the intermediate activations too: GSPMD does not
+        # reliably carry the feed sharding through embedding/reshape
+        # chains, so every [B, S, ...] float temporary in the main block
+        # gets the same (dp, sp) constraint (applied at lowering time by
+        # _apply_var_marks). Without these the surrounding layers run
+        # seq-REPLICATED and all-gather at the attention boundary.
+        # dim-1-size match is a heuristic: a rank-3+ float temporary whose
+        # dim 1 equals an attention sequence length is taken to be
+        # [B, S, ...]. A model with d_model == seq_len could alias a
+        # transposed [B, D, S] activation here (mis-pinning its hidden
+        # dim); rank-2 temporaries are excluded outright because [B, D]
+        # fc outputs collide far more often than [B, S] per-token values
+        # appear. Recorded with the other scope limits in the module
+        # docstring / PARITY.md.
+        for op in block.ops:
+            for out_name in op.output_names():
+                v = var(out_name)
+                if (v is None or v.sharding is not None or v.persistable
+                        or v.is_parameter or len(v.shape) < 3):
+                    continue
+                if (int(v.shape[1]) in seq_lens
+                        and v.shape[1] % sp_size == 0
+                        and str(v.dtype).startswith(("float", "bfloat"))):
+                    v.sharding = ("dp", "sp") + (None,) * (len(v.shape) - 2)
 
     # -- optimizer accumulators follow their param -------------------------
     for p_name, acc_name in iter_optimizer_state_inputs(block):

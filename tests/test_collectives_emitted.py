@@ -1,0 +1,209 @@
+"""Assert WHAT GSPMD actually emits for every parallelism axis.
+
+On a rig with no multi-chip hardware, compiled-HLO inspection is the
+load-bearing proof that each axis lowers to the intended collectives —
+not an all-gather fallback that would silently reintroduce the memory
+and bandwidth profile the axis exists to avoid. ≙ SURVEY §2.3's
+"TPU-native equivalent" being *checked*, not assumed (the reference's
+equivalent guarantee is its hand-built NCCL op graph:
+details/all_reduce_op_handle.cc, reduce_op_handle.cc — there the
+collective mix is explicit in the graph; here GSPMD derives it, so a
+test must pin it).
+
+Counts come from `ParallelExecutor.compiled_hlo` (post-GSPMD optimized
+HLO of the full train step) on the 8-device virtual CPU mesh.
+
+History these assertions pin (measured on this mesh, round 4):
+  * the einsum MoE formulation emitted 0 all-to-alls and 8 expert-weight
+    all-gathers per step; the shard_map dispatch/combine emits the a2a
+    pair and none of the gathers;
+  * before activation-sharding threading, the sp transformer all-gathered
+    every [B, S, D] activation at the attention boundary (4+ full-seq
+    gathers/layer); the mul-op reshape forced one more per matmul.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.parallel import ParallelExecutor, make_mesh
+from paddle_tpu.parallel.parallel_executor import (BuildStrategy,
+                                                   ReduceStrategy)
+
+SEQ = 32
+
+
+def collective_hist(hlo: str) -> dict:
+    """instruction-name -> definition count for collective ops. The return
+    type may be a tuple `= (f32[..], f32[..]) all-to-all(...)`, so the
+    regex accepts both forms."""
+    ops = collections.Counter(
+        re.findall(r"= (?:\([^)]*\)|\S+) ([a-z0-9-]+)\(", hlo))
+    return {k: v for k, v in ops.items()
+            if k in ("all-reduce", "all-gather", "all-to-all",
+                     "reduce-scatter", "collective-permute")}
+
+
+def gather_shapes(hlo: str):
+    """Shapes (as dim tuples) of every all-gather result (tuple results
+    contribute each of their elements)."""
+    out = []
+    for ret in re.findall(r"= ((?:\([^)]*\)|\S+)) all-gather\(", hlo):
+        for dims in re.findall(r"\[([0-9,]+)\]", ret):
+            out.append(tuple(int(d) for d in dims.split(",")))
+    return out
+
+
+def _mlp_program(opt_f):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [16])
+        y = layers.data("y", [1])
+        h = layers.fc(x, size=64, act="relu")
+        p = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(input=p, label=y))
+        opt_f().minimize(loss)
+    return main, startup, loss
+
+
+def _mlp_feed():
+    rng = np.random.RandomState(0)
+    return {"x": rng.rand(16, 16).astype(np.float32),
+            "y": rng.rand(16, 1).astype(np.float32)}
+
+
+def _compile(main, startup, loss, mesh, feed, build_strategy=None):
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        pe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                              mesh=mesh, scope=scope,
+                              build_strategy=build_strategy)
+        return pe.compiled_hlo([loss], feed)
+
+
+def _sp_transformer_hlo(mode):
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        from paddle_tpu.models.transformer import transformer_lm_loss
+        avg, _ = transformer_lm_loss(vocab_size=64, seq_len=SEQ, n_layers=1,
+                                     d_model=32, n_heads=4, d_ff=64)
+        pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(avg)
+    pt.transpiler.transpile(main, mesh=mesh,
+                            strategy=pt.TranspileStrategy(sp_mode=mode))
+    ids = np.random.RandomState(1).randint(0, 64, (4, SEQ)).astype(np.int64)
+    feed = {"src_ids": ids, "tgt_ids": np.roll(ids, -1, 1).reshape(4, SEQ, 1)}
+    return _compile(main, startup, avg, mesh, feed)
+
+
+def _assert_no_full_seq_gather(hlo):
+    """No rank-3+ all-gather may produce a full-sequence activation: that
+    is the fallback that voids sequence parallelism (rank-2 gathers are
+    tables/weights — [vocab, D] etc. — and are fine)."""
+    bad = [s for s in gather_shapes(hlo) if len(s) >= 3 and SEQ in s]
+    assert not bad, f"full-sequence activation all-gathers emitted: {bad}"
+
+
+class TestDataParallel:
+    def test_grad_allreduce_only(self):
+        main, startup, loss = _mlp_program(
+            lambda: pt.optimizer.MomentumOptimizer(learning_rate=0.1,
+                                                   momentum=0.9))
+        hlo = _compile(main, startup, loss, make_mesh({"dp": 8}),
+                       _mlp_feed())
+        h = collective_hist(hlo)
+        # one fused grad all-reduce (≙ AllReduceOpHandle), nothing else
+        assert h.get("all-reduce", 0) >= 1, h
+        assert h.get("all-reduce", 0) <= 3, f"grad bucketing regressed: {h}"
+        assert h.get("all-to-all", 0) == 0, h
+        assert h.get("collective-permute", 0) == 0, h
+        assert h.get("all-gather", 0) == 0, h
+
+
+class TestZero1:
+    def test_param_gathers_only_state_stays_sharded(self):
+        main, startup, loss = _mlp_program(
+            lambda: pt.optimizer.AdamOptimizer(learning_rate=0.01))
+        bs = BuildStrategy()
+        bs.reduce_strategy = ReduceStrategy.Reduce
+        hlo = _compile(main, startup, loss, make_mesh({"dp": 8}),
+                       _mlp_feed(), build_strategy=bs)
+        h = collective_hist(hlo)
+        # grads must be reduced (GSPMD may express the reduce-scatter as
+        # all-reduce + per-shard slice; both are the kReduce dataflow)
+        assert h.get("all-reduce", 0) + h.get("reduce-scatter", 0) >= 1, h
+        # updated params come back via all-gather ...
+        gathers = gather_shapes(hlo)
+        assert gathers, "ZeRO-1 emitted no param all-gather"
+        # ... and ONLY params: every gathered shape must be one of the
+        # param shapes. Adam moments are param-shaped too, but the dp-
+        # sharded ones (what this mode shards) stay sharded end-to-end:
+        # 3 shardable params -> at most 3 + a f32/bf16 pair margin
+        param_shapes = {(16, 64), (64,), (64, 1), (1,)}
+        for s in gathers:
+            assert s in param_shapes, \
+                f"all-gather of non-param shape {s} (optimizer state?)"
+        assert len(gathers) <= 4, \
+            f"{len(gathers)} gathers for 4 params — state gathered too?"
+
+
+class TestRingAttention:
+    def test_ppermute_chain_no_seq_gather(self):
+        hlo = _sp_transformer_hlo("ring")
+        h = collective_hist(hlo)
+        # k and v rotate via ppermute inside the fwd fori_loop (sp steps
+        # per ring pass), and the backward runs its own ring(s): >= 4
+        # static collective-permutes across >= 2 while loops
+        assert h.get("collective-permute", 0) >= 4, h
+        assert len(re.findall(r"= (?:\([^)]*\)|\S+) while\(", hlo)) >= 2
+        # ring must NOT fall back to gathering the sequence or to a2a
+        assert h.get("all-to-all", 0) == 0, h
+        _assert_no_full_seq_gather(hlo)
+
+
+class TestUlysses:
+    def test_all_to_all_resharding(self):
+        hlo = _sp_transformer_hlo("ulysses")
+        h = collective_hist(hlo)
+        # fwd reshards q, k, v (seq->head) and the output back: 4 a2a;
+        # the backward mirrors them: >= 8 total
+        assert h.get("all-to-all", 0) >= 8, h
+        _assert_no_full_seq_gather(hlo)
+
+
+class TestMoE:
+    def test_dispatch_combine_all_to_all_pair(self):
+        mesh = make_mesh({"ep": 4, "dp": 2})
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [16])
+            yv = layers.data("y", [1])
+            out, aux = layers.moe_ffn(x, num_experts=4, hidden_size=32,
+                                      top_k=1, capacity_factor=4.0)
+            pred = layers.fc(input=out, size=1)
+            mse = layers.mean(layers.square_error_cost(input=pred, label=yv))
+            mloss = layers.elementwise_add(mse,
+                                           layers.scale(aux, scale=0.01))
+            pt.optimizer.AdamOptimizer(learning_rate=0.01).minimize(mloss)
+        rng = np.random.RandomState(3)
+        xb = rng.rand(16, 16).astype(np.float32)
+        feed = {"x": xb,
+                "y": np.sin(xb.sum(1, keepdims=True)).astype("float32")}
+        hlo = _compile(main, startup, mloss, mesh, feed)
+        h = collective_hist(hlo)
+        # the dispatch/combine pair (plus their backward twins, which XLA
+        # may merge): at least 2 a2a instructions
+        assert h.get("all-to-all", 0) >= 2, h
+        # expert weights and their adam moments stay ep-sharded: no
+        # expert-stack-shaped gathers ([4, 16, 32], [4, 32, 16], [4, H])
+        for s in gather_shapes(hlo):
+            assert len(s) < 2 or s[0] != 4, \
+                f"expert-stack all-gather {s}: ep sharding fell back"
