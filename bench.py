@@ -16,13 +16,19 @@ Measurement notes (evidence gathered on the v5e-via-tunnel rig, round 2):
   * each lax.scan iteration adds ~2 ms of control overhead; run_loop's
     unroll=2 halves it.
   * device→host bandwidth is ~15 MB/s: fetch scalars only.
-  * ResNet-50 bs128 bf16 is HBM-bandwidth-bound on one chip — anchored in
-    round 3 by a raw-JAX control (tools/resnet50_control.py, artifact in
-    docs/artifacts/resnet50_control.json): paddle_tpu 50.6 ms/batch vs
-    hand-written raw JAX 49.1 ms (~3%), both ~16% MFU; XLA cost
-    analysis 44.2 GB accessed/step ÷ 819 GB/s ≈ 54 ms bound. The ~17%
-    ceiling is the model's arithmetic intensity, not framework overhead —
-    NCHW vs NHWC measured a wash (XLA canonicalizes conv layouts). The
+  * ResNet-50 bs128 bf16 is HBM-bandwidth-bound on one chip. Round 3
+    anchored vs a raw-JAX control (docs/artifacts/resnet50_control.json:
+    within ~3%); round 4 closed the remaining slack with a custom
+    memory-lean BN VJP (ops/nn_ops.py _bn_train: default AD kept an f32
+    cast of every activation alive into the backward) — 50.6 -> 49.0
+    ms/batch, BEATING the raw-JAX control. The round-4 MEASURED
+    per-stage table (tools/layer_profile.py ->
+    docs/artifacts/resnet50_layer_profile.json — per-block timings, not
+    cost-analysis totals) shows each bottleneck stage within 1.1-1.4x of
+    the op-formulation's bandwidth floor, and a perfect fused
+    conv+BN+relu kernel chain (activation written once, read once) would
+    floor at ~33 ms ≈ 24% MFU: the headline number is the model's
+    arithmetic intensity at 224px/bf16, not framework overhead. The
     compute-bound MFU story is the transformer + long-context configs
     below (57.3% at bs8 / 56.0% MFU measured on the same chip with the
     Pallas flash forward+backward — past the 45% bar).

@@ -30,8 +30,7 @@ def _moe_infer(op, block):
     aux.shape, aux.dtype = (), "float32"
 
 
-def _moe_tokens(xt, gate_w, w1, b1, w2, b2, top_k, cap_f, act,
-                expert_fn, stat_mean):
+def _moe_tokens(xt, gate_w, top_k, cap_f, act, expert_fn, stat_mean):
     """Shared MoE math over a flat token block xt [n, D].
 
     `expert_fn(expert_in [E, C, D]) -> expert_out [E, C, D]` runs the
@@ -148,7 +147,7 @@ def moe_ffn(ctx, ins, attrs):
 
     if not use_ep:
         out, aux = _moe_tokens(
-            xt, gate_w, w1, b1, w2, b2, top_k, cap_f, act,
+            xt, gate_w, top_k, cap_f, act,
             expert_fn=lambda ein: _expert_ffn(ein, w1, b1, w2, b2, act),
             stat_mean=lambda s, cnt: s / cnt)
         return {"Out": [out.reshape(lead + (d,))], "AuxLoss": [aux]}
@@ -166,8 +165,8 @@ def moe_ffn(ctx, ins, attrs):
         def stat_mean(s, cnt):
             return jax.lax.psum(s, tok_axes) / (cnt * tok_shards)
 
-        return _moe_tokens(xt_l, gate_w_l, w1_l, b1_l, w2_l, b2_l,
-                           top_k, cap_f, act, expert_fn, stat_mean)
+        return _moe_tokens(xt_l, gate_w_l, top_k, cap_f, act, expert_fn,
+                           stat_mean)
 
     tok_spec = PartitionSpec(tok_axes if len(tok_axes) > 1
                              else tok_axes[0], None)

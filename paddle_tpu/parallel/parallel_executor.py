@@ -151,25 +151,42 @@ class ParallelExecutor:
                         return PartitionSpec(*([None] * i + [DP]))
         return PartitionSpec()
 
-    def _feed_spec(self, var: Optional[VarDesc], value) -> PartitionSpec:
+    def _feed_spec(self, var: Optional[VarDesc], value,
+                   step_axis: bool = False) -> PartitionSpec:
+        """step_axis: the array carries a leading [n_steps] window axis
+        (run_loop per_step_feeds) — replicated; the batch axis moves to
+        dim 1 and the var's own spec shifts right by one."""
         if var is not None and var.sharding:
-            return spec_for(var.sharding, self._mesh)
+            spec = spec_for(var.sharding, self._mesh)
+            if step_axis:
+                spec = PartitionSpec(None, *tuple(spec))
+            # _divisible guard like _state_spec: an epoch-tail fragment
+            # batch (3 rows on dp=2) must degrade to replication on the
+            # offending axis, not crash jit in_shardings
+            return self._divisible(spec, value)
         shape = jnp.shape(value)
+        bdim = 1 if step_axis else 0
         dp_size = self._mesh.shape.get(DP, 1)
-        if shape and dp_size > 1 and shape[0] % dp_size == 0:
-            return PartitionSpec(DP)  # batch split ≙ SplitLoDTensor
+        if (len(shape) > bdim and dp_size > 1
+                and shape[bdim] % dp_size == 0):
+            # batch split ≙ SplitLoDTensor
+            return PartitionSpec(*([None] * bdim), DP)
         return PartitionSpec()
 
     # -- compile ------------------------------------------------------------
-    def _get_compiled(self, fetch_list: Sequence, feed: dict):
+    def _get_compiled(self, fetch_list: Sequence, feed: dict,
+                      loop: Optional[tuple] = None):
         """Build (or fetch from cache) the jitted sharded step for this
         (program, feed-shapes, fetches) signature. Returns
-        (compiled, state, feed_arrays)."""
+        (compiled, state, feed_arrays). `loop` = (n_steps, per_step_feeds,
+        unroll) compiles a device-side lax.scan over the SAME sharded step
+        — the multi-device fast path (run_loop)."""
         program = self._program
         block = program.global_block
         exe_helper = Executor()
+        per_step = bool(loop and loop[1])
         fetch_names = [exe_helper._fetch_name(f) for f in fetch_list]
-        feed_arrays = exe_helper._prep_feed(program, feed)
+        feed_arrays = exe_helper._prep_feed(program, feed, per_step=per_step)
         state = exe_helper._state_for(program, self._scope)
 
         feed_sig = tuple(sorted((k, v.shape, str(v.dtype))
@@ -177,13 +194,20 @@ class ParallelExecutor:
         state_sig = tuple(sorted((k, jnp.shape(v), str(jnp.result_type(v)))
                                  for k, v in state.items()))
         key = (program.fingerprint(), feed_sig, tuple(fetch_names), state_sig,
-               id(self._mesh), self._build_strategy.reduce_strategy)
+               id(self._mesh), self._build_strategy.reduce_strategy, loop)
 
         compiled = self._cache.get(key)
         if compiled is None:
-            step, state_out = lowering.build_step_fn(
-                program, list(feed_arrays), fetch_names, sorted(state),
-                mesh=self._mesh)
+            if loop is None:
+                step, state_out = lowering.build_step_fn(
+                    program, list(feed_arrays), fetch_names, sorted(state),
+                    mesh=self._mesh)
+            else:
+                n_steps, per_step_feeds, unroll = loop
+                step, state_out = lowering.build_loop_fn(
+                    program, list(feed_arrays), fetch_names, sorted(state),
+                    n_steps=n_steps, mesh=self._mesh,
+                    per_step_feeds=per_step_feeds, unroll=unroll)
 
             def var_of(name):
                 try:
@@ -192,12 +216,16 @@ class ParallelExecutor:
                     return None
 
             mesh = self._mesh
+
+            def feed_sharding(n, v):
+                spec = self._feed_spec(var_of(n), v, step_axis=per_step)
+                return NamedSharding(mesh, spec)
+
             state_shardings = {
                 n: NamedSharding(mesh, self._state_spec(var_of(n), v))
                 for n, v in state.items()}
-            feed_shardings = {
-                n: NamedSharding(mesh, self._feed_spec(var_of(n), v))
-                for n, v in feed_arrays.items()}
+            feed_shardings = {n: feed_sharding(n, v)
+                              for n, v in feed_arrays.items()}
             rng_sharding = NamedSharding(mesh, PartitionSpec())
             out_state_shardings = {
                 n: state_shardings.get(n, NamedSharding(mesh, self._state_spec(var_of(n), state.get(n))))
@@ -230,10 +258,33 @@ class ParallelExecutor:
                                      rng).compile().as_text()
 
     # -- run ----------------------------------------------------------------
+    def run_loop(self, fetch_list: Sequence, feed: Optional[dict] = None,
+                 n_steps: int = 1, per_step_feeds: bool = False,
+                 unroll: int = 2, return_numpy: bool = True):
+        """Run `n_steps` SHARDED training steps in one device dispatch:
+        lax.scan over the same GSPMD-partitioned step `run` executes.
+
+        This is the multi-device reading of the reference's hot loop —
+        ParallelExecutor::Run drives the whole multi-GPU step graph per
+        call (parallel_executor.cc:193, threaded_ssa_graph_executor.cc) —
+        composed with the device-side loop that is this runtime's fast
+        path (host dispatch costs 150-250 ms on the benched fabric;
+        docs/design_decisions.md). Feeds follow Executor.run_loop
+        semantics: same dict every step, or a leading [n_steps] axis with
+        per_step_feeds=True (the batch axis then dp-shards at dim 1).
+        Fetches come back stacked [n_steps, ...]."""
+        feed = feed or {}
+        compiled, state, feed_arrays = self._get_compiled(
+            fetch_list, feed, loop=(n_steps, per_step_feeds, unroll))
+        return self._execute(compiled, state, feed_arrays, return_numpy)
+
     def run(self, fetch_list: Sequence, feed: Optional[dict] = None,
             feed_dict: Optional[dict] = None, return_numpy: bool = True):
         feed = feed if feed is not None else (feed_dict or {})
         compiled, state, feed_arrays = self._get_compiled(fetch_list, feed)
+        return self._execute(compiled, state, feed_arrays, return_numpy)
+
+    def _execute(self, compiled, state, feed_arrays, return_numpy):
         program = self._program
         seed = program.random_seed if program.random_seed is not None else 0
         self._run_counter += 1

@@ -176,12 +176,24 @@ class Trainer:
                         if self.parallel else self.exe)
             start_epoch = (self.checkpoint_cfg.epoch_id
                            if self.checkpoint_cfg else 0)
-            use_loop = steps_per_loop > 1 and not self.parallel
-            if steps_per_loop > 1 and self.parallel:
-                import warnings
-                warnings.warn(
-                    "steps_per_loop>1 is not supported under the "
-                    "ParallelExecutor path yet; training per-step")
+            use_loop = steps_per_loop > 1
+
+            def _run_window(feed, fetch, n):
+                # ParallelExecutor.run_loop scans the SAME sharded step
+                # (mesh-parallel fast path); Executor.run_loop is the
+                # single-chip one — same windowed semantics either way
+                if self.parallel:
+                    return executor.run_loop(fetch_list=fetch, feed=feed,
+                                             n_steps=n, per_step_feeds=True)
+                return executor.run_loop(self.train_program, feed=feed,
+                                         fetch_list=fetch, n_steps=n,
+                                         per_step_feeds=True)
+
+            def _run_one(feed, fetch):
+                if self.parallel:
+                    return executor.run(fetch_list=fetch, feed=feed)
+                return executor.run(self.train_program, feed=feed,
+                                    fetch_list=fetch)
             for epoch_id in range(start_epoch, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
                 batches = (DeviceFeeder(feeder, reader)
@@ -218,17 +230,12 @@ class Trainer:
                         fetch = (self.train_func_outputs
                                  if begin.fetch_metrics else [])
                         if isinstance(window, dict):
-                            metrics = executor.run_loop(
-                                self.train_program, feed=window,
-                                fetch_list=fetch, n_steps=n_in_window,
-                                per_step_feeds=True)
+                            metrics = _run_window(window, fetch, n_in_window)
                         else:
                             # fragment windows (shape-change flush, epoch
                             # tail) run per-step: one compiled loop variant
                             # only, no per-length recompiles
-                            per = [executor.run(self.train_program, feed=f,
-                                                fetch_list=fetch)
-                                   for f in window]
+                            per = [_run_one(f, fetch) for f in window]
                             metrics = [np.stack(ms) for ms in zip(*per)] \
                                 if per and fetch else []
                         event_handler(EndStepEvent(epoch_id, step_id,

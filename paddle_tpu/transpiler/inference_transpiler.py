@@ -120,10 +120,23 @@ class InferenceTranspiler:
 
         # conv keeps its op (weights updated in place); bias add + BN fold
         # into ONE bias add writing BN's output name so downstream readers
-        # are untouched
+        # are untouched. A relu fused into the BN op (fuse_with_relu,
+        # layers.batch_norm(act="relu")) must survive the fold: emit it
+        # as an explicit op after the bias add.
+        out_name = bn.outputs["Y"][0]
+        if bn.attrs.get("fuse_with_relu"):
+            mid = unique_name(f"{out_name}.bnfold_pre_relu")
+            block.create_var(mid, shape=block.var(out_name).shape,
+                             dtype=block.var(out_name).dtype)
+            add = OpDesc("elementwise_add",
+                         {"X": [conv.outputs["Output"][0]],
+                          "Y": [bias_name]},
+                         {"Out": [mid]}, {"axis": 1})
+            relu = OpDesc("relu", {"X": [mid]}, {"Out": [out_name]}, {})
+            return [conv, add, relu]
         add = OpDesc("elementwise_add",
                      {"X": [conv.outputs["Output"][0]], "Y": [bias_name]},
-                     {"Out": [bn.outputs["Y"][0]]}, {"axis": 1})
+                     {"Out": [out_name]}, {"axis": 1})
         return [conv, add]
 
 

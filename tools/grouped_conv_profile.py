@@ -1,0 +1,164 @@
+"""Grouped-conv strategy shootout on the chip (SE-ResNeXt-50 32x4d shapes).
+
+VERDICT r3 weak #2: se_resnext sits at ~5% MFU with no kernel-level
+attempt. The cardinality-32 grouped 3x3 convs put only C/32 channels per
+MXU pass; XLA's native grouped conv lowering runs them at tiny-N matmul
+efficiency. Candidate reformulations, timed fwd+bwd per stage shape:
+
+  native   — lax.conv_general_dilated(feature_group_count=G) (current op)
+  bundled  — pack ceil(128/Cg) groups into 128-lane bundles; each of the
+             9 taps is a block-diagonal [128x128] matmul on the MXU
+             (einsum 'bnihw,nio->bnohw'), summed over taps. FLOP
+             inflation 128/Cg instead of dense's C/Cg, full MXU lanes.
+  dense    — ordinary dense conv with block-diagonal-expanded weights
+             (upper bound on MXU-friendliness, C/Cg flop inflation).
+
+Writes docs/artifacts/grouped_conv_profile.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+PEAK = 197e12
+
+
+def native_gconv(x, w, groups, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=[(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups)
+
+
+def pack_weights(w, groups, lanes=128):
+    """w [C_out, Cg, 3, 3] -> Wp [3, 3, nb, lanes(in), lanes(out)]
+    block-diagonal, via a constant one-hot placement einsum (AD routes dW
+    straight back to w)."""
+    c_out, cg = w.shape[0], w.shape[1]
+    nb = max(c_out // lanes, 1)
+    lanes = min(lanes, c_out)
+    wv = w.reshape(nb, lanes, cg, 3, 3)           # [nb, o, k, dy, dx]
+    place = np.zeros((lanes, cg, lanes), w.dtype.type
+                     if hasattr(w.dtype, "type") else np.float32)
+    for o in range(lanes):
+        base = (o // cg) * cg
+        for k in range(cg):
+            place[o, k, base + k] = 1
+    return jnp.einsum("nokyx,oki->yxnio", wv, jnp.asarray(place, w.dtype))
+
+
+def bundled_gconv(x, w, groups, stride=1, lanes=128):
+    """Per-tap block-diagonal bundled matmul grouped conv."""
+    b, c, h, wd = x.shape
+    cg = w.shape[1]
+    nb = c // lanes if c >= lanes else 1
+    lanes = min(lanes, c)
+    wp = pack_weights(w, groups, lanes)           # [3,3,nb,lanes,lanes]
+    xp = jnp.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    xb = xp.reshape(b, nb, lanes, h + 2, wd + 2)
+    ho = (h - 1) // stride + 1
+    out = None
+    for dy in range(3):
+        for dx in range(3):
+            xs = xb[:, :, :, dy:dy + h:stride, dx:dx + wd:stride]
+            t = jnp.einsum("bnihw,nio->bnohw", xs, wp[dy, dx],
+                           preferred_element_type=jnp.float32)
+            out = t if out is None else out + t
+    return out.reshape(b, c, ho, ho).astype(x.dtype)
+
+
+def expand_dense(w, groups):
+    """[C_out, Cg, 3, 3] -> [C_out, C_in, 3, 3] zero-padded block diag."""
+    c_out, cg = w.shape[0], w.shape[1]
+    c_in = cg * groups
+    out = jnp.zeros((c_out, c_in, 3, 3), w.dtype)
+    o = np.arange(c_out)
+    base = (o // (c_out // groups)) * cg
+    cols = base[:, None] + np.arange(cg)[None, :]
+    return out.at[o[:, None], cols].set(w)
+
+
+def dense_gconv(x, w, groups, stride=1):
+    wd = expand_dense(w, groups)
+    return jax.lax.conv_general_dilated(
+        x, wd, window_strides=(stride, stride), padding=[(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def time_fn(fn, args, steps=100, base=10, windows=3):
+    def make(n):
+        @jax.jit
+        def loop(a):
+            def one(c, _):
+                loss, g = jax.value_and_grad(
+                    lambda c: jnp.sum(fn(*c).astype(jnp.float32)))(c)
+                return jax.tree.map(
+                    lambda p, gg: p - 1e-6 * gg.astype(p.dtype), c, g), loss
+            c, losses = jax.lax.scan(one, a, None, length=n)
+            return losses[-1]
+        return loop
+    big, small = make(steps), make(base)
+    float(np.asarray(big(args)))
+    float(np.asarray(small(args)))
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.time(); float(np.asarray(small(args))); ts = time.time() - t0
+        t0 = time.time(); float(np.asarray(big(args))); tb = time.time() - t0
+        best = min(best, (tb - ts) / (steps - base))
+    return max(best, 0.0) * 1000.0
+
+
+def main():
+    batch = int(os.environ.get("PROF_BATCH", 64))
+    groups = 32
+    rng = np.random.RandomState(0)
+    rows = []
+    # SE-ResNeXt-50 32x4d grouped 3x3 stages: (C, HW_out, stride, blocks)
+    for c, hw, stride, blocks in [(128, 56, 1, 3), (256, 28, 1, 4),
+                                  (512, 14, 1, 6), (1024, 7, 1, 3)]:
+        cg = c // groups
+        in_hw = hw * stride
+        x = jnp.asarray(rng.rand(batch, c, in_hw, in_hw)
+                        .astype(np.float32) - 0.5, jnp.bfloat16)
+        w = jnp.asarray(rng.randn(c, cg, 3, 3).astype(np.float32) * 0.05,
+                        jnp.bfloat16)
+        # correctness cross-check (fwd) before timing
+        ref = np.asarray(native_gconv(x, w, groups, stride),
+                         np.float32)
+        got = np.asarray(bundled_gconv(x, w, groups, stride), np.float32)
+        err = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-6)
+        assert err < 3e-2, f"bundled mismatch at C={c}: rel {err}"
+
+        gflops = 2 * c * cg * 9 * hw * hw * batch / 1e9  # true model flops
+        entry = {"c": c, "hw": hw, "cg": cg,
+                 "true_train_gflops": round(3 * gflops, 1),
+                 "blocks": blocks}
+        for name, fn in (("native", native_gconv),
+                         ("bundled", bundled_gconv),
+                         ("dense", dense_gconv)):
+            ms = time_fn(lambda xx, ww: fn(xx, ww, groups, stride), (x, w))
+            entry[f"{name}_ms"] = round(ms, 3)
+            # true-model-flops MFU (the flop inflation of a reformulation
+            # is overhead, not useful work)
+            entry[f"{name}_true_mfu_pct"] = round(
+                (3 * gflops * 1e9) / (ms * 1e-3) / PEAK * 100, 2) \
+                if ms > 0 else 0.0
+        rows.append(entry)
+        print(json.dumps(entry))
+
+    out = os.path.join(os.path.dirname(__file__), "..", "docs", "artifacts",
+                       "grouped_conv_profile.json")
+    with open(os.path.abspath(out), "w") as f:
+        json.dump({"batch": batch, "groups": groups, "stages": rows}, f,
+                  indent=1)
+
+
+if __name__ == "__main__":
+    main()
