@@ -7,7 +7,7 @@ memory, with the prefetch rewrite in
 pserver-side sparse optimizer blocks (`listen_and_serv_op.cc:73-360`).
 
 TPU-native reading: there is no parameter-server process — the table lives
-in THIS host's RAM as numpy, and only the rows a batch actually touches are
+in host RAM as numpy, and only the rows a batch actually touches are
 shipped to the device:
 
   1. host: `prepare(ids)` uniquifies the batch's ids, gathers
@@ -19,22 +19,43 @@ shipped to the device:
      `capacity x dim`, never `vocab x dim`;
   3. host: the fetched rows-gradient is applied back to the table by the
      numpy mirror of the sparse optimizer kernels (optimizer.py's
-     SelectedRows sgd/adagrad paths — same math, host memory).
+     SelectedRows sgd/adagrad/momentum/adam paths — same math, host
+     memory).
+
+Multi-process sharding (`distributed=True`) is the actual pserver
+topology (distribute_transpiler.py:120-180 slice_variable): process p of
+P owns the contiguous vocab range [p*V/P, (p+1)*V/P) — host memory per
+process is V/P rows. Each step the processes union their batches' ids
+(host allgather), every owner contributes its owned rows, and the summed
+row block (ranges are disjoint) feeds the device replicated while the
+ids stay batch-sharded. The fetched rows-grad is the dp-summed cotangent
+(GSPMD replicates it to every process), and each process applies ONLY
+its owned range — shards never diverge.
 
 `prepare` output is a plain feed dict, so it rides the existing
-double-buffer prefetch (reader/prefetch.py) unchanged: row gather for batch
-N+1 overlaps the device step for batch N, exactly the reference's prefetch
-pipelining.
+double-buffer prefetch (reader/prefetch.py) unchanged: row gather for
+batch N+1 overlaps the device step for batch N, exactly the reference's
+prefetch pipelining.
 
 Gradient plumbing: after `optimizer.minimize(loss)`, `table.grad_var(loss)`
 requests d(loss)/d(rows) — backward.append_backward merges the rows var
 into the block's single autodiff op, so the rows cotangent falls out of the
-same value_and_grad that computes the parameter grads.
+same value_and_grad that computes the parameter grads. The Trainer wires
+all of this automatically for registered tables (fetching the grad and
+applying it each step); manual Executor loops call grad_var/apply_grad
+themselves.
+
+Checkpoints: tables register themselves in a module registry;
+io.save_persistables / load_persistables persist every registered
+table's shard (+ optimizer state) beside the program vars, so
+Trainer auto-resume restores them (ADVICE r3: state outside the scope
+must not silently revert to fresh init).
 """
 
 from __future__ import annotations
 
 import collections
+import os
 import threading
 from typing import Dict, NamedTuple, Optional, Tuple
 
@@ -44,12 +65,21 @@ from . import backward
 from .core.program import default_main_program
 from .core.types import np_dtype
 
-__all__ = ["HostEmbeddingTable", "HostBatch", "host_embedding"]
+__all__ = ["HostEmbeddingTable", "HostBatch", "host_embedding",
+           "registered_tables"]
+
+# name -> table; io.save_persistables/load_persistables walk this so host
+# state rides every checkpoint (cleared per table via .unregister())
+_REGISTRY: "Dict[str, HostEmbeddingTable]" = {}
+
+
+def registered_tables() -> Dict[str, "HostEmbeddingTable"]:
+    return dict(_REGISTRY)
 
 
 class HostBatch(NamedTuple):
     """Which table rows a prepared batch touches (pass to apply_grad)."""
-    uniq: np.ndarray     # [n_valid] distinct vocabulary ids
+    uniq: np.ndarray     # [n_valid] distinct vocabulary ids (global)
     n_valid: int         # valid prefix length of the capacity block
 
 
@@ -59,38 +89,84 @@ class HostEmbeddingTable:
     capacity: max distinct ids per batch (static row-block size). The
     reference's pserver table is similarly touched only through the rows a
     minibatch requests (lookup_sparse_table_op.cc).
+
+    optimizer: sgd | adagrad | momentum | adam — numpy mirrors of the
+    device sparse kernels (≙ the optimizer blocks the reference transpiler
+    installs pserver-side, distribute_transpiler.py:120-180).
+
+    distributed=True: shard the vocab over jax processes (see module
+    docstring). With one process it is identical to the local table.
     """
 
     def __init__(self, name: str, size: int, dim: int, capacity: int,
                  optimizer: str = "sgd", learning_rate: float = 0.1,
                  dtype: str = "float32", initial_value: Optional[np.ndarray] = None,
-                 init_scale: float = 0.1, seed: int = 0, epsilon: float = 1e-6):
-        if optimizer not in ("sgd", "adagrad"):
+                 init_scale: float = 0.1, seed: int = 0, epsilon: float = 1e-6,
+                 momentum: float = 0.9, beta1: float = 0.9,
+                 beta2: float = 0.999, distributed: bool = False):
+        if optimizer not in ("sgd", "adagrad", "momentum", "adam"):
             raise ValueError(f"unsupported host-table optimizer {optimizer!r}"
-                             " (sgd | adagrad)")
+                             " (sgd | adagrad | momentum | adam)")
         self.name = name
         self.size, self.dim, self.capacity = size, dim, capacity
         self.dtype = np_dtype(dtype)
+        self.distributed = bool(distributed)
+        if self.distributed:
+            import jax
+            self.rank, self.nprocs = jax.process_index(), jax.process_count()
+        else:
+            self.rank, self.nprocs = 0, 1
+        # contiguous owned range ≙ slice_variable's block assignment
+        per = -(-size // self.nprocs)          # ceil
+        self.lo = min(self.rank * per, size)
+        self.hi = min(self.lo + per, size)
+        n_local = self.hi - self.lo
+
         if initial_value is not None:
             assert initial_value.shape == (size, dim)
-            self.table = np.asarray(initial_value, self.dtype).copy()
+            self.table = np.asarray(initial_value[self.lo:self.hi],
+                                    self.dtype).copy()
         else:
+            # deterministic per-row init regardless of sharding: every
+            # process draws the same full-table stream and keeps its slice
+            # (tables are modest host-RAM objects; init runs once)
             rng = np.random.RandomState(seed)
-            self.table = rng.uniform(-init_scale, init_scale,
-                                     (size, dim)).astype(self.dtype)
+            full = rng.uniform(-init_scale, init_scale,
+                               (size, dim)).astype(self.dtype)
+            self.table = full[self.lo:self.hi].copy()
         self.optimizer = optimizer
         self.learning_rate = learning_rate
         self.epsilon = epsilon
-        # per-element accumulator, same shape contract as the device
-        # sparse adagrad kernel (optimizer.py SelectedRows path)
-        self.moment = (np.zeros((size, dim), np.float32)
-                       if optimizer == "adagrad" else None)
+        self.momentum_coef = momentum
+        self.beta1, self.beta2 = beta1, beta2
+        self.step_count = 0                     # adam bias correction
+        # per-element accumulators over the OWNED shard only, same shape
+        # contract as the device sparse kernels (optimizer.py SelectedRows)
+        self.moment = (np.zeros((n_local, dim), np.float32)
+                       if optimizer in ("adagrad", "momentum", "adam")
+                       else None)
+        self.moment2 = (np.zeros((n_local, dim), np.float32)
+                        if optimizer == "adam" else None)
         # FIFO of prepared-but-unapplied batches: under double-buffer
         # prefetch the worker thread prepares batch N+1 while batch N is
         # still on device, so apply_grad must pop the OLDEST pending batch,
         # never "the last prepared one"
         self._pending: "collections.deque[HostBatch]" = collections.deque()
+        # guards _pending AND table/accumulator access: prepare() runs on
+        # the prefetch thread while apply_grad() writes on the main thread
+        # (ADVICE r3: an unguarded gather could see half-applied rows)
         self._lock = threading.Lock()
+        if name in _REGISTRY:
+            import warnings
+            warnings.warn(
+                f"HostEmbeddingTable {name!r} replaces an already-"
+                "registered table of the same name: the old table will no "
+                "longer be checkpointed (call .unregister() on tables you "
+                "are done with)")
+        _REGISTRY[name] = self
+
+    def unregister(self):
+        _REGISTRY.pop(self.name, None)
 
     # -- program-side names -------------------------------------------------
     @property
@@ -111,17 +187,45 @@ class HostEmbeddingTable:
         return pair[1]
 
     # -- host side: feed preparation and sparse update ----------------------
+    def _gather_rows(self, uniq_padded: np.ndarray) -> np.ndarray:
+        """Row values for global ids (zeros for ids other shards own)."""
+        owned = (uniq_padded >= self.lo) & (uniq_padded < self.hi)
+        out = np.zeros((len(uniq_padded), self.dim), self.dtype)
+        out[owned] = self.table[uniq_padded[owned] - self.lo]
+        return out
+
     def prepare(self, ids: np.ndarray):
         """ids (any int shape) -> ({rows feed, remapped local ids}, batch).
 
         Pass the HostBatch back to apply_grad with that batch's fetched
         gradient. The feed's local-ids key is namespaced per table
-        (`<name>@LOCAL_IDS`) so multiple host tables coexist in one feed."""
+        (`<name>@LOCAL_IDS`) so multiple host tables coexist in one feed.
+
+        distributed: `ids` is this process's batch SHARD; the returned
+        rows block covers the union of every process's ids (summed
+        disjoint contributions) and local ids are remapped against that
+        global union — every process must call prepare() collectively."""
         ids = np.asarray(ids)
         uniq, inv = np.unique(ids, return_inverse=True)
         if uniq.size > self.capacity:
+            # checked BEFORE the collective: a post-allgather error would
+            # leave the peers hanging in process_allgather
             raise ValueError(
                 f"host table {self.name!r}: batch touches {uniq.size} "
+                f"distinct ids > capacity {self.capacity}; raise capacity "
+                "or shrink the batch")
+        if self.distributed and self.nprocs > 1:
+            from jax.experimental import multihost_utils
+            mine = np.full((self.capacity,), -1, np.int64)
+            mine[:uniq.size] = uniq
+            everyone = np.asarray(
+                multihost_utils.process_allgather(mine, tiled=False))
+            guniq = np.unique(everyone[everyone >= 0])
+        else:
+            guniq = uniq
+        if guniq.size > self.capacity:
+            raise ValueError(
+                f"host table {self.name!r}: batch touches {guniq.size} "
                 f"distinct ids > capacity {self.capacity}; raise capacity "
                 "or shrink the batch")
         # pad slots point at row 0 but no local id maps to them, so their
@@ -129,11 +233,18 @@ class HostEmbeddingTable:
         # prefix (writing the padded block would clobber row 0's update
         # with the stale pad copies whenever id 0 is in the batch)
         uniq_padded = np.zeros((self.capacity,), np.int64)
-        uniq_padded[:uniq.size] = uniq
-        batch = HostBatch(uniq=uniq.copy(), n_valid=int(uniq.size))
-        feed = {self.rows_name: self.table[uniq_padded],
-                self.local_ids_name:
-                    inv.reshape(ids.shape).astype(np.int64)}
+        uniq_padded[:guniq.size] = guniq
+        with self._lock:
+            rows = self._gather_rows(uniq_padded)
+        rows[guniq.size:] = 0
+        if self.distributed and self.nprocs > 1:
+            from jax.experimental import multihost_utils
+            rows = np.asarray(multihost_utils.process_allgather(
+                rows, tiled=False)).sum(axis=0).astype(self.dtype)
+        batch = HostBatch(uniq=guniq.copy(), n_valid=int(guniq.size))
+        local = np.searchsorted(guniq, uniq)[inv].reshape(ids.shape)
+        feed = {self.rows_name: rows,
+                self.local_ids_name: local.astype(np.int64)}
         return feed, batch
 
     def apply_grad(self, grad_rows: np.ndarray,
@@ -142,32 +253,56 @@ class HostEmbeddingTable:
         numpy mirror of the device sparse optimizer kernels. `batch` is
         the HostBatch prepare() returned for THIS gradient's feed; when
         omitted, the oldest wrap_reader-prepared batch is popped (FIFO —
-        correct as long as gradients are applied in feed order)."""
-        if batch is None:
-            with self._lock:
+        correct as long as gradients are applied in feed order).
+
+        distributed: grad_rows is the dp-summed cotangent (identical on
+        every process); each process updates only its owned range."""
+        with self._lock:
+            if batch is None:
                 if not self._pending:
                     raise ValueError(
                         "apply_grad without a HostBatch: nothing pending — "
                         "pass prepare()'s batch explicitly")
                 batch = self._pending.popleft()
-        n = batch.n_valid
-        uniq = batch.uniq[:n]
-        g = np.asarray(grad_rows, np.float32)[:n]
-        rows = self.table[uniq].astype(np.float32)
-        if self.optimizer == "sgd":
-            rows -= self.learning_rate * g
-        else:  # adagrad (≙ sparse adagrad: per-element accumulator)
-            m = self.moment[uniq] + g * g
-            self.moment[uniq] = m
-            rows -= self.learning_rate * g / (np.sqrt(m) + self.epsilon)
-        self.table[uniq] = rows.astype(self.dtype)
+            n = batch.n_valid
+            uniq = batch.uniq[:n]
+            g = np.asarray(grad_rows, np.float32)[:n]
+            owned = (uniq >= self.lo) & (uniq < self.hi)
+            idx = uniq[owned] - self.lo
+            g = g[owned]
+            if idx.size == 0:
+                self.step_count += 1
+                return
+            rows = self.table[idx].astype(np.float32)
+            lr = self.learning_rate
+            if self.optimizer == "sgd":
+                rows -= lr * g
+            elif self.optimizer == "adagrad":
+                m = self.moment[idx] + g * g
+                self.moment[idx] = m
+                rows -= lr * g / (np.sqrt(m) + self.epsilon)
+            elif self.optimizer == "momentum":
+                v = self.momentum_coef * self.moment[idx] + g
+                self.moment[idx] = v
+                rows -= lr * v
+            else:  # adam (lazy/sparse: moments advance only for touched rows)
+                t = self.step_count + 1
+                m = self.beta1 * self.moment[idx] + (1 - self.beta1) * g
+                v = self.beta2 * self.moment2[idx] + (1 - self.beta2) * g * g
+                self.moment[idx] = m
+                self.moment2[idx] = v
+                mhat = m / (1 - self.beta1 ** t)
+                vhat = v / (1 - self.beta2 ** t)
+                rows -= lr * mhat / (np.sqrt(vhat) + self.epsilon)
+            self.step_count += 1
+            self.table[idx] = rows.astype(self.dtype)
 
     def wrap_reader(self, reader, ids_key: str,
                     local_ids_key: Optional[str] = None,
                     training: bool = True):
         """Decorate a feed-dict reader so each batch ships prepared rows +
         remapped ids instead of raw vocabulary ids (rides double_buffer —
-        the gather for batch N+1 overlaps batch N's device step).
+        the gather for batch N+1 overlaps the device step).
 
         training=True queues each prepared HostBatch; apply_grad() pops
         them in FIFO order, one per step. Use training=False for eval/test
@@ -192,15 +327,80 @@ class HostEmbeddingTable:
                 yield feed
         return wrapped
 
+    # -- persistence (≙ pserver checkpoint shards, go/pserver/service.go:346)
+    def _ckpt_path(self, dirname: str) -> str:
+        return os.path.join(
+            dirname, f"__host_table__.{self.name}.rank{self.rank}.npz")
+
+    def save(self, dirname: str) -> None:
+        """Persist this process's shard (+ optimizer state) beside the
+        program vars. Every process writes its own rank file."""
+        state = {"table": self.table, "lo": np.int64(self.lo),
+                 "hi": np.int64(self.hi),
+                 "step_count": np.int64(self.step_count)}
+        if self.moment is not None:
+            state["moment"] = self.moment
+        if self.moment2 is not None:
+            state["moment2"] = self.moment2
+        tmp = self._ckpt_path(dirname) + ".tmp"
+        with self._lock:
+            # file-handle form: np.savez would append .npz to a bare
+            # string path, breaking the atomic-rename pairing
+            with open(tmp, "wb") as f:
+                np.savez(f, **state)
+        os.replace(tmp, self._ckpt_path(dirname))
+
+    def load(self, dirname: str) -> bool:
+        """Restore this process's shard; returns False if absent."""
+        path = self._ckpt_path(dirname)
+        if not os.path.exists(path):
+            return False
+        with np.load(path) as z:
+            if (int(z["lo"]), int(z["hi"])) != (self.lo, self.hi):
+                raise ValueError(
+                    f"host table {self.name!r}: checkpoint shard covers "
+                    f"[{int(z['lo'])}, {int(z['hi'])}) but this process "
+                    f"owns [{self.lo}, {self.hi}) — process count changed; "
+                    "re-shard the table checkpoint first")
+            with self._lock:
+                self.table[...] = z["table"]
+                self.step_count = int(z["step_count"])
+                if self.moment is not None:
+                    self.moment[...] = z["moment"]
+                if self.moment2 is not None:
+                    self.moment2[...] = z["moment2"]
+        return True
+
     def device_bytes(self) -> int:
         """HBM the table contributes per step: the rows block, not vocab."""
         return int(self.capacity * self.dim * self.table.dtype.itemsize)
 
     def host_bytes(self) -> int:
         b = int(self.table.nbytes)
-        if self.moment is not None:
-            b += int(self.moment.nbytes)
+        for m in (self.moment, self.moment2):
+            if m is not None:
+                b += int(m.nbytes)
         return b
+
+
+def _tables_for(program) -> list:
+    """Registered tables the given program actually consumes (rows var
+    present). Scoping by program keeps one model's checkpoint from
+    snapshotting — or, worse, rolling back — another model's table."""
+    if program is None:
+        return list(_REGISTRY.values())
+    vars_ = program.global_block.vars
+    return [t for t in _REGISTRY.values() if t.rows_name in vars_]
+
+
+def save_all(dirname: str, program=None) -> None:
+    for t in _tables_for(program):
+        t.save(dirname)
+
+
+def load_all(dirname: str, program=None) -> None:
+    for t in _tables_for(program):
+        t.load(dirname)
 
 
 def host_embedding(input, table: HostEmbeddingTable):

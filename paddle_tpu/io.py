@@ -240,8 +240,15 @@ def save_params(executor=None, dirname: str = "", main_program=None,
 
 def save_persistables(executor=None, dirname: str = "", main_program=None,
                       filename=None, scope=None):
-    return save_vars(executor, dirname, main_program, None, _is_persistable,
-                     filename, scope)
+    out = save_vars(executor, dirname, main_program, None, _is_persistable,
+                    filename, scope)
+    # host-RAM embedding tables live OUTSIDE the scope (host_table.py);
+    # every process persists its own vocab shard beside the program vars
+    # so checkpoints/auto-resume restore them too (≙ the pserver saving
+    # its table shards, go/pserver/service.go:346)
+    from . import host_table as _ht
+    _ht.save_all(dirname, main_program or default_main_program())
+    return out
 
 
 def load_vars(executor=None, dirname: str = "", main_program=None, vars=None,
@@ -308,8 +315,11 @@ def load_params(executor=None, dirname: str = "", main_program=None,
 
 def load_persistables(executor=None, dirname: str = "", main_program=None,
                       filename=None, scope=None):
-    return load_vars(executor, dirname, main_program, None, _is_persistable,
-                     filename, scope)
+    out = load_vars(executor, dirname, main_program, None, _is_persistable,
+                    filename, scope)
+    from . import host_table as _ht
+    _ht.load_all(dirname, main_program or default_main_program())
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -113,6 +113,26 @@ class Trainer:
             self.loss = outs[0]
             optimizer = optimizer_func()
             optimizer.minimize(self.loss)
+            # host-RAM embedding tables (host_table.py): any registered
+            # table whose rows block this program consumes gets fully
+            # auto-wired — rows-grad requested here, reader wrapped and
+            # grads applied inside train() (≙ the transpiler installing
+            # the prefetch rewrite + pserver optimizer blocks,
+            # distribute_transpiler.py:120-180 — zero per-model plumbing)
+            from . import host_table as _ht
+            self._host_tables = []
+            blk = self.train_program.global_block
+            for t in _ht.registered_tables().values():
+                if t.rows_name not in blk.vars:
+                    continue
+                ids_name = next(
+                    (op.inputs["Ids"][0] for op in blk.ops
+                     if op.type == "lookup_table"
+                     and op.inputs["W"][0] == t.rows_name), None)
+                if ids_name is None:
+                    continue
+                gv = t.grad_var(self.loss)
+                self._host_tables.append((t, gv, ids_name))
 
         self._dist_init_if_necessary()
 
@@ -177,23 +197,70 @@ class Trainer:
             start_epoch = (self.checkpoint_cfg.epoch_id
                            if self.checkpoint_cfg else 0)
             use_loop = steps_per_loop > 1
+            if self._host_tables and use_loop:
+                import warnings
+                warnings.warn(
+                    "steps_per_loop>1 with host-RAM embedding tables: all "
+                    "rows blocks of a window are gathered BEFORE any of "
+                    "the window's gradients apply, so rows are up to "
+                    "steps_per_loop batches stale (asynchronous-SGD "
+                    "semantics on the table, exactly like the reference's "
+                    "async pserver mode). Use steps_per_loop=1 for "
+                    "strictly synchronous embedding updates.")
+            if self._host_tables:
+                # normalize to feed dicts FIRST (wrap_reader pops the ids
+                # key from a dict; list-style readers go through the
+                # feeder), then chain each table's prepare stage
+                raw_reader = reader
+
+                def reader():
+                    for d in raw_reader():
+                        yield d if isinstance(d, dict) else feeder.feed(d)
+            for t, _gv, ids_name in self._host_tables:
+                # raw vocabulary ids in the feed become prepared rows +
+                # remapped local ids (rides double_buffer unchanged)
+                reader = t.wrap_reader(reader, ids_key=ids_name,
+                                       local_ids_key=ids_name)
+            ht_fetch = [gv for _t, gv, _i in self._host_tables]
+
+            def _apply_host_grads(outs, stacked_steps=0):
+                """Split host-table rows-grads off the fetch results and
+                scatter them into the tables (FIFO order inside a stacked
+                window)."""
+                if not ht_fetch:
+                    return outs
+                grads = outs[len(outs) - len(ht_fetch):]
+                outs = outs[:len(outs) - len(ht_fetch)]
+                for (t, _gv, _i), g in zip(self._host_tables, grads):
+                    if stacked_steps:
+                        for k in range(stacked_steps):
+                            t.apply_grad(g[k])
+                    else:
+                        t.apply_grad(g)
+                return outs
 
             def _run_window(feed, fetch, n):
                 # ParallelExecutor.run_loop scans the SAME sharded step
                 # (mesh-parallel fast path); Executor.run_loop is the
                 # single-chip one — same windowed semantics either way
+                full = list(fetch) + ht_fetch
                 if self.parallel:
-                    return executor.run_loop(fetch_list=fetch, feed=feed,
+                    outs = executor.run_loop(fetch_list=full, feed=feed,
                                              n_steps=n, per_step_feeds=True)
-                return executor.run_loop(self.train_program, feed=feed,
-                                         fetch_list=fetch, n_steps=n,
-                                         per_step_feeds=True)
+                else:
+                    outs = executor.run_loop(self.train_program, feed=feed,
+                                             fetch_list=full, n_steps=n,
+                                             per_step_feeds=True)
+                return _apply_host_grads(outs, stacked_steps=n)
 
             def _run_one(feed, fetch):
+                full = list(fetch) + ht_fetch
                 if self.parallel:
-                    return executor.run(fetch_list=fetch, feed=feed)
-                return executor.run(self.train_program, feed=feed,
-                                    fetch_list=fetch)
+                    outs = executor.run(fetch_list=full, feed=feed)
+                else:
+                    outs = executor.run(self.train_program, feed=feed,
+                                        fetch_list=full)
+                return _apply_host_grads(outs)
             for epoch_id in range(start_epoch, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
                 batches = (DeviceFeeder(feeder, reader)
@@ -252,14 +319,17 @@ class Trainer:
                     begin = BeginStepEvent(epoch_id, step_id)
                     event_handler(begin)
                     fetch = self.train_func_outputs if begin.fetch_metrics else []
-                    if self.parallel:
-                        metrics = executor.run(fetch_list=fetch, feed=feed)
-                    else:
-                        metrics = executor.run(self.train_program, feed=feed,
-                                               fetch_list=fetch)
+                    metrics = _run_one(feed, fetch)
                     event_handler(EndStepEvent(epoch_id, step_id, metrics))
-                    if (self.checkpoint_cfg and
-                            step_id % self.checkpoint_cfg.step_interval == 0):
+                    # crossing semantics, matching the windowed path: fire
+                    # every `step_interval` COMPLETED steps — never at step
+                    # 0, whose save would carry one step of this epoch's
+                    # progress and poison an epoch-granularity resume
+                    # (a crash before the next epoch boundary would then
+                    # replay epoch steps on already-stepped state)
+                    iv = (self.checkpoint_cfg.step_interval
+                          if self.checkpoint_cfg else 0)
+                    if iv and step_id // iv != (step_id + 1) // iv:
                         self._save_checkpoint(epoch_id, step_id)
                 event_handler(EndEpochEvent(epoch_id))
                 self._epoch_checkpoint(epoch_id)
@@ -269,10 +339,20 @@ class Trainer:
         with scope_guard(self.scope):
             feeder = DataFeeder(self._feed_vars(feed_order),
                                 program=self.train_program)
+            def batches():
+                for d in reader():
+                    yield d if isinstance(d, dict) else feeder.feed(d)
+            for t, _gv, ids_name in self._host_tables:
+                # eval feeds carry raw vocabulary ids too; training=False
+                # keeps the eval pass off the training FIFO (a mid-epoch
+                # eval must not steal a pending training batch's slot)
+                batches = t.wrap_reader(batches, ids_key=ids_name,
+                                        local_ids_key=ids_name,
+                                        training=False)
             totals = None
             count = 0
-            for data in reader():
-                outs = self.exe.run(test_program, feed=feeder.feed(data),
+            for feed in batches():
+                outs = self.exe.run(test_program, feed=feed,
                                     fetch_list=self.train_func_outputs)
                 vals = [float(np.ravel(o)[0]) for o in outs]
                 totals = vals if totals is None else \

@@ -27,6 +27,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from _profile_util import time_grad_steps
+
 PEAK = 197e12
 
 
@@ -92,29 +94,6 @@ def dense_gconv(x, w, groups, stride=1):
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
 
 
-def time_fn(fn, args, steps=100, base=10, windows=3):
-    def make(n):
-        @jax.jit
-        def loop(a):
-            def one(c, _):
-                loss, g = jax.value_and_grad(
-                    lambda c: jnp.sum(fn(*c).astype(jnp.float32)))(c)
-                return jax.tree.map(
-                    lambda p, gg: p - 1e-6 * gg.astype(p.dtype), c, g), loss
-            c, losses = jax.lax.scan(one, a, None, length=n)
-            return losses[-1]
-        return loop
-    big, small = make(steps), make(base)
-    float(np.asarray(big(args)))
-    float(np.asarray(small(args)))
-    best = float("inf")
-    for _ in range(windows):
-        t0 = time.time(); float(np.asarray(small(args))); ts = time.time() - t0
-        t0 = time.time(); float(np.asarray(big(args))); tb = time.time() - t0
-        best = min(best, (tb - ts) / (steps - base))
-    return max(best, 0.0) * 1000.0
-
-
 def main():
     batch = int(os.environ.get("PROF_BATCH", 64))
     groups = 32
@@ -143,7 +122,8 @@ def main():
         for name, fn in (("native", native_gconv),
                          ("bundled", bundled_gconv),
                          ("dense", dense_gconv)):
-            ms = time_fn(lambda xx, ww: fn(xx, ww, groups, stride), (x, w))
+            ms = time_grad_steps(
+                lambda c, fn=fn: fn(c[0], c[1], groups, stride), (x, w))
             entry[f"{name}_ms"] = round(ms, 3)
             # true-model-flops MFU (the flop inflation of a reformulation
             # is overhead, not useful work)

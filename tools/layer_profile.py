@@ -36,6 +36,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from _profile_util import time_grad_steps
+
 HBM_BW = 819e9          # v5e HBM bandwidth, bytes/s
 PEAK = 197e12           # v5e bf16 FLOP/s
 
@@ -89,43 +91,6 @@ def make_params(rng, in_c, mid, out_c, project):
     return ps
 
 
-def time_block(fn, args, steps=200, base_steps=20, windows=3):
-    """ms per grad-step via two scan lengths.
-
-    On this rig block_until_ready does NOT synchronize through the TPU
-    tunnel — only an actual value fetch does, and that fetch costs ~1 s
-    regardless of payload. So each window is timed INCLUDING the scalar
-    fetch, at two scan lengths, and the difference cancels the fixed
-    dispatch+fetch cost: ms = (T(steps) - T(base)) / (steps - base)."""
-    def make(n):
-        @jax.jit
-        def loop(args):
-            def one(c, _):
-                loss, grads = jax.value_and_grad(fn)(c)
-                # fold grads back so the loop has a carried dependency and
-                # XLA cannot hoist the step out of the scan
-                c2 = jax.tree.map(lambda a, g: a - 1e-6 * g.astype(a.dtype),
-                                  c, grads)
-                return c2, loss
-            c, losses = jax.lax.scan(one, args, None, length=n)
-            return losses[-1]
-        return loop
-
-    big, small = make(steps), make(base_steps)
-    float(np.asarray(big(args)))    # compile + warm
-    float(np.asarray(small(args)))
-    best = float("inf")
-    for _ in range(windows):
-        t0 = time.time()
-        float(np.asarray(small(args)))
-        t_small = time.time() - t0
-        t0 = time.time()
-        float(np.asarray(big(args)))
-        t_big = time.time() - t0
-        best = min(best, (t_big - t_small) / (steps - base_steps))
-    return max(best, 0.0) * 1000.0
-
-
 def stage_entry(name, batch, in_c, hw, mid, out_c, stride, project,
                 n_blocks, rng):
     in_hw = hw * stride
@@ -137,12 +102,13 @@ def stage_entry(name, batch, in_c, hw, mid, out_c, stride, project,
         return jnp.sum(bottleneck(c["x"], c["p"], stride, mid, out_c)
                        .astype(jnp.float32))
 
-    ms = time_block(lambda c: step(c), {"x": x, "p": params})
+    ms = time_grad_steps(step, {"x": x, "p": params},
+                         steps=200, base=20)
 
     # analytic per-block model flops (train = 3x fwd conv flops)
     def cflops(cin, cout, k, h):
         return 2 * cin * cout * k * k * h * h * batch
-    f = cflops(in_c, mid, 1, in_hw) / (1 if stride == 1 else 1) \
+    f = cflops(in_c, mid, 1, in_hw) \
         + cflops(mid, mid, 3, hw) + cflops(mid, out_c, 1, hw)
     if project:
         f += cflops(in_c, out_c, 1, hw)

@@ -1,0 +1,125 @@
+"""SE-ResNeXt-50 per-stage block profile on the chip (bs64, bf16).
+
+The grouped-conv shootout (grouped_conv_profile.py) showed XLA's native
+grouped conv costs only ~9 ms of the ~80 ms se_resnext step — so the
+verdict's 'grouped conv = MXU waste' diagnosis explains a minority of
+the time. This tool times one FULL bottleneck (1x1 reduce -> grouped
+3x3 -> 1x1 expand -> SE gate -> residual add, each conv + BN, the
+framework's formulation) per stage, plus ablations:
+
+  block       — the full bottleneck
+  no_se       — without the SE gate (isolates the SE cost)
+  convs_only  — convs without BN/relu (isolates normalization cost)
+
+Writes docs/artifacts/se_resnext_block_profile.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from _profile_util import time_grad_steps
+
+PEAK = 197e12
+
+
+def conv(x, w, stride=1, groups=1, k=None):
+    pad = (w.shape[-1] - 1) // 2
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=[(pad, pad), (pad, pad)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups)
+
+
+def bn_relu(x, gamma, beta, relu=True):
+    axes = (0, 2, 3)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+    inv = jax.lax.rsqrt(var + 1e-5)
+    bshape = (1, -1, 1, 1)
+    y = (x - mean.reshape(bshape).astype(x.dtype)) * \
+        (inv * gamma).reshape(bshape).astype(x.dtype) + \
+        beta.reshape(bshape).astype(x.dtype)
+    return jnp.maximum(y, 0) if relu else y
+
+
+def se_gate(x, w1, b1, w2, b2):
+    """squeeze-excitation: global pool -> fc(C/r) relu -> fc(C) sigmoid."""
+    s = jnp.mean(x.astype(jnp.float32), axis=(2, 3))        # [B, C]
+    h = jnp.maximum(s @ w1 + b1, 0)
+    g = jax.nn.sigmoid(h @ w2 + b2)
+    return x * g[:, :, None, None].astype(x.dtype)
+
+
+def block(x, p, groups, use_se=True, use_bn=True, stride=1):
+    def maybe_bn(y, g, b, relu):
+        if use_bn:
+            return bn_relu(y, g, b, relu)
+        return jnp.maximum(y, 0) if relu else y
+    h = maybe_bn(conv(x, p["w1"]), p["g1"], p["b1"], True)
+    h = maybe_bn(conv(h, p["w2"], stride=stride, groups=groups),
+                 p["g2"], p["b2"], True)
+    h = maybe_bn(conv(h, p["w3"]), p["g3"], p["b3"], False)
+    if use_se:
+        h = se_gate(h, p["sw1"], p["sb1"], p["sw2"], p["sb2"])
+    return jnp.maximum(h + x, 0)
+
+
+def main():
+    batch = int(os.environ.get("PROF_BATCH", 64))
+    groups = 32
+    rng = np.random.RandomState(0)
+    rows = []
+    # SE-ResNeXt-50 stages: (C_in, width, C_out, HW, blocks); reduction 16
+    for c_in, width, c_out, hw, blocks in [
+            (256, 128, 256, 56, 3), (512, 256, 512, 28, 4),
+            (1024, 512, 1024, 14, 6), (2048, 1024, 2048, 7, 3)]:
+        def w(shape):
+            return jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.04,
+                               jnp.bfloat16)
+        r = c_out // 16
+        p = {"w1": w((width, c_in, 1, 1)),
+             "g1": jnp.ones((width,), jnp.float32),
+             "b1": jnp.zeros((width,), jnp.float32),
+             "w2": w((width, width // groups, 3, 3)),
+             "g2": jnp.ones((width,), jnp.float32),
+             "b2": jnp.zeros((width,), jnp.float32),
+             "w3": w((c_out, width, 1, 1)),
+             "g3": jnp.ones((c_out,), jnp.float32),
+             "b3": jnp.zeros((c_out,), jnp.float32),
+             "sw1": jnp.asarray(rng.randn(c_out, r).astype(np.float32) * .05),
+             "sb1": jnp.zeros((r,), jnp.float32),
+             "sw2": jnp.asarray(rng.randn(r, c_out).astype(np.float32) * .05),
+             "sb2": jnp.zeros((c_out,), jnp.float32)}
+        x = jnp.asarray(rng.rand(batch, c_in, hw, hw).astype(np.float32) - .5,
+                        jnp.bfloat16)
+        entry = {"c_in": c_in, "width": width, "hw": hw, "blocks": blocks}
+        for name, kw in (("block", {}), ("no_se", {"use_se": False}),
+                         ("convs_only", {"use_se": False, "use_bn": False})):
+            args = {"x": x, "p": p}
+            ms = time_grad_steps(lambda a, kw=kw: block(a["x"], a["p"], groups, **kw),
+                         args)
+            entry[f"{name}_ms"] = round(ms, 3)
+        rows.append(entry)
+        print(json.dumps(entry))
+
+    total = sum(r["block_ms"] * r["blocks"] for r in rows)
+    print(json.dumps({"stages_total_ms": round(total, 2), "batch": batch}))
+    out = os.path.join(os.path.dirname(__file__), "..", "docs", "artifacts",
+                       "se_resnext_block_profile.json")
+    with open(os.path.abspath(out), "w") as f:
+        json.dump({"batch": batch, "stages_total_ms": round(total, 2),
+                   "stages": rows}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
