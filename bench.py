@@ -63,27 +63,42 @@ def _as_bf16(a):
 
 
 def _train_loop(main_prog, startup, fetch, feed, steps, unroll=2,
-                timed_windows=3):
+                timed_windows=3, varied_feed_fn=None, varied_steps=16):
     """Compile + run a device-side loop; return (ms/batch, losses).
 
-    The reported losses come from the FIRST window — i.e. from fresh
-    parameter init — so loss_first/loss_last prove training happens rather
-    than showing a post-memorization plateau (VERDICT r2 weak #2).
-    Timing is the MINIMUM over `timed_windows` steady-state windows: the
-    tunneled chip is a shared fabric and a single window can absorb
-    another tenant's burst (observed 49.7 vs 68.6 ms on back-to-back
-    otherwise-idle ResNet runs); the min is the least-contended estimate
-    of true device time."""
+    Losses come from a VARIED-DATA pass at fresh parameter init when
+    `varied_feed_fn(i)` is given (VERDICT r3 weak #4: a single repeated
+    batch proves optimizer mechanics, not learning): `varied_steps`
+    distinct batches run via run_loop(per_step_feeds=True) — one upload,
+    per-step slices — and loss_first/loss_last report THAT pass.
+    Otherwise the first fixed-feed window's losses are reported (fresh
+    init, VERDICT r2 weak #2).
+
+    Timing still uses the fixed feed (identical steady-state compute;
+    varied feeds would only add upload variance): MINIMUM over
+    `timed_windows` windows — the tunneled chip is a shared fabric and a
+    single window can absorb another tenant's burst (observed 49.7 vs
+    68.6 ms back-to-back); the min is the least-contended estimate."""
     import paddle_tpu as pt
     scope = pt.Scope()
     with pt.scope_guard(scope):
         exe = pt.Executor()
         exe.run(startup)
+        losses = None
+        if varied_feed_fn is not None:
+            stacked = collections_stack([varied_feed_fn(i)
+                                         for i in range(varied_steps)])
+            (losses,) = exe.run_loop(main_prog, feed=stacked,
+                                     fetch_list=[fetch],
+                                     n_steps=varied_steps,
+                                     per_step_feeds=True, unroll=1)
         t0 = time.time()
-        (fresh_losses,) = exe.run_loop(main_prog, feed=feed,
-                                       fetch_list=[fetch], n_steps=steps,
-                                       unroll=unroll)
+        (w1_losses,) = exe.run_loop(main_prog, feed=feed,
+                                    fetch_list=[fetch], n_steps=steps,
+                                    unroll=unroll)
         first_s = time.time() - t0
+        if losses is None:
+            losses = w1_losses
         window_s = []
         for _ in range(max(timed_windows, 1)):
             t0 = time.time()
@@ -95,19 +110,41 @@ def _train_loop(main_prog, startup, fetch, feed, steps, unroll=2,
         # the first call = compile + one full execution window; subtract the
         # measured window so compile_s is actual compilation overhead
         compile_s = max(first_s - best, 0.0)
-    return (elapsed * 1000.0, np.asarray(fresh_losses, dtype=np.float32),
-            compile_s)
+    # flatten [steps, 1] fetches: float(arr[0]) on a size-1 ndarray is
+    # deprecated (NumPy 1.25) and will raise once NumPy promotes it
+    return (elapsed * 1000.0,
+            np.asarray(losses, dtype=np.float32).reshape(-1), compile_s)
 
 
-def bench_resnet(on_tpu):
-    """BASELINE config 2 (benchmark/fluid/models/resnet.py), the headline."""
+def collections_stack(feeds):
+    return {k: np.stack([f[k] for f in feeds]) for k in feeds[0]}
+
+
+def _mfu_fields(train_flops, ms, peak, on_tpu):
+    out = {"train_flops_per_batch": float(train_flops)}
+    if on_tpu and ms > 0:
+        out["mfu_pct"] = round(train_flops / (ms / 1000.0) / peak * 100, 2)
+    return out
+
+
+def bench_resnet(on_tpu, peak):
+    """BASELINE config 2 (benchmark/fluid/models/resnet.py), the headline.
+
+    FLOP accounting (round 4): derived from the program IR
+    (utils/flops.py program_train_flops — 2 flops per MAC, the standard
+    MFU convention the transformer configs always used). Rounds 1-3
+    hand-coded 4.089e9/img, which is the published MACs number: the conv
+    configs were UNDERCOUNTING MFU by 2x relative to the LM configs.
+    Program-derived: 7.716 GFLOP/img fwd ≈ 2 x the 3.86-4.09 GMACs
+    literature figure — cross-checked in tests/test_flops_counter.py."""
     import paddle_tpu as pt
     from paddle_tpu.models import resnet
+    from paddle_tpu.utils.flops import program_train_flops
     batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 4))
     image = 224 if on_tpu else 32
     # 300-step windows: the ~1.5 s fixed window cost (dispatch + fetch sync
     # on this fabric) drops from ~15 ms/step at 100 steps to ~5 ms/step
-    # (measured 69.3 -> 59.3 ms/batch, 11.5% -> 13.5% MFU)
+    # (measured 69.3 -> 59.3 ms/batch)
     steps = int(os.environ.get("BENCH_STEPS", 300 if on_tpu else 2))
     dtype = "bfloat16" if on_tpu else "float32"
     main_prog, startup = pt.Program(), pt.Program()
@@ -116,33 +153,47 @@ def bench_resnet(on_tpu):
             data_set="imagenet" if on_tpu else "cifar10", depth=50,
             dtype=dtype, fused_xent=True)
     rng = np.random.RandomState(0)
-    data = rng.rand(batch, 3, image, image).astype("float32")
-    if dtype == "bfloat16":
-        data = _as_bf16(data)
-    feed = {"data": data,
-            "label": rng.randint(0, 10, (batch, 1)).astype("int64")}
-    ms, losses, compile_s = _train_loop(main_prog, startup, avg_cost, feed, steps)
-    # analytic fwd conv+fc flops: resnet50 4.089 GFLOP/img at 224²; train ≈ 3×
-    train_flops = 3.0 * 4.089e9 * (image / 224.0) ** 2 * batch
+
+    def varied(i):
+        # labels are a deterministic function of one pixel, so the loss
+        # can FALL on never-repeated batches (random labels on random
+        # images have no learnable signal beyond the class prior and
+        # diverge/flatline — VERDICT r3 weak #4 wants real learning)
+        vrng = np.random.RandomState(1000 + i)
+        data = vrng.rand(batch, 3, image, image).astype("float32")
+        label = (data[:, 0, 0, 0] * 9.999).astype("int64")
+        return {"data": _as_bf16(data) if dtype == "bfloat16" else data,
+                "label": label.reshape(-1, 1)}
+
+    feed = varied(0)
+    ms, losses, compile_s = _train_loop(main_prog, startup, avg_cost, feed,
+                                        steps, varied_feed_fn=varied)
+    train_flops = program_train_flops(main_prog, batch)
     return {"batch": batch, "image": image, "dtype": dtype, "steps": steps,
             "ms_per_batch": round(ms, 2),
             "examples_per_sec": round(batch / ms * 1000.0, 1),
-            "train_flops_per_batch": train_flops,
             "compile_s": round(compile_s, 1),
-            "loss_first": float(losses[0]), "loss_last": float(losses[-1])}
+            "varied_feeds": True,
+            "loss_first": float(losses[0]), "loss_last": float(losses[-1]),
+            **_mfu_fields(train_flops, ms if on_tpu else 0, peak, on_tpu)}
 
 
 def bench_se_resnext(on_tpu, peak):
-    """SE-ResNeXt-50 — the second model in the BASELINE headline metric
+    """SE-ResNeXt — the second model in the BASELINE headline metric
     ("images/sec/chip + MFU on ResNet-50/SE-ResNeXt").
 
-    Its MFU reads far lower than ResNet-50's: cardinality-32 grouped
-    convolutions put 32x fewer channels per MXU pass at the same HBM
-    traffic, so the model is even deeper into the bandwidth-bound regime
-    (same ceiling class as resnet's — see resnet50_control.json — not a
-    framework loss)."""
+    This is the REFERENCE TEST variant
+    (test_parallel_executor_seresnext.py): its grouped stage runs at
+    2x the standard 32x4d width, so its true cost is 16.92 GFLOP/img fwd
+    (program-derived) — rounds 1-3 benched it against the standard
+    model's 4.25 GMACs, understating MFU ~4x (wrong width AND the MAC
+    convention; see bench_resnet docstring). The round-4 on-chip
+    shootout (docs/artifacts/grouped_conv_profile.json) also showed
+    XLA's native grouped conv is only ~9 ms of this step — the model is
+    simply 2.2x the flops of ResNet-50 at half the batch."""
     import paddle_tpu as pt
     from paddle_tpu.models import se_resnext
+    from paddle_tpu.utils.flops import program_train_flops
     batch = int(os.environ.get("BENCH_BATCH", 64 if on_tpu else 2))
     image = 224 if on_tpu else 32
     steps = int(os.environ.get("BENCH_STEPS", 200 if on_tpu else 2))
@@ -157,47 +208,59 @@ def bench_se_resnext(on_tpu, peak):
                                        momentum=0.9).minimize(avg_cost)
     if on_tpu:
         main_prog.amp_dtype = "bfloat16"
-    rng = np.random.RandomState(0)
-    feed = {"data": rng.rand(batch, 3, image, image).astype("float32"),
-            "label": rng.randint(0, 10, (batch, 1)).astype("int64")}
-    ms, losses, compile_s = _train_loop(main_prog, startup, avg_cost, feed,
-                                        steps)
-    # SE-ResNeXt-50 32x4d fwd ~= 4.25 GFLOP/img at 224^2 (convs + fc; the
-    # SE gates are <0.1%); train ~= 3x fwd — same accounting as resnet's
-    train_flops = 3.0 * 4.25e9 * (image / 224.0) ** 2 * batch
-    mfu = train_flops / (ms / 1000.0) / peak if on_tpu else 0.0
+
+    def varied(i):
+        vrng = np.random.RandomState(2000 + i)
+        data = vrng.rand(batch, 3, image, image).astype("float32")
+        label = (data[:, 0, 0, 0] * 9.999).astype("int64")
+        return {"data": data, "label": label.reshape(-1, 1)}
+
+    ms, losses, compile_s = _train_loop(main_prog, startup, avg_cost,
+                                        varied(0), steps,
+                                        varied_feed_fn=varied)
+    train_flops = program_train_flops(main_prog, batch)
     return {"batch": batch, "image": image, "steps": steps,
             "ms_per_batch": round(ms, 2),
             "examples_per_sec": round(batch / ms * 1000.0, 1),
-            "train_flops_per_batch": train_flops,
-            "mfu_pct": round(mfu * 100, 2),
             "compile_s": round(compile_s, 1),
-            "loss_first": float(losses[0]), "loss_last": float(losses[-1])}
+            "varied_feeds": True,
+            "loss_first": float(losses[0]), "loss_last": float(losses[-1]),
+            **_mfu_fields(train_flops, ms if on_tpu else 0, peak, on_tpu)}
 
 
-def bench_mnist(on_tpu):
+def bench_mnist(on_tpu, peak):
     """BASELINE config 1 (models/mnist.py LeNet)."""
     import paddle_tpu as pt
     from paddle_tpu.models import mnist
+    from paddle_tpu.utils.flops import program_train_flops
     batch = 128
     steps = int(os.environ.get("BENCH_STEPS", 200 if on_tpu else 2))
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):
         avg_cost, _, _, _ = mnist.get_model(batch_size=batch)
-    rng = np.random.RandomState(0)
-    feed = {"pixel": rng.rand(batch, 1, 28, 28).astype("float32"),
-            "label": rng.randint(0, 10, (batch, 1)).astype("int64")}
-    ms, losses, compile_s = _train_loop(main_prog, startup, avg_cost, feed, steps)
+
+    def varied(i):
+        vrng = np.random.RandomState(3000 + i)
+        data = vrng.rand(batch, 1, 28, 28).astype("float32")
+        label = (data[:, 0, 0, 0] * 9.999).astype("int64")
+        return {"pixel": data, "label": label.reshape(-1, 1)}
+
+    ms, losses, compile_s = _train_loop(main_prog, startup, avg_cost,
+                                        varied(0), steps,
+                                        varied_feed_fn=varied)
+    train_flops = program_train_flops(main_prog, batch)
     return {"batch": batch, "steps": steps, "ms_per_batch": round(ms, 2),
             "examples_per_sec": round(batch / ms * 1000.0, 1),
-            "compile_s": round(compile_s, 1),
-            "loss_first": float(losses[0]), "loss_last": float(losses[-1])}
+            "compile_s": round(compile_s, 1), "varied_feeds": True,
+            "loss_first": float(losses[0]), "loss_last": float(losses[-1]),
+            **_mfu_fields(train_flops, ms if on_tpu else 0, peak, on_tpu)}
 
 
-def bench_vgg(on_tpu):
+def bench_vgg(on_tpu, peak):
     """BASELINE config 3 (models/vgg.py VGG-16 CIFAR-10)."""
     import paddle_tpu as pt
     from paddle_tpu.models import vgg
+    from paddle_tpu.utils.flops import program_train_flops
     batch = 128 if on_tpu else 4
     steps = int(os.environ.get("BENCH_STEPS", 100 if on_tpu else 2))
     main_prog, startup = pt.Program(), pt.Program()
@@ -205,43 +268,73 @@ def bench_vgg(on_tpu):
         avg_cost, _, _, _ = vgg.get_model(data_set="cifar10")
     if on_tpu:
         main_prog.amp_dtype = "bfloat16"
-    rng = np.random.RandomState(0)
-    feed = {"data": rng.rand(batch, 3, 32, 32).astype("float32"),
-            "label": rng.randint(0, 10, (batch, 1)).astype("int64")}
-    ms, losses, compile_s = _train_loop(main_prog, startup, avg_cost, feed, steps)
+
+    def varied(i):
+        vrng = np.random.RandomState(4000 + i)
+        data = vrng.rand(batch, 3, 32, 32).astype("float32")
+        label = (data[:, 0, 0, 0] * 9.999).astype("int64")
+        return {"data": data, "label": label.reshape(-1, 1)}
+
+    ms, losses, compile_s = _train_loop(main_prog, startup, avg_cost,
+                                        varied(0), steps,
+                                        varied_feed_fn=varied)
+    train_flops = program_train_flops(main_prog, batch)
     return {"batch": batch, "steps": steps, "ms_per_batch": round(ms, 2),
             "examples_per_sec": round(batch / ms * 1000.0, 1),
-            "compile_s": round(compile_s, 1),
-            "loss_first": float(losses[0]), "loss_last": float(losses[-1])}
+            "compile_s": round(compile_s, 1), "varied_feeds": True,
+            "loss_first": float(losses[0]), "loss_last": float(losses[-1]),
+            **_mfu_fields(train_flops, ms if on_tpu else 0, peak, on_tpu)}
 
 
-def bench_lstm(on_tpu):
+def bench_lstm(on_tpu, peak):
     """BASELINE config 4 (models/stacked_dynamic_lstm.py, IMDB-like).
 
     Reference published number: 2×LSTM h512 text classification bs64
-    seq~100 → 184 ms/batch on K40m (benchmark/README.md:110-120)."""
+    seq~100 → 184 ms/batch on K40m (benchmark/README.md:110-120).
+
+    FLOPs (2/MAC, recurrent ops live in a scan sub-block so the program
+    counter cannot see them — explicit formula): per token, tanh-fc
+    2·E·H + input proj 2·H·4H + recurrent proj 2·H·4H; train 3x."""
     import paddle_tpu as pt
     from paddle_tpu.models import stacked_dynamic_lstm as sdl
     batch, seqlen = (64, 100) if on_tpu else (4, 8)
+    emb, hid = 512, 512
     steps = int(os.environ.get("BENCH_STEPS", 100 if on_tpu else 2))
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):
-        loss, _, _, _ = sdl.get_model(dict_size=30000, lstm_size=512,
+        loss, _, _, _ = sdl.get_model(dict_size=30000, lstm_size=hid,
                                       use_fused=True)
-    rng = np.random.RandomState(0)
-    feed = {"words": rng.randint(0, 30000, (batch, seqlen)).astype("int64"),
-            "label": rng.randint(0, 2, (batch, 1)).astype("int64")}
-    ms, losses, compile_s = _train_loop(main_prog, startup, loss, feed, steps)
+
+    def varied(i):
+        vrng = np.random.RandomState(5000 + i)
+        words = vrng.randint(0, 30000, (batch, seqlen)).astype("int64")
+        # learnable: the FIRST word's parity (sum-parity over 100 tokens
+        # is not learnable in a 64-step probe)
+        label = (words[:, :1] % 2).astype("int64")
+        return {"words": words, "label": label}
+
+    ms, losses, compile_s = _train_loop(main_prog, startup, loss, varied(0),
+                                        steps, varied_feed_fn=varied,
+                                        varied_steps=64)
+    per_tok = 2 * emb * hid + 2 * hid * 4 * hid + 2 * hid * 4 * hid
+    train_flops = 3.0 * per_tok * batch * seqlen
     return {"batch": batch, "seq_len": seqlen, "steps": steps,
             "ms_per_batch": round(ms, 2),
             "examples_per_sec": round(batch / ms * 1000.0, 1),
-            "compile_s": round(compile_s, 1),
+            "compile_s": round(compile_s, 1), "varied_feeds": True,
             "loss_first": float(losses[0]), "loss_last": float(losses[-1]),
-            "ref_k40m_ms_per_batch": 184}
+            "ref_k40m_ms_per_batch": 184,
+            **_mfu_fields(train_flops, ms if on_tpu else 0, peak, on_tpu)}
 
 
-def bench_machine_translation(on_tpu):
-    """BASELINE config 5 (models/machine_translation.py seq2seq+attention)."""
+def bench_machine_translation(on_tpu, peak):
+    """BASELINE config 5 (models/machine_translation.py seq2seq+attention).
+
+    FLOPs (2/MAC, recurrence in sub-blocks — explicit formula): per src
+    token (bi-LSTM, both dirs): input proj 2·E·4H·2 + recurrent
+    2·H·4H·2 + encoded fc 2·2H·D; per tgt token: lstm_step gates
+    2·(E+D)·4D + attention state proj 2·D·D + output vocab proj 2·D·V
+    (dominant); train 3x."""
     import paddle_tpu as pt
     from paddle_tpu.models import machine_translation as mt
     batch, seqlen = (64, 30) if on_tpu else (4, 6)
@@ -252,21 +345,38 @@ def bench_machine_translation(on_tpu):
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):
         avg_cost, _, feeds = mt.train_net(**dims)
-    rng = np.random.RandomState(0)
     vocab = dims["source_dict_dim"]
-    feed = {"source_sequence": rng.randint(1, vocab, (batch, seqlen)).astype("int64"),
-            "target_sequence": rng.randint(1, vocab, (batch, seqlen)).astype("int64"),
-            "label_sequence": rng.randint(1, vocab, (batch, seqlen)).astype("int64")}
-    ms, losses, compile_s = _train_loop(main_prog, startup, avg_cost, feed, steps)
+
+    def varied(i):
+        # a learnable toy mapping: target/label = source shifted one
+        # step (the attention decoder can learn the copy-shift rule)
+        vrng = np.random.RandomState(6000 + i)
+        src = vrng.randint(1, vocab, (batch, seqlen)).astype("int64")
+        tgt = np.roll(src, 1, axis=1)
+        return {"source_sequence": src, "target_sequence": tgt,
+                "label_sequence": np.roll(src, -1, axis=1)}
+
+    ms, losses, compile_s = _train_loop(main_prog, startup, avg_cost,
+                                        varied(0), steps,
+                                        varied_feed_fn=varied,
+                                        varied_steps=64)
+    e = dims.get("embedding_dim", 512)
+    h = dims.get("encoder_size", 512)
+    d = dims.get("decoder_size", 512)
+    v = dims["target_dict_dim"]
+    per_src = 2 * e * 4 * h * 2 + 2 * h * 4 * h * 2 + 2 * (2 * h) * d
+    per_tgt = 2 * (e + d) * 4 * d + 2 * d * d + 2 * d * v
+    train_flops = 3.0 * batch * seqlen * (per_src + per_tgt)
     return {"batch": batch, "seq_len": seqlen, "steps": steps,
             "ms_per_batch": round(ms, 2),
             "examples_per_sec": round(batch / ms * 1000.0, 1),
-            "compile_s": round(compile_s, 1),
-            "loss_first": float(losses[0]), "loss_last": float(losses[-1])}
+            "compile_s": round(compile_s, 1), "varied_feeds": True,
+            "loss_first": float(losses[0]), "loss_last": float(losses[-1]),
+            **_mfu_fields(train_flops, ms if on_tpu else 0, peak, on_tpu)}
 
 
 def _lm_bench(on_tpu, peak, batch, seqlen, d_model, n_layers, n_heads,
-              d_ff, vocab, steps, remat):
+              d_ff, vocab, steps, remat, varied_steps=32):
     """Shared transformer-LM measurement: build, (optionally remat), train
     via the device-side loop, and report analytic-MFU numbers. One FLOP
     formula for both LM configs so the accounting cannot drift."""
@@ -282,10 +392,18 @@ def _lm_bench(on_tpu, peak, batch, seqlen, d_model, n_layers, n_heads,
         opt.minimize(avg)
     if on_tpu:
         main_prog.amp_dtype = "bfloat16"
-    rng = np.random.RandomState(0)
-    feed = {"src_ids": rng.randint(0, vocab, (batch, seqlen)).astype("int64"),
-            "tgt_ids": rng.randint(0, vocab, (batch, seqlen, 1)).astype("int64")}
-    ms, losses, compile_s = _train_loop(main_prog, startup, avg, feed, steps)
+
+    def varied(i):
+        # next-token = current token (the trivially learnable LM copy
+        # rule): loss falls on fresh batches instead of flatlining on
+        # unlearnable random targets
+        vrng = np.random.RandomState(7000 + i)
+        src = vrng.randint(0, vocab, (batch, seqlen)).astype("int64")
+        return {"src_ids": src, "tgt_ids": src[..., None]}
+
+    ms, losses, compile_s = _train_loop(main_prog, startup, avg, varied(0),
+                                        steps, varied_feed_fn=varied,
+                                        varied_steps=varied_steps)
     # analytic train flops: per token fwd ~= 2*(4d^2 + 2*d*d_ff)/layer +
     # attention 2*2*S*d/layer + logits 2*d*V; train ~= 3x fwd, and remat
     # re-runs the forward inside backward: ~4x
@@ -306,7 +424,7 @@ def _lm_bench(on_tpu, peak, batch, seqlen, d_model, n_layers, n_heads,
     mfu = 3.0 * per_tok * tokens / (ms / 1000.0) / peak
     hfu = mult * per_tok * tokens / (ms / 1000.0) / peak
     out = {"batch": batch, "seq_len": seqlen, "d_model": d_model,
-           "n_layers": n_layers, "steps": steps,
+           "n_layers": n_layers, "steps": steps, "varied_feeds": True,
            "ms_per_batch": round(ms, 2),
            "tokens_per_sec": round(tokens / ms * 1000.0),
            "mfu_pct": round(mfu * 100, 2),
@@ -369,6 +487,112 @@ def bench_long_context(on_tpu, peak):
                          "full | save_attn | dots")
     remat = True if policy in ("full", "true") else policy
     return _lm_bench(on_tpu, peak, remat=remat, **cfg)
+
+
+def bench_long_context_32k(on_tpu, peak):
+    """32k tokens on ONE chip: Pallas flash fwd+bwd composed with full
+    per-layer remat (VERDICT r4 item #9). Attention is ~67% of the
+    model flops at this length, so the number is mostly the flash
+    kernel's efficiency; block sizes follow the seq-adaptive dispatch
+    (1024 above 4k tokens)."""
+    if on_tpu:
+        cfg = dict(batch=1,
+                   seqlen=int(os.environ.get("BENCH_LC32_SEQ", 32768)),
+                   d_model=2048, n_layers=4, n_heads=16, d_ff=8192,
+                   vocab=32000,
+                   steps=int(os.environ.get("BENCH_LC32_STEPS", 6)))
+    else:
+        cfg = dict(batch=1, seqlen=512, d_model=64, n_layers=2, n_heads=2,
+                   d_ff=128, vocab=500, steps=2)
+    out = _lm_bench(on_tpu, peak, remat=True, varied_steps=4, **cfg)
+    out["remat_policy"] = "full_per_layer"
+    out["flash_block_qk"] = (1024, 1024) if on_tpu else "xla_ref"
+    return out
+
+
+def bench_transpiler_sanity(on_tpu, peak):
+    """Degenerate-mesh rewrite cost (VERDICT r4 item #10): the SAME
+    transformer step, once plain and once through auto-pp
+    (pipeline_transpile, 1 stage) + the sharding transpiler on a
+    1-device mesh, must cost the same on the real chip — multi-chip
+    projections from the dryrun must not ride an unmeasured rewrite
+    penalty."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models.transformer import transformer_lm_loss
+    from paddle_tpu.transpiler import pipeline_transpile
+    if on_tpu:
+        cfg = dict(vocab_size=32000, seq_len=1024, n_layers=6,
+                   d_model=2048, n_heads=8, d_ff=8192, max_len=1024)
+        batch, steps = 8, int(os.environ.get("BENCH_STEPS", 30))
+    else:
+        cfg = dict(vocab_size=200, seq_len=32, n_layers=2, d_model=32,
+                   n_heads=2, d_ff=64, max_len=32)
+        batch, steps = 2, 2
+
+    def build(transpiled):
+        pt.core.program.reset_unique_names()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            avg, _ = transformer_lm_loss(**cfg)
+            if transpiled:
+                pipeline_transpile(main, startup, num_stages=1,
+                                   num_microbatches=1)
+            pt.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(avg)
+        if transpiled:
+            from paddle_tpu.parallel import make_mesh
+            pt.transpiler.transpile(
+                main, mesh=make_mesh({"dp": 1}, devices=jax.devices()[:1]))
+        if on_tpu:
+            main.amp_dtype = "bfloat16"
+        return main, startup, avg
+
+    rng = np.random.RandomState(0)
+    feed = {"src_ids": rng.randint(0, cfg["vocab_size"],
+                                   (batch, cfg["seq_len"])).astype("int64"),
+            "tgt_ids": rng.randint(0, cfg["vocab_size"],
+                                   (batch, cfg["seq_len"], 1)).astype("int64")}
+    # INTERLEAVED two-length windows: (a) two separately-timed runs
+    # differ by up to ±13% from fabric contention alone, and (b) each
+    # window carries a ~1.5 s fixed dispatch+fetch cost that would scale
+    # a real delta by T/(T+C) if not differenced out. So each side runs
+    # at TWO scan lengths, per-step = (T_big - T_small)/(steps - base),
+    # sides alternating within each repetition, min over repetitions.
+    base = max(steps // 6, 1)
+    runs = {}
+    for tag, transpiled in (("plain", False), ("transpiled", True)):
+        main, startup, avg = build(transpiled)
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            (losses,) = exe.run_loop(main, feed=feed, fetch_list=[avg],
+                                     n_steps=steps)  # compile + warm big
+            exe.run_loop(main, feed=feed, fetch_list=[avg], n_steps=base)
+        runs[tag] = (exe, scope, main, avg,
+                     float(np.ravel(np.asarray(losses))[-1]))
+    out = {"batch": batch, "steps": steps}
+    best = {"plain": float("inf"), "transpiled": float("inf")}
+    for _ in range(3):
+        for tag in ("plain", "transpiled"):
+            exe, scope, main, avg, _ = runs[tag]
+            with pt.scope_guard(scope):
+                t0 = time.time()
+                exe.run_loop(main, feed=feed, fetch_list=[avg],
+                             n_steps=base)
+                t_small = time.time() - t0
+                t0 = time.time()
+                exe.run_loop(main, feed=feed, fetch_list=[avg],
+                             n_steps=steps)
+                t_big = time.time() - t0
+            best[tag] = min(best[tag],
+                            max(t_big - t_small, 0.0) / (steps - base))
+    for tag in ("plain", "transpiled"):
+        out[f"{tag}_ms"] = round(best[tag] * 1000.0, 2)
+        out[f"{tag}_loss_last"] = runs[tag][4]
+    out["overhead_pct"] = round(
+        (out["transpiled_ms"] / out["plain_ms"] - 1) * 100, 2)
+    return out
 
 
 def bench_data_pipeline(on_tpu, resnet_result):
@@ -498,25 +722,41 @@ def bench_data_pipeline(on_tpu, resnet_result):
 
 def main():
     import jax
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # the axon TPU plugin force-selects itself regardless of the env
+        # var (see tests/conftest.py); the config knob wins
+        jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
     on_tpu = "tpu" in dev.platform.lower() or "TPU" in dev.device_kind
     peak = peak_flops_per_chip(dev)
     only = [s for s in os.environ.get("BENCH_CONFIGS", "").split(",") if s]
 
     configs = {}
-    table = [("resnet50", lambda: bench_resnet(on_tpu)),
+    table = [("resnet50", lambda: bench_resnet(on_tpu, peak)),
              ("se_resnext50", lambda: bench_se_resnext(on_tpu, peak)),
-             ("mnist", lambda: bench_mnist(on_tpu)),
-             ("vgg16", lambda: bench_vgg(on_tpu)),
-             ("stacked_lstm", lambda: bench_lstm(on_tpu)),
-             ("machine_translation", lambda: bench_machine_translation(on_tpu)),
+             ("mnist", lambda: bench_mnist(on_tpu, peak)),
+             ("vgg16", lambda: bench_vgg(on_tpu, peak)),
+             ("stacked_lstm", lambda: bench_lstm(on_tpu, peak)),
+             ("machine_translation",
+              lambda: bench_machine_translation(on_tpu, peak)),
              ("transformer", lambda: bench_transformer(on_tpu, peak)),
              ("long_context", lambda: bench_long_context(on_tpu, peak)),
+             ("long_context_32k",
+              lambda: bench_long_context_32k(on_tpu, peak)),
+             ("transpiler_sanity",
+              lambda: bench_transpiler_sanity(on_tpu, peak)),
              ("data_pipeline",
               lambda: bench_data_pipeline(on_tpu, configs.get("resnet50")))]
     for name, fn in table:
         if only and name not in only:
             continue
+        # each config tears down its scope, but compiled executables and
+        # lingering buffers otherwise accumulate across 11 configs and the
+        # tail configs hit RESOURCE_EXHAUSTED on the 16 GB chip (observed:
+        # transpiler_sanity + data_pipeline failing after long_context_32k)
+        import gc
+        jax.clear_caches()
+        gc.collect()
         for attempt in (0, 1):
             try:
                 configs[name] = fn()
@@ -534,15 +774,22 @@ def main():
                 time.sleep(5.0)
 
     rn = configs.get("resnet50", {})
-    if "ms_per_batch" in rn:
-        mfu = rn["train_flops_per_batch"] / (rn["ms_per_batch"] / 1000.0) / peak
-    else:
-        mfu = 0.0
+    # reuse the config's own mfu_pct: _mfu_fields suppresses it off-TPU
+    # (the fallback peak constant would make the headline meaningless),
+    # and one formula must not exist in two places
+    mfu = rn.get("mfu_pct", 0.0) / 100.0
     result = {
         "metric": f"resnet50_bs{rn.get('batch', 0)}_{rn.get('image', 0)}px_"
                   f"{rn.get('dtype', '?')}_train_mfu",
         "value": round(mfu * 100, 2),
         "unit": "% MFU",
+        # flop convention: 2 flops/MAC, denominator derived from the
+        # program IR (utils/flops.py) — rounds 1-3 used the published
+        # GMACs figure as "FLOPs" for the conv configs, understating
+        # their MFU 2x vs the LM configs' accounting; the underlying
+        # measured ms_per_batch/images_per_sec are directly comparable
+        # across rounds
+        "flop_convention": "2/MAC, program-derived",
         "vs_baseline": round(mfu / 0.45, 4),
         "images_per_sec": rn.get("examples_per_sec"),
         "ms_per_batch": rn.get("ms_per_batch"),

@@ -31,6 +31,9 @@ from .parallel import ParallelExecutor, BuildStrategy, ExecutionStrategy
 from . import reader
 from .reader import batch  # ≙ top-level paddle.batch (python/paddle/batch.py)
 from . import recordio
+from . import concurrency
+from .concurrency import (make_channel, channel_send, channel_recv,
+                          channel_close)
 from . import dataset
 from . import transpiler
 from .transpiler import DistributeTranspiler, TranspileStrategy

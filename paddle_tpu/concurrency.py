@@ -1,0 +1,265 @@
+"""CSP concurrency: channels / go / select (host control plane).
+
+≙ reference python/paddle/fluid/concurrency.py (Go :27, Select :193,
+make_channel :279, channel_send/recv/close :335-451) and the channel
+runtime in paddle/fluid/framework/channel.h. The reference executed CSP
+constructs on the CPU control plane — go_op ran a sub-block on a C++
+thread, channels were mutex+condvar queues — and its use cases were
+host-side pipelines (producer/consumer feeding, the fibonacci/pingpong
+unit tests).
+
+TPU-native reading: device concurrency belongs to XLA (async collectives
+and overlapped scheduling inside one compiled program — see
+docs/design_decisions.md), so *in-graph* CSP ops are deliberately
+absent. What the reference actually used CSP FOR — concurrent host
+pipelines around the training loop — is served by this module with the
+same API shape, implemented on Python threads:
+
+  * Channel: Go-style bounded channel. capacity=0 is a RENDEZVOUS
+    channel (send blocks until a receiver takes the value — the
+    reference's unbuffered semantics), capacity>0 a bounded buffer.
+  * go(fn, *args): run fn on a daemon thread (≙ go_op). Returns the
+    thread. The reference's `with Go():` captured program ops into a
+    sub-block; a host-side runtime cannot intercept arbitrary Python,
+    so the body is an explicit callable — deviation recorded in
+    PARITY.md row 38.
+  * select(cases, default=None): wait until one case fires, Go-style.
+    Cases are ("send", ch, value) / ("recv", ch); returns
+    (index, value_or_None, ok).
+
+channel_send/channel_recv/channel_close/make_channel are kept as
+API-parity aliases with the reference's status-returning contracts:
+send -> bool (False once closed), recv -> (value, bool).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+__all__ = ["Channel", "make_channel", "channel_send", "channel_recv",
+           "channel_close", "go", "select", "ChannelClosed"]
+
+
+class ChannelClosed(Exception):
+    """Raised by Channel.send on a closed channel (channel_send returns
+    False instead, matching the reference's status output)."""
+
+
+class Channel:
+    """Go-style channel: rendezvous (capacity=0) or bounded buffer."""
+
+    def __init__(self, capacity: int = 0, dtype=None):
+        self.capacity = int(capacity)
+        self.dtype = dtype          # kept for make_channel parity; unchecked
+        self._buf: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+        # rendezvous accounting: number of receivers currently waiting
+        self._recv_waiting = 0
+        self._handoff: deque = deque()   # values passed sender->receiver
+
+    # -- core ---------------------------------------------------------------
+    @staticmethod
+    def _deadline(timeout):
+        import time as _time
+        return None if timeout is None else _time.monotonic() + timeout
+
+    @staticmethod
+    def _remaining(end):
+        """Seconds left until `end` (None = wait forever); <= 0 is up.
+        A fresh full `timeout` per condition wakeup would let a starved
+        waiter block forever under contention — waits use the remainder."""
+        if end is None:
+            return None
+        import time as _time
+        return end - _time.monotonic()
+
+    def send(self, value, timeout: Optional[float] = None) -> None:
+        """Block until a receiver takes the value (capacity 0) or buffer
+        space exists. Raises ChannelClosed if the channel is (or becomes)
+        closed before the value is delivered."""
+        end = self._deadline(timeout)
+        with self._lock:
+            if self._closed:
+                raise ChannelClosed
+            if self.capacity > 0:
+                while len(self._buf) >= self.capacity:
+                    rem = self._remaining(end)
+                    if rem is not None and rem <= 0:
+                        raise TimeoutError("channel send timed out")
+                    if not self._not_full.wait(rem):
+                        raise TimeoutError("channel send timed out")
+                    if self._closed:
+                        raise ChannelClosed
+                self._buf.append(value)
+                self._not_empty.notify()
+                return
+            # rendezvous: hand the value to a receiver via a unique cell
+            # (identity-tracked — two senders may send EQUAL values)
+            cell = [value]
+            self._handoff.append(cell)
+            self._not_empty.notify()
+
+            def pending():
+                return any(c is cell for c in self._handoff)
+
+            while pending():
+                rem = self._remaining(end)
+                timed_out = (rem is not None and rem <= 0) or \
+                    not self._not_full.wait(rem)
+                if timed_out:
+                    if pending():
+                        self._handoff.remove(cell)
+                        raise TimeoutError("channel send timed out")
+                    return  # taken right at the deadline
+                if self._closed and pending():
+                    self._handoff.remove(cell)
+                    raise ChannelClosed
+
+    def recv(self, timeout: Optional[float] = None) -> Tuple[Any, bool]:
+        """Returns (value, True), or (None, False) when the channel is
+        closed and drained (the reference's status output contract)."""
+        end = self._deadline(timeout)
+        with self._lock:
+            while True:
+                if self._buf:
+                    v = self._buf.popleft()
+                    self._not_full.notify()
+                    return v, True
+                if self._handoff:
+                    cell = self._handoff.popleft()
+                    self._not_full.notify_all()
+                    return cell[0], True
+                if self._closed:
+                    return None, False
+                rem = self._remaining(end)
+                if rem is not None and rem <= 0:
+                    raise TimeoutError("channel recv timed out")
+                self._recv_waiting += 1
+                try:
+                    if not self._not_empty.wait(rem):
+                        raise TimeoutError("channel recv timed out")
+                finally:
+                    self._recv_waiting -= 1
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    # -- introspection (select uses these under the lock) -------------------
+    def _can_recv(self) -> bool:
+        return bool(self._buf or self._handoff or self._closed)
+
+    def _can_send(self) -> bool:
+        if self._closed:
+            return True  # a send would complete (by raising/failing) now
+        if self.capacity > 0:
+            return len(self._buf) < self.capacity
+        return self._recv_waiting > 0
+
+    def __len__(self):
+        with self._lock:
+            return len(self._buf) + len(self._handoff)
+
+
+# -- reference-API wrappers -------------------------------------------------
+
+def make_channel(dtype=None, capacity: int = 0) -> Channel:
+    """≙ fluid.make_channel (concurrency.py:279)."""
+    return Channel(capacity=capacity, dtype=dtype)
+
+
+def channel_send(channel: Channel, value, is_copy: bool = False) -> bool:
+    """≙ fluid.channel_send (:335): returns success status."""
+    if is_copy:
+        import copy as _copy
+        value = _copy.deepcopy(value)
+    try:
+        channel.send(value)
+        return True
+    except ChannelClosed:
+        return False
+
+
+def channel_recv(channel: Channel,
+                 return_value=None) -> Tuple[Any, bool]:
+    """≙ fluid.channel_recv (:385): (value, status). `return_value` is
+    the reference's output-var slot; returned as-is when closed."""
+    v, ok = channel.recv()
+    return (v if ok else return_value), ok
+
+
+def channel_close(channel: Channel) -> None:
+    """≙ fluid.channel_close (:429)."""
+    channel.close()
+
+
+def go(fn: Callable, *args, **kwargs) -> threading.Thread:
+    """≙ the Go block (concurrency.py:27 / go_op): run `fn` concurrently
+    on a daemon thread. Exceptions propagate on .join() via re-raise."""
+    box = {}
+
+    def runner():
+        try:
+            box["result"] = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — surfaced in join_go
+            box["error"] = e
+
+    t = threading.Thread(target=runner, daemon=True)
+    t._csp_box = box  # type: ignore[attr-defined]
+    t.start()
+    return t
+
+
+def join_go(thread: threading.Thread, timeout: Optional[float] = None):
+    """Join a go() thread; re-raises its exception, returns its result."""
+    thread.join(timeout)
+    box = getattr(thread, "_csp_box", {})
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
+def select(cases: Sequence[tuple], default: bool = False,
+           poll_interval: float = 0.001,
+           timeout: Optional[float] = None):
+    """≙ fluid.Select (:193): wait until one case can proceed and run it.
+
+    cases: ("send", channel, value) or ("recv", channel) tuples.
+    Returns (case_index, value, ok): for recv cases `value` is the
+    received value; for send cases None. With default=True, returns
+    (-1, None, False) immediately when no case is ready (Go's default
+    branch)."""
+    import time as _time
+    end = None if timeout is None else _time.monotonic() + timeout
+    while True:
+        for i, case in enumerate(cases):
+            kind, ch = case[0], case[1]
+            # readiness checks race with other threads; the short-timeout
+            # retry keeps select from blocking on a case another consumer
+            # won
+            if kind == "recv" and ch._can_recv():
+                try:
+                    v, ok = ch.recv(timeout=poll_interval)
+                except TimeoutError:
+                    continue
+                return i, v, ok
+            if kind == "send" and ch._can_send():
+                try:
+                    ch.send(case[2], timeout=poll_interval)
+                except ChannelClosed:
+                    return i, None, False
+                except TimeoutError:
+                    continue
+                return i, None, True
+        if default:
+            return -1, None, False
+        if end is not None and _time.monotonic() >= end:
+            raise TimeoutError("select timed out")
+        _time.sleep(poll_interval)

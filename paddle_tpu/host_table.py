@@ -207,20 +207,33 @@ class HostEmbeddingTable:
         global union — every process must call prepare() collectively."""
         ids = np.asarray(ids)
         uniq, inv = np.unique(ids, return_inverse=True)
-        if uniq.size > self.capacity:
-            # checked BEFORE the collective: a post-allgather error would
-            # leave the peers hanging in process_allgather
+        overflow = uniq.size > self.capacity
+        if overflow and not (self.distributed and self.nprocs > 1):
             raise ValueError(
                 f"host table {self.name!r}: batch touches {uniq.size} "
                 f"distinct ids > capacity {self.capacity}; raise capacity "
                 "or shrink the batch")
         if self.distributed and self.nprocs > 1:
             from jax.experimental import multihost_utils
-            mine = np.full((self.capacity,), -1, np.int64)
-            mine[:uniq.size] = uniq
+            # an overflowing rank must still ENTER the collective (its
+            # peers are already blocked in process_allgather — raising
+            # before it would hang the job); ship the overflow flag
+            # through the gather so EVERY rank raises the same error
+            mine = np.full((self.capacity + 1,), -1, np.int64)
+            mine[0] = uniq.size
+            mine[1:1 + min(uniq.size, self.capacity)] = \
+                uniq[:self.capacity]
             everyone = np.asarray(
                 multihost_utils.process_allgather(mine, tiled=False))
-            guniq = np.unique(everyone[everyone >= 0])
+            counts = everyone[:, 0]
+            if (counts > self.capacity).any():
+                bad = int(np.argmax(counts))
+                raise ValueError(
+                    f"host table {self.name!r}: process {bad}'s batch "
+                    f"touches {int(counts[bad])} distinct ids > capacity "
+                    f"{self.capacity}; raise capacity or shrink the batch")
+            body = everyone[:, 1:]
+            guniq = np.unique(body[body >= 0])
         else:
             guniq = uniq
         if guniq.size > self.capacity:

@@ -502,17 +502,70 @@ def cross_entropy(ctx, ins, attrs):
     return {"Y": [loss]}
 
 
+@jax.custom_vjp
+def _softmax_xent_hard(logits, lbl):
+    """Numerically-stable hard-label softmax cross-entropy with a
+    memory-lean hand-written VJP.
+
+    Default AD of log_softmax keeps an f32 copy of the FULL logits (and
+    builds dlogits through a scatter-add into another full f32 array) —
+    at 32k tokens x 32k vocab that is 2 x 3.9 GB of HLO temps, the
+    allocations that OOM'd the long_context_32k config on a 16 GB chip.
+    This VJP saves only the bf16 logits (alive anyway as the projection
+    output) + the [*, 1] logsumexp, and computes
+    dlogits = (softmax - onehot) * g with the onehot expressed as an
+    iota==label compare (fuses; no scatter, no f32 temp)."""
+    loss, _ = _softmax_xent_hard_fwd(logits, lbl)
+    return loss
+
+
+def _softmax_xent_hard_fwd(logits, lbl):
+    lf = logits.astype(jnp.float32)
+    m = jnp.max(lf, axis=-1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(lf - m), axis=-1, keepdims=True))
+    picked = jnp.take_along_axis(lf, lbl[..., None].astype(jnp.int32),
+                                 axis=-1)
+    return lse - picked, (logits, lbl, lse)
+
+
+def _softmax_xent_hard_bwd(res, g):
+    logits, lbl, lse = res
+    lf = logits.astype(jnp.float32)
+    p = jnp.exp(lf - lse)
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, logits.shape,
+                                       logits.ndim - 1)
+              == lbl[..., None].astype(jnp.int32))
+    dl = (p - onehot.astype(jnp.float32)) * g
+    return (dl.astype(logits.dtype),
+            np.zeros(lbl.shape, jax.dtypes.float0))
+
+
+_softmax_xent_hard.defvjp(_softmax_xent_hard_fwd, _softmax_xent_hard_bwd)
+
+
 @register_op("softmax_with_cross_entropy", infer_shape=_xent_infer)
 def softmax_with_cross_entropy(ctx, ins, attrs):
-    """softmax_with_cross_entropy_op.cu: numerically-stable fused version."""
+    """softmax_with_cross_entropy_op.cu: numerically-stable fused version.
+    Hard labels route through the memory-lean custom VJP (see
+    _softmax_xent_hard; PT_XENT_PLAIN=1 restores default AD for A/B)."""
     logits, label = ins["Logits"][0], ins["Label"][0]
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     if attrs.get("soft_label", False):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
-    else:
-        lbl = label.reshape(label.shape[:-1]) if label.shape[-1:] == (1,) else label
-        loss = -jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32), axis=-1)
-    return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
+        return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
+    lbl = label.reshape(label.shape[:-1]) if label.shape[-1:] == (1,) \
+        else label
+    if os.environ.get("PT_XENT_PLAIN"):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        loss = -jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32),
+                                    axis=-1)
+        return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
+    loss = _softmax_xent_hard(logits, lbl)
+    # the Softmax side-output is DCE'd when unused; stop_gradient keeps it
+    # off the AD path so consuming it costs fwd memory only
+    soft = jax.lax.stop_gradient(
+        jax.nn.softmax(logits.astype(jnp.float32), axis=-1))
+    return {"Loss": [loss], "Softmax": [soft]}
 
 
 @register_op("sigmoid_cross_entropy_with_logits", infer_shape=same_shape())

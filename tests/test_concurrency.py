@@ -1,0 +1,148 @@
+"""CSP concurrency (channels / go / select) — host control plane.
+
+≙ reference tests test_csp / notest_concurrency (fibonacci through an
+unbuffered channel inside a Go block, concurrency.py:27-451) — the same
+programs, on this runtime's host-side CSP module."""
+
+import time
+
+import pytest
+
+from paddle_tpu.concurrency import (Channel, ChannelClosed, channel_close,
+                                    channel_recv, channel_send, go, join_go,
+                                    make_channel, select)
+
+
+class TestChannels:
+    def test_fibonacci_rendezvous(self):
+        """The reference's canonical CSP demo: a goroutine streams fib
+        numbers through an UNBUFFERED channel; main pulls ten."""
+        ch = make_channel(capacity=0)
+        quit_ch = make_channel(capacity=0)
+
+        def fib():
+            a, b = 0, 1
+            while True:
+                idx, _, ok = select([("send", ch, a), ("recv", quit_ch)])
+                if idx == 1:  # quit signal
+                    return
+                a, b = b, a + b
+
+        t = go(fib)
+        got = [channel_recv(ch)[0] for _ in range(10)]
+        channel_send(quit_ch, None)
+        join_go(t, timeout=10)
+        assert got == [0, 1, 1, 2, 3, 5, 8, 13, 21, 34]
+
+    def test_buffered_producer_consumer(self):
+        ch = make_channel(capacity=4)
+        n = 50
+
+        def produce():
+            for i in range(n):
+                assert channel_send(ch, i)
+            channel_close(ch)
+
+        t = go(produce)
+        out = []
+        while True:
+            v, ok = channel_recv(ch)
+            if not ok:
+                break
+            out.append(v)
+        join_go(t, timeout=10)
+        assert out == list(range(n))
+
+    def test_recv_on_closed_drains_then_fails(self):
+        ch = make_channel(capacity=3)
+        channel_send(ch, 1)
+        channel_send(ch, 2)
+        channel_close(ch)
+        assert channel_recv(ch) == (1, True)
+        assert channel_recv(ch) == (2, True)
+        v, ok = channel_recv(ch, return_value="sentinel")
+        assert (v, ok) == ("sentinel", False)
+
+    def test_send_on_closed_reports_failure(self):
+        ch = make_channel(capacity=1)
+        channel_close(ch)
+        assert channel_send(ch, 9) is False
+        with pytest.raises(ChannelClosed):
+            ch.send(9)
+
+    def test_rendezvous_blocks_until_taken(self):
+        ch = make_channel(capacity=0)
+        order = []
+
+        def sender():
+            order.append("send-start")
+            ch.send("x")
+            order.append("send-done")
+
+        t = go(sender)
+        time.sleep(0.05)          # sender must still be parked
+        assert order == ["send-start"]
+        v, ok = ch.recv()
+        join_go(t, timeout=10)
+        assert (v, ok) == ("x", True)
+        assert order == ["send-start", "send-done"]
+
+    def test_equal_values_from_two_senders(self):
+        """Identity-tracked handoff: two senders of EQUAL values must both
+        complete exactly once."""
+        ch = make_channel(capacity=0)
+        t1 = go(ch.send, 7)
+        t2 = go(ch.send, 7)
+        got = [ch.recv()[0], ch.recv()[0]]
+        join_go(t1, timeout=10)
+        join_go(t2, timeout=10)
+        assert got == [7, 7]
+
+
+class TestSelectAndGo:
+    def test_select_default_when_nothing_ready(self):
+        ch = make_channel(capacity=0)
+        assert select([("recv", ch)], default=True) == (-1, None, False)
+
+    def test_select_prefers_ready_case(self):
+        a = make_channel(capacity=1)
+        b = make_channel(capacity=1)
+        channel_send(b, "beta")
+        idx, v, ok = select([("recv", a), ("recv", b)], timeout=5)
+        assert (idx, v, ok) == (1, "beta", True)
+
+    def test_select_send_case(self):
+        ch = make_channel(capacity=1)
+        idx, v, ok = select([("send", ch, 42)], timeout=5)
+        assert (idx, ok) == (0, True)
+        assert channel_recv(ch) == (42, True)
+
+    def test_go_exception_propagates_on_join(self):
+        def boom():
+            raise ValueError("csp")
+        t = go(boom)
+        with pytest.raises(ValueError, match="csp"):
+            join_go(t, timeout=10)
+
+    def test_pingpong_pipeline(self):
+        """≙ the reference's pingpong test: a token bounces through a
+        two-channel loop N times."""
+        ping = make_channel(capacity=0)
+        pong = make_channel(capacity=0)
+
+        def player():
+            while True:
+                v, ok = ping.recv()
+                if not ok:
+                    return
+                pong.send(v + 1)
+
+        t = go(player)
+        v = 0
+        for _ in range(20):
+            ping.send(v)
+            v, ok = pong.recv()
+            assert ok
+        ping.close()
+        join_go(t, timeout=10)
+        assert v == 20

@@ -1,0 +1,68 @@
+"""Program-derived FLOP counting (utils/flops.py) — the MFU denominator.
+
+Cross-checks against hand-computed values so the bench's efficiency
+numbers cannot drift from the convention (2 flops per MAC, forward
+matmul-class work only)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.utils.flops import (program_forward_flops,
+                                    program_train_flops)
+
+
+def test_conv_and_fc_counts_exact():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [3, 16, 16])
+        c = layers.conv2d(x, num_filters=8, filter_size=3, padding=1)
+        # grouped conv: per-output-channel K = (Cin/g)*k*k
+        g = layers.conv2d(c, num_filters=8, filter_size=3, padding=1,
+                          groups=4)
+        p = layers.pool2d(g, pool_size=16, pool_type="avg",
+                          global_pooling=True)
+        layers.fc(p, size=10)
+    f = program_forward_flops(main, batch=2)
+    conv1 = 2 * 2 * 8 * 16 * 16 * 3 * 3 * 3          # 2*N*Cout*HW*Cin*k²
+    conv2 = 2 * 2 * 8 * 16 * 16 * 2 * 3 * 3          # Cin/g = 2
+    fc = 2 * 2 * 8 * 10
+    assert f == conv1 + conv2 + fc, (f, conv1, conv2, fc)
+    assert program_train_flops(main, batch=2) == 3 * f
+
+
+def test_resnet50_matches_published_gmacs_x2():
+    """ResNet-50 at 224² is 3.86-4.09 GMACs in the literature; at
+    2 flops/MAC the counter must land in [7.6, 8.4] GFLOP/img."""
+    from paddle_tpu.models import resnet
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        resnet.get_model(data_set="imagenet", depth=50, dtype="float32",
+                         fused_xent=True)
+    f = program_forward_flops(main, batch=1)
+    assert 7.6e9 < f < 8.4e9, f
+
+
+def test_matmul_and_attention_ops_counted():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        a = layers.data("a", [4, 8])
+        b = layers.data("b", [8, 5])
+        layers.matmul(a, b)
+    f = program_forward_flops(main, batch=3)
+    assert f == 2 * 3 * 4 * 5 * 8, f
+
+
+def test_optimizer_suffix_not_counted():
+    """Ops after the autodiff marker (optimizer updates) are not forward
+    work; minimize() must not change the count."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8])
+        y = layers.data("y", [1])
+        p = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(input=p, label=y))
+    before = program_forward_flops(main, batch=4)
+    with pt.program_guard(main, startup):
+        pt.optimizer.AdamOptimizer(learning_rate=0.1).minimize(loss)
+    assert program_forward_flops(main, batch=4) == before
