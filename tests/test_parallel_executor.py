@@ -143,6 +143,53 @@ class TestRunLoopComposes:
         # progress itself is covered by the loss-falling trainer test)
         np.testing.assert_allclose(per, np.ravel(stacked), rtol=2e-4)
 
+    def test_sp_ring_window_matches_per_step(self):
+        """The windowed fast path must also compose with shard_map-based
+        sequence parallelism (lax.scan OVER the ring-attention step)."""
+        from paddle_tpu.models.transformer import transformer_lm_loss
+
+        def build():
+            main, startup = pt.Program(), pt.Program()
+            main.random_seed = 13
+            with pt.program_guard(main, startup):
+                avg, _ = transformer_lm_loss(vocab_size=64, seq_len=32,
+                                             n_layers=1, d_model=32,
+                                             n_heads=4, d_ff=64)
+                pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(avg)
+            mesh = make_mesh({"dp": 2, "sp": 4})
+            pt.transpiler.transpile(
+                main, mesh=mesh,
+                strategy=pt.TranspileStrategy(sp_mode="ring"))
+            return main, startup, avg, mesh
+
+        drng = np.random.RandomState(1)
+        feeds = []
+        for _ in range(4):
+            ids = drng.randint(0, 64, (4, 32)).astype(np.int64)
+            feeds.append({"src_ids": ids,
+                          "tgt_ids": np.roll(ids, -1, 1).reshape(4, 32, 1)})
+
+        main, startup, avg, mesh = build()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            pt.Executor().run(startup)
+            pe = ParallelExecutor(loss_name=avg.name, main_program=main,
+                                  mesh=mesh, scope=scope)
+            per = [float(np.ravel(pe.run([avg], feed=f)[0])[0])
+                   for f in feeds]
+
+        pt.core.program.reset_unique_names()
+        main, startup, avg, mesh = build()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            pt.Executor().run(startup)
+            pe = ParallelExecutor(loss_name=avg.name, main_program=main,
+                                  mesh=mesh, scope=scope)
+            window = {k: np.stack([f[k] for f in feeds]) for k in feeds[0]}
+            (stacked,) = pe.run_loop([avg], feed=window, n_steps=4,
+                                     per_step_feeds=True)
+        np.testing.assert_allclose(per, np.ravel(stacked), rtol=2e-4)
+
     def test_trainer_uses_loop_under_parallel(self, rng, tmp_path):
         """Trainer(parallel=True) + steps_per_loop>1 goes through
         PE.run_loop (the old warn-and-fall-back path is gone) and the
