@@ -27,11 +27,16 @@ Measurement notes (evidence gathered on the v5e-via-tunnel rig, round 2):
     cost-analysis totals) shows each bottleneck stage within 1.1-1.4x of
     the op-formulation's bandwidth floor, and a perfect fused
     conv+BN+relu kernel chain (activation written once, read once) would
-    floor at ~33 ms ≈ 24% MFU: the headline number is the model's
-    arithmetic intensity at 224px/bf16, not framework overhead. The
-    compute-bound MFU story is the transformer + long-context configs
-    below (57.3% at bs8 / 56.0% MFU measured on the same chip with the
-    Pallas flash forward+backward — past the 45% bar).
+    floor at ~32 ms: the headline number is the model's arithmetic
+    intensity at 224px/bf16, not framework overhead. Round-4 numbers
+    (2 flops/MAC program-derived accounting; committed run =
+    docs/artifacts/bench_r04_preview.json, best observed across the
+    round's runs in parentheses): ResNet-50 52.6 ms ≈ 28.6% MFU
+    (best 48.8 ms ≈ 30.9%) with falling varied-data loss; transformer
+    60.4% (60.9) MFU at bs8; 8k 55.7% MFU / 71.3% HFU; 32k 62.9% MFU /
+    82.2% HFU — all on the same chip with the Pallas flash
+    forward+backward. Spread between runs is tunnel contention; each
+    run's min-of-3 windows bounds it within, not across, runs.
 """
 
 from __future__ import annotations
@@ -549,8 +554,18 @@ def bench_transpiler_sanity(on_tpu, peak):
     from paddle_tpu.models.transformer import transformer_lm_loss
     from paddle_tpu.transpiler import pipeline_transpile
     if on_tpu:
-        cfg = dict(vocab_size=32000, seq_len=1024, n_layers=6,
-                   d_model=2048, n_heads=8, d_ff=8192, max_len=1024)
+        # HALF-SIZE transformer: the check holds BOTH programs (plain +
+        # transpiled, each with adam state) resident to interleave their
+        # windows — two 6L/2048/8192 instances alone exceed the 16 GB
+        # chip. The rewrite-cost RATIO is what matters and it is
+        # scale-independent (same transpiler machinery per op).
+        cfg = dict(vocab_size=int(os.environ.get("BENCH_TS_VOCAB", 32000)),
+                   seq_len=1024,
+                   n_layers=int(os.environ.get("BENCH_TS_LAYERS", 4)),
+                   d_model=int(os.environ.get("BENCH_TS_DMODEL", 1024)),
+                   n_heads=8,
+                   d_ff=int(os.environ.get("BENCH_TS_DFF", 4096)),
+                   max_len=1024)
         batch, steps = 8, int(os.environ.get("BENCH_STEPS", 30))
     else:
         cfg = dict(vocab_size=200, seq_len=32, n_layers=2, d_model=32,
