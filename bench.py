@@ -32,11 +32,13 @@ Measurement notes (evidence gathered on the v5e-via-tunnel rig, round 2):
     (2 flops/MAC program-derived accounting; committed run =
     docs/artifacts/bench_r04_preview.json, best observed across the
     round's runs in parentheses): ResNet-50 52.6 ms ≈ 28.6% MFU
-    (best 48.8 ms ≈ 30.9%) with falling varied-data loss; transformer
-    60.4% (60.9) MFU at bs8; 8k 55.7% MFU / 71.3% HFU; 32k 62.9% MFU /
-    82.2% HFU — all on the same chip with the Pallas flash
-    forward+backward. Spread between runs is tunnel contention; each
-    run's min-of-3 windows bounds it within, not across, runs.
+    (best 48.8 ms ≈ 30.9%) with falling varied-data loss; SE-ResNeXt
+    57.2 ms ≈ 28.9% MFU (the grouped-conv dense-expansion rule, was
+    72-86 ms); transformer 60.4-60.9% MFU at bs8; 8k 55.9% MFU / 71.4%
+    HFU; 32k 63.2% MFU / 82.5% HFU — all on the same chip with the
+    Pallas flash forward+backward. Spread between runs is tunnel
+    contention; each run's min-of-3 windows bounds it within, not
+    across, runs.
 """
 
 from __future__ import annotations

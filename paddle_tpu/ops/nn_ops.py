@@ -158,12 +158,55 @@ def _harmonize_w(x, w):
     return harmonize(x, w)
 
 
+def _dense_expand_grouped(w, groups):
+    """[C_out, Cg, kh, kw] grouped filter -> [C_out, C_in, kh, kw] dense
+    with zeros off the block diagonal, via a constant one-hot placement
+    einsum (AD routes dW straight back to the grouped filter and the
+    zeros contribute nothing)."""
+    c_out, cg = int(w.shape[0]), int(w.shape[1])
+    c_in = cg * groups
+    out_per_group = c_out // groups
+    place = np.zeros((c_out, cg, c_in), np.float32)
+    for o in range(c_out):
+        base = (o // out_per_group) * cg
+        place[o, np.arange(cg), base + np.arange(cg)] = 1
+    return jnp.einsum("ocyx,oci->oiyx", w,
+                      jnp.asarray(place, w.dtype))
+
+
+def _gconv_prefers_dense(x, w, groups) -> bool:
+    """XLA's native grouped-conv lowering loses to a dense conv over
+    block-diagonal-expanded weights exactly in the large-spatial /
+    tiny-group regime (measured on the v5e, fwd+bwd per shape —
+    docs/artifacts/grouped_conv_profile.json: C=128@56²/Cg=4 native
+    1.78 ms vs dense 0.93; at 28² and below native wins by 2-10x). The
+    dense detour pays Cg->C_in flops inflation, so it only ever makes
+    sense where the MXU would otherwise idle on 4-8 lane matmuls."""
+    cg = int(w.shape[1])
+    # malformed configs (c_out not divisible by groups, mismatched c_in)
+    # must keep the native path so XLA raises its loud shape error
+    # instead of a silently wrong block placement
+    if int(w.shape[0]) % groups or int(x.shape[1]) != cg * groups:
+        return False
+    mode = os.environ.get("PT_GCONV_DENSE", "auto")
+    if mode in ("0", "never"):
+        return False
+    if mode in ("1", "always"):
+        return True
+    spatial = min(int(x.shape[-1]), int(x.shape[-2]))  # non-square safe
+    return groups > 1 and cg <= 8 and spatial >= 56
+
+
 def _conv2d(x, w, attrs, feature_group_count=None):
     w = _harmonize_w(x, w)
     s = _pair(attrs.get("strides", 1))
     p = _pair(attrs.get("paddings", 0))
     d = _pair(attrs.get("dilations", 1))
     groups = feature_group_count or attrs.get("groups", 1) or 1
+    if groups > 1 and groups < x.shape[1] \
+            and _gconv_prefers_dense(x, w, groups):
+        w = _dense_expand_grouped(w, groups)
+        groups = 1
     # NOTE: no preferred_element_type upcast — the MXU accumulates bf16
     # operands in fp32 internally, and jax 0.9's conv transpose rule cannot
     # transpose a dtype-upcasting conv.

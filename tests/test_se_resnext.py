@@ -77,3 +77,52 @@ class TestSEResNeXt:
                 pexe.run([avg_cost], feed=feed)[0]).reshape(()))
                 for _ in range(3)]
         np.testing.assert_allclose(single, par, rtol=2e-4, atol=2e-5)
+
+
+class TestGroupedConvDenseExpansion:
+    """The large-spatial/tiny-group grouped-conv regime routes through a
+    dense conv over block-diagonal-expanded weights (measured faster on
+    the chip there — ops/nn_ops.py _gconv_prefers_dense); values and
+    grads must match the native grouped path."""
+
+    def test_auto_matches_native(self):
+        import os
+
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops import nn_ops
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(2, 128, 56, 56).astype(np.float32) * .1)
+        w = jnp.asarray(rng.randn(256, 4, 3, 3).astype(np.float32) * .1)
+        attrs = {"strides": 1, "paddings": 1, "groups": 32}
+
+        prev = os.environ.get("PT_GCONV_DENSE")
+
+        def f(x, w, mode):
+            os.environ["PT_GCONV_DENSE"] = mode
+            try:
+                return jnp.sum(jnp.sin(nn_ops._conv2d(x, w, attrs)))
+            finally:
+                if prev is None:
+                    os.environ.pop("PT_GCONV_DENSE", None)
+                else:
+                    os.environ["PT_GCONV_DENSE"] = prev
+
+        v0, g0 = jax.value_and_grad(f, argnums=(0, 1))(x, w, "never")
+        v1, g1 = jax.value_and_grad(f, argnums=(0, 1))(x, w, "auto")
+        np.testing.assert_allclose(v0, v1, rtol=1e-4)
+        for a, b in zip(g0, g1):
+            np.testing.assert_allclose(a, b, rtol=2e-3, atol=1e-4)
+
+    def test_small_spatial_stays_native(self, monkeypatch):
+        import jax.numpy as jnp
+        from paddle_tpu.ops import nn_ops
+        monkeypatch.setenv("PT_GCONV_DENSE", "auto")  # pin ambient mode
+        # 7x7/Cg=32 is deep in native-wins territory: auto must not expand
+        x = jnp.zeros((1, 1024, 7, 7))
+        w = jnp.zeros((1024, 32, 3, 3))
+        assert not nn_ops._gconv_prefers_dense(x, w, 32)
+        # non-square: the SMALLER spatial dim governs (28 < 56 -> native)
+        x2 = jnp.zeros((1, 128, 28, 56))
+        w2 = jnp.zeros((128, 4, 3, 3))
+        assert not nn_ops._gconv_prefers_dense(x2, w2, 32)
