@@ -31,7 +31,7 @@ Measurement notes (evidence gathered on the v5e-via-tunnel rig, round 2):
     intensity at 224px/bf16, not framework overhead. Round-4 numbers
     (2 flops/MAC program-derived accounting; committed run =
     docs/artifacts/bench_r04_preview.json, best observed across the
-    round's runs in parentheses): ResNet-50 52.6 ms ≈ 28.6% MFU
+    round's runs in parentheses): ResNet-50 50.0 ms ≈ 30.1% MFU
     (best 48.8 ms ≈ 30.9%) with falling varied-data loss; SE-ResNeXt
     57.2 ms ≈ 28.9% MFU (the grouped-conv dense-expansion rule, was
     72-86 ms); transformer 60.4-60.9% MFU at bs8; 8k 55.9% MFU / 71.4%

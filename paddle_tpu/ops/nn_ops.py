@@ -174,14 +174,21 @@ def _dense_expand_grouped(w, groups):
                       jnp.asarray(place, w.dtype))
 
 
-def _gconv_prefers_dense(x, w, groups) -> bool:
+def _gconv_prefers_dense(x, w, groups, stride=(1, 1)) -> bool:
     """XLA's native grouped-conv lowering loses to a dense conv over
     block-diagonal-expanded weights exactly in the large-spatial /
     tiny-group regime (measured on the v5e, fwd+bwd per shape —
     docs/artifacts/grouped_conv_profile.json: C=128@56²/Cg=4 native
     1.78 ms vs dense 0.93; at 28² and below native wins by 2-10x). The
     dense detour pays Cg->C_in flops inflation, so it only ever makes
-    sense where the MXU would otherwise idle on 4-8 lane matmuls."""
+    sense where the MXU would otherwise idle on 4-8 lane matmuls.
+
+    Full-model evidence is distribution-level: the shared tunnel's
+    contention band swamps single readings (se_resnext spans 57-116 ms
+    across one day), but the day's medians (auto ~68 ms vs never ~79)
+    and the clean full-suite run (57.2 vs 72-86) both favor auto.
+    PT_GCONV_DENSE=never reverts in one env var if a future chip/XLA
+    shifts the regime boundary."""
     cg = int(w.shape[1])
     # malformed configs (c_out not divisible by groups, mismatched c_in)
     # must keep the native path so XLA raises its loud shape error
@@ -193,7 +200,10 @@ def _gconv_prefers_dense(x, w, groups) -> bool:
         return False
     if mode in ("1", "always"):
         return True
-    spatial = min(int(x.shape[-1]), int(x.shape[-2]))  # non-square safe
+    # OUTPUT spatial governs (the measured regime boundary): a stride-2
+    # conv on 56² input has 28²'s arithmetic, where native wins 4x
+    spatial = min(int(x.shape[-1]) // max(int(stride[1]), 1),
+                  int(x.shape[-2]) // max(int(stride[0]), 1))
     return groups > 1 and cg <= 8 and spatial >= 56
 
 
@@ -204,7 +214,7 @@ def _conv2d(x, w, attrs, feature_group_count=None):
     d = _pair(attrs.get("dilations", 1))
     groups = feature_group_count or attrs.get("groups", 1) or 1
     if groups > 1 and groups < x.shape[1] \
-            and _gconv_prefers_dense(x, w, groups):
+            and _gconv_prefers_dense(x, w, groups, stride=s):
         w = _dense_expand_grouped(w, groups)
         groups = 1
     # NOTE: no preferred_element_type upcast — the MXU accumulates bf16

@@ -179,6 +179,41 @@ class TestUlysses:
         _assert_no_full_seq_gather(hlo)
 
 
+class TestPipeline:
+    def test_gpipe_ppermute_schedule(self):
+        """The pp schedule must move microbatch activations with
+        collective-permute (the stage-to-stage hop), not gather them."""
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [16])
+            yv = layers.data("y", [1])
+            pipe = layers.Pipeline(num_stages=4, num_microbatches=4)
+            with pipe.stage():
+                xin = pipe.stage_input(x)
+                w = pipe.stage_param([16, 16])
+                b = pipe.stage_param([16], is_bias=True)
+                h = layers.tanh(layers.elementwise_add(
+                    layers.matmul(xin, w), b))
+                pipe.output(h)
+            h = pipe()
+            pred = layers.fc(input=h, size=1)
+            loss = layers.mean(layers.square_error_cost(input=pred,
+                                                        label=yv))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        rng = np.random.RandomState(2)
+        xb = rng.rand(8, 16).astype(np.float32)
+        feed = {"x": xb, "y": (xb.sum(1, keepdims=True) * 0.1)}
+        hlo = _compile(main, startup, loss, mesh, feed)
+        h = collective_hist(hlo)
+        # fwd ring + backward ring: >= 2 collective-permutes inside the
+        # tick loops; the microbatch stream must NOT be all-gathered
+        assert h.get("collective-permute", 0) >= 2, h
+        for s in gather_shapes(hlo):
+            assert len(s) < 2 or s[:2] != (4, 2), \
+                f"microbatch buffer all-gather {s}"
+
+
 class TestMoE:
     def test_dispatch_combine_all_to_all_pair(self):
         mesh = make_mesh({"ep": 4, "dp": 2})

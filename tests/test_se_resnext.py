@@ -126,3 +126,8 @@ class TestGroupedConvDenseExpansion:
         x2 = jnp.zeros((1, 128, 28, 56))
         w2 = jnp.zeros((128, 4, 3, 3))
         assert not nn_ops._gconv_prefers_dense(x2, w2, 32)
+        # stride 2 on 56² input has 28²'s arithmetic -> native
+        x3 = jnp.zeros((1, 256, 56, 56))
+        w3 = jnp.zeros((512, 8, 3, 3))
+        assert not nn_ops._gconv_prefers_dense(x3, w3, 32, stride=(2, 2))
+        assert nn_ops._gconv_prefers_dense(x3, w3, 32, stride=(1, 1))
