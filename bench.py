@@ -616,7 +616,7 @@ def bench_transpiler_sanity(on_tpu, peak):
         runs[tag] = (exe, scope, main, avg,
                      float(np.ravel(np.asarray(losses))[-1]))
     out = {"batch": batch, "steps": steps}
-    best = {"plain": float("inf"), "transpiled": float("inf")}
+    diffs = {"plain": [], "transpiled": []}
     for _ in range(3):
         for tag in ("plain", "transpiled"):
             exe, scope, main, avg, _ = runs[tag]
@@ -629,14 +629,17 @@ def bench_transpiler_sanity(on_tpu, peak):
                 exe.run_loop(main, feed=feed, fetch_list=[avg],
                              n_steps=steps)
                 t_big = time.time() - t0
-            best[tag] = min(best[tag],
-                            max(t_big - t_small, 0.0) / (steps - base))
+            diffs[tag].append((t_big - t_small) / (steps - base))
     for tag in ("plain", "transpiled"):
-        out[f"{tag}_ms"] = round(best[tag] * 1000.0, 2)
+        # smallest POSITIVE difference: a contention burst during one
+        # small window makes that rep's diff <= 0 and a plain min would
+        # report 0 ms (observed once on the shared fabric)
+        pos = [d for d in diffs[tag] if d > 0]
+        out[f"{tag}_ms"] = round(min(pos) * 1000.0, 2) if pos else None
         out[f"{tag}_loss_last"] = runs[tag][4]
     # off-TPU the two-length difference can clamp to ~0 ms (the fixed
     # dispatch cost dwarfs two tiny steps): no meaningful ratio there
-    if out["plain_ms"] > 0:
+    if out["plain_ms"] and out["transpiled_ms"]:
         out["overhead_pct"] = round(
             (out["transpiled_ms"] / out["plain_ms"] - 1) * 100, 2)
     else:
