@@ -837,12 +837,16 @@ def main():
                 # keep per-config tracebacks and the INPUT-BOUND warning
                 # visible in the parent's stderr
                 sys.stderr.write(r.stderr[-2000:])
-            lines = [ln for ln in r.stdout.splitlines()
-                     if ln.startswith("{")]
-            try:
-                child = json.loads(lines[-1]) if lines else None
-            except json.JSONDecodeError:
-                child = None  # truncated line from a dying child
+            child = None
+            for ln in reversed([ln for ln in r.stdout.splitlines()
+                                if ln.startswith("{")]):
+                try:
+                    parsed = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue  # truncated line from a dying child
+                if "configs" in parsed:  # skip the short headline line
+                    child = parsed
+                    break
             if child is not None:
                 configs[name] = child.get("configs", {}).get(
                     name, {"error": "child produced no config entry"})
@@ -902,6 +906,13 @@ def _print_result(configs, dev, peak):
         "configs": configs,
     }
     print(json.dumps(result))
+    # Second, SHORT headline line (VERDICT r4 next #10): the full line has
+    # outgrown the driver's stdout tail window since r2 (`parsed: null`),
+    # so repeat just the headline fields afterwards — last line wins for
+    # any tail-based parser, and it always fits.
+    print(json.dumps({k: result[k] for k in
+                      ("metric", "value", "unit", "vs_baseline",
+                       "images_per_sec", "ms_per_batch", "device")}))
 
 
 if __name__ == "__main__":

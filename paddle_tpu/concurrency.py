@@ -61,6 +61,9 @@ class Channel:
         # rendezvous accounting: number of receivers currently waiting
         self._recv_waiting = 0
         self._handoff: deque = deque()   # values passed sender->receiver
+        # Events armed by select(): set on every state change so idle
+        # selects park instead of sleep-polling
+        self._select_waiters: list = []
 
     # -- core ---------------------------------------------------------------
     @staticmethod
@@ -97,12 +100,14 @@ class Channel:
                         raise ChannelClosed
                 self._buf.append(value)
                 self._not_empty.notify()
+                self._wake_selects()
                 return
             # rendezvous: hand the value to a receiver via a unique cell
             # (identity-tracked — two senders may send EQUAL values)
             cell = [value]
             self._handoff.append(cell)
             self._not_empty.notify()
+            self._wake_selects()
 
             def pending():
                 return any(c is cell for c in self._handoff)
@@ -129,10 +134,12 @@ class Channel:
                 if self._buf:
                     v = self._buf.popleft()
                     self._not_full.notify()
+                    self._wake_selects()  # a send case may be ready now
                     return v, True
                 if self._handoff:
                     cell = self._handoff.popleft()
                     self._not_full.notify_all()
+                    self._wake_selects()
                     return cell[0], True
                 if self._closed:
                     return None, False
@@ -140,6 +147,8 @@ class Channel:
                 if rem is not None and rem <= 0:
                     raise TimeoutError("channel recv timed out")
                 self._recv_waiting += 1
+                # a waiting receiver makes rendezvous SEND cases ready
+                self._wake_selects()
                 try:
                     if not self._not_empty.wait(rem):
                         raise TimeoutError("channel recv timed out")
@@ -151,8 +160,28 @@ class Channel:
             self._closed = True
             self._not_empty.notify_all()
             self._not_full.notify_all()
+            self._wake_selects()
 
-    # -- introspection (select uses these under the lock) -------------------
+    # -- select plumbing ----------------------------------------------------
+    def _arm_select(self, ev) -> None:
+        with self._lock:
+            self._select_waiters.append(ev)
+
+    def _disarm_select(self, ev) -> None:
+        with self._lock:
+            try:
+                self._select_waiters.remove(ev)
+            except ValueError:
+                pass
+
+    def _wake_selects(self) -> None:
+        """Caller holds self._lock. Wake every parked select()."""
+        for ev in self._select_waiters:
+            ev.set()
+
+    # -- introspection (select snapshots these while HOLDING self._lock;
+    #    the snapshot can still go stale before the op runs, which is why
+    #    select's actual send/recv uses a short-timeout retry) ------------
     def _can_recv(self) -> bool:
         return bool(self._buf or self._handoff or self._closed)
 
@@ -235,22 +264,37 @@ def select(cases: Sequence[tuple], default: bool = False,
     Returns (case_index, value, ok): for recv cases `value` is the
     received value; for send cases None. With default=True, returns
     (-1, None, False) immediately when no case is ready (Go's default
-    branch)."""
+    branch).
+
+    Idle selects PARK on an Event armed with every involved channel
+    (channels set it on any state change) instead of sleep-polling;
+    readiness snapshots hold the channel lock. `poll_interval` only
+    bounds the actual send/recv attempt on a ready case, which can still
+    lose a race against another consumer — losing retries the scan."""
     import time as _time
     end = None if timeout is None else _time.monotonic() + timeout
-    while True:
-        for i, case in enumerate(cases):
-            kind, ch = case[0], case[1]
-            # readiness checks race with other threads; the short-timeout
-            # retry keeps select from blocking on a case another consumer
-            # won
-            if kind == "recv" and ch._can_recv():
-                try:
-                    v, ok = ch.recv(timeout=poll_interval)
-                except TimeoutError:
+    ev = threading.Event()
+    chans = list({id(case[1]): case[1] for case in cases}.values())
+    for ch in chans:
+        ch._arm_select(ev)
+    try:
+        while True:
+            # clear BEFORE scanning: any state change after this point
+            # re-sets the event, so the wait below cannot miss it
+            ev.clear()
+            for i, case in enumerate(cases):
+                kind, ch = case[0], case[1]
+                with ch._lock:
+                    ready = ch._can_recv() if kind == "recv" \
+                        else ch._can_send()
+                if not ready:
                     continue
-                return i, v, ok
-            if kind == "send" and ch._can_send():
+                if kind == "recv":
+                    try:
+                        v, ok = ch.recv(timeout=poll_interval)
+                    except TimeoutError:
+                        continue
+                    return i, v, ok
                 try:
                     ch.send(case[2], timeout=poll_interval)
                 except ChannelClosed:
@@ -258,8 +302,12 @@ def select(cases: Sequence[tuple], default: bool = False,
                 except TimeoutError:
                     continue
                 return i, None, True
-        if default:
-            return -1, None, False
-        if end is not None and _time.monotonic() >= end:
-            raise TimeoutError("select timed out")
-        _time.sleep(poll_interval)
+            if default:
+                return -1, None, False
+            rem = None if end is None else end - _time.monotonic()
+            if rem is not None and rem <= 0:
+                raise TimeoutError("select timed out")
+            ev.wait(rem)
+    finally:
+        for ch in chans:
+            ch._disarm_select(ev)

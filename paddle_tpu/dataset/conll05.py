@@ -4,7 +4,6 @@ word/props files → (word, ctx windows, predicate, mark, label) samples."""
 from __future__ import annotations
 
 import gzip
-import itertools
 import tarfile
 
 from . import common
@@ -53,106 +52,98 @@ def load_dict(filename):
     return d
 
 
+def _bio_decode(column):
+    """One CoNLL bracket column -> BIO tags.
+
+    Bracket tokens are `(TAG*`, `(TAG*)`, `*`, `*)`. A `(` starts span
+    TAG (B-), the span stays open (I-) until a token ending in `)`;
+    tokens outside any span are `O`. Shapes outside this grammar are a
+    corpus error."""
+    tags = []
+    span = None  # most recent tag; sticky so a stray `*)` closes as I-
+    open_ = False
+    for tok in column:
+        if tok.startswith("(") and "*" in tok:
+            span = tok[1:tok.index("*")]
+            tags.append("B-" + span)
+            open_ = not tok.endswith(")")
+        elif tok == "*)":
+            tags.append("I-" + (span if span is not None else "O"))
+            open_ = False
+        elif tok == "*":
+            tags.append("I-" + span if open_ else "O")
+        else:
+            raise RuntimeError(f"unexpected label: {tok}")
+    return tags
+
+
+def _sentence_blocks(word_lines, prop_lines):
+    """Group the parallel line streams into per-sentence (words, prop-rows)
+    blocks; sentences are separated by blank prop lines."""
+    words, rows = [], []
+    for wline, pline in zip(word_lines, prop_lines):
+        cols = pline.split()
+        if cols:
+            words.append(wline.strip())
+            rows.append(cols)
+        elif words:
+            yield words, rows
+            words, rows = [], []
+    if words:  # no trailing blank line
+        yield words, rows
+
+
 def corpus_reader(data_path, words_name, props_name):
-    """Yield (sentence tokens, label columns) per sentence; one sample per
-    predicate column, exactly the reference's traversal."""
+    """Yield (sentence tokens, predicate, BIO tags) — one sample per
+    predicate column of each sentence (≙ reference
+    python/paddle/dataset/conll05.py corpus_reader, redesigned: sentence
+    blocking, column transpose, and BIO decoding are separate steps)."""
 
     def reader():
-        with tarfile.open(data_path) as tf:
-            wf = tf.extractfile(words_name)
-            pf = tf.extractfile(props_name)
-            with gzip.GzipFile(fileobj=wf) as words_file, \
-                    gzip.GzipFile(fileobj=pf) as props_file:
-                sentences = []
-                labels = []
-                one_seg = []
-                for word, label in zip(words_file, props_file):
-                    word = word.decode().strip()
-                    label = label.decode().strip().split()
-                    if len(label) == 0:  # sentence boundary
-                        for i in range(len(one_seg[0])):
-                            a_kind_lable = [x[i] for x in one_seg]
-                            labels.append(a_kind_lable)
-                        if len(labels) >= 1:
-                            verb_list = []
-                            for x in labels[0]:
-                                if x != "-":
-                                    verb_list.append(x)
-                            for i, lbl in enumerate(labels[1:]):
-                                cur_tag = "O"
-                                is_in_bracket = False
-                                lbl_seq = []
-                                verb_word = ""
-                                for l in lbl:
-                                    if l == "*" and not is_in_bracket:
-                                        lbl_seq.append("O")
-                                    elif l == "*" and is_in_bracket:
-                                        lbl_seq.append("I-" + cur_tag)
-                                    elif l == "*)":
-                                        lbl_seq.append("I-" + cur_tag)
-                                        is_in_bracket = False
-                                    elif l.startswith("(") and l.endswith(")"):
-                                        cur_tag = l[1:l.find("*")]
-                                        lbl_seq.append("B-" + cur_tag)
-                                        is_in_bracket = False
-                                    elif l.startswith("("):
-                                        cur_tag = l[1:l.find("*")]
-                                        lbl_seq.append("B-" + cur_tag)
-                                        is_in_bracket = True
-                                    else:
-                                        raise RuntimeError(
-                                            f"unexpected label: {l}")
-                                yield sentences, verb_list[i], lbl_seq
-                        sentences = []
-                        labels = []
-                        one_seg = []
-                    else:
-                        sentences.append(word)
-                        one_seg.append(label)
+        with tarfile.open(data_path) as tar:
+            with gzip.open(tar.extractfile(words_name), mode="rt") as wf, \
+                    gzip.open(tar.extractfile(props_name), mode="rt") as pf:
+                for words, rows in _sentence_blocks(wf, pf):
+                    # row-major file -> column-major props: column 0 names
+                    # the predicates ('-' elsewhere), column 1+k is the
+                    # bracket annotation for the k-th predicate
+                    ncol = len(rows[0])
+                    if any(len(r) != ncol for r in rows):
+                        raise RuntimeError(
+                            f"ragged props rows near {words[:3]}: "
+                            "corrupt corpus")
+                    columns = list(zip(*rows))
+                    predicates = [v for v in columns[0] if v != "-"]
+                    if len(predicates) != len(columns) - 1:
+                        raise RuntimeError(
+                            f"{len(predicates)} predicates vs "
+                            f"{len(columns) - 1} annotation columns near "
+                            f"{words[:3]}: corrupt corpus")
+                    for verb, col in zip(predicates, columns[1:]):
+                        yield words, verb, _bio_decode(col)
 
     return reader
 
 
 def reader_creator(corpus_reader_fn, word_dict=None, predicate_dict=None,
                    label_dict=None):
-    def reader():
-        for sentence, predicate, labels in corpus_reader_fn():
-            sen_len = len(sentence)
-            verb_index = labels.index("B-V")
-            mark = [0] * len(labels)
-            if verb_index > 0:
-                mark[verb_index - 1] = 1
-                ctx_n1 = sentence[verb_index - 1]
-            else:
-                ctx_n1 = "bos"
-            if verb_index > 1:
-                mark[verb_index - 2] = 1
-                ctx_n2 = sentence[verb_index - 2]
-            else:
-                ctx_n2 = "bos"
-            mark[verb_index] = 1
-            ctx_0 = sentence[verb_index]
-            if verb_index < len(labels) - 1:
-                mark[verb_index + 1] = 1
-                ctx_p1 = sentence[verb_index + 1]
-            else:
-                ctx_p1 = "eos"
-            if verb_index < len(labels) - 2:
-                mark[verb_index + 2] = 1
-                ctx_p2 = sentence[verb_index + 2]
-            else:
-                ctx_p2 = "eos"
+    """Samples -> the 9 index sequences the SRL model feeds
+    (≙ reference reader_creator): words, five predicate-context windows
+    (each broadcast sentence-wide), predicate id, region mark, labels."""
 
-            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
-            ctx_n2_idx = [word_dict.get(ctx_n2, UNK_IDX)] * sen_len
-            ctx_n1_idx = [word_dict.get(ctx_n1, UNK_IDX)] * sen_len
-            ctx_0_idx = [word_dict.get(ctx_0, UNK_IDX)] * sen_len
-            ctx_p1_idx = [word_dict.get(ctx_p1, UNK_IDX)] * sen_len
-            ctx_p2_idx = [word_dict.get(ctx_p2, UNK_IDX)] * sen_len
-            pred_idx = [predicate_dict.get(predicate)] * sen_len
-            label_idx = [label_dict.get(w) for w in labels]
-            yield (word_idx, ctx_n2_idx, ctx_n1_idx, ctx_0_idx, ctx_p1_idx,
-                   ctx_p2_idx, pred_idx, mark, label_idx)
+    def reader():
+        for words, verb, tags in corpus_reader_fn():
+            n = len(words)
+            v = tags.index("B-V")
+            # ±2 context window around the predicate, edge-padded — the
+            # same five tokens the reference picks with per-offset branches
+            padded = ["bos", "bos", *words, "eos", "eos"]
+            window = padded[v:v + 5]  # [v-2 .. v+2] in sentence coords
+            mark = [int(abs(i - v) <= 2) for i in range(n)]
+            ctx = [[word_dict.get(tok, UNK_IDX)] * n for tok in window]
+            yield ([word_dict.get(w, UNK_IDX) for w in words], *ctx,
+                   [predicate_dict.get(verb)] * n, mark,
+                   [label_dict.get(t) for t in tags])
 
     return reader
 

@@ -57,6 +57,7 @@ from __future__ import annotations
 import collections
 import os
 import threading
+import warnings
 from typing import Dict, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -411,9 +412,28 @@ def save_all(dirname: str, program=None) -> None:
         t.save(dirname)
 
 
-def load_all(dirname: str, program=None) -> None:
-    for t in _tables_for(program):
-        t.load(dirname)
+def load_all(dirname: str, program=None, strict: Optional[bool] = None
+             ) -> None:
+    """Restore every registered table the program consumes.
+
+    A table whose shard file is absent from `dirname` (pre-table
+    checkpoint, renamed table) would otherwise silently keep its fresh
+    init while the dense params resume — the exact silent-revert failure
+    this module's docstring warns about (ADVICE r3/r4). Missing shards
+    therefore WARN by default and raise when `strict` (default: env
+    PT_HOST_TABLE_STRICT_LOAD=1)."""
+    if strict is None:
+        strict = os.environ.get("PT_HOST_TABLE_STRICT_LOAD", ""
+                                ).lower() not in ("", "0", "false")
+    missing = [t.name for t in _tables_for(program) if not t.load(dirname)]
+    if missing:
+        msg = (f"host tables {missing} have no checkpoint shard in "
+               f"{dirname!r} (rank {_REGISTRY[missing[0]].rank}): they "
+               "keep their current (likely fresh-init) values while the "
+               "dense params were restored")
+        if strict:
+            raise FileNotFoundError(msg)
+        warnings.warn(msg, stacklevel=2)
 
 
 def host_embedding(input, table: HostEmbeddingTable):

@@ -1,0 +1,291 @@
+"""Define-by-run (eager) prototype — the tape tier.
+
+≙ the reference's experimental `paddle/contrib/tape/` (tape.h:1 — record
+each op as it runs, then `Tape::Backward` builds and executes the grad
+ops). TPU-first reading: eager ops execute immediately through the SAME
+registry kernels (`core/registry.py`) the graph path lowers to, the tape
+records (op_type, inputs, outputs, attrs, rng_key), and `backward()`
+replays the whole tape as a pure function of the leaf variables under
+`jax.grad` + `jit` — one compiled XLA program for the full
+forward+backward, not op-by-op interpretation (the reference tape pays
+per-op executor dispatch; tape.h ExecuteOnce).
+
+Per-entry rng keys are RECORDED at eager time and reused by the replay,
+so stochastic ops (dropout) see identical randomness forward and during
+differentiation.
+
+Experimental tier, like the reference's: the Program/Executor path is
+the production API; this module exists for define-by-run ergonomics
+(debugging with real values, Python control flow between ops).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.registry import ExecContext, require_op
+
+__all__ = ["Variable", "to_variable", "run_op", "backward", "Linear",
+           "Conv2D", "relu", "softmax", "mean", "cross_entropy", "matmul",
+           "add", "SGD", "reset"]
+
+_counter = itertools.count()
+_TAPE: List[dict] = []
+_seed = itertools.count(17)
+
+
+def reset() -> None:
+    """Drop all recorded entries (start a fresh step)."""
+    _TAPE.clear()
+
+
+class Variable:
+    """Eager value + autodiff leaf marker. `.grad` is populated by
+    backward() for trainable leaves."""
+
+    def __init__(self, value, trainable: bool = False,
+                 name: Optional[str] = None):
+        import jax.numpy as jnp
+        self.value = jnp.asarray(value)
+        self.trainable = trainable
+        self.name = name or f"imp_{next(_counter)}"
+        self.grad = None
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def __repr__(self):
+        return f"imperative.Variable({self.name}, shape={self.shape})"
+
+
+def to_variable(value, trainable: bool = False) -> Variable:
+    return Variable(value, trainable=trainable)
+
+
+def run_op(op_type: str, ins: Dict[str, Sequence[Variable]],
+           attrs: Optional[Dict[str, Any]] = None,
+           n_outs: Optional[Dict[str, int]] = None) -> Dict[str, List[Variable]]:
+    """Execute one registry op eagerly and record it on the tape."""
+    import jax
+    impl = require_op(op_type)
+    attrs = dict(attrs or {})
+    key = jax.random.PRNGKey(next(_seed))
+    ctx = ExecContext(key, is_test=False)
+    conc = {slot: [v.value for v in vs] for slot, vs in ins.items()}
+    outs = impl.compute(ctx, conc, attrs)
+    out_vars = {slot: [Variable(val) for val in vals]
+                for slot, vals in outs.items()}
+    _TAPE.append({"type": op_type, "attrs": attrs, "key": key,
+                  "ins": {s: [v.name for v in vs] for s, vs in ins.items()},
+                  "outs": {s: [v.name for v in vs]
+                           for s, vs in out_vars.items()},
+                  "in_vars": ins, "out_vars": out_vars})
+    return out_vars
+
+
+def _collect_leaves(loss: Variable) -> List[Variable]:
+    """Trainable Variables that (transitively) feed the loss, in first-use
+    order."""
+    produced = {}
+    for e in _TAPE:
+        for vs in e["out_vars"].values():
+            for v in vs:
+                produced[v.name] = e
+    leaves, seen = [], set()
+
+    def walk(name):
+        e = produced.get(name)
+        if e is None:
+            return
+        for vs in e["in_vars"].values():
+            for v in vs:
+                if v.name in seen:
+                    continue
+                seen.add(v.name)
+                if v.trainable:
+                    leaves.append(v)
+                walk(v.name)
+
+    walk(loss.name)
+    return leaves
+
+
+_REPLAY_CACHE: Dict[tuple, Any] = {}
+
+
+def backward(loss: Variable) -> List[Variable]:
+    """Differentiate the recorded tape w.r.t. every trainable leaf that
+    feeds `loss`; sets `.grad` on each and returns them.
+
+    The replay is a pure function of (leaf values, external inputs, rng
+    keys), jitted and CACHED on the tape's canonical structure: repeated
+    steps of the same model hit the cache and recompile only when the
+    recorded op graph actually changes. Variable names are canonicalized
+    by first-appearance order so fresh per-step Variables (new data, new
+    ids) still map to the same compiled program."""
+    import jax
+
+    leaves = _collect_leaves(loss)
+    if not leaves:
+        return []
+    tape = list(_TAPE)
+    leaf_set = {v.name for v in leaves}
+    produced = {v.name for e in tape
+                for vs in e["out_vars"].values() for v in vs}
+    ext, seen_ext = [], set()
+    for e in tape:
+        for vs in e["in_vars"].values():
+            for v in vs:
+                if (v.name not in produced and v.name not in leaf_set
+                        and v.name not in seen_ext):
+                    seen_ext.add(v.name)
+                    ext.append(v)
+
+    canon: Dict[str, str] = {}
+
+    def c(name):
+        if name not in canon:
+            canon[name] = f"v{len(canon)}"
+        return canon[name]
+
+    for v in leaves:
+        c(v.name)
+    for v in ext:
+        c(v.name)
+    struct = tuple(
+        (e["type"],
+         tuple(sorted((k, repr(val)) for k, val in e["attrs"].items())),
+         tuple((s, tuple(c(v.name) for v in vs))
+               for s, vs in sorted(e["in_vars"].items())),
+         tuple((s, tuple(c(v.name) for v in vs))
+               for s, vs in sorted(e["out_vars"].items())))
+        for e in tape)
+    key = (struct, tuple(c(v.name) for v in leaves),
+           tuple(c(v.name) for v in ext), c(loss.name))
+
+    fn = _REPLAY_CACHE.get(key)
+    if fn is None:
+        attrs_list = [e["attrs"] for e in tape]
+        _, leaf_cn, ext_cn, loss_cn = key
+
+        def replay(leaf_vals, ext_vals, keys):
+            env = dict(zip(leaf_cn, leaf_vals))
+            env.update(zip(ext_cn, ext_vals))
+            for (op_type, _, ins, outs), attrs, k in zip(
+                    struct, attrs_list, keys):
+                ctx = ExecContext(k, is_test=False)
+                conc = {s: [env[n] for n in ns] for s, ns in ins}
+                res = require_op(op_type).compute(ctx, conc, attrs)
+                for s, ns in outs:
+                    for n, val in zip(ns, res[s]):
+                        env[n] = val
+            out = env[loss_cn]
+            return out.sum() if out.ndim else out
+
+        fn = jax.jit(jax.grad(replay))
+        _REPLAY_CACHE[key] = fn
+
+    grads = fn([v.value for v in leaves], [v.value for v in ext],
+               [e["key"] for e in tape])
+    for v, g in zip(leaves, grads):
+        v.grad = g
+    return leaves
+
+
+# -- eager layer/function sugar (≙ tape/function.h Linear/Convolution2D) --
+
+def _xavier(rng, shape):
+    fan_in = int(np.prod(shape[:-1])) or 1
+    return (rng.randn(*shape) * np.sqrt(2.0 / fan_in)).astype("float32")
+
+
+class Linear:
+    """≙ tape/function.h Linear: mul + elementwise_add + activation."""
+
+    def __init__(self, in_dim: int, out_dim: int, act: Optional[str] = None,
+                 seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.w = Variable(_xavier(rng, (in_dim, out_dim)), trainable=True)
+        self.b = Variable(np.zeros(out_dim, "float32"), trainable=True)
+        self.act = act
+
+    def __call__(self, x: Variable) -> Variable:
+        y = run_op("mul", {"X": [x], "Y": [self.w]})["Out"][0]
+        y = run_op("elementwise_add",
+                   {"X": [y], "Y": [self.b]}, {"axis": -1})["Out"][0]
+        if self.act:
+            y = run_op(self.act, {"X": [y]})["Out"][0]
+        return y
+
+    @property
+    def params(self):
+        return [self.w, self.b]
+
+
+class Conv2D:
+    """≙ tape/function.h Convolution2D (NCHW)."""
+
+    def __init__(self, in_ch: int, out_ch: int, ksize: int,
+                 act: Optional[str] = None, seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.w = Variable(_xavier(rng, (out_ch, in_ch, ksize, ksize)),
+                          trainable=True)
+        self.act = act
+
+    def __call__(self, x: Variable) -> Variable:
+        y = run_op("conv2d", {"Input": [x], "Filter": [self.w]},
+                   {"strides": [1, 1], "paddings": [0, 0]})["Output"][0]
+        if self.act:
+            y = run_op(self.act, {"X": [y]})["Out"][0]
+        return y
+
+    @property
+    def params(self):
+        return [self.w]
+
+
+def relu(x: Variable) -> Variable:
+    return run_op("relu", {"X": [x]})["Out"][0]
+
+
+def softmax(x: Variable) -> Variable:
+    return run_op("softmax", {"X": [x]})["Out"][0]
+
+
+def matmul(x: Variable, y: Variable) -> Variable:
+    return run_op("mul", {"X": [x], "Y": [y]})["Out"][0]
+
+
+def add(x: Variable, y: Variable) -> Variable:
+    return run_op("elementwise_add", {"X": [x], "Y": [y]})["Out"][0]
+
+
+def mean(x: Variable) -> Variable:
+    return run_op("mean", {"X": [x]})["Out"][0]
+
+
+def cross_entropy(probs: Variable, label: Variable) -> Variable:
+    return run_op("cross_entropy", {"X": [probs], "Label": [label]})["Y"][0]
+
+
+class SGD:
+    """≙ tape's OptimizerStep over recorded parameters."""
+
+    def __init__(self, learning_rate: float = 0.01):
+        self.lr = learning_rate
+
+    def minimize(self, loss: Variable) -> None:
+        for v in backward(loss):
+            v.value = v.value - self.lr * v.grad
+        reset()

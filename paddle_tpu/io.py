@@ -182,6 +182,12 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
         if jax.process_count() == 1 or jax.process_index() == 0:
             np.savez(os.path.join(dirname, filename),
                      **{n: np.asarray(v) for n, v in values.items()})
+        if jax.process_count() > 1:
+            # barrier AFTER the rank-0 write (ADVICE r4 #3): without it a
+            # non-zero rank returning immediately can read a partial or
+            # absent archive before rank 0 finishes writing
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("pt_save_vars_combined")
         return
     import jax
     multi = jax.process_count() > 1
@@ -230,6 +236,10 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
             # out); process 0 is the single writer, atomically
             _atomic_save(os.path.join(dirname, base + ".npy"),
                          np.asarray(val))
+    if multi:
+        # nobody returns (and possibly reloads) until every writer — rank
+        # 0's .npy files AND all shard pieces — has hit the filesystem
+        multihost_utils.sync_global_devices("paddle_tpu_save_vars_done")
 
 
 def save_params(executor=None, dirname: str = "", main_program=None,

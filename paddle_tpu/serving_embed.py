@@ -1,0 +1,64 @@
+"""Embedded-interpreter backend for the C serving API.
+
+`native/predictor_capi.cpp` (≙ the reference's C/C++ inference surface:
+paddle/contrib/inference/paddle_inference_api.h:46 PaddlePredictor::Run
+and paddle/capi/) embeds CPython and drives THIS module with only
+ints/bytes/tuples — no numpy C API on the native side. The heavy lifting
+(deserializing the jax.export StableHLO artifact, running it) stays in
+Python; the compiled program itself is XLA, so the embedded interpreter
+only marshals buffers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Tuple
+
+_PREDICTORS: Dict[int, Tuple] = {}
+_NEXT = [0]
+
+
+def create(model_dir: str) -> int:
+    """Load an export_serving_model artifact; returns a handle."""
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # the axon TPU plugin force-selects itself regardless of the env
+        # var; the config knob wins (see tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+    from . import io as pio
+    predict, feed_names, fetch_names = pio.load_serving_model(model_dir)
+    _NEXT[0] += 1
+    _PREDICTORS[_NEXT[0]] = (predict, feed_names, fetch_names)
+    return _NEXT[0]
+
+
+def feed_spec(handle: int, model_dir: str):
+    """[(name, shape, dtype), ...] for the artifact's feeds."""
+    import json
+    with open(os.path.join(model_dir, "serving.json")) as f:
+        meta = json.load(f)
+    return [(m["name"], tuple(m["shape"]), m["dtype"])
+            for m in meta["feeds"]]
+
+
+def run(handle: int, feeds):
+    """feeds: [(raw_bytes, shape_tuple, dtype_str), ...] in feed order.
+    Returns [(f32_bytes, shape_tuple), ...] in fetch order."""
+    import numpy as np
+    predict, _, _ = _PREDICTORS[handle]
+    arrays = [np.frombuffer(raw, dtype=np.dtype(dt)).reshape(shape)
+              for raw, shape, dt in feeds]
+    outs = predict(*arrays)
+    if isinstance(outs, dict):
+        outs = list(outs.values())
+    elif not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    result = []
+    for o in outs:
+        a = np.asarray(o, dtype=np.float32)
+        result.append((a.tobytes(), tuple(int(s) for s in a.shape)))
+    return result
+
+
+def destroy(handle: int) -> None:
+    _PREDICTORS.pop(handle, None)

@@ -213,28 +213,47 @@ def transpile(program: Optional[Program] = None, mesh=None,
                 v.sharding = ("dp", "sp") + (None,) * (len(v.shape) - 2)
         # ... and pin the intermediate activations too: GSPMD does not
         # reliably carry the feed sharding through embedding/reshape
-        # chains, so every [B, S, ...] float temporary in the main block
-        # gets the same (dp, sp) constraint (applied at lowering time by
+        # chains, so [B, S, ...] float temporaries in the main block get
+        # the same (dp, sp) constraint (applied at lowering time by
         # _apply_var_marks). Without these the surrounding layers run
         # seq-REPLICATED and all-gather at the attention boundary.
-        # dim-1-size match is a heuristic: a rank-3+ float temporary whose
-        # dim 1 equals an attention sequence length is taken to be
-        # [B, S, ...]. A model with d_model == seq_len could alias a
-        # transposed [B, D, S] activation here (mis-pinning its hidden
-        # dim); rank-2 temporaries are excluded outright because [B, D]
-        # fc outputs collide far more often than [B, S] per-token values
-        # appear. Recorded with the other scope limits in the module
-        # docstring / PARITY.md.
+        # PROVENANCE-tracked (ADVICE r4 #5): an output is pinned only if
+        # (a) some input is already sequence-pinned on dim 1 with the
+        # same dim-1 size, and (b) the op is not an axis-mover
+        # (transpose/reshape/...), whose output dim 1 need not be the
+        # sequence axis even when the size matches. This kills the
+        # d_model == seq_len false positive the round-4 advisor flagged:
+        # a transposed [B, D, S] tensor matches on SIZE but has no
+        # matching-dim pinned input behind a non-axis-mover op.
+        axis_movers = {"transpose", "transpose2", "reshape", "reshape2",
+                       "squeeze", "squeeze2", "unsqueeze", "unsqueeze2",
+                       "flatten", "flatten2", "split", "concat", "stack"}
+        pinned = {v.name for v in block.vars.values()
+                  if v.sharding is not None and len(v.shape) >= 2
+                  and v.sharding[:2] == ("dp", "sp")}
         for op in block.ops:
+            if op.type in axis_movers:
+                continue
             for out_name in op.output_names():
                 v = var(out_name)
                 if (v is None or v.sharding is not None or v.persistable
                         or v.is_parameter or len(v.shape) < 3):
                     continue
-                if (int(v.shape[1]) in seq_lens
-                        and v.shape[1] % sp_size == 0
-                        and str(v.dtype).startswith(("float", "bfloat"))):
+                if (int(v.shape[1]) not in seq_lens
+                        or v.shape[1] % sp_size
+                        or not str(v.dtype).startswith(("float", "bfloat"))):
+                    continue
+                src_ok = False
+                for in_name in op.input_names():
+                    s = var(in_name)
+                    if (s is not None and s.name in pinned
+                            and len(s.shape) >= 2
+                            and s.shape[1] == v.shape[1]):
+                        src_ok = True
+                        break
+                if src_ok:
                     v.sharding = ("dp", "sp") + (None,) * (len(v.shape) - 2)
+                    pinned.add(v.name)
 
     # -- optimizer accumulators follow their param -------------------------
     for p_name, acc_name in iter_optimizer_state_inputs(block):

