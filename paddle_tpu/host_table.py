@@ -452,6 +452,10 @@ def host_embedding(input, table: HostEmbeddingTable):
                                 else "bfloat16")
         rows.is_data = True
         rows.stop_gradient = False  # the whole point: we want d(loss)/d(rows)
+        # host-prepared per-process block: replicated on ANY device mesh
+        # (ParallelExecutor's default feed heuristic would otherwise
+        # dp-split dim 0 = capacity, which is not a batch axis)
+        rows.sharding = (None,)
     out = helper.create_tmp_variable("float32")
     helper.append_op("lookup_table", {"W": rows, "Ids": input},
                      {"Out": out}, {"is_sparse": False})

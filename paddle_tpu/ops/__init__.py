@@ -21,5 +21,6 @@ from . import misc_ops  # noqa: F401
 from . import pipeline_ops  # noqa: F401
 from . import moe_ops  # noqa: F401
 from . import volumetric_ops  # noqa: F401
+from . import fused_ops  # noqa: F401
 
 from ..core.registry import registered_ops  # noqa: F401

@@ -65,6 +65,18 @@ def _op_flops(op, block, batch) -> int:
         else:
             k = x[-1]
         return 2 * _prod(out) * int(k)
+    if t == "fused_bottleneck":
+        # three convs over the same spatial extent: 1x1 Cin->C, 3x3 C->C,
+        # 1x1 C->Cin (ops/fused_ops.py); identical count to the op-by-op
+        # graph it replaces
+        x = _shape(block, op.inputs["X"][0], batch)
+        w1 = _shape(block, op.inputs["W1"][0], batch)
+        w2 = _shape(block, op.inputs["W2"][0], batch)
+        n, cin = x[0], x[1]
+        sp = _prod(x[2:])
+        c = w1[0]
+        k2 = _prod(w2[1:])
+        return 2 * n * sp * (cin * c + c * k2 + c * cin)
     if t == "scaled_dot_product_attention":
         q = _shape(block, op.inputs["Q"][0], batch)
         kv = _shape(block, op.inputs["K"][0], batch)

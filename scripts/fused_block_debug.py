@@ -1,0 +1,120 @@
+"""Numerics debugger for the fused-block backward: f32, CPU interpreter,
+small shapes — compares the custom VJP against jax.grad of an exact jnp
+replica of the fused forward semantics (no bf16 rounding anywhere, so
+agreement should be ~1e-5)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.kernels import fused_block as fb
+
+fb.INTERPRET = True
+
+N, C0, C, H = 2, 16, 8, 8
+S = H * H
+EPS = 1e-5
+
+
+def replica(x, w1, taps, w3, g1, b1, g2, b2, g3, b3):
+    """Exact f32 jnp mirror of bottleneck_rest_fwd (biased var, analytic
+    bn3 == direct bn3 in exact arithmetic)."""
+    def bn(a, g, b):
+        m = jnp.mean(a, axis=(0, 2))
+        v = jnp.mean(a * a, axis=(0, 2)) - m * m
+        inv = jax.lax.rsqrt(v + EPS)
+        y = (a - m[None, :, None]) * (inv * g)[None, :, None] \
+            + b[None, :, None]
+        return y, m, v
+
+    a1 = jnp.einsum("oc,ncs->nos", w1, x)
+    h1, m1, v1 = bn(a1, g1, b1)
+    h1 = jnp.maximum(h1, 0)
+    # 3x3 conv via taps on the flattened [C, S] view
+    h1img = h1.reshape(N, C, H, H)
+    h1pad = jnp.pad(h1img, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    a2 = jnp.zeros((N, C, H, H), jnp.float32)
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            t = (dy + 1) * 3 + (dx + 1)
+            sl = h1pad[:, :, 1 + dy:1 + dy + H, 1 + dx:1 + dx + H]
+            a2 += jnp.einsum("oc,nchw->nohw", taps[t], sl)
+    a2 = a2.reshape(N, C, S)
+    h2, m2, v2 = bn(a2, g2, b2)
+    h2 = jnp.maximum(h2, 0)
+    a3 = jnp.einsum("oc,ncs->nos", w3, h2)
+    h3, m3, v3 = bn(a3, g3, b3)
+    out = jnp.maximum(h3 + x, 0)
+    return out, (m1, v1, m2, v2, m3, v3)
+
+
+def main():
+    ks = jax.random.split(jax.random.PRNGKey(7), 12)
+    x = jax.random.normal(ks[0], (N, C0, S), jnp.float32)
+    w1 = jax.random.normal(ks[1], (C, C0)) * 0.3
+    taps = jax.random.normal(ks[2], (9, C, C)) * 0.2
+    w3 = jax.random.normal(ks[3], (C0, C)) * 0.3
+    g1 = 1.0 + 0.2 * jax.random.normal(ks[4], (C,))
+    b1 = 0.2 * jax.random.normal(ks[5], (C,))
+    g2 = 1.0 + 0.2 * jax.random.normal(ks[6], (C,))
+    b2 = 0.2 * jax.random.normal(ks[7], (C,))
+    g3 = 1.0 + 0.2 * jax.random.normal(ks[8], (C0,))
+    b3 = 0.2 * jax.random.normal(ks[9], (C0,))
+    args = (x, w1, taps, w3, g1, b1, g2, b2, g3, b3)
+
+    # forward parity first
+    outs = fb.fused_bottleneck_rest(*args, H, EPS)
+    rout, rstats = replica(*args)
+    print("fwd out err:", float(jnp.max(jnp.abs(outs[0] - rout))))
+    for i, nm in enumerate(("m1", "v1", "m2", "v2", "m3", "v3")):
+        print(f"  {nm} err: {float(jnp.max(jnp.abs(outs[1 + i] - rstats[i]))):.2e}")
+
+    dvec = jax.random.normal(ks[10], (N, C0, S), jnp.float32)
+
+    def loss_f(*a):
+        o = fb.fused_bottleneck_rest(*a, H, EPS)
+        return jnp.sum(o[0] * dvec)
+
+    def loss_r(*a):
+        o, _ = replica(*a)
+        return jnp.sum(o * dvec)
+
+    gf = jax.grad(loss_f, argnums=tuple(range(10)))(*args)
+    gr = jax.grad(loss_r, argnums=tuple(range(10)))(*args)
+    names = ["dx", "dw1", "dtaps", "dw3", "dg1", "db1", "dg2", "db2",
+             "dg3", "db3"]
+    for nm, a, b in zip(names, gf, gr):
+        scale = jnp.max(jnp.abs(b)) + 1e-12
+        print(f"  {nm}: max rel err = {float(jnp.max(jnp.abs(a - b)) / scale):.3e}")
+
+    # stat-cotangent exactness: make the loss touch every stat output
+    cvecs = [jax.random.normal(jax.random.PRNGKey(100 + i), s.shape)
+             for i, s in enumerate(outs[1:])]
+
+    def loss_f2(*a):
+        o = fb.fused_bottleneck_rest(*a, H, EPS)
+        return jnp.sum(o[0] * dvec) + sum(
+            jnp.sum(c * s) for c, s in zip(cvecs, o[1:]))
+
+    def loss_r2(*a):
+        o, st = replica(*a)
+        return jnp.sum(o * dvec) + sum(
+            jnp.sum(c * s) for c, s in zip(cvecs, st))
+
+    gf2 = jax.grad(loss_f2, argnums=tuple(range(10)))(*args)
+    gr2 = jax.grad(loss_r2, argnums=tuple(range(10)))(*args)
+    print("with stat cotangents:")
+    for nm, a, b in zip(names, gf2, gr2):
+        scale = jnp.max(jnp.abs(b)) + 1e-12
+        print(f"  {nm}: max rel err = {float(jnp.max(jnp.abs(a - b)) / scale):.3e}")
+
+
+if __name__ == "__main__":
+    main()

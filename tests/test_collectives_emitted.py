@@ -242,3 +242,61 @@ class TestMoE:
         for s in gather_shapes(hlo):
             assert len(s) < 2 or s[0] != 4, \
                 f"expert-stack all-gather {s}: ep sharding fell back"
+
+
+class TestComposedMesh:
+    def test_dp_sp_tp_host_table_histogram(self):
+        """The composed dp×sp×tp program (__graft_entry__'s composed dryrun
+        leg: ring attention + tp-pattern head + host-RAM embedding) keeps
+        every axis's collective signature SIMULTANEOUSLY — composition must
+        not regress any single axis to a gather fallback."""
+        from paddle_tpu.host_table import HostEmbeddingTable, host_embedding
+        from paddle_tpu.param_attr import ParamAttr
+
+        mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+        # d_model 48 != SEQ: with d_model == SEQ the full-seq-gather
+        # detector cannot tell a hidden-dim match from a sequence match
+        table = HostEmbeddingTable("hist_emb", 64, 48, capacity=256, seed=3)
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                ids = layers.data("ids", [SEQ], dtype="int64")
+                tgt = layers.data("tgt", [SEQ, 1], dtype="int64")
+                emb = host_embedding(ids, table)
+                ln1 = layers.layer_norm(
+                    emb, begin_norm_axis=2, name="h_ln1",
+                    param_attr=ParamAttr(name="h_ln1_s"),
+                    bias_attr=ParamAttr(name="h_ln1_b"))
+                att = layers.multi_head_attention(ln1, num_heads=4,
+                                                  causal=True, name="h_at")
+                x = layers.elementwise_add(emb, att)
+                ff = layers.fc(layers.fc(x, size=64, num_flatten_dims=2,
+                                         act="relu"),
+                               size=48, num_flatten_dims=2)
+                x = layers.elementwise_add(x, ff)
+                logits = layers.fc(x, size=64, num_flatten_dims=2)
+                loss = layers.mean(
+                    layers.softmax_with_cross_entropy(logits, tgt))
+                pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+            pt.transpiler.transpile(
+                main, mesh=mesh,
+                strategy=pt.TranspileStrategy(sp_mode="ring"))
+            rng = np.random.RandomState(5)
+            raw = rng.randint(0, 64, (4, SEQ)).astype(np.int64)
+            prep, _hb = table.prepare(raw)
+            feed = {"ids": prep[table.local_ids_name],
+                    table.rows_name: prep[table.rows_name],
+                    "tgt": np.roll(raw, -1, 1).reshape(4, SEQ, 1)}
+            hlo = _compile(main, startup, loss, mesh, feed)
+        finally:
+            table.unregister()
+        h = collective_hist(hlo)
+        # ring sp: the ppermute chains survive composition
+        assert h.get("collective-permute", 0) >= 4, h
+        # no axis regressed to a full-sequence activation gather
+        _assert_no_full_seq_gather(hlo)
+        # dp grad reduction (and tp partial-sum reduction) present
+        assert h.get("all-reduce", 0) + h.get("reduce-scatter", 0) >= 1, h
+        # the host-table rows block stays replicated: no [capacity, dim]
+        # gather traffic (256, 48) — it is feed data, not sharded state
+        assert (256, 48) not in gather_shapes(hlo), gather_shapes(hlo)

@@ -1,0 +1,153 @@
+"""fused_bottleneck op/layer: the tuned-kernel tier above the generic conv
+path (ops/fused_ops.py, kernels/fused_block.py; ≙ the role of the
+reference's conv_cudnn_op.cu.cc tier).
+
+On CPU the op lowers to the composition path — these tests pin the op's
+program-level semantics (training, state threading, autodiff, inference
+mode, fused↔unfused numerical agreement); the Pallas path's numerics are
+pinned by scripts/fused_block_debug.py (f32 interpreter, exact) and the
+on-chip dev harness."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+N, C, CH, H = 16, 32, 8, 8  # block input [N, 32, 8, 8], bottleneck width 8
+
+
+def _build(lr=0.1):
+    data = layers.data("data", [C, H, H], dtype="float32")
+    label = layers.data("label", [1], dtype="int64")
+    block = layers.fused_bottleneck(data, CH)
+    pool = layers.pool2d(block, pool_type="avg", global_pooling=True)
+    logits = layers.fc(pool, size=10, act=None)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    opt = pt.optimizer.MomentumOptimizer(learning_rate=lr, momentum=0.9)
+    opt.minimize(loss)
+    return loss
+
+
+def _feed(i):
+    rng = np.random.RandomState(100 + i)
+    data = rng.rand(N, C, H, H).astype("float32")
+    label = (data[:, 0, 0, 0] * 9.999).astype("int64").reshape(-1, 1)
+    return {"data": data, "label": label}
+
+
+def test_trains_and_threads_bn_state():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        loss = _build()
+    mean_vars = [v.name for v in main.global_block.vars.values()
+                 if v.persistable and "b_" not in v.name
+                 and v.dtype == "float32" and len(v.shape) == 1
+                 and v.name.startswith("fused_bottleneck")]
+    assert mean_vars, "fused block created BN state vars"
+    exe = pt.Executor()
+    exe.run(startup)
+    losses = []
+    for i in range(60):
+        (lv,) = exe.run(main, feed=_feed(i), fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) - 0.05, losses
+    # running stats moved off their init (mean 0 / var 1)
+    scope = pt.global_scope()
+    moved = 0
+    for name in mean_vars:
+        arr = scope.get_numpy(name)
+        if not np.allclose(arr, 0.0) and not np.allclose(arr, 1.0):
+            moved += 1
+    assert moved > 0
+
+
+def test_matches_unfused_composition():
+    """Same init weights → fused op output == op-by-op graph output (the
+    CPU fallback is definitionally the composition; this pins the layer
+    wiring, layouts and state plumbing end to end)."""
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(CH, C, 1, 1).astype("float32") * 0.2
+    w2 = rng.randn(CH, CH, 3, 3).astype("float32") * 0.1
+    w3 = rng.randn(C, CH, 1, 1).astype("float32") * 0.2
+    x = rng.randn(N, C, H, H).astype("float32")
+
+    def run_one(fused):
+        pt.core.program.reset_unique_names()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            data = layers.data("data", [C, H, H], dtype="float32")
+            if fused:
+                out = layers.fused_bottleneck(data, CH)
+            else:
+                c1 = layers.conv2d(data, CH, 1, act=None, bias_attr=False)
+                b1 = layers.batch_norm(c1, act="relu")
+                c2 = layers.conv2d(b1, CH, 3, padding=1, act=None,
+                                   bias_attr=False)
+                b2 = layers.batch_norm(c2, act="relu")
+                c3 = layers.conv2d(b2, C, 1, act=None, bias_attr=False)
+                b3 = layers.batch_norm(c3, act=None)
+                out = layers.elementwise_add(x=data, y=b3, act="relu")
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            # overwrite conv weights with the shared fixtures
+            names = [v.name for v in startup.global_block.vars.values()
+                     if v.is_parameter and "w_" in v.name
+                     and len(v.shape) == 4]
+            names.sort(key=lambda n: (startup.global_block.vars[n].shape[2],
+                                      n))
+            fixtures = {1: [w1, w3], 3: [w2]}
+            used = {1: 0, 3: 0}
+            for n in names:
+                k = startup.global_block.vars[n].shape[2]
+                # order within same k: creation order = w1 then w3
+                arr = fixtures[k][used[k]]
+                used[k] += 1
+                scope.set_var(n, arr)
+            (o,) = exe.run(main, feed={"data": x}, fetch_list=[out])
+        return np.asarray(o)
+
+    fused_out = run_one(True)
+    ref_out = run_one(False)
+    np.testing.assert_allclose(fused_out, ref_out, rtol=2e-4, atol=2e-4)
+
+
+def test_resnet_emits_fused_op_in_train_and_infer():
+    """Both graphs emit the op (is_test attr switches the math) so
+    parameter names match and train checkpoints load into infer graphs."""
+    from paddle_tpu.models import resnet
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        resnet.get_model(data_set="imagenet", depth=50, dtype="float32")
+    types = [op.type for op in main.global_block.ops]
+    n_fused = types.count("fused_bottleneck")
+    assert n_fused == 12, f"12 rest blocks expected, got {n_fused}"
+    train_params = {v.name for v in startup.global_block.vars.values()
+                    if v.is_parameter}
+
+    pt.core.program.reset_unique_names()
+    main_t, startup_t = pt.Program(), pt.Program()
+    with pt.program_guard(main_t, startup_t):
+        resnet.get_model(data_set="imagenet", depth=50, dtype="float32",
+                         is_test=True)
+    types_t = [op.type for op in main_t.global_block.ops]
+    assert types_t.count("fused_bottleneck") == 12
+    infer_params = {v.name for v in startup_t.global_block.vars.values()
+                    if v.is_parameter}
+    assert train_params == infer_params, (
+        train_params.symmetric_difference(infer_params))
+
+
+def test_flops_counts_fused_op():
+    from paddle_tpu.utils.flops import program_forward_flops
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        data = layers.data("data", [C, H, H], dtype="float32")
+        layers.fused_bottleneck(data, CH)
+    got = program_forward_flops(main, batch=N)
+    want = 2 * N * H * H * (C * CH + CH * CH * 9 + CH * C)
+    assert got == want, (got, want)
