@@ -181,6 +181,17 @@ class Executor:
         compiled = self._cache.get(key)
         was_cached = compiled is not None
         if compiled is None:
+            # grouped-conv autotune pre-pass (utils/gconv_autotune.py):
+            # the formulation choice inside the trace is cache-lookup
+            # only, so any un-tuned shape must be measured BEFORE tracing
+            from ..utils import gconv_autotune
+            # per_step_feeds arrays carry a leading [n_steps] axis: the
+            # batch lives at dim 1 there (dim 0 otherwise)
+            bdim = 1 if per_step_feed_prep else 0
+            bh = next((int(jnp.shape(v)[bdim])
+                       for v in feed_arrays.values()
+                       if len(jnp.shape(v)) > bdim), 8)
+            gconv_autotune.tune_program(program, bh)
             raw, state_out, donate = build(program, list(feed_arrays),
                                            fetch_names, sorted(state))
             if FLAGS.check_nan_inf:

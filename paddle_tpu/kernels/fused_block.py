@@ -150,13 +150,17 @@ fused_bottleneck_rest.defvjp(_fused_rest_fwd, _fused_rest_bwd)
 # ---------------------------------------------------------------------------
 
 
-def _b1a_kernel(dout_ref, out_ref, a2_ref, aff2_ref, red_ref):
+def _b1a_kernel(dout_ref, out_ref, a2_ref, aff2_ref, g3_ref, red_ref):
     """Reduction pass for bn3: P = g3 @ h2ᵀ and Σg3, with
-    g3 = dout·(out>0) and h2 recomputed from raw a2 on load."""
+    g3 = dout·(out>0) and h2 recomputed from raw a2 on load.  g3 is
+    MATERIALIZED here so B1b/B3 read one tensor instead of re-deriving it
+    from the (dout, out) pair — one extra write, two (dout+out) re-read
+    pairs saved."""
     i = pl.program_id(0)
     # Mosaic cannot compare bf16 vectors; the mask compare runs in f32
     g3 = jnp.where(out_ref[0].astype(jnp.float32) > 0, dout_ref[0],
                    jnp.zeros_like(dout_ref[0]))
+    g3_ref[0] = g3
     a2 = a2_ref[0]
     h2 = jnp.maximum(a2.astype(jnp.float32) * aff2_ref[:, 0:1]
                      + aff2_ref[:, 1:2], 0.0).astype(a2.dtype)
@@ -178,7 +182,7 @@ def bwd_reduce3(dout, out, a2, scale2, shift2):
     n, c0, s = dout.shape
     c = a2.shape[1]
     aff2 = jnp.stack([scale2, shift2], axis=1)
-    red = pl.pallas_call(
+    g3, red = pl.pallas_call(
         _b1a_kernel,
         interpret=INTERPRET,
         grid=(n,),
@@ -191,26 +195,31 @@ def bwd_reduce3(dout, out, a2, scale2, shift2):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((c, 2), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((c0, c + 1), lambda i: (0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((c0, c + 1), jnp.float32),
+        out_specs=[
+            pl.BlockSpec((1, c0, s), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c0, c + 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, c0, s), dout.dtype),
+            jax.ShapeDtypeStruct((c0, c + 1), jnp.float32),
+        ],
         cost_estimate=pl.CostEstimate(
             flops=2 * n * c0 * c * s,
-            bytes_accessed=(2 * n * c0 * s + n * c * s) * dout.dtype.itemsize,
+            bytes_accessed=(3 * n * c0 * s + n * c * s) * dout.dtype.itemsize,
             transcendentals=0,
         ),
     )(dout, out, a2, aff2)
-    return red[:, :c], red[:, c]          # P, sum_g3
+    return g3, red[:, :c], red[:, c]          # g3, P, sum_g3
 
 
-def _b1b_kernel(dout_ref, out_ref, a2_ref, aff2_ref, amat_ref, bmat_ref,
+def _b1b_kernel(g3_ref, a2_ref, aff2_ref, amat_ref, bmat_ref,
                 v0_ref, xh2_ref, g2_ref, red_ref):
     """Apply pass: g2 = (A@g3 + B@h2 + v0) · (h2f>0), with bn2's backward
     reductions (Σg2, Σg2·xhat2) accumulated in the epilogue."""
     i = pl.program_id(0)
-    # Mosaic cannot compare bf16 vectors; the mask compare runs in f32
-    g3 = jnp.where(out_ref[0].astype(jnp.float32) > 0, dout_ref[0],
-                   jnp.zeros_like(dout_ref[0]))
+    g3 = g3_ref[0]
     a2 = a2_ref[0]
     a2f = a2.astype(jnp.float32)
     h2f = jnp.maximum(a2f * aff2_ref[:, 0:1] + aff2_ref[:, 1:2], 0.0)
@@ -236,8 +245,8 @@ def _b1b_kernel(dout_ref, out_ref, a2_ref, aff2_ref, amat_ref, bmat_ref,
         red_ref[:] = red_ref[:] + red
 
 
-def bwd_apply3(dout, out, a2, scale2, shift2, amat, bmat, v0, inv2, m2):
-    n, c0, s = dout.shape
+def bwd_apply3(g3, a2, scale2, shift2, amat, bmat, v0, inv2, m2):
+    n, c0, s = g3.shape
     c = a2.shape[1]
     aff2 = jnp.stack([scale2, shift2], axis=1)
     v0c = jnp.stack([v0, jnp.zeros_like(v0)], axis=1)
@@ -247,8 +256,6 @@ def bwd_apply3(dout, out, a2, scale2, shift2, amat, bmat, v0, inv2, m2):
         interpret=INTERPRET,
         grid=(n,),
         in_specs=[
-            pl.BlockSpec((1, c0, s), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
             pl.BlockSpec((1, c0, s), lambda i: (i, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, c, s), lambda i: (i, 0, 0),
@@ -265,16 +272,16 @@ def bwd_apply3(dout, out, a2, scale2, shift2, amat, bmat, v0, inv2, m2):
             pl.BlockSpec((c, 2), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, c, s), dout.dtype),
+            jax.ShapeDtypeStruct((n, c, s), g3.dtype),
             jax.ShapeDtypeStruct((c, 2), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * n * (c * c0 + c * c) * s,
-            bytes_accessed=(2 * n * c0 * s + 2 * n * c * s)
-            * dout.dtype.itemsize,
+            bytes_accessed=(n * c0 * s + 2 * n * c * s)
+            * g3.dtype.itemsize,
             transcendentals=0,
         ),
-    )(dout, out, a2, aff2, amat, bmat, v0c, xh2)
+    )(g3, a2, aff2, amat, bmat, v0c, xh2)
     return g2, red[:, 0], red[:, 1]
 
 
@@ -299,27 +306,58 @@ def _b2_kernel(h_side, w_side, g2_ref, a2_ref, a1_ref, aff1_ref, cst2_ref,
     col = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1) % w_side
     row = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1) // w_side
     c = a1_ref.shape[1]
+
+    # Grouped rolls (mirror of _k2_kernel's decomposition — 8 rolls
+    # total instead of 16):
+    #   dgrad  dh1[p] = Σ_dx v'_dx[p−dx],
+    #          v'_dx = Σ_dy Wᵀ_(dy,dx) @ ds_dy,  ds_dy[q] = da2[q−dyW]
+    #   wgrad  dW_(dy,dx) = dc_dx @ hr_dyᵀ,
+    #          dc_dx[q] = da2[q−dx]·[col(q)−dx valid],
+    #          hr_dy[q] = h1[q+dyW]·[row(q)+dy valid]
+    ds = {}
+    for dy in (-1, 0, 1):
+        if dy:
+            rr = pltpu.roll(da2f, (dy * w_side) % s, axis=1)
+            vrow = (row - dy >= 0) & (row - dy < h_side)
+            rr = jnp.where(vrow, rr, 0.0)
+        else:
+            rr = da2f
+        ds[dy] = rr.astype(a1.dtype)
     dh1 = jnp.zeros((c, s), jnp.float32)
+    for dx in (-1, 0, 1):
+        v = jnp.zeros((c, s), jnp.float32)
+        for dy in (-1, 0, 1):
+            v += jnp.dot(tapsT_ref[(dy + 1) * 3 + (dx + 1)], ds[dy],
+                         preferred_element_type=jnp.float32)
+        if dx:
+            v = pltpu.roll(v, dx % s, axis=1)               # v'[p]=v[p−dx]
+            vcol = (col - dx >= 0) & (col - dx < w_side)
+            v = jnp.where(vcol, v, 0.0)
+        dh1 += v
+
+    dc = {}
+    for dx in (-1, 0, 1):
+        if dx:
+            cc = pltpu.roll(da2f, dx % s, axis=1)           # cc[q]=da2[q−dx]
+            vcol = (col - dx >= 0) & (col - dx < w_side)
+            cc = jnp.where(vcol, cc, 0.0)
+        else:
+            cc = da2f
+        dc[dx] = cc.astype(a1.dtype)
+    hr = {}
+    for dy in (-1, 0, 1):
+        if dy:
+            rr = pltpu.roll(h1f, (-dy * w_side) % s, axis=1)  # hr[q]=h1[q+dyW]
+            vrow = (row + dy >= 0) & (row + dy < h_side)
+            rr = jnp.where(vrow, rr, 0.0)
+        else:
+            rr = h1f
+        hr[dy] = rr.astype(a1.dtype)
     dw2_acc = []
     for dy in (-1, 0, 1):
         for dx in (-1, 0, 1):
-            off = dy * w_side + dx
-            t = (dy + 1) * 3 + (dx + 1)
-            # dgrad: dh1[p] += W_tᵀ @ da2[p − off], valid where the fwd tap
-            # read position p (i.e. p − off is a pixel whose tap p existed)
-            sh_da2 = pltpu.roll(da2f, off % s, axis=1) if off else da2f
-            valid_t = ((col - dx >= 0) & (col - dx < w_side) &
-                       (row - dy >= 0) & (row - dy < h_side))
-            m_da2 = jnp.where(valid_t, sh_da2, 0.0).astype(a1.dtype)
-            dh1 += jnp.dot(tapsT_ref[t], m_da2,
-                           preferred_element_type=jnp.float32)
-            # wgrad: dW_t = Σ_p da2[p] · h1[p + off]ᵀ (same mask as fwd)
-            sh_h1 = pltpu.roll(h1f, (-off) % s, axis=1) if off else h1f
-            valid_f = ((col + dx >= 0) & (col + dx < w_side) &
-                       (row + dy >= 0) & (row + dy < h_side))
-            m_h1 = jnp.where(valid_f, sh_h1, 0.0).astype(a1.dtype)
             dw2_acc.append(jax.lax.dot_general(
-                da2, m_h1, (((1,), (1,)), ((), ())),
+                dc[dx], hr[dy], (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32))
     dw2 = jnp.stack(dw2_acc)                      # [9, Cout, Cin]
     g1f = jnp.where(h1f > 0, dh1, 0.0)
@@ -388,14 +426,12 @@ def bwd_mid(g2, a2, a1, scale1, shift1, p2, q2, r2, inv1, m1, taps,
     return g1, dw2, red[:, 0], red[:, 1]
 
 
-def _b3_kernel(dout_ref, out_ref, g1_ref, a1_ref, x_ref, cst1_ref,
+def _b3_kernel(g3_ref, g1_ref, a1_ref, x_ref, cst1_ref,
                w1t_ref, dx_ref, dw1_ref):
     """Final assembly: da1 = g1·p + a1·q + r, dx = W1ᵀ@da1 + g3,
     dW1 accumulated over the batch."""
     i = pl.program_id(0)
-    # Mosaic cannot compare bf16 vectors; the mask compare runs in f32
-    g3 = jnp.where(out_ref[0].astype(jnp.float32) > 0, dout_ref[0],
-                   jnp.zeros_like(dout_ref[0]))
+    g3 = g3_ref[0]
     a1 = a1_ref[0]
     da1f = g1_ref[0].astype(jnp.float32) * cst1_ref[:, 0:1] \
         + a1.astype(jnp.float32) * cst1_ref[:, 1:2] + cst1_ref[:, 2:3]
@@ -415,8 +451,8 @@ def _b3_kernel(dout_ref, out_ref, g1_ref, a1_ref, x_ref, cst1_ref,
         dw1_ref[:] = dw1_ref[:] + dw1
 
 
-def bwd_final(dout, out, g1, a1, x, p1, q1, r1, w1):
-    n, c0, s = dout.shape
+def bwd_final(g3, g1, a1, x, p1, q1, r1, w1):
+    n, c0, s = g3.shape
     c = a1.shape[1]
     cst1 = jnp.stack([p1, q1, r1], axis=1)
     w1t = jnp.transpose(w1)                       # [Cin, C]
@@ -425,8 +461,6 @@ def bwd_final(dout, out, g1, a1, x, p1, q1, r1, w1):
         interpret=INTERPRET,
         grid=(n,),
         in_specs=[
-            pl.BlockSpec((1, c0, s), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
             pl.BlockSpec((1, c0, s), lambda i: (i, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, c, s), lambda i: (i, 0, 0),
@@ -444,16 +478,16 @@ def bwd_final(dout, out, g1, a1, x, p1, q1, r1, w1):
             pl.BlockSpec((c, c0), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, c0, s), dout.dtype),
+            jax.ShapeDtypeStruct((n, c0, s), g3.dtype),
             jax.ShapeDtypeStruct((c, c0), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * 2 * n * c * c0 * s,
-            bytes_accessed=(4 * n * c0 * s + 2 * n * c * s)
-            * dout.dtype.itemsize,
+            bytes_accessed=(3 * n * c0 * s + 2 * n * c * s)
+            * g3.dtype.itemsize,
             transcendentals=0,
         ),
-    )(dout, out, g1, a1, x, cst1, w1t)
+    )(g3, g1, a1, x, cst1, w1t)
     return dx, dw1
 
 
@@ -487,7 +521,7 @@ def bottleneck_rest_bwd(res, dout, stat_cots, h_side, eps=EPS_DEFAULT):
     w3f = w3.astype(jnp.float32)
 
     # ---- bn3 (analytic: a3 never existed) ----
-    p_mat, sum_g3 = bwd_reduce3(dout, out, a2, sc2, sh2)
+    g3t, p_mat, sum_g3 = bwd_reduce3(dout, out, a2, sc2, sh2)
     sum_g3a3 = jnp.sum(w3f * p_mat, axis=1)
     sum_g3x3 = inv3 * (sum_g3a3 - m3 * sum_g3)
     dgam3, dbeta3 = sum_g3x3, sum_g3
@@ -500,7 +534,7 @@ def bottleneck_rest_bwd(res, dout, stat_cots, h_side, eps=EPS_DEFAULT):
         + r3[:, None] * sum_h_raw[None, :]
 
     # ---- bn2 + last-1×1 transpose ----
-    g2, sum_g2, sum_g2x2 = bwd_apply3(dout, out, a2, sc2, sh2,
+    g2, sum_g2, sum_g2x2 = bwd_apply3(g3t, a2, sc2, sh2,
                                       amat, bmat, v0, inv2, m2)
     dgam2, dbeta2 = sum_g2x2, sum_g2
     p2, q2, r2 = _bn_affine_consts(inv2 * gam2, inv2, m2, sum_g2,
@@ -515,7 +549,7 @@ def bottleneck_rest_bwd(res, dout, stat_cots, h_side, eps=EPS_DEFAULT):
                                    sum_g1x1, m_count, gm1, gv1)
 
     # ---- first-1×1 transpose + residual + dW1 ----
-    dx, dw1 = bwd_final(dout, out, g1, a1, x, p1, q1, r1, w1)
+    dx, dw1 = bwd_final(g3t, g1, a1, x, p1, q1, r1, w1)
 
     return (dx, dw1.astype(w1.dtype), dtaps.astype(taps.dtype),
             dw3.astype(w3.dtype),
@@ -554,24 +588,37 @@ def _k2_kernel(h_side, w_side, x_ref, taps_ref, aff_ref, out_ref, stats_ref):
     shift = aff_ref[:, 1:2]
     # keep h in f32 until after the roll: Mosaic's lane rotate only
     # handles 32-bit data; the normalized value is f32 anyway and the
-    # bf16 rounding happens per-tap just before the MXU
+    # bf16 rounding happens just before the MXU.
+    # Grouped-roll decomposition (VPU cost was the kernel's hog): instead
+    # of 8 rolls + 9 masks (one per tap), roll by ROWS once per dy (2
+    # rolls, row-masked) and fold the column shifts into the OUTPUT frame
+    # (2 rolls + 2 masks on the accumulated v_dx):
+    #   y[p] = Σ_dx v_dx[p+dx],  v_dx = Σ_dy W_(dy,dx) @ rowshift(h, dy)
     hf = jnp.maximum(x.astype(jnp.float32) * scale + shift, 0.0)
     s = h_side * w_side
     col = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1) % w_side
     row = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1) // w_side
-    acc = jnp.zeros((taps_ref.shape[1], s), jnp.float32)
+    hs = {}
     for dy in (-1, 0, 1):
-        for dx in (-1, 0, 1):
-            off = dy * w_side + dx
-            # shifted[p] = h[p + off]  (pltpu.roll wants shift >= 0)
-            shifted = pltpu.roll(hf, (-off) % s, axis=1) if off else hf
-            valid = ((col + dx >= 0) & (col + dx < w_side) &
-                     (row + dy >= 0) & (row + dy < h_side))
-            masked = jnp.where(valid, shifted,
-                               jnp.zeros_like(shifted)).astype(x.dtype)
-            w_tap = taps_ref[(dy + 1) * 3 + (dx + 1)]   # [Cout, Cin]
-            acc += jnp.dot(w_tap, masked,
-                           preferred_element_type=jnp.float32)
+        if dy:
+            r = pltpu.roll(hf, (-dy * w_side) % s, axis=1)  # r[p]=h[p+dyW]
+            vrow = (row + dy >= 0) & (row + dy < h_side)
+            r = jnp.where(vrow, r, 0.0)
+        else:
+            r = hf
+        hs[dy] = r.astype(x.dtype)
+    cout = taps_ref.shape[1]
+    acc = jnp.zeros((cout, s), jnp.float32)
+    for dx in (-1, 0, 1):
+        v = jnp.zeros((cout, s), jnp.float32)
+        for dy in (-1, 0, 1):
+            v += jnp.dot(taps_ref[(dy + 1) * 3 + (dx + 1)], hs[dy],
+                         preferred_element_type=jnp.float32)
+        if dx:
+            v = pltpu.roll(v, (-dx) % s, axis=1)            # v'[p]=v[p+dx]
+            vcol = (col + dx >= 0) & (col + dx < w_side)
+            v = jnp.where(vcol, v, 0.0)
+        acc += v
     y = acc.astype(out_ref.dtype)
     out_ref[0] = y
     yf = y.astype(jnp.float32)

@@ -1,0 +1,280 @@
+"""Fused LSTM whole-sequence Pallas kernels.
+
+≙ the reference's hand-scheduled LSTM tier (hl_cuda_lstm.cu,
+operators/math/detail/lstm_gpu_kernel.h): there, one persistent CUDA
+kernel keeps weights in shared memory across timesteps.  The TPU
+analogue: ONE Pallas kernel runs the entire lax.scan-equivalent loop as
+its grid, with the [H,4H] recurrent weight resident in VMEM for the whole
+sequence and the (h, c) carry living in VMEM scratch — the XLA scan
+formulation (ops/rnn_ops._lstm_scan) re-streams the 2 MB weight from HBM
+and pays ~13 ops of per-step overhead on every one of T timesteps, which
+is why the bench's stacked_lstm sat at 9.9%% MFU.
+
+Semantics match _lstm_scan for the (no-peephole, no-projection,
+sigmoid/tanh/tanh) configuration: gate order i,c,f,o, length masking with
+carry-forward rows, bf16 carries rounded once per step.  The backward is
+the exact reverse-time derivation with dW/db accumulated in VMEM across
+the grid (f32), checked against jax.grad of the scan to ~1e-6 in f32.
+
+Residuals: the kernel streams out the CARRY sequences (pre-mask r_t, c_t)
+— the op's masked outputs (r_t·m) are one cheap XLA elementwise away, and
+the backward needs the carries, not the masked values.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+INTERPRET = False
+
+
+def _fwd_kernel(x_ref, w_ref, b_ref, m_ref, r0_ref, c0_ref,
+                rs_ref, cs_ref, r_scr, c_scr):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        r_scr[:] = r0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h4 = w_ref.shape[1]
+    h = h4 // 4
+    r = r_scr[:]
+    c = c_scr[:].astype(jnp.float32)
+    gates = x_ref[0].astype(jnp.float32) \
+        + jnp.dot(r, w_ref[:], preferred_element_type=jnp.float32) \
+        + b_ref[0:1, :]
+    gi = gates[:, :h]
+    gc = gates[:, h:2 * h]
+    gf = gates[:, 2 * h:3 * h]
+    go = gates[:, 3 * h:]
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf)
+    o = jax.nn.sigmoid(go)
+    cand = jnp.tanh(gc)
+    c_new = f * c + i * cand
+    r_new = o * jnp.tanh(c_new)
+    m = m_ref[0].astype(jnp.float32)        # [B, 1]
+    r_t = (m * r_new + (1.0 - m) * r.astype(jnp.float32)).astype(r_scr.dtype)
+    c_t = (m * c_new + (1.0 - m) * c).astype(c_scr.dtype)
+    r_scr[:] = r_t
+    c_scr[:] = c_t
+    rs_ref[0] = r_t
+    cs_ref[0] = c_t
+
+
+def lstm_seq_fwd(x, w, b, mask, r0, c0):
+    """x: [T,B,4H] time-major pre-projected inputs; w: [H,4H]; b: [4H];
+    mask: [T,B]; r0/c0: [B,H].  Returns carry sequences (rs, cs) [T,B,H].
+    """
+    tt, bb, h4 = x.shape
+    h = h4 // 4
+    b2 = b.reshape(1, h4)
+    rs, cs = pl.pallas_call(
+        _fwd_kernel,
+        interpret=INTERPRET,
+        grid=(tt,),
+        in_specs=[
+            pl.BlockSpec((1, bb, h4), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((h, h4), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h4), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bb, 1), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, h), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, h), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bb, h), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bb, h), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((tt, bb, h), x.dtype),
+            jax.ShapeDtypeStruct((tt, bb, h), x.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bb, h), x.dtype),
+            pltpu.VMEM((bb, h), x.dtype),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * tt * bb * h * h4,
+            bytes_accessed=(x.size + 2 * tt * bb * h) * x.dtype.itemsize,
+            transcendentals=4 * tt * bb * h,
+        ),
+    )(x, w, b2, mask.reshape(tt, bb, 1), r0, c0)
+    return rs, cs
+
+
+def _bwd_kernel(x_ref, w_ref, b_ref, m_ref, rp_ref, cp_ref, drs_ref,
+                dcs_ref, dx_ref, dw_ref, db_ref, dr0_ref, dc0_ref,
+                dr_scr, dc_scr):
+    """Reverse-time step (grid index k runs the ORIGINAL t = T-1-k via the
+    index maps).  Recomputes the gate path from the streamed residuals,
+    carries (dr, dc) in f32 scratch, accumulates dW/db in VMEM."""
+    k = pl.program_id(0)
+    tt = pl.num_programs(0)
+    h4 = w_ref.shape[1]
+    h = h4 // 4
+
+    @pl.when(k == 0)
+    def _():
+        dr_scr[:] = jnp.zeros_like(dr_scr)
+        dc_scr[:] = jnp.zeros_like(dc_scr)
+
+    r_prev = rp_ref[0]
+    c_prev = cp_ref[0].astype(jnp.float32)
+    gates = x_ref[0].astype(jnp.float32) \
+        + jnp.dot(r_prev, w_ref[:], preferred_element_type=jnp.float32) \
+        + b_ref[0:1, :]
+    gi = gates[:, :h]
+    gc = gates[:, h:2 * h]
+    gf = gates[:, 2 * h:3 * h]
+    go = gates[:, 3 * h:]
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf)
+    o = jax.nn.sigmoid(go)
+    cand = jnp.tanh(gc)
+    c_new = f * c_prev + i * cand
+    tc = jnp.tanh(c_new)
+
+    m = m_ref[0].astype(jnp.float32)        # [B, 1]
+    d_rt = dr_scr[:] + drs_ref[0].astype(jnp.float32)
+    d_ct = dc_scr[:] + dcs_ref[0].astype(jnp.float32)
+    dr_new = d_rt * m
+    dr_prev = d_rt * (1.0 - m)
+    dc_new = d_ct * m
+    dc_prev = d_ct * (1.0 - m)
+    do = dr_new * tc
+    dc_new = dc_new + dr_new * o * (1.0 - tc * tc)
+    df = dc_new * c_prev
+    di = dc_new * cand
+    dcand = dc_new * i
+    dc_prev = dc_prev + dc_new * f
+    dgi = di * i * (1.0 - i)
+    dgf = df * f * (1.0 - f)
+    dgo = do * o * (1.0 - o)
+    dgc = dcand * (1.0 - cand * cand)
+    dgates = jnp.concatenate([dgi, dgc, dgf, dgo], axis=1)
+    dgates_lp = dgates.astype(x_ref.dtype)
+    dx_ref[0] = dgates_lp
+    dr_prev = dr_prev + jax.lax.dot_general(
+        dgates_lp, w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dr_scr[:] = dr_prev
+    dc_scr[:] = dc_prev
+
+    dw_step = jax.lax.dot_general(
+        r_prev, dgates_lp, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [H, 4H]
+    db_step = jnp.sum(dgates, axis=0, keepdims=True)     # [1, 4H]
+
+    @pl.when(k == 0)
+    def _():
+        dw_ref[:] = dw_step
+        db_ref[:] = db_step
+
+    @pl.when(k > 0)
+    def _():
+        dw_ref[:] = dw_ref[:] + dw_step
+        db_ref[:] = db_ref[:] + db_step
+
+    @pl.when(k == tt - 1)
+    def _():
+        dr0_ref[:] = dr_scr[:]
+        dc0_ref[:] = dc_scr[:]
+
+
+def lstm_seq_bwd(x, w, b, mask, r_prevs, c_prevs, drs, dcs):
+    """Inputs mirror the fwd residuals: r_prevs/c_prevs are the carry
+    sequences SHIFTED by one (element t holds r_{t-1}, with r0 at t=0 —
+    the caller builds them with one concatenate).  Returns
+    (dx [T,B,4H], dw [H,4H] f32, db [4H] f32, dr0, dc0)."""
+    tt, bb, h4 = x.shape
+    h = h4 // 4
+    b2 = b.reshape(1, h4)
+    rev = lambda t: (tt - 1 - t, 0, 0)
+    dx, dw, db, dr0, dc0 = pl.pallas_call(
+        _bwd_kernel,
+        interpret=INTERPRET,
+        grid=(tt,),
+        in_specs=[
+            pl.BlockSpec((1, bb, h4), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((h, h4), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h4), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bb, 1), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bb, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bb, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bb, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bb, h), rev, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bb, h4), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((h, h4), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h4), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, h), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, h), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((tt, bb, h4), x.dtype),
+            jax.ShapeDtypeStruct((h, h4), jnp.float32),
+            jax.ShapeDtypeStruct((1, h4), jnp.float32),
+            jax.ShapeDtypeStruct((bb, h), jnp.float32),
+            jax.ShapeDtypeStruct((bb, h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bb, h), jnp.float32),
+            pltpu.VMEM((bb, h), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=3 * 2 * tt * bb * h * h4,
+            bytes_accessed=(5 * tt * bb * h + 2 * x.size)
+            * x.dtype.itemsize,
+            transcendentals=4 * tt * bb * h,
+        ),
+    )(x, w, b2, mask.reshape(tt, bb, 1), r_prevs, c_prevs, drs, dcs)
+    return dx, dw, db.reshape(h4), dr0, dc0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def lstm_sequence(x, w, b, mask, r0, c0):
+    """Differentiable fused whole-sequence LSTM.  All args time-major /
+    batch-major as in lstm_seq_fwd; returns CARRY sequences (rs, cs)."""
+    rs, cs = lstm_seq_fwd(x, w, b, mask, r0, c0)
+    return rs, cs
+
+
+def _lstm_fwd(x, w, b, mask, r0, c0):
+    rs, cs = lstm_seq_fwd(x, w, b, mask, r0, c0)
+    return (rs, cs), (x, w, b, mask, r0, c0, rs, cs)
+
+
+def _lstm_bwd(res, cts):
+    x, w, b, mask, r0, c0, rs, cs = res
+    drs, dcs = cts
+    r_prevs = jnp.concatenate([r0[None], rs[:-1]], axis=0)
+    c_prevs = jnp.concatenate([c0[None], cs[:-1]], axis=0)
+    dx, dw, db, dr0, dc0 = lstm_seq_bwd(x, w, b, mask, r_prevs, c_prevs,
+                                        drs, dcs)
+    return (dx, dw.astype(w.dtype), db.astype(b.dtype),
+            jnp.zeros_like(mask), dr0.astype(r0.dtype),
+            dc0.astype(c0.dtype))
+
+
+lstm_sequence.defvjp(_lstm_fwd, _lstm_bwd)

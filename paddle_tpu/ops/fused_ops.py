@@ -134,8 +134,9 @@ def fused_bottleneck(ctx, ins, attrs):
                 "MeanOut3": [m3], "VarOut3": [v3],
                 "SavedMean3": [m3], "SavedVar3": [v3]}
 
+    min_s = int(os.environ.get("PT_FUSED_BLOCK_MIN_S", 196))
     use_pallas = (_fused_block_enabled(ctx) and hh == ww and n >= 8
-                  and hh * ww >= 196 and cin % 128 == 0 and c % 64 == 0)
+                  and hh * ww >= min_s and cin % 128 == 0 and c % 64 == 0)
     if not use_pallas:
         out, st = _compose_block(x, w1, w2, w3, bn_params, eps, momentum)
         (nm1, nv1, sm1, sv1, nm2, nv2, sm2, sv2, nm3, nv3, sm3,

@@ -175,20 +175,17 @@ def _dense_expand_grouped(w, groups):
 
 
 def _gconv_prefers_dense(x, w, groups, stride=(1, 1)) -> bool:
-    """XLA's native grouped-conv lowering loses to a dense conv over
-    block-diagonal-expanded weights exactly in the large-spatial /
-    tiny-group regime (measured on the v5e, fwd+bwd per shape —
-    docs/artifacts/grouped_conv_profile.json: C=128@56²/Cg=4 native
-    1.78 ms vs dense 0.93; at 28² and below native wins by 2-10x). The
-    dense detour pays Cg->C_in flops inflation, so it only ever makes
-    sense where the MXU would otherwise idle on 4-8 lane matmuls.
+    """Formulation choice for grouped convs: XLA's native grouped lowering
+    vs a dense conv over block-diagonal-expanded weights (the dense detour
+    pays Cg->C_in flops inflation but keeps the MXU's lanes full where
+    tiny groups would idle them).
 
-    Full-model evidence is distribution-level: the shared tunnel's
-    contention band swamps single readings (se_resnext spans 57-116 ms
-    across one day), but the day's medians (auto ~68 ms vs never ~79)
-    and the clean full-suite run (57.2 vs 72-86) both favor auto.
-    PT_GCONV_DENSE=never reverts in one env var if a future chip/XLA
-    shifts the regime boundary."""
+    Decided by MEASUREMENT, not a rule (VERDICT r4 next #4): the executor
+    pre-tunes every grouped conv shape before first compile
+    (utils/gconv_autotune.py — per-shape fwd+bwd shootout memoized on
+    disk, keyed by device kind); here at trace time the cache can only be
+    read. An untuned shape (CPU tests, PT_GCONV_TUNE=0) takes the native
+    path. PT_GCONV_DENSE=always|never remains the override."""
     cg = int(w.shape[1])
     # malformed configs (c_out not divisible by groups, mismatched c_in)
     # must keep the native path so XLA raises its loud shape error
@@ -200,11 +197,14 @@ def _gconv_prefers_dense(x, w, groups, stride=(1, 1)) -> bool:
         return False
     if mode in ("1", "always"):
         return True
-    # OUTPUT spatial governs (the measured regime boundary): a stride-2
-    # conv on 56² input has 28²'s arithmetic, where native wins 4x
-    spatial = min(int(x.shape[-1]) // max(int(stride[1]), 1),
-                  int(x.shape[-2]) // max(int(stride[0]), 1))
-    return groups > 1 and cg <= 8 and spatial >= 56
+    from ..utils import gconv_autotune as _gt
+    key = _gt.shape_key(int(x.shape[0]), int(x.shape[1]),
+                        int(x.shape[2]), int(x.shape[3]),
+                        int(w.shape[0]), int(groups),
+                        (int(stride[0]), int(stride[1])),
+                        str(x.dtype), int(w.shape[2]))
+    hit = _gt.lookup(key)
+    return bool(hit) if hit is not None else False
 
 
 def _conv2d(x, w, attrs, feature_group_count=None):

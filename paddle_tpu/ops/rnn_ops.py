@@ -27,7 +27,30 @@ _ACT = {
 }
 
 
-def _lstm_scan(ins, attrs, w_proj=None, pact=None):
+def _pallas_lstm_ok(ctx, attrs, use_peep, w_proj, b, h, t):
+    """Route to the whole-sequence Pallas kernel (kernels/fused_lstm.py, ≙
+    the reference's hl_cuda_lstm.cu persistent-weight tier) when the
+    configuration matches its contract and we are on one real TPU device.
+    PT_FUSED_LSTM=never reverts to the lax.scan formulation."""
+    import os
+    if os.environ.get("PT_FUSED_LSTM", "auto") in ("0", "never"):
+        return False
+    if use_peep or w_proj is not None:
+        return False
+    if attrs.get("gate_activation", "sigmoid") != "sigmoid"             or attrs.get("cell_activation", "tanh") != "tanh"             or attrs.get("candidate_activation", "tanh") != "tanh":
+        return False
+    if ctx is None or getattr(ctx, "mesh", None) is not None:
+        return False
+    if h % 128 or b % 8 or t < 4:
+        return False
+    try:
+        import jax
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _lstm_scan(ins, attrs, w_proj=None, pact=None, ctx=None):
     """Shared fused-LSTM scan (lstm_op.cc / lstmp_op.h): one lax.scan whose
     carry is (recurrent_state, cell). For plain LSTM the recurrent state is
     the hidden h [B,H]; for LSTMP it is the projection r = pact(h @ w_proj)
@@ -93,7 +116,17 @@ def _lstm_scan(ins, attrs, w_proj=None, pact=None):
         c_new = m1 * c_new + (1 - m1) * c
         return (r_new, c_new), (r_new * m1, c_new * m1)
 
-    (_, _), (rs, cs) = jax.lax.scan(step, (r0, c0), (xs, mask))
+    if _pallas_lstm_ok(ctx, attrs, use_peep, w_proj, B, H, T):
+        from ..kernels.fused_lstm import lstm_sequence
+        bz = b_gate if b_gate is not None else jnp.zeros((4 * H,), x.dtype)
+        rs_c, cs_c = lstm_sequence(xs, w, bz, mask, r0, c0)
+        # the op's outputs are the MASKED values; carries come from the
+        # kernel (its backward needs them), the mask ride is one fused
+        # XLA elementwise
+        m3 = mask[:, :, None]
+        rs, cs = rs_c * m3.astype(rs_c.dtype), cs_c * m3.astype(cs_c.dtype)
+    else:
+        (_, _), (rs, cs) = jax.lax.scan(step, (r0, c0), (xs, mask))
     if reverse:
         rs, cs = jnp.flip(rs, 0), jnp.flip(cs, 0)
     return jnp.moveaxis(rs, 0, 1), jnp.moveaxis(cs, 0, 1)
@@ -104,7 +137,7 @@ def dynamic_lstm(ctx, ins, attrs):
     """lstm_op.cc. Input [B,T,4H] (pre-projected x*W_x), Weight [H,4H]
     recurrent, Bias [1,4H] (+[1,3H] peephole tail when use_peepholes).
     Outputs Hidden/Cell [B,T,H]."""
-    hs, cs = _lstm_scan(ins, attrs)
+    hs, cs = _lstm_scan(ins, attrs, ctx=ctx)
     return {"Hidden": [hs], "Cell": [cs]}
 
 

@@ -25,47 +25,13 @@ EPS = 1e-5
 
 
 def timeit(step, carry, iters=None, reps=5, est_ms=3.0):
-    """Time one `carry = step(carry)` application, amortized on-device.
-
-    block_until_ready does not truly sync through the tunnel, so the only
-    trustworthy number is: one jit'd fori_loop whose iterations form a real
-    data-dependency chain, synced by fetching a scalar derived from EVERY
-    carry leaf, min-of-reps (contention), and a least-squares slope over
-    four window lengths to cancel the fixed dispatch+fetch cost (same idea
-    as bench.py's window difference).  iters is sized so the largest window
-    is well above the ~100 ms fixed cost."""
+    """One `carry = step(carry)` application, amortized on-device — thin
+    wrapper over the ONE shared harness (paddle_tpu/utils/chain_timer.py;
+    see its docstring for the dedupe/DCE/window rules)."""
+    from paddle_tpu.utils.chain_timer import time_step
     if iters is None:
         iters = max(24, int(120.0 / est_ms))
-    def probe(c):
-        # touch EVERY leaf: probing only one lets XLA dead-code-eliminate
-        # the whole loop when that leaf happens to be carried unchanged
-        return sum(leaf.reshape(-1)[0].astype(jnp.float32)
-                   for leaf in jax.tree_util.tree_leaves(c))
-
-    def seeded(c, s):
-        leaves, treedef = jax.tree_util.tree_flatten(c)
-        leaves[0] = (leaves[0].astype(jnp.float32) + s).astype(leaves[0].dtype)
-        return jax.tree_util.tree_unflatten(treedef, leaves)
-
-    def run(n):
-        f = jax.jit(lambda c, s: probe(
-            jax.lax.fori_loop(0, n, lambda i, c: step(c), seeded(c, s))))
-        ts = []
-        for r in range(reps + 1):
-            t0 = time.perf_counter()
-            float(f(carry, jnp.float32(r * 1e-3)))
-            ts.append(time.perf_counter() - t0)
-        return min(ts[1:])  # rep 0 pays compile; seed defeats the dedupe
-
-    # least-squares slope over four window lengths: a single (n, 2n) pair
-    # is at the mercy of ±30 ms tunnel-contention noise on the fixed cost
-    ns = [iters, 2 * iters, 3 * iters, 4 * iters]
-    ys = [run(n) for n in ns]
-    nbar = sum(ns) / len(ns)
-    ybar = sum(ys) / len(ys)
-    slope = sum((n - nbar) * (y - ybar) for n, y in zip(ns, ys)) / \
-        sum((n - nbar) ** 2 for n in ns)
-    return max(slope, 1e-9) * 1000.0
+    return time_step(step, carry, iters, reps=reps, window_mult=4) * 1000.0
 
 
 def make_inputs(key=0):

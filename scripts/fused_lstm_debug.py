@@ -1,0 +1,78 @@
+"""Numerics check: Pallas whole-sequence LSTM vs the lax.scan formulation
+(ops/rnn_ops._lstm_scan math), values and gradients, f32 CPU interpreter."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.kernels import fused_lstm as fl
+
+fl.INTERPRET = True
+
+T, B, H = 6, 8, 16
+
+
+def scan_ref(x, w, b, mask, r0, c0):
+    def step(carry, inp):
+        r, c = carry
+        xt, m = inp
+        gates = xt + r @ w + b
+        gi, gc, gf, go = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(gi)
+        f = jax.nn.sigmoid(gf)
+        o = jax.nn.sigmoid(go)
+        cand = jnp.tanh(gc)
+        c_new = f * c + i * cand
+        r_new = o * jnp.tanh(c_new)
+        m1 = m[:, None]
+        r_t = m1 * r_new + (1 - m1) * r
+        c_t = m1 * c_new + (1 - m1) * c
+        return (r_t, c_t), (r_t, c_t)
+
+    (_, _), (rs, cs) = jax.lax.scan(step, (r0, c0), (x, mask))
+    return rs, cs
+
+
+def main():
+    ks = jax.random.split(jax.random.PRNGKey(3), 8)
+    x = jax.random.normal(ks[0], (T, B, 4 * H), jnp.float32)
+    w = jax.random.normal(ks[1], (H, 4 * H)) * 0.3
+    b = jax.random.normal(ks[2], (4 * H,)) * 0.1
+    r0 = jax.random.normal(ks[3], (B, H)) * 0.5
+    c0 = jax.random.normal(ks[4], (B, H)) * 0.5
+    lens = np.array([6, 6, 4, 3, 6, 1, 5, 2])
+    mask = (np.arange(T)[:, None] < lens[None, :]).astype(np.float32)
+    mask = jnp.asarray(mask)
+
+    rs, cs = fl.lstm_sequence(x, w, b, mask, r0, c0)
+    rr, cr = scan_ref(x, w, b, mask, r0, c0)
+    print("fwd rs err:", float(jnp.max(jnp.abs(rs - rr))))
+    print("fwd cs err:", float(jnp.max(jnp.abs(cs - cr))))
+
+    dv1 = jax.random.normal(ks[5], (T, B, H))
+    dv2 = jax.random.normal(ks[6], (T, B, H)) * 0.3
+
+    def loss_p(x, w, b, r0, c0):
+        rs, cs = fl.lstm_sequence(x, w, b, mask, r0, c0)
+        return jnp.sum(rs * dv1) + jnp.sum(cs * dv2)
+
+    def loss_r(x, w, b, r0, c0):
+        rs, cs = scan_ref(x, w, b, mask, r0, c0)
+        return jnp.sum(rs * dv1) + jnp.sum(cs * dv2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2, 3, 4))(x, w, b, r0, c0)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2, 3, 4))(x, w, b, r0, c0)
+    for nm, a, bb in zip(("dx", "dw", "db", "dr0", "dc0"), gp, gr):
+        sc = jnp.max(jnp.abs(bb)) + 1e-12
+        print(f"  {nm}: max rel err = {float(jnp.max(jnp.abs(a - bb)) / sc):.3e}")
+
+
+if __name__ == "__main__":
+    main()

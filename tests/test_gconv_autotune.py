@@ -1,0 +1,82 @@
+"""Grouped-conv autotune cache (utils/gconv_autotune.py, ≙ the cuDNN
+algorithm-search role of conv_cudnn_op.cu.cc): mechanism tests with a
+fake measure function — the real shootout runs on the chip."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.utils import gconv_autotune as gt
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("PT_GCONV_CACHE", str(tmp_path / "cache.json"))
+    monkeypatch.setattr(gt, "_MEM", None)
+    yield
+
+
+def test_cache_roundtrip_and_lookup(monkeypatch):
+    calls = []
+
+    def fake_measure(n, cin, h, w, cout, groups, stride, dtype, k=3):
+        calls.append((n, cin, h, w, cout, groups, stride, dtype, k))
+        return {"native_ms": 2.0, "dense_ms": 1.0, "prefers_dense": True}
+
+    monkeypatch.setattr(gt, "measure", fake_measure)
+    gt.ensure_tuned(8, 128, 56, 56, 128, 4, (1, 1), "float32", 3)
+    key = gt.shape_key(8, 128, 56, 56, 128, 4, (1, 1), "float32", 3)
+    assert gt.lookup(key) is True
+    assert len(calls) == 1
+    # second call: cache hit, no re-measure
+    gt.ensure_tuned(8, 128, 56, 56, 128, 4, (1, 1), "float32", 3)
+    assert len(calls) == 1
+    # persisted on disk and reloadable by a fresh process state
+    with open(os.environ["PT_GCONV_CACHE"]) as f:
+        disk = json.load(f)
+    assert key in disk
+    gt._MEM = None
+    assert gt.lookup(key) is True
+
+
+def test_trace_decision_reads_cache(monkeypatch):
+    """A cache entry flips the trace-time formulation decision; untuned
+    shapes stay native (the CPU-test default)."""
+    from paddle_tpu.ops.nn_ops import _gconv_prefers_dense
+
+    class FakeArr:
+        def __init__(self, shape, dtype="float32"):
+            self.shape = shape
+            self.dtype = np.dtype(dtype)
+
+    x = FakeArr((8, 128, 56, 56))
+    w = FakeArr((128, 32, 3, 3))
+    assert _gconv_prefers_dense(x, w, 4) is False  # untuned -> native
+    key = gt.shape_key(8, 128, 56, 56, 128, 4, (1, 1), "float32", 3)
+    gt._load()[key] = {"prefers_dense": True}
+    assert _gconv_prefers_dense(x, w, 4) is True
+    # the env override still wins
+    monkeypatch.setenv("PT_GCONV_DENSE", "never")
+    assert _gconv_prefers_dense(x, w, 4) is False
+
+
+def test_tune_program_walks_grouped_convs(monkeypatch):
+    tuned = []
+    monkeypatch.setattr(gt, "ensure_tuned",
+                        lambda *a, **kw: tuned.append(a))
+    monkeypatch.setattr("jax.default_backend", lambda: "tpu")
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        data = layers.data("data", [128, 28, 28], dtype="float32")
+        layers.conv2d(data, 128, 3, padding=1, groups=4, act=None,
+                      bias_attr=False)
+        layers.conv2d(data, 64, 1, act=None, bias_attr=False)  # g=1: skip
+    gt.tune_program(main, batch_hint=16)
+    assert len(tuned) == 1
+    n, cin, h, w, cout, groups = tuned[0][:6]
+    assert (cin, h, w, cout, groups) == (128, 28, 28, 128, 4)
+    assert n == 16  # -1 batch replaced by the feed hint
