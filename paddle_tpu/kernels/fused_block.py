@@ -6,9 +6,16 @@ way: not per-conv algorithm selection, but cross-op fusion that XLA cannot
 do on its own because convolutions are materialization boundaries in HLO.
 
 Design (from docs/artifacts/resnet50_layer_profile.json): the 56²/28²
-bottleneck stages are HBM-bound — measured 5.68 ms/block (train) on
-conv2_rest vs a 3.14 ms fused floor where every activation is written
-once and read once.  The chain here realizes that floor:
+bottleneck stages are HBM-bound; a fused floor where every activation is
+written once and read once projected ~3.1 ms/block (train) vs the
+profile's 5.68 in-model reading.  ADJUDICATION (round 5,
+docs/artifacts/fused_block_ab.json): the projection did not survive
+measurement — XLA's op-by-op block runs 3.2 ms in isolation and the full
+model wins the A/B at every gate setting, so this chain is NOT the
+default lowering (PT_FUSED_BLOCK=always forces it; the composition path
+in ops/fused_ops.py is what `auto` runs).  The kernels stay: K1 runs at
+HBM peak, the numerics are exact, and the per-shape gate machinery is the
+hook if a future chip/Mosaic shifts the regime.  The chain design:
 
   K1  reads the assembled block input x̄ [Cin, S], GEMMs the first 1×1,
       writes raw a1 [C, S] and accumulates per-channel sum/sumsq of the
