@@ -1,0 +1,28 @@
+"""Static analysis: whole-program IR verification + artifact sanity.
+
+The compile-time checking layer the interpreted reference never had
+(executor.cc trusts the op stream). Three surfaces:
+
+* `verify_program(program, feeds=…, fetches=…, mesh=…)` — multi-pass
+  verifier over Program/Block/OpDesc (verifier.py). Runs as an executor
+  pre-pass when PT_VERIFY=1 (default-on in tests) and as a CLI
+  (tools/verify_program.py).
+* `artifacts` — schema + physical-floor checks for measurement JSON
+  (autotune cache, bench output), applied at load AND save.
+* `source_lint` — custom repo lint rules behind tools/lint.py (kept
+  stdlib-only so the lint gate never imports jax).
+
+docs/analysis.md describes each pass, its defect class, and how to add
+a new one.
+"""
+
+from . import artifacts  # noqa: F401
+from .verifier import (Diagnostic, ProgramVerificationError,  # noqa: F401
+                       VerifyResult, registered_passes, verifier_pass,
+                       verify_enabled, verify_program)
+
+__all__ = [
+    "Diagnostic", "ProgramVerificationError", "VerifyResult",
+    "artifacts", "registered_passes", "verifier_pass", "verify_enabled",
+    "verify_program",
+]

@@ -1,0 +1,119 @@
+"""Artifact sanity: schema + physical-floor checks for measurement JSON.
+
+Round 5's gconv autotuner cached physically impossible 0.0 ms readings
+and decided kernel formulations from them (VERDICT Weak #4). The fix is
+structural, not a one-off: the autotune cache is validated at save AND
+at load (utils/gconv_autotune.py — poisoned entries are dropped and
+re-measure), and bench.py runs validate_bench_json on its result at
+emit time (impossible readings ship flagged in the artifact itself);
+tools/verify_program.py --autotune-cache/--bench re-checks either file
+after the fact.
+
+Floors: MS_FLOOR is deliberately conservative — a reading at or below
+0.05 ms is indistinguishable from the failure modes chain_timer.py
+documents (deduped dispatches, DCE'd loops, broken carry chains), so it
+is treated as untrustworthy even though the fastest genuine kernels can
+brush against it; the cost of a false rejection is one re-measure and a
+native-formulation fallback, the cost of trusting a fake 0.0 is a wrong
+formulation pinned forever (round 5 shipped exactly that). Nothing a
+single chip runs takes >= MS_CEILING (an hour) per iteration.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+#: readings at or below this are physically impossible on this fabric
+MS_FLOOR = 0.05
+#: readings above this are runaway-clock garbage, not measurements
+MS_CEILING = 3.6e6
+
+
+def _bad_ms(value) -> bool:
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return True
+    return not math.isfinite(v) or v <= MS_FLOOR or v >= MS_CEILING
+
+
+def check_autotune_entry(key: str, ent: dict) -> List[str]:
+    """Problems with one gconv autotune cache entry ([] = valid).
+
+    Entries that *declare* themselves non-measurements are legal:
+    {"error": ...} (measurement raised) and {"invalid": True} (readings
+    rejected twice) both carry prefers_dense=False fallbacks.
+    """
+    if not isinstance(ent, dict):
+        return [f"{key}: entry is {type(ent).__name__}, not an object"]
+    if "prefers_dense" not in ent:
+        return [f"{key}: missing required field 'prefers_dense'"]
+    if ent.get("error") or ent.get("invalid"):
+        return []
+    problems = []
+    for field in ("native_ms", "dense_ms"):
+        if field not in ent:
+            problems.append(f"{key}: missing measurement field {field!r}")
+        elif _bad_ms(ent[field]):
+            problems.append(
+                f"{key}: {field}={ent[field]!r} is outside the physical "
+                f"band ({MS_FLOOR}, {MS_CEILING}) ms — impossible reading")
+    return problems
+
+
+def validate_autotune_cache(cache: dict) -> List[str]:
+    """Problems across a whole autotune cache dict ([] = valid)."""
+    if not isinstance(cache, dict):
+        return [f"cache root is {type(cache).__name__}, not an object"]
+    problems: List[str] = []
+    for key, ent in cache.items():
+        problems.extend(check_autotune_entry(str(key), ent))
+    return problems
+
+
+def filter_autotune_cache(cache: dict) -> Dict[str, dict]:
+    """Drop entries with impossible readings (load-time self-heal); the
+    dropped keys simply re-measure on next use."""
+    return {k: v for k, v in cache.items()
+            if not check_autotune_entry(str(k), v)}
+
+
+_MS_KEY_MARKERS = ("_ms", "ms_per_batch", "ms_per_step")
+_RATIO_KEY_MARKERS = ("mfu", "hfu")
+
+
+def validate_bench_json(doc, path: str = "$") -> List[str]:
+    """Recursive floor checks over a bench.py-style JSON document.
+
+    Any numeric field whose key names a millisecond reading must sit in
+    the physical band; MFU/HFU-style ratios must be finite and
+    non-negative. Schema-agnostic on purpose: bench.py's layout drifts
+    between rounds, impossible numbers never become legitimate.
+    """
+    problems: List[str] = []
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            here = f"{path}.{k}"
+            if isinstance(v, (dict, list)):
+                problems.extend(validate_bench_json(v, here))
+                continue
+            lk = str(k).lower()
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                if any(m in lk for m in _MS_KEY_MARKERS) and _bad_ms(v):
+                    problems.append(
+                        f"{here}: {v!r} ms is outside the physical band "
+                        f"({MS_FLOOR}, {MS_CEILING})")
+                elif any(m in lk for m in _RATIO_KEY_MARKERS):
+                    # >100% hardware utilization is as impossible as a
+                    # 0.0 ms reading; percent-style keys (mfu_pct) cap at
+                    # 100, fraction-style at 1.0 (small slack for fp noise)
+                    hi = 101.0 if "pct" in lk else 1.01
+                    if not math.isfinite(float(v)) or v < 0 or v > hi:
+                        problems.append(
+                            f"{here}: utilization ratio {v!r} is outside "
+                            f"[0, {hi}] — impossible reading")
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            problems.extend(validate_bench_json(v, f"{path}[{i}]"))
+    return problems

@@ -1,0 +1,186 @@
+"""Repo source lint: custom AST/token checks beyond what ruff covers.
+
+Deliberately importable WITHOUT the paddle_tpu package (tools/lint.py
+loads this file directly): stdlib only, no jax, no package-relative
+imports — the lint gate must run in a bare interpreter in under a
+second.
+
+Rules:
+
+  joined-continuation  a boolean connector ('or'/'and') preceded by a
+      long run of spaces mid-line — the fossil of a lost continuation
+      backslash, where three conditions collapse into one fragile
+      physical line (ops/rnn_ops.py:39, ADVICE round 5, is the type
+      specimen; its pre-fix form is the regression fixture in
+      tests/test_analysis.py).
+
+  undeclared-env-knob  a read of a PT_* / FLAGS_* environment variable
+      that paddle_tpu/flags.py does not declare (DEFINE_flag for FLAGS_*,
+      declare_env_knob for PT_*). Undeclared knobs are invisible to
+      FLAGS.help() and to the next maintainer; every env switch must be
+      registered where the others live.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Sequence, Set
+
+#: minimum run of spaces before or/and that marks a lost continuation —
+#: aligned wrapped operators sit at line start (prev token on an earlier
+#: line) and never hit this.
+JOINED_GAP = 8
+
+#: env-var prefixes the knob-declaration rule governs. BENCH_*/FLASH_*
+#: and friends are bench-harness locals, out of scope by design.
+GOVERNED_PREFIXES = ("PT_", "FLAGS_")
+
+
+@dataclass(frozen=True)
+class LintFinding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.code}] " \
+               f"{self.message}"
+
+
+# ---------------------------------------------------------------------------
+# rule: joined-continuation
+# ---------------------------------------------------------------------------
+
+def check_joined_continuation(path: str, src: str) -> List[LintFinding]:
+    findings: List[LintFinding] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return findings  # unparsable files are ruff/compile's problem
+    prev = None
+    for tok in tokens:
+        if (tok.type == tokenize.NAME and tok.string in ("or", "and")
+                and prev is not None
+                and prev.end[0] == tok.start[0]
+                and tok.start[1] - prev.end[1] >= JOINED_GAP):
+            findings.append(LintFinding(
+                path, tok.start[0], tok.start[1], "joined-continuation",
+                f"{tok.string!r} preceded by "
+                f"{tok.start[1] - prev.end[1]} spaces mid-line — a lost "
+                "continuation backslash; parenthesize the condition "
+                "across lines"))
+        if tok.type not in (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+                            tokenize.DEDENT, tokenize.COMMENT):
+            prev = tok
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule: undeclared-env-knob
+# ---------------------------------------------------------------------------
+
+def _env_read_name(node: ast.AST) -> Optional[ast.Constant]:
+    """The constant-string env name read by `node`, if it is an env read:
+    os.environ.get(X…) / os.getenv(X…) / os.environ[X]."""
+
+    def is_os_environ(n) -> bool:
+        return (isinstance(n, ast.Attribute) and n.attr == "environ"
+                and isinstance(n.value, ast.Name) and n.value.id == "os")
+
+    key = None
+    if isinstance(node, ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "get"
+                and is_os_environ(f.value) and node.args):
+            key = node.args[0]
+        elif (isinstance(f, ast.Attribute) and f.attr == "getenv"
+                and isinstance(f.value, ast.Name) and f.value.id == "os"
+                and node.args):
+            key = node.args[0]
+    elif isinstance(node, ast.Subscript) and is_os_environ(node.value):
+        key = node.slice
+    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+        return key
+    return None
+
+
+def check_env_knobs(path: str, src: str,
+                    declared: Set[str]) -> List[LintFinding]:
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    findings: List[LintFinding] = []
+    for node in ast.walk(tree):
+        const = _env_read_name(node)
+        if const is None:
+            continue
+        name = const.value
+        if name.startswith(GOVERNED_PREFIXES) and name not in declared:
+            findings.append(LintFinding(
+                path, const.lineno, const.col_offset,
+                "undeclared-env-knob",
+                f"env var {name!r} is read here but not declared in "
+                "paddle_tpu/flags.py (declare_env_knob / DEFINE_flag)"))
+    return findings
+
+
+def declared_knobs_from_flags(flags_path: str) -> Set[str]:
+    """Statically parse flags.py for the declared knob set — no package
+    import, so the lint gate works in a bare interpreter."""
+    with open(flags_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    declared: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant):
+            continue
+        name = node.args[0].value
+        if not isinstance(name, str):
+            continue
+        if node.func.id == "declare_env_knob":
+            declared.add(name)
+        elif node.func.id == "DEFINE_flag":
+            declared.add(f"FLAGS_{name}")
+    return declared
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def lint_file(path: str, declared: Set[str]) -> List[LintFinding]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return (check_joined_continuation(path, src)
+            + check_env_knobs(path, src, declared))
+
+
+def default_targets(root: str) -> List[str]:
+    """The governed source set: the package, tools, scripts, bench.py."""
+    targets: List[str] = []
+    for rel in ("paddle_tpu", "tools", "scripts"):
+        top = os.path.join(root, rel)
+        for dirpath, _dirnames, filenames in os.walk(top):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    targets.append(os.path.join(dirpath, fn))
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        targets.append(bench)
+    return targets
+
+
+def lint_paths(paths: Sequence[str], flags_path: str) -> List[LintFinding]:
+    declared = declared_knobs_from_flags(flags_path)
+    findings: List[LintFinding] = []
+    for p in paths:
+        findings.extend(lint_file(p, declared))
+    return findings

@@ -1,0 +1,556 @@
+"""Whole-program static verifier over Program/Block/OpDesc.
+
+The reference interprets ProgramDesc with almost no compile-time checking
+(executor.cc:322 trusts the op stream; the only validation is per-op
+InferShape at append time). This module is the missing lint gate: a
+multi-pass analyzer that walks a WHOLE program — including transpiled
+ones — before anything compiles or runs, the same role program-level
+validation plays in GSPMD-style sharding systems (arXiv:2004.13336,
+arXiv:2110.10548: axes and collectives are checked statically before
+hardware is touched).
+
+Passes (each registered via @verifier_pass; run in registration order):
+
+  def-use       every op input resolves to a var defined earlier in the
+                block (or fed / persistable / data / parent-block state);
+                every output var is declared. Undeclared names are errors
+                ("dangling"); declared-but-never-produced reads are
+                warnings (they may be fed at run time).
+  dtype-prop    re-derives dtypes through the registered infer_shape fns
+                on a clone and flags disagreement with the recorded
+                VarDesc.dtype (the f32-probe-under-AMP no-op bug class).
+  dead-code     ops whose outputs reach no fetch/persistable/side-effect
+                root, and vars referenced by no op — with a prune
+                suggestion. Warnings: a fetch list the verifier cannot
+                see may keep them alive.
+  write-hazard  the same var written by two ops with no intervening read
+                (a dead store at best, a lost update across
+                ParallelExecutor windows at worst).
+  shard-check   transpiler post-conditions: sharding axis names exist in
+                the mesh, sharded dims divide evenly, BLOCK attrs point
+                at real blocks, sp-rewritten attention has an 'sp' axis,
+                and no device op consumes a host op's output without a
+                registered boundary (core/registry.py).
+
+Severities: "error" aborts execution under PT_VERIFY=1 (the executor
+pre-pass raises ProgramVerificationError); "warning" is reported but
+non-fatal — a program is "clean" when it produces zero errors.
+
+Adding a pass: write fn(program, ctx) -> List[Diagnostic], decorate with
+@verifier_pass("name"). ctx carries feeds/fetches/axis_sizes. See
+docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from ..core.program import Program, op_block_refs, sub_block_var_names
+
+#: mesh-axis alphabet (parallel/mesh.py) used when no concrete mesh is
+#: supplied — kept literal so the verifier never needs to import jax.
+KNOWN_AXES = ("dp", "tp", "pp", "sp", "ep")
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding, addressable enough to act on: severity, a stable
+    machine-readable code, and the (block, op, var) coordinates."""
+
+    severity: str
+    code: str
+    message: str
+    block_idx: int
+    op_idx: Optional[int] = None
+    op_type: Optional[str] = None
+    var: Optional[str] = None
+
+    def __str__(self) -> str:
+        loc = f"block {self.block_idx}"
+        if self.op_idx is not None:
+            loc += f" op {self.op_idx}"
+        if self.op_type:
+            loc += f" ({self.op_type})"
+        return f"{self.severity}[{self.code}] {loc}: {self.message}"
+
+
+class VerifyResult:
+    def __init__(self, diagnostics: Sequence[Diagnostic]):
+        self.diagnostics = list(diagnostics)
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == ERROR]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == WARNING]
+
+    @property
+    def ok(self) -> bool:
+        """Clean = zero errors (warnings allowed)."""
+        return not self.errors
+
+    def report(self) -> str:
+        if not self.diagnostics:
+            return "program verifies clean (0 diagnostics)"
+        lines = [str(d) for d in self.diagnostics]
+        lines.append(f"{len(self.errors)} error(s), "
+                     f"{len(self.warnings)} warning(s)")
+        return "\n".join(lines)
+
+    def raise_if_errors(self) -> "VerifyResult":
+        if self.errors:
+            raise ProgramVerificationError(self)
+        return self
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+
+class ProgramVerificationError(RuntimeError):
+    def __init__(self, result: VerifyResult):
+        self.result = result
+        super().__init__("program failed static verification:\n"
+                         + result.report())
+
+
+class _Ctx:
+    def __init__(self, feeds: Iterable[str], fetches: Iterable[str],
+                 axis_sizes: Optional[Dict[str, int]]):
+        self.feeds = set(feeds)
+        self.fetches = set(fetches)
+        self.axis_sizes = axis_sizes  # None = no concrete mesh known
+
+
+_PASSES: Dict[str, object] = {}
+
+
+def verifier_pass(name: str):
+    """Register fn(program, ctx) -> List[Diagnostic] under `name`."""
+
+    def deco(fn):
+        _PASSES[name] = fn
+        return fn
+
+    return deco
+
+
+def registered_passes() -> List[str]:
+    return list(_PASSES)
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+_AUTODIFF = "autodiff"
+_EXEC_INJECTED = ("feed", "fetch")
+
+
+def _valid_block_refs(program: Program, op) -> List[int]:
+    return [bi for bi in op_block_refs(op)
+            if isinstance(bi, int) and 0 <= bi < len(program.blocks)]
+
+
+# liveness through sub-blocks: the ONE shared definition prune uses
+# (core/program.py) — verifier and prune must agree on what a
+# control-flow op keeps alive
+_sub_block_names = sub_block_var_names
+
+
+def _declared_chain(program: Program, block) -> Set[str]:
+    """Var names visible from `block` through its ancestors."""
+    names: Set[str] = set()
+    b = block
+    while b is not None:
+        names |= set(b.vars)
+        b = program.blocks[b.parent_idx] if b.parent_idx >= 0 else None
+    return names
+
+
+def _state_like(v) -> bool:
+    """Vars whose value exists before the first op runs: scope state
+    (persistable / parameters) and feed placeholders (is_data)."""
+    return bool(v.persistable or v.is_parameter
+                or getattr(v, "is_data", False))
+
+
+def _axes_of(dim_spec) -> tuple:
+    if dim_spec is None:
+        return ()
+    if isinstance(dim_spec, (list, tuple)):
+        return tuple(dim_spec)
+    return (dim_spec,)
+
+
+# ---------------------------------------------------------------------------
+# pass 1: def-before-use / dangling slots
+# ---------------------------------------------------------------------------
+
+@verifier_pass("def-use")
+def _check_def_use(program: Program, ctx: _Ctx) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+
+    def walk(block, defined: Set[str], relaxed: bool):
+        declared = _declared_chain(program, block)
+        for i, op in enumerate(block.ops):
+            if op.type in _EXEC_INJECTED:
+                continue
+            reads = list(op.input_names())
+            if op.type == _AUTODIFF and op.attrs.get("loss"):
+                reads.append(op.attrs["loss"])
+            for n in reads:
+                if n in defined:
+                    continue
+                if n not in declared:
+                    diags.append(Diagnostic(
+                        ERROR, "dangling-input",
+                        f"input {n!r} of op {op.type!r} resolves to no "
+                        f"variable declared in block {block.idx} or its "
+                        "ancestors", block.idx, i, op.type, n))
+                elif not relaxed:
+                    diags.append(Diagnostic(
+                        WARNING, "use-before-def",
+                        f"input {n!r} of op {op.type!r} is declared but "
+                        "produced by no earlier op and is not "
+                        "fed/persistable/data — it will be unbound unless "
+                        "fed at run time", block.idx, i, op.type, n))
+                defined.add(n)  # report each name once
+            for bi in _valid_block_refs(program, op):
+                sub = program.blocks[bi]
+                # sub-block interpreters bind locally declared vars
+                # (carry/param slots) themselves; only undeclared names
+                # are checkable there.
+                walk(sub, defined | set(sub.vars), relaxed=True)
+            for n in op.output_names():
+                if n not in declared:
+                    diags.append(Diagnostic(
+                        ERROR, "undeclared-output",
+                        f"output {n!r} of op {op.type!r} is not declared "
+                        f"in block {block.idx} or its ancestors",
+                        block.idx, i, op.type, n))
+                defined.add(n)
+
+    block0 = program.global_block
+    defined: Set[str] = set(ctx.feeds)
+    for b in program.blocks:
+        for v in b.vars.values():
+            if _state_like(v):
+                defined.add(v.name)
+            if getattr(v, "seq_len_var", None):
+                # the executor materializes length companions with the feed
+                defined.add(v.seq_len_var)
+    walk(block0, defined, relaxed=False)
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# pass 2: dtype propagation
+# ---------------------------------------------------------------------------
+
+@verifier_pass("dtype-prop")
+def _check_dtype_prop(program: Program, ctx: _Ctx) -> List[Diagnostic]:
+    from ..core.registry import get_op
+
+    diags: List[Diagnostic] = []
+    clone = Program.from_dict(program.to_dict())
+    for b_orig, b_clone in zip(program.blocks, clone.blocks):
+        for i, op in enumerate(b_clone.ops):
+            impl = get_op(op.type)
+            if impl is None or impl.infer_shape is None:
+                continue
+            try:
+                impl.infer_shape(op, b_clone)
+            except Exception:
+                # infer needed state the verifier lacks (missing attrs on a
+                # hand-built op, etc.) — def-use / executor will surface it
+                continue
+            for n in op.output_names():
+                try:
+                    derived = b_clone.var(n).dtype
+                    recorded = b_orig.var(n).dtype
+                except KeyError:
+                    continue
+                if derived != recorded:
+                    diags.append(Diagnostic(
+                        ERROR, "dtype-mismatch",
+                        f"var {n!r} is recorded as {recorded} but op "
+                        f"{op.type!r} derives {derived} from its inputs — "
+                        "the descriptor and the computation disagree",
+                        b_orig.idx, i, op.type, n))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# pass 3: dead ops / dead vars
+# ---------------------------------------------------------------------------
+
+@verifier_pass("dead-code")
+def _check_dead_code(program: Program, ctx: _Ctx) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    block = program.global_block
+
+    def resolves_state(name: str) -> bool:
+        try:
+            return _state_like(block.var(name))
+        except KeyError:
+            return False
+
+    needed: Set[str] = set(ctx.fetches)
+    alive = [False] * len(block.ops)
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        if op.type in _EXEC_INJECTED:
+            alive[i] = True
+            continue
+        outs = set(op.output_names())
+        sub_names = _sub_block_names(program, op)
+        root = (op.attrs.get("__side_effect__", False)
+                or any(resolves_state(n) for n in outs)
+                or any(resolves_state(n) for n in sub_names)
+                or op.type == _AUTODIFF)
+        if root or outs & needed:
+            alive[i] = True
+            needed |= set(op.input_names()) | sub_names
+            if op.type == _AUTODIFF and op.attrs.get("loss"):
+                needed.add(op.attrs["loss"])
+    for i, op in enumerate(block.ops):
+        if not alive[i]:
+            outs = op.output_names()
+            diags.append(Diagnostic(
+                WARNING, "dead-op",
+                f"op {op.type!r} (outputs {outs}) reaches no fetch, "
+                "persistable var, or side effect — prune it with "
+                "Program.prune(targets) or drop the layer call",
+                block.idx, i, op.type, outs[0] if outs else None))
+
+    # dead vars: declared anywhere, referenced by no op in any block
+    used: Set[str] = set(ctx.fetches) | set(ctx.feeds)
+    seq_companions: Set[str] = set()
+    for b in program.blocks:
+        for op in b.ops:
+            used |= set(op.input_names()) | set(op.output_names())
+            for v in op.attrs.values():  # name-valued attrs (x_var, loss…)
+                if isinstance(v, str):
+                    used.add(v)
+                elif isinstance(v, (list, tuple)):
+                    used |= {x for x in v if isinstance(x, str)}
+            if op.type == _AUTODIFF and op.attrs.get("loss"):
+                # append_backward declares <loss>@GRAD; the lowering binds
+                # it implicitly as the value_and_grad seed cotangent
+                used.add(op.attrs["loss"] + "@GRAD")
+        for v in b.vars.values():
+            if getattr(v, "seq_len_var", None):
+                seq_companions.add(v.seq_len_var)
+    for b in program.blocks:
+        for name, v in b.vars.items():
+            if (name not in used and name not in seq_companions
+                    and not _state_like(v)):
+                diags.append(Diagnostic(
+                    WARNING, "dead-var",
+                    f"var {name!r} is declared but referenced by no op — "
+                    "prune it from the block's var table",
+                    b.idx, None, None, name))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# pass 4: write-write hazards
+# ---------------------------------------------------------------------------
+
+@verifier_pass("write-hazard")
+def _check_write_hazard(program: Program, ctx: _Ctx) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for block in program.blocks:
+        last_write: Dict[str, int] = {}
+        read_since: Dict[str, bool] = {}
+        for i, op in enumerate(block.ops):
+            reads = set(op.input_names()) | _sub_block_names(program, op)
+            if op.type == _AUTODIFF:
+                # autodiff replays the whole forward prefix: everything
+                # written so far is read by it
+                read_since = {n: True for n in read_since}
+            for n in reads:
+                if n in read_since:
+                    read_since[n] = True
+            for n in op.output_names():
+                j = last_write.get(n)
+                if (j is not None and not read_since.get(n, True)
+                        and n not in reads):
+                    diags.append(Diagnostic(
+                        WARNING, "double-write",
+                        f"var {n!r} is written by op {j} "
+                        f"({block.ops[j].type!r}) and again by op {i} "
+                        f"({op.type!r}) with no read in between — the "
+                        "first write is lost", block.idx, i, op.type, n))
+                last_write[n] = i
+                read_since[n] = False
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# pass 5: transpiler post-conditions (sharding / blocks / host boundary)
+# ---------------------------------------------------------------------------
+
+@verifier_pass("shard-check")
+def _check_sharding(program: Program, ctx: _Ctx) -> List[Diagnostic]:
+    from ..core.registry import get_op, is_host_boundary
+
+    diags: List[Diagnostic] = []
+    known = set(ctx.axis_sizes) if ctx.axis_sizes else set(KNOWN_AXES)
+
+    for block in program.blocks:
+        for v in block.vars.values():
+            if not v.sharding:
+                continue
+            if len(v.sharding) > len(v.shape):
+                diags.append(Diagnostic(
+                    WARNING, "sharding-rank",
+                    f"var {v.name!r} has a rank-{len(v.sharding)} sharding "
+                    f"spec on a rank-{len(v.shape)} shape — trailing axes "
+                    "are dropped at lowering", block.idx, None, None,
+                    v.name))
+            for dim, spec in enumerate(v.sharding):
+                axes = _axes_of(spec)
+                for a in axes:
+                    if a in known:
+                        continue
+                    if ctx.axis_sizes is not None:
+                        # concrete mesh: spec_for documents dropping
+                        # absent axes (a tp-annotated program running on
+                        # a dp×sp mesh is legal, just less distributed)
+                        diags.append(Diagnostic(
+                            WARNING, "mesh-axis-dropped",
+                            f"var {v.name!r} dim {dim} names axis {a!r} "
+                            f"absent from the mesh {sorted(known)} — the "
+                            "lowering drops it (replicated on that dim)",
+                            block.idx, None, None, v.name))
+                    else:
+                        # no mesh to check against: the axis alphabet is
+                        # the only oracle, and a name outside it is a typo
+                        diags.append(Diagnostic(
+                            ERROR, "unknown-mesh-axis",
+                            f"var {v.name!r} dim {dim} is sharded over "
+                            f"axis {a!r} which is not in the axis "
+                            f"alphabet {sorted(known)}",
+                            block.idx, None, None, v.name))
+                if ctx.axis_sizes and axes and dim < len(v.shape):
+                    size = 1
+                    for a in axes:
+                        size *= int(ctx.axis_sizes.get(a, 1))
+                    d = int(v.shape[dim])
+                    if d > 0 and size > 1 and d % size:
+                        # warning, not error: the documented runtime
+                        # contract (transpiler docstring, _divisible in
+                        # parallel_executor, _apply_var_marks) is that a
+                        # non-divisible dim silently DEGRADES to
+                        # replication — legal, but the user asked for a
+                        # distribution they are not getting
+                        diags.append(Diagnostic(
+                            WARNING, "uneven-shard",
+                            f"var {v.name!r} dim {dim} of size {d} does "
+                            f"not divide over mesh axes {axes} (size "
+                            f"{size}) — the lowering degrades this var "
+                            "to replication", block.idx, None, None,
+                            v.name))
+
+        host_outs: Set[str] = set()
+        for i, op in enumerate(block.ops):
+            for bi in op_block_refs(op):
+                if not (isinstance(bi, int) and 0 <= bi < len(program.blocks)):
+                    diags.append(Diagnostic(
+                        ERROR, "dangling-block",
+                        f"op {op.type!r} references block {bi!r} but the "
+                        f"program has {len(program.blocks)} blocks",
+                        block.idx, i, op.type))
+            if (op.type == "scaled_dot_product_attention"
+                    and op.attrs.get("sp_mode") not in (None, "", "none")
+                    and ctx.axis_sizes is not None
+                    and int(ctx.axis_sizes.get("sp", 1)) <= 1):
+                diags.append(Diagnostic(
+                    ERROR, "sp-axis-missing",
+                    f"attention op rewritten for sp_mode="
+                    f"{op.attrs['sp_mode']!r} but the mesh has no 'sp' "
+                    "axis of size > 1", block.idx, i, op.type))
+            if op.type == "pipeline":
+                sub_idx = op.attrs.get("sub_block")
+                if isinstance(sub_idx, int) and 0 <= sub_idx < len(program.blocks):
+                    sub = program.blocks[sub_idx]
+                    inner = [op.attrs.get("x_var"), op.attrs.get("out_var")]
+                    inner += list(op.attrs.get("param_vars", ()))
+                    for n in inner:
+                        if n and n not in sub.vars:
+                            diags.append(Diagnostic(
+                                ERROR, "pipeline-binding",
+                                f"pipeline op binds {n!r} but the stage "
+                                f"sub-block {sub_idx} declares no such "
+                                "var", block.idx, i, op.type, n))
+            impl = get_op(op.type)
+            if impl is not None and impl.is_host_op:
+                host_outs |= set(op.output_names())
+            else:
+                if not is_host_boundary(op.type):
+                    for n in op.input_names():
+                        if n in host_outs:
+                            diags.append(Diagnostic(
+                                ERROR, "host-boundary",
+                                f"device op {op.type!r} consumes {n!r}, "
+                                "the output of a host op, without a "
+                                "registered boundary (core/registry."
+                                "register_host_boundary)",
+                                block.idx, i, op.type, n))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _axis_sizes_of(mesh) -> Optional[Dict[str, int]]:
+    if mesh is None:
+        return None
+    if isinstance(mesh, dict):
+        return {str(k): int(v) for k, v in mesh.items()}
+    shape = getattr(mesh, "shape", None)  # jax.sharding.Mesh
+    if shape is not None:
+        return {str(k): int(v) for k, v in dict(shape).items()}
+    raise TypeError(f"mesh must be a Mesh or {{axis: size}} dict, "
+                    f"got {type(mesh).__name__}")
+
+
+def verify_program(program: Program, *, feeds: Iterable[str] = (),
+                   fetches: Iterable[str] = (), mesh=None,
+                   passes: Optional[Sequence[str]] = None) -> VerifyResult:
+    """Run the registered verifier passes over `program`.
+
+    feeds/fetches: names the caller will feed/fetch (the executor pre-pass
+    supplies its actual lists; the CLI takes them as flags) — they seed
+    def-use availability and dead-code roots. mesh: a jax Mesh or
+    {axis: size} dict enabling the concrete divisibility checks.
+    """
+    ctx = _Ctx(feeds, fetches, _axis_sizes_of(mesh))
+    names = list(passes) if passes is not None else list(_PASSES)
+    diags: List[Diagnostic] = []
+    for name in names:
+        try:
+            fn = _PASSES[name]
+        except KeyError:
+            raise ValueError(f"unknown verifier pass {name!r} "
+                             f"(have {registered_passes()})") from None
+        diags.extend(fn(program, ctx))
+    order = {ERROR: 0, WARNING: 1}
+    diags.sort(key=lambda d: (order.get(d.severity, 2), d.block_idx,
+                              -1 if d.op_idx is None else d.op_idx))
+    return VerifyResult(diags)
+
+
+def verify_enabled() -> bool:
+    """The PT_VERIFY knob (default off; tests default it on in conftest)."""
+    return os.environ.get("PT_VERIFY", "0").strip().lower() not in (
+        "", "0", "false", "off", "never")

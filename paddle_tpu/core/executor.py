@@ -181,6 +181,14 @@ class Executor:
         compiled = self._cache.get(key)
         was_cached = compiled is not None
         if compiled is None:
+            # static verification pre-pass (analysis/verifier.py): once per
+            # compile, never per step — the same amortization as the jit
+            # cache itself. Errors abort before tracing; warnings are
+            # available via verify_program directly / the CLI.
+            from ..analysis import verify_enabled, verify_program
+            if verify_enabled():
+                verify_program(program, feeds=list(feed_arrays),
+                               fetches=fetch_names).raise_if_errors()
             # grouped-conv autotune pre-pass (utils/gconv_autotune.py):
             # the formulation choice inside the trace is cache-lookup
             # only, so any un-tuned shape must be measured BEFORE tracing

@@ -95,6 +95,43 @@ def current_remat_policy() -> Optional[str]:
     return _remat_stack[-1][1] if _remat_stack else None
 
 
+def op_block_refs(op) -> List[int]:
+    """Block indices an op references through its BLOCK-typed attrs
+    (sub_block / true_block / false_block / sub_blocks) — the one shared
+    definition used by prune, the transpilers, and the static verifier
+    (analysis/verifier.py)."""
+    refs: List[int] = []
+    for key in ("sub_block", "true_block", "false_block"):
+        if key in op.attrs:
+            refs.append(op.attrs[key])
+    refs.extend(op.attrs.get("sub_blocks", ()))  # Switch cases
+    return refs
+
+
+def sub_block_var_names(program: "Program", op) -> set:
+    """Every var name any reachable sub-block of `op` touches (reads and
+    writes) — sub-block ops read outer vars the control-flow op does not
+    declare (parameters created inside rnn.block(), undeclared captures).
+    One shared liveness definition for prune (≙ prune.cc keeping
+    sub-block dependencies whole) and the static verifier — the two must
+    never drift. Invalid block indices are skipped (the verifier reports
+    them separately as dangling-block)."""
+    names: set = set()
+    todo = [bi for bi in op_block_refs(op)
+            if isinstance(bi, int) and 0 <= bi < len(program.blocks)]
+    seen: set = set()
+    while todo:
+        bi = todo.pop()
+        if bi in seen:
+            continue
+        seen.add(bi)
+        for sop in program.blocks[bi].ops:
+            names |= set(sop.input_names()) | set(sop.output_names())
+            todo.extend(bj for bj in op_block_refs(sop)
+                        if isinstance(bj, int) and 0 <= bj < len(program.blocks))
+    return names
+
+
 def iter_optimizer_state_inputs(block) -> Iterator[tuple]:
     """Yield (param_name, accumulator_name) for every optimizer-state input
     of Param-carrying ops (velocity, moments, …) — the one shared
@@ -417,31 +454,6 @@ class Program:
         p = self.clone()
         blk = p.global_block
 
-        def op_block_refs(op):
-            refs = []
-            for key in ("sub_block", "true_block", "false_block"):
-                if key in op.attrs:
-                    refs.append(op.attrs[key])
-            refs.extend(op.attrs.get("sub_blocks", ()))  # Switch cases
-            return refs
-
-        def sub_block_names(op):
-            """Every name any reachable sub-block of `op` touches —
-            sub-block ops read global vars the control-flow op does not
-            declare (parameters created inside rnn.block(), undeclared
-            captures), and their producers must survive pruning
-            (≙ prune.cc keeping sub-block dependencies whole)."""
-            names, todo, seen = set(), op_block_refs(op), set()
-            while todo:
-                bi = todo.pop()
-                if bi in seen or bi >= len(p.blocks):
-                    continue
-                seen.add(bi)
-                for sop in p.blocks[bi].ops:
-                    names |= set(sop.input_names()) | set(sop.output_names())
-                    todo.extend(op_block_refs(sop))
-            return names
-
         needed = set(targets)
         kept: List[OpDesc] = []
         sub_names_union: set = set()
@@ -455,7 +467,7 @@ class Program:
                 # keep producers of everything the op's sub-blocks read
                 # (their block-0 producers come LATER in this reversed
                 # walk, so seeding here is sufficient)
-                names = sub_block_names(op)
+                names = sub_block_var_names(p, op)
                 needed |= names
                 sub_names_union |= names
         kept.reverse()

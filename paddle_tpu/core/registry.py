@@ -69,6 +69,33 @@ def registered_ops() -> List[str]:
 
 
 # ---------------------------------------------------------------------------
+# Host/device boundary registry
+# ---------------------------------------------------------------------------
+# Ops registered with is_host_op=True run on the host, outside the traced
+# computation. A device op may only consume a host op's output through an
+# op registered here as a boundary (a marshalling op that owns the
+# host->device transfer). The static verifier (analysis/verifier.py
+# "shard-check" pass) enforces this; nothing at trace time does.
+# NOTE: no in-tree op currently sets is_host_op — the host-side surfaces
+# (readers, host tables, CSP channels) live as modules, not program ops
+# (op_parity_audit's host_module class). The contract exists so the next
+# host-resident op (e.g. an in-program host-table read) lands with its
+# boundary checked from day one; tests/test_analysis.py exercises it with
+# synthetic registrations.
+
+_HOST_BOUNDARY_OPS: set = set()
+
+
+def register_host_boundary(type: str) -> None:
+    """Declare `type` as a legal host->device boundary consumer."""
+    _HOST_BOUNDARY_OPS.add(type)
+
+
+def is_host_boundary(type: str) -> bool:
+    return type in _HOST_BOUNDARY_OPS
+
+
+# ---------------------------------------------------------------------------
 # Execution context passed to compute fns
 # ---------------------------------------------------------------------------
 

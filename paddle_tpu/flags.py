@@ -17,7 +17,8 @@ from __future__ import annotations
 import os
 from typing import Any, Dict
 
-__all__ = ["FLAGS", "DEFINE_flag", "reset_flags_from_env"]
+__all__ = ["FLAGS", "DEFINE_flag", "reset_flags_from_env",
+           "ENV_KNOBS", "declare_env_knob"]
 
 
 class _Flags:
@@ -95,3 +96,52 @@ DEFINE_flag("use_mkldnn", bool, False,
             "accepted for launch-script compatibility", noop=True)
 DEFINE_flag("eager_delete_scope", bool, True,
             "accepted for launch-script compatibility", noop=True)
+
+
+# --- PT_* env-knob registry -------------------------------------------------
+# Direct os.environ switches (read at point of use, not through FLAGS —
+# most gate module-level or per-trace decisions where the FLAGS object
+# would be a circular import). Every PT_* read in the package MUST be
+# declared here: tools/lint.py statically cross-checks reads against this
+# registry (the undeclared-env-knob rule), so a knob can't ship invisible
+# to FLAGS-style discovery.
+
+ENV_KNOBS: Dict[str, str] = {}
+
+
+def declare_env_knob(name: str, help_str: str = ""):
+    ENV_KNOBS[name] = help_str
+
+
+declare_env_knob("PT_VERIFY",
+                 "run the static program verifier (analysis/) as an "
+                 "executor/transpiler pre-pass; errors raise before "
+                 "compile. Default off; tests default it on")
+declare_env_knob("PT_GCONV_CACHE",
+                 "path of the grouped-conv autotune cache JSON "
+                 "(default ~/.cache/paddle_tpu/gconv_autotune.json)")
+declare_env_knob("PT_GCONV_TUNE",
+                 "0|never disables grouped-conv measurement (untuned "
+                 "shapes keep the native formulation)")
+declare_env_knob("PT_GCONV_DENSE",
+                 "always|never overrides the measured grouped-conv "
+                 "formulation choice")
+declare_env_knob("PT_FUSED_LSTM",
+                 "never reverts the whole-sequence Pallas LSTM kernel "
+                 "to the lax.scan formulation")
+declare_env_knob("PT_FUSED_BLOCK",
+                 "always enables the fused ResNet-bottleneck Pallas "
+                 "chain (default: XLA op-by-op, the measured winner)")
+declare_env_knob("PT_FUSED_BLOCK_MIN_S",
+                 "minimum spatial size for the fused bottleneck path")
+declare_env_knob("PT_BN_PLAIN_VJP",
+                 "use plain-AD batch-norm gradients instead of the "
+                 "memory-lean custom VJP (timing A/B)")
+declare_env_knob("PT_XENT_PLAIN",
+                 "use plain-AD softmax-xent gradients instead of the "
+                 "logits-temp-free custom VJP (timing A/B)")
+declare_env_knob("PT_LSTM_AMP",
+                 "include the lstm bench config in the bf16 AMP set")
+declare_env_knob("PT_HOST_TABLE_STRICT_LOAD",
+                 "error (instead of warn) on host-table checkpoint "
+                 "shard-coverage gaps")

@@ -443,7 +443,8 @@ def export_serving_model(dirname: str, feeded_var_names: Sequence[str],
         feed_meta.append({"name": name, "shape": list(shape),
                           "dtype": np.dtype(dt).name})
 
-    exported = jax.export.export(jax.jit(serve))(*example)
+    from .core.compat import jax_export
+    exported = jax_export().export(jax.jit(serve))(*example)
     os.makedirs(dirname, exist_ok=True)
     with open(os.path.join(dirname, "serving.stablehlo"), "wb") as f:
         f.write(exported.serialize())
@@ -460,8 +461,9 @@ def load_serving_model(dirname: str):
 
     with open(os.path.join(dirname, "serving.json")) as f:
         meta = json.load(f)
+    from .core.compat import jax_export
     with open(os.path.join(dirname, "serving.stablehlo"), "rb") as f:
-        exported = jax.export.deserialize(bytearray(f.read()))
+        exported = jax_export().deserialize(bytearray(f.read()))
 
     def predict(*arrays):
         return exported.call(*arrays)

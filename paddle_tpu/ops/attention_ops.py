@@ -90,8 +90,9 @@ def scaled_dot_product_attention(ctx, ins, attrs):
     def local(q, k, v):
         return inner(q, k, v, axis_name=SP, causal=causal, scale=scale)
 
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    from ..core.compat import shard_map
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
     # same tag as the single-chip path so remat_scope(policy="save_attn")
     # keeps the (ring/ulysses) attention output instead of silently
     # degrading to full recompute under sp

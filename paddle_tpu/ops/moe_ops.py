@@ -170,7 +170,8 @@ def moe_ffn(ctx, ins, attrs):
 
     tok_spec = PartitionSpec(tok_axes if len(tok_axes) > 1
                              else tok_axes[0], None)
-    fn = jax.shard_map(
+    from ..core.compat import shard_map
+    fn = shard_map(
         local, mesh=mesh,
         in_specs=(tok_spec, PartitionSpec(),
                   PartitionSpec(EP, None, None), PartitionSpec(EP, None),

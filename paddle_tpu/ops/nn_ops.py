@@ -174,7 +174,8 @@ def _dense_expand_grouped(w, groups):
                       jnp.asarray(place, w.dtype))
 
 
-def _gconv_prefers_dense(x, w, groups, stride=(1, 1)) -> bool:
+def _gconv_prefers_dense(x, w, groups, stride=(1, 1), padding=None,
+                         dilation=(1, 1)) -> bool:
     """Formulation choice for grouped convs: XLA's native grouped lowering
     vs a dense conv over block-diagonal-expanded weights (the dense detour
     pays Cg->C_in flops inflation but keeps the MXU's lanes full where
@@ -202,7 +203,8 @@ def _gconv_prefers_dense(x, w, groups, stride=(1, 1)) -> bool:
                         int(x.shape[2]), int(x.shape[3]),
                         int(w.shape[0]), int(groups),
                         (int(stride[0]), int(stride[1])),
-                        str(x.dtype), int(w.shape[2]))
+                        str(x.dtype), int(w.shape[2]),
+                        padding=padding, dilation=dilation)
     hit = _gt.lookup(key)
     return bool(hit) if hit is not None else False
 
@@ -214,7 +216,8 @@ def _conv2d(x, w, attrs, feature_group_count=None):
     d = _pair(attrs.get("dilations", 1))
     groups = feature_group_count or attrs.get("groups", 1) or 1
     if groups > 1 and groups < x.shape[1] \
-            and _gconv_prefers_dense(x, w, groups, stride=s):
+            and _gconv_prefers_dense(x, w, groups, stride=s, padding=p,
+                                     dilation=d):
         w = _dense_expand_grouped(w, groups)
         groups = 1
     # NOTE: no preferred_element_type upcast — the MXU accumulates bf16

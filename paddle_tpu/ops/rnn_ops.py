@@ -37,7 +37,9 @@ def _pallas_lstm_ok(ctx, attrs, use_peep, w_proj, b, h, t):
         return False
     if use_peep or w_proj is not None:
         return False
-    if attrs.get("gate_activation", "sigmoid") != "sigmoid"             or attrs.get("cell_activation", "tanh") != "tanh"             or attrs.get("candidate_activation", "tanh") != "tanh":
+    if (attrs.get("gate_activation", "sigmoid") != "sigmoid"
+            or attrs.get("cell_activation", "tanh") != "tanh"
+            or attrs.get("candidate_activation", "tanh") != "tanh"):
         return False
     if ctx is None or getattr(ctx, "mesh", None) is not None:
         return False

@@ -198,6 +198,14 @@ class ParallelExecutor:
 
         compiled = self._cache.get(key)
         if compiled is None:
+            from ..analysis import verify_enabled, verify_program
+            if verify_enabled():
+                # the mesh is known here, so the shard divisibility checks
+                # run concrete (the single-chip Executor can only check
+                # axis names against the alphabet)
+                verify_program(program, feeds=list(feed_arrays),
+                               fetches=fetch_names,
+                               mesh=self._mesh).raise_if_errors()
             if loop is None:
                 step, state_out = lowering.build_step_fn(
                     program, list(feed_arrays), fetch_names, sorted(state),

@@ -77,6 +77,7 @@ def gpipe(stage_fn: Callable, params, xs, *, mesh: Mesh, axis: str = "pp"):
         return jax.lax.psum(
             jnp.where(idx == s - 1, outbuf, jnp.zeros_like(outbuf)), axis)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(P(axis), x_spec),
-                       out_specs=x_spec, check_vma=False)
+    from ..core.compat import shard_map
+    fn = shard_map(body, mesh=mesh, in_specs=(P(axis), x_spec),
+                   out_specs=x_spec, check_vma=False)
     return fn(params, xs)

@@ -267,6 +267,14 @@ def transpile(program: Optional[Program] = None, mesh=None,
             acc.sharding = p.sharding
 
     program.invalidate_cache()
+
+    # post-condition gate (PT_VERIFY): every sharding this pass derived
+    # must name real mesh axes and divide evenly — catching a bad
+    # annotation here names the transpiler, not a cryptic jit error later
+    from ..analysis import verify_enabled, verify_program
+    if verify_enabled():
+        verify_program(program, mesh=mesh,
+                       passes=["shard-check"]).raise_if_errors()
     return program
 
 

@@ -335,4 +335,11 @@ def pipeline_transpile(program: Optional[Program] = None,
                "layers_per_stage": r // int(num_stages)})
     block.ops[start:start + r * w] = [pipe_op]
     program.invalidate_cache()
+
+    # post-condition gate (PT_VERIFY): the pipeline op's sub-block index
+    # and inner-var bindings must be real before anything lowers them
+    from ..analysis import verify_enabled, verify_program
+    if verify_enabled():
+        verify_program(program,
+                       passes=["shard-check"]).raise_if_errors()
     return region

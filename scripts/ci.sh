@@ -1,9 +1,17 @@
 #!/usr/bin/env bash
 # CI entry (≙ paddle/scripts/paddle_build.sh: build + test in one place).
-# Runs the full suite on the 8-device virtual CPU mesh, the multi-chip
-# dryrun, and a bench sanity pass. Usage: scripts/ci.sh [quick]
+# Runs the lint gate, the full suite on the 8-device virtual CPU mesh,
+# the multi-chip dryrun, and a bench sanity pass.
+# Usage: scripts/ci.sh [quick|lint]   (lint = just the lint gate)
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+echo "== lint gate (ruff + custom AST checks, tools/lint.py) =="
+python tools/lint.py
+if [[ "${1:-}" == "lint" ]]; then
+  echo "LINT OK"
+  exit 0
+fi
 
 echo "== unit + integration tests (8-device virtual CPU mesh) =="
 # jax's "Explicitly requested dtype int64 ... truncated" warning is promoted

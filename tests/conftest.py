@@ -10,6 +10,12 @@ import os
 # Force CPU regardless of the session's JAX_PLATFORMS (e.g. a live TPU):
 # tests need determinism, fp32 matmuls, and the 8-device virtual mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Static program verification (analysis/verifier.py) is opt-in at large
+# (PT_VERIFY=1) but DEFAULT-ON under test: every program a test compiles
+# is verified first, so an IR defect fails as a named diagnostic here
+# instead of a cryptic trace error on hardware.
+os.environ.setdefault("PT_VERIFY", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

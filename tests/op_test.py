@@ -138,7 +138,8 @@ class OpTest:
         def to64(v):
             return v.astype(np.float64) if v.dtype.kind == "f" else v
 
-        with jax.enable_x64(True):
+        from paddle_tpu.core.compat import enable_x64
+        with enable_x64(True):
             step, _ = lowering.build_step_fn(fwd_prog, list(feed2),
                                              ["loss__"], [])
             key = jax.random.PRNGKey(0)

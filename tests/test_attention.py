@@ -63,7 +63,8 @@ def test_sequence_parallel_matches_full(rng, mode, causal):
                for _ in range(3)]
     spec = P(None, "sp", None, None)
     inner = ring_attention if mode == "ring" else ulysses_attention
-    f = jax.jit(jax.shard_map(
+    from paddle_tpu.core.compat import shard_map
+    f = jax.jit(shard_map(
         lambda q, k, v: inner(q, k, v, axis_name="sp", causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False))

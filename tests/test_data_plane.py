@@ -409,6 +409,7 @@ class TestSampleRecordIO:
         assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 class TestRealDataEpochEndToEnd:
     """The full integration the pieces above exercise separately
     (VERDICT r2 weak #3): RecordIO file -> native decode -> double_buffer

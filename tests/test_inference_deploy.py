@@ -137,7 +137,8 @@ class TestServingExport:
             jax.config.update("jax_platforms", "cpu")
             import numpy as np
             with open({os.path.join(d, 'serving.stablehlo')!r}, "rb") as f:
-                ex = jax.export.deserialize(bytearray(f.read()))
+                from paddle_tpu.core.compat import jax_export
+                ex = jax_export().deserialize(bytearray(f.read()))
             out = ex.call(np.ones((1, 4), np.float32))
             print("SERVED", np.asarray(out[0]).shape)
         """)

@@ -4,6 +4,7 @@ shared-table embeddings -> 8-deep alternating-direction LSTM stack ->
 linear-chain CRF; trains until the cost falls, then decodes."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu.models import label_semantic_roles as srl
@@ -27,6 +28,7 @@ def _batch(rng, n=4, tmax=6):
 
 
 class TestLabelSemanticRoles:
+    @pytest.mark.slow
     def test_trains_and_decodes(self):
         rng = np.random.RandomState(0)
         main, startup = pt.Program(), pt.Program()

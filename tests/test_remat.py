@@ -59,6 +59,7 @@ def _jaxpr_str(main, startup, avg, seq_len=64):
         return str(jax.make_jaxpr(step)(state, fa, jax.random.PRNGKey(0)))
 
 
+@pytest.mark.slow
 class TestRematParity:
     def test_transformer_remat_matches_baseline(self):
         base = _run_steps(*_tfm_program(remat=False))

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Standalone whole-program verifier CLI (analysis/verifier.py).
+
+Verify a serialized Program (Program.to_json output, e.g. a checkpointed
+model or a transpiler artifact) without executing it — the same passes
+PT_VERIFY=1 runs inside the executor, plus artifact sanity checks for
+measurement JSON:
+
+    python tools/verify_program.py program.json
+    python tools/verify_program.py program.json --mesh dp=2,tp=4 \
+        --fetch mean_0 --feed data --feed label
+    python tools/verify_program.py --autotune-cache ~/.cache/paddle_tpu/gconv_autotune.json
+    python tools/verify_program.py --bench BENCH_r05.json
+
+Exit status: 0 clean (warnings allowed), 1 any error-severity finding,
+2 usage/IO problems.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def parse_mesh(spec: str) -> dict:
+    axes = {}
+    for part in spec.split(","):
+        if not part:
+            continue
+        name, _, size = part.partition("=")
+        if not size:
+            raise argparse.ArgumentTypeError(
+                f"mesh axis {part!r} is not name=size")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("program", nargs="?",
+                    help="Program JSON file (Program.to_json)")
+    ap.add_argument("--mesh", type=parse_mesh, default=None,
+                    help="mesh axes as name=size,name=size — enables "
+                         "concrete shard-divisibility checks")
+    ap.add_argument("--feed", action="append", default=[],
+                    help="a var name that will be fed (repeatable)")
+    ap.add_argument("--fetch", action="append", default=[],
+                    help="a var name that will be fetched (repeatable)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset of verifier passes")
+    ap.add_argument("--autotune-cache", default=None,
+                    help="validate a gconv autotune cache JSON")
+    ap.add_argument("--bench", default=None,
+                    help="floor-check a bench.py output JSON")
+    args = ap.parse_args(argv)
+
+    if not (args.program or args.autotune_cache or args.bench):
+        ap.error("nothing to do: give a program JSON, --autotune-cache, "
+                 "or --bench")
+
+    rc = 0
+
+    if args.autotune_cache or args.bench:
+        from paddle_tpu.analysis import artifacts
+        for path, validate in ((args.autotune_cache,
+                                artifacts.validate_autotune_cache),
+                               (args.bench, artifacts.validate_bench_json)):
+            if not path:
+                continue
+            try:
+                with open(os.path.expanduser(path)) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"{path}: cannot load: {e}", file=sys.stderr)
+                return 2
+            problems = validate(doc)
+            for p in problems:
+                print(f"{path}: error[artifact-sanity] {p}")
+            if problems:
+                rc = 1
+            else:
+                print(f"{path}: artifact verifies clean")
+
+    if args.program:
+        from paddle_tpu.analysis import verify_program
+        from paddle_tpu.core.program import Program
+        try:
+            with open(args.program) as f:
+                program = Program.from_json(f.read())
+        except (OSError, ValueError, KeyError) as e:
+            print(f"{args.program}: cannot load program: {e}",
+                  file=sys.stderr)
+            return 2
+        passes = args.passes.split(",") if args.passes else None
+        result = verify_program(program, feeds=args.feed,
+                                fetches=args.fetch, mesh=args.mesh,
+                                passes=passes)
+        print(result.report())
+        if not result.ok:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
