@@ -42,6 +42,7 @@ from .lod import LoDTensor, create_lod_tensor
 from . import flags
 from .flags import FLAGS
 from . import debugger
+from . import resilience
 from .utils import profiler
 from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent)

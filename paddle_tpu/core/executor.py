@@ -56,6 +56,40 @@ class _Compiled:
         self.fetch_names = fetch_names
 
 
+def _autotune_batch_hint(program: Program, feed_arrays: Dict[str, object],
+                         bdim: int) -> int:
+    """Batch-size hint for the gconv autotune pre-pass.
+
+    The leading dim of an arbitrary feed is NOT necessarily a batch axis:
+    a host-table rows feed is [capacity, dim], and dict order could hand
+    its capacity to the tuner as the batch, caching measurements under
+    the wrong n (ADVICE r5). Registered rows feeds are skipped outright;
+    feeds bound to program data vars whose declared leading dim is the
+    symbolic batch (-1, layers.data's append_batch_size) win immediately;
+    anything else (static-shape data vars, unknown names) is only the
+    first-seen fallback."""
+    from .. import host_table as _ht
+    rows_names = {t.rows_name for t in _ht.registered_tables().values()}
+    fallback = None
+    for name, v in feed_arrays.items():
+        if name in rows_names:
+            continue  # [capacity, dim] rows block: never a batch axis
+        shp = jnp.shape(v)
+        if len(shp) <= bdim:
+            continue
+        try:
+            var = program.global_block.var(name)
+        except KeyError:
+            var = None
+        if var is not None and getattr(var, "is_data", False):
+            dims = tuple(var.shape or ())
+            if dims and int(dims[0]) == -1:
+                return int(shp[bdim])
+        if fallback is None:
+            fallback = int(shp[bdim])
+    return fallback if fallback is not None else 8
+
+
 class Executor:
     def __init__(self, place: Optional[Place] = None):
         self.place = place or Place("tpu")
@@ -196,9 +230,7 @@ class Executor:
             # per_step_feeds arrays carry a leading [n_steps] axis: the
             # batch lives at dim 1 there (dim 0 otherwise)
             bdim = 1 if per_step_feed_prep else 0
-            bh = next((int(jnp.shape(v)[bdim])
-                       for v in feed_arrays.values()
-                       if len(jnp.shape(v)) > bdim), 8)
+            bh = _autotune_batch_hint(program, feed_arrays, bdim)
             gconv_autotune.tune_program(program, bh)
             raw, state_out, donate = build(program, list(feed_arrays),
                                            fetch_names, sorted(state))

@@ -145,3 +145,19 @@ declare_env_knob("PT_LSTM_AMP",
 declare_env_knob("PT_HOST_TABLE_STRICT_LOAD",
                  "error (instead of warn) on host-table checkpoint "
                  "shard-coverage gaps")
+declare_env_knob("PT_FAULT_INJECT",
+                 "deterministic fault-plan injector (resilience/"
+                 "faults.py): comma-separated site@trigger specs + "
+                 "optional :seed=N, e.g. "
+                 "'io_write_truncate@3,step_crash@7,reader_raise@2:seed=0'"
+                 " — triggers are N (1-based one-shot), * (every hit), "
+                 "or pFLOAT (seeded probability)")
+declare_env_knob("PT_CKPT_VERIFY",
+                 "0|false disables checkpoint manifest verification on "
+                 "load (default on: corrupt committed serials are "
+                 "quarantined and the loader falls back to the newest "
+                 "serial that verifies)")
+declare_env_knob("PT_CHAOS_SEED",
+                 "seed forwarded to the chaos suite's probabilistic "
+                 "fault plans (scripts/ci.sh chaos runs the resilience "
+                 "tests under two fixed values)")

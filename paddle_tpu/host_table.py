@@ -356,6 +356,8 @@ class HostEmbeddingTable:
             state["moment"] = self.moment
         if self.moment2 is not None:
             state["moment2"] = self.moment2
+        from .resilience import faults
+        faults.crash_point("io_crash")
         tmp = self._ckpt_path(dirname) + ".tmp"
         with self._lock:
             # file-handle form: np.savez would append .npz to a bare
@@ -369,6 +371,19 @@ class HostEmbeddingTable:
         path = self._ckpt_path(dirname)
         if not os.path.exists(path):
             return False
+        # same manifest treatment as the program vars: a torn/bit-rotten
+        # shard in a manifested dir fails HERE, not as silently-wrong
+        # embeddings three epochs later (resilience/manifest.py; dirs
+        # without a manifest — standalone save_persistables — skip this)
+        from .resilience import manifest as _manifest
+        problem = (_manifest.verify_file(dirname, os.path.basename(path))
+                   if _manifest.verify_on_load() else None)
+        if problem:
+            # VerificationError: deterministic — retry layers must not
+            # re-run a load that can only fail the same way
+            raise _manifest.VerificationError(
+                f"host table {self.name!r}: checkpoint shard failed "
+                f"manifest verification — {problem}")
         with np.load(path) as z:
             if (int(z["lo"]), int(z["hi"])) != (self.lo, self.hi):
                 raise ValueError(

@@ -20,6 +20,10 @@ import numpy as np
 
 from .core.program import Program, VarDesc, default_main_program
 from .core.scope import Scope, global_scope
+from .resilience import FaultInjected, faults
+from .resilience import manifest as _manifest
+from .resilience.manifest import VerificationError as _VerificationError
+from .resilience.retry import RetryPolicy, retry_call
 
 __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
@@ -27,11 +31,32 @@ __all__ = [
     "load_inference_model", "get_inference_program",
     "export_serving_model", "load_serving_model",
     "save_checkpoint", "load_checkpoint", "clean_checkpoint",
-    "get_latest_checkpoint_serial",
+    "get_latest_checkpoint_serial", "CheckpointCorruptError",
 ]
 
 SUCCESS_MARK_FILENAME = "_SUCCESS"
 CHECKPOINT_PREFIX = "checkpoint"
+
+
+class CheckpointCorruptError(_VerificationError):
+    """An explicitly requested checkpoint failed manifest verification
+    (auto-selection never raises this — it falls back to the newest
+    serial that verifies, quarantining the corrupt one)."""
+
+
+#: load-time verification gate (PT_CKPT_VERIFY): shared with
+#: host_table.load so the opt-out covers every verification site
+_verify_on_load = _manifest.verify_on_load
+
+
+#: transient-FS retry for checkpoint reads. Deterministic failures are
+#: excluded on purpose: a missing var file (FileNotFoundError) and
+#: integrity failures (VerificationError — manifest mismatch, mixed
+#: layouts) can only fail identically on every attempt
+_LOAD_RETRY = RetryPolicy(
+    retries=2, base_delay=0.05, max_delay=0.5,
+    retry_on=lambda e: isinstance(e, OSError)
+    and not isinstance(e, (FileNotFoundError, _VerificationError)))
 
 
 def _is_persistable(var: VarDesc) -> bool:
@@ -65,9 +90,20 @@ def _shard_slices(val, sh):
 
 
 def _atomic_save(path: str, arr) -> None:
+    faults.crash_point("io_crash")
     tmp = path + f".tmp{os.getpid()}"
     with open(tmp, "wb") as f:
         np.save(f, arr)
+    hit = faults.fire("io_write_truncate")
+    if hit is not None:
+        # torn write: half the bytes make it to the FINAL name before the
+        # "process dies" — the exact artifact a power loss can leave that
+        # tmp+replace alone cannot guard against (the manifest can)
+        size = os.path.getsize(tmp)
+        with open(tmp, "r+b") as f:
+            f.truncate(size // 2)
+        os.replace(tmp, path)
+        raise FaultInjected("io_write_truncate", hit)
     os.replace(tmp, path)
 
 
@@ -140,6 +176,108 @@ def _load_sharded(dirname: str, base: str):
 def _is_cross_process(val) -> bool:
     import jax
     return isinstance(val, jax.Array) and not val.is_fully_addressable
+
+
+# ---------------------------------------------------------------------------
+# fused <-> op-by-op checkpoint name mapping (ADVICE r5 medium)
+#
+# models/resnet.py emits the one-op fused_bottleneck for stride-1 rest
+# blocks by default; a checkpoint saved from the op-by-op graph
+# (PT_FUSED_BLOCK=never, or any pre-fused-era run) names those parameters
+# conv2d_i.w_0 / batch_norm_j.* while the fused graph names them
+# fused_bottleneck_M.*. The two graphs are structurally identical — each
+# fused op IS three (conv2d, batch_norm) pairs in the op-by-op creation
+# order — so the mapping is positional: walk the target program's ops,
+# expand every fused_bottleneck into its conv/bn groups, and pair the
+# k-th group with the k-th conv2d/batch_norm name run in the checkpoint
+# directory. Applied only as a FALLBACK for vars whose exact name is
+# absent, and only when the counts line up exactly — a wrong-directory
+# load must keep failing loudly, not succeed positionally.
+# ---------------------------------------------------------------------------
+
+#: op-by-op file tails per bn slot, fixed by _bn_state_vars creation
+#: order (layers/nn.py): scale, bias, then the two persistable running
+#: stats (saved-batch stats are non-persistable and never on disk)
+_BN_SLOT_TAILS = (("Scale", "w_0"), ("Bias", "b_0"),
+                  ("Mean", "tmp_0"), ("Variance", "tmp_1"))
+
+
+def _conv_bn_groups(program) -> list:
+    """Ordered (kind, {slot: target_var_name}) over the program's global
+    block, fused bottlenecks expanded to conv1,bn1,conv2,bn2,conv3,bn3 —
+    the op-by-op graph's creation (and therefore naming) order."""
+    groups = []
+    for op in program.global_block.ops:
+        if op.type == "conv2d":
+            groups.append(("conv", {"W": op.inputs["Filter"][0]}))
+        elif op.type == "batch_norm":
+            groups.append(("bn", {s: op.inputs[s][0]
+                                  for s, _ in _BN_SLOT_TAILS}))
+        elif op.type == "fused_bottleneck":
+            for k in ("1", "2", "3"):
+                groups.append(("conv", {"W": op.inputs["W" + k][0]}))
+                groups.append(("bn", {s: op.inputs[s + k][0]
+                                      for s, _ in _BN_SLOT_TAILS}))
+    return groups
+
+
+def _fused_fallback_map(program, dirname: str) -> dict:
+    """target var name -> checkpoint file base, or {} when the positional
+    pairing is not provably sound (counts/contiguity mismatch).
+
+    When it engages, the map covers EVERY conv/bn group param and is
+    AUTHORITATIVE for all of them, identity pairs included: unique_name
+    counters shift after the first fused block, so a fused-graph name
+    like conv2d_4 can exist in the op-by-op checkpoint while belonging to
+    a DIFFERENT physical block — loading it by exact name would silently
+    scramble parameters. The engage conditions make false positives
+    structurally impossible for a same-graph load: a checkpoint saved
+    from the fused form holds the fused params under fused_bottleneck_*
+    names, so its conv2d_*/batch_norm_* name runs can never match the
+    expanded group counts."""
+    if not any(op.type == "fused_bottleneck"
+               for op in program.global_block.ops):
+        return {}
+    groups = _conv_bn_groups(program)
+    names = os.listdir(dirname)
+
+    def index_run(pat, count):
+        idx = sorted(int(m.group(1)) for n in names
+                     for m in [re.fullmatch(pat, n)] if m)
+        if len(idx) != count or (idx and idx != list(
+                range(idx[0], idx[0] + count))):
+            return None
+        return idx
+    n_conv = sum(1 for k, _ in groups if k == "conv")
+    n_bn = len(groups) - n_conv
+    conv_idx = index_run(r"conv2d_(\d+)\.w_0\.npy", n_conv)
+    bn_idx = index_run(r"batch_norm_(\d+)\.w_0\.npy", n_bn)
+    if conv_idx is None or bn_idx is None:
+        return {}
+    out = {}
+    ci = bi = 0
+    for kind, slots in groups:
+        if kind == "conv":
+            out[slots["W"]] = f"conv2d_{conv_idx[ci]}.w_0"
+            ci += 1
+        else:
+            j = bn_idx[bi]
+            bi += 1
+            for slot, tail in _BN_SLOT_TAILS:
+                out[slots[slot]] = f"batch_norm_{j}.{tail}"
+    return out
+
+
+def _remap_missing(remap: dict, name: str) -> Optional[str]:
+    """Checkpoint file base for a missing var, via the fused mapping.
+    Derived names (optimizer accumulators are `<param>_velocity_0` etc.)
+    remap by their parameter prefix."""
+    if name in remap:
+        return remap[name]
+    for target, source in remap.items():
+        if name.startswith(target + "_"):
+            return source + name[len(target):]
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -285,8 +423,28 @@ def load_vars(executor=None, dirname: str = "", main_program=None, vars=None,
         for v in vars:
             scope.set_var(v.name, data[v.name])
         return
+    # fused-bottleneck graphs loading an op-by-op checkpoint: the
+    # positional mapping, when it engages, is AUTHORITATIVE for every
+    # conv/bn group param — unique_name counters shift after the first
+    # fused block, so exact-name hits can be a DIFFERENT physical
+    # block's weights (loading them would scramble the model silently)
+    remap = _fused_fallback_map(main_program, dirname)
     missing = []
+    mapped = 0
     for v in vars:
+        src = _remap_missing(remap, v.name) if remap else None
+        if src is not None:
+            path = os.path.join(dirname, src.replace("/", "__") + ".npy")
+            if os.path.exists(path):
+                scope.set_var(v.name, np.load(path))
+                if src != v.name:
+                    mapped += 1
+                continue
+            if src != v.name:
+                missing.append(v.name)
+                continue
+            # identity-mapped name without a .npy: fall through to the
+            # normal layout handling (sharded pieces etc.)
         base = v.name.replace("/", "__")
         path = os.path.join(dirname, base + ".npy")
         has_npy = os.path.exists(path)
@@ -296,7 +454,7 @@ def load_vars(executor=None, dirname: str = "", main_program=None, vars=None,
             # both layouts present = an interrupted re-save with a changed
             # sharding; guessing which is current would silently restore
             # stale values (save_vars cleans the other layout on success)
-            raise IOError(
+            raise _VerificationError(
                 f"load_vars: {v.name!r} has BOTH a full .npy and shard "
                 f"pieces in {dirname!r} — the directory mixes saves with "
                 "different layouts; delete the stale layout or re-save")
@@ -308,6 +466,12 @@ def load_vars(executor=None, dirname: str = "", main_program=None, vars=None,
                 scope.set_var(v.name, assembled)
             else:
                 missing.append(v.name)
+    if mapped:
+        import warnings
+        warnings.warn(
+            f"load_vars: restored {mapped} variable(s) through the "
+            f"fused/op-by-op graph-form mapping for {dirname!r} "
+            "(PT_FUSED_BLOCK checkpoint compatibility)", stacklevel=2)
     if missing:
         raise FileNotFoundError(
             f"load_vars: no saved file for {len(missing)} variable(s) in "
@@ -362,12 +526,29 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
         json.dump(meta, f)
     save_persistables(executor, dirname, pruned,
                       filename=params_filename, scope=scope)
+    # same manifest treatment as checkpoints: a deployed model dir can be
+    # verified (and a torn copy detected) before it serves traffic
+    import jax
+    if jax.process_count() > 1:
+        # save_vars barriers internally, but host-table rank shards are
+        # written AFTER that barrier (save_persistables tail) — without
+        # this sync rank 0's manifest scan could miss a peer's file
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("pt_save_inference_manifest")
+    if jax.process_count() == 1 or jax.process_index() == 0:
+        _manifest.write_manifest(dirname, layout="inference")
     return target_names
 
 
 def load_inference_model(dirname: str, executor=None,
                          model_filename: Optional[str] = None,
                          params_filename: Optional[str] = None, scope=None):
+    if _verify_on_load() and _manifest.read_manifest(dirname) is not None:
+        status, problems = _manifest.verify_dir(dirname)
+        if status == "corrupt":
+            raise CheckpointCorruptError(
+                f"inference model dir {dirname!r} failed manifest "
+                f"verification: {'; '.join(problems[:5])}")
     with open(os.path.join(dirname, model_filename or "__model__.json")) as f:
         meta = json.load(f)
     program = Program.from_dict(meta["program"])
@@ -479,16 +660,63 @@ def _serial_dir(checkpoint_dir: str, serial: int) -> str:
     return os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
 
 
-def get_latest_checkpoint_serial(checkpoint_dir: str) -> int:
-    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
-        return -1
-    best = -1
+def _committed_serials(checkpoint_dir: str) -> List[int]:
+    out = []
     for name in os.listdir(checkpoint_dir):
         m = re.fullmatch(rf"{CHECKPOINT_PREFIX}_(\d+)", name)
         if m and os.path.exists(os.path.join(checkpoint_dir, name,
                                              SUCCESS_MARK_FILENAME)):
-            best = max(best, int(m.group(1)))
-    return best
+            out.append(int(m.group(1)))
+    return sorted(out, reverse=True)
+
+
+def get_latest_checkpoint_serial(checkpoint_dir: str,
+                                 verify: Optional[bool] = None) -> int:
+    """Newest committed serial — by default (PT_CKPT_VERIFY, on) the
+    newest that also passes manifest verification. A committed serial
+    that fails verification is QUARANTINED (renamed to
+    ``checkpoint_N.corrupt``, never deleted — resilience/manifest.py) and
+    the scan falls back to the next older one, so auto-resume restores
+    the newest checkpoint that is actually restorable instead of
+    faithfully loading garbage. Pre-manifest serials verify as legacy
+    and are accepted."""
+    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
+        return -1
+    if verify is None:
+        verify = _verify_on_load()
+    for serial in _committed_serials(checkpoint_dir):
+        if not verify:
+            return serial
+        cur = _serial_dir(checkpoint_dir, serial)
+        import warnings
+        try:
+            status, problems = _manifest.verify_dir(cur,
+                                                    SUCCESS_MARK_FILENAME)
+        except FileNotFoundError as e:
+            # a peer rank quarantined (renamed) the dir mid-digest: the
+            # serial is gone — skip it WITHOUT quarantining (nothing left
+            # to rename). Any other OSError propagates: a transient EIO
+            # must fail the load loudly, never rename a good serial away.
+            warnings.warn(
+                f"checkpoint serial {serial} in {checkpoint_dir!r} "
+                f"vanished during verification ({e}) — a peer process "
+                "quarantined it; falling back to the next older serial",
+                stacklevel=2)
+            continue
+        if status != "corrupt":
+            return serial
+        try:
+            dest = _manifest.quarantine(cur)
+        except OSError:
+            # multi-process load: another rank quarantined it first
+            dest = "(already quarantined by a peer)"
+        warnings.warn(
+            f"checkpoint serial {serial} in {checkpoint_dir!r} failed "
+            f"manifest verification ({'; '.join(problems[:3])}"
+            f"{'...' if len(problems) > 3 else ''}) — quarantined to "
+            f"{dest}; falling back to the next older serial",
+            stacklevel=2)
+    return -1
 
 
 def save_checkpoint(executor=None, checkpoint_dir: str = "", trainer_id: int = 0,
@@ -507,7 +735,9 @@ def save_checkpoint(executor=None, checkpoint_dir: str = "", trainer_id: int = 0
     attempt's files can never blend into the next one."""
     import jax
     multi = jax.process_count() > 1
-    serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
+    # serial picking must not re-digest (or quarantine) old serials on
+    # every save — corruption handling is the LOAD path's duty
+    serial = get_latest_checkpoint_serial(checkpoint_dir, verify=False) + 1
     if multi:
         from jax.experimental import multihost_utils
         serial = int(multihost_utils.broadcast_one_to_all(
@@ -517,6 +747,11 @@ def save_checkpoint(executor=None, checkpoint_dir: str = "", trainer_id: int = 0
             shutil.rmtree(cur, ignore_errors=True)  # uncommitted leftovers
         multihost_utils.sync_global_devices(f"paddle_tpu_ckpt_pre_{serial}")
     cur = _serial_dir(checkpoint_dir, serial)
+    if not multi and os.path.isdir(cur):
+        # serial picking skips uncommitted dirs, so anything here is a
+        # crashed attempt's leftovers — clear them, or stale files from a
+        # different var set would blend into this save's manifest
+        shutil.rmtree(cur, ignore_errors=True)
     os.makedirs(cur, exist_ok=True)
     save_persistables(executor, cur, main_program, scope=scope)
     if trainer_args:
@@ -526,21 +761,48 @@ def save_checkpoint(executor=None, checkpoint_dir: str = "", trainer_id: int = 0
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"paddle_tpu_ckpt_{serial}")
     if not multi or jax.process_index() == 0:
-        with open(os.path.join(cur, SUCCESS_MARK_FILENAME), "w") as f:
-            f.write("")
+        # manifest BEFORE _SUCCESS (every rank's files are on disk — the
+        # barrier above guarantees it): a crash anywhere in this window
+        # leaves an uncommitted dir the next save clears, never a
+        # _SUCCESS-marked serial that cannot be verified
+        _manifest.write_manifest(cur, layout="checkpoint")
+        faults.crash_point("commit_crash")
+        marker = os.path.join(cur, SUCCESS_MARK_FILENAME)
+        tmp = marker + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(_manifest.success_payload(cur))
+        os.replace(tmp, marker)
         _scroll_delete(checkpoint_dir, max_num_checkpoints)
     return serial
 
 
 def load_checkpoint(executor=None, checkpoint_dir: str = "", serial: Optional[int] = None,
-                    main_program=None, trainer_id: int = 0, scope=None):
-    """io.py:504: restore persistables (+ trainer args if present)."""
+                    main_program=None, trainer_id: int = 0, scope=None,
+                    verify: Optional[bool] = None):
+    """io.py:504: restore persistables (+ trainer args if present).
+
+    `verify=False` skips manifest re-verification of an explicit serial —
+    for callers that just selected it via the verifying
+    get_latest_checkpoint_serial (re-digesting a multi-GB checkpoint
+    doubles resume I/O for nothing)."""
     if serial is None:
+        # verified selection: quarantines corrupt serials, falls back to
+        # the newest one that verifies
         serial = get_latest_checkpoint_serial(checkpoint_dir)
+    elif _verify_on_load() if verify is None else verify:
+        # an EXPLICIT serial is a user decision — no silent fallback;
+        # corruption raises (and the dir is left in place for forensics)
+        status, problems = _manifest.verify_dir(
+            _serial_dir(checkpoint_dir, serial), SUCCESS_MARK_FILENAME)
+        if status == "corrupt":
+            raise CheckpointCorruptError(
+                f"checkpoint serial {serial} in {checkpoint_dir!r} failed "
+                f"manifest verification: {'; '.join(problems[:5])}")
     if serial < 0:
         return None
     cur = _serial_dir(checkpoint_dir, serial)
-    load_persistables(executor, cur, main_program, scope=scope)
+    retry_call(load_persistables, executor, cur, main_program, scope=scope,
+               policy=_LOAD_RETRY)
     args_path = os.path.join(cur, f"trainer_{trainer_id}.json")
     if os.path.exists(args_path):
         with open(args_path) as f:

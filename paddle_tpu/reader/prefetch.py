@@ -19,14 +19,25 @@ __all__ = ["double_buffer", "DeviceFeeder"]
 _STOP = object()
 
 
-def double_buffer(reader: Callable, place=None, capacity: int = 2):
+def double_buffer(reader: Callable, place=None, capacity: int = 2,
+                  retry_policy=None):
     """Wrap a feed-dict reader so device uploads overlap compute.
 
     reader() yields dicts of numpy arrays (or anything jax.device_put
     accepts). A worker thread stays `capacity` batches ahead; exceptions
     propagate to the consumer. ≙ layers/io.py:556 double_buffer.
+
+    retry_policy (resilience.RetryPolicy): bound restarts of a flaky
+    reader INSIDE the worker thread — the underlying reader is re-invoked
+    and fast-forwarded past delivered batches, so the consumer never sees
+    a duplicate; exhaustion propagates the original error as before.
+    (The Trainer installs its own wrapper upstream — don't pass a policy
+    there too, or each error spends two retry budgets.)
     """
     import jax
+    if retry_policy is not None:
+        from ..resilience.retry import resilient_reader
+        reader = resilient_reader(reader, policy=retry_policy)
 
     def buffered():
         q: "queue.Queue" = queue.Queue(maxsize=capacity)
@@ -83,10 +94,12 @@ class DeviceFeeder:
     """DataFeeder + double_buffer in one: converts raw reader rows with a
     DataFeeder and keeps the uploads ahead of compute."""
 
-    def __init__(self, feeder, reader: Callable, capacity: int = 2):
+    def __init__(self, feeder, reader: Callable, capacity: int = 2,
+                 retry_policy=None):
         self._feeder = feeder
         self._reader = reader
         self._capacity = capacity
+        self._retry_policy = retry_policy
 
     def __iter__(self):
         def feed_reader():
@@ -95,4 +108,5 @@ class DeviceFeeder:
                 # e.g. RecordIO -> native batcher); rows go through the feeder
                 yield data if isinstance(data, dict) else self._feeder.feed(data)
 
-        yield from double_buffer(feed_reader, capacity=self._capacity)()
+        yield from double_buffer(feed_reader, capacity=self._capacity,
+                                 retry_policy=self._retry_policy)()

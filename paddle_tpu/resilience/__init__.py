@@ -1,0 +1,24 @@
+"""Fault tolerance: injection, retry, and verified-checkpoint primitives.
+
+The reference pserver treats checkpoint-and-recover as a first-class
+server duty (go/pserver/service.go:346); on preemptible TPU slices the
+*trainer* carries that duty, and a recovery path that only runs on real
+failures is a recovery path that has never run. This package makes the
+failure side drivable (faults.py: a deterministic, seeded injector behind
+the PT_FAULT_INJECT knob), the retry side reusable (retry.py: bounded
+exponential backoff + the reader-restart wrapper), and the persistence
+side provable (manifest.py: per-file size+crc32 manifests that
+save_checkpoint commits *before* the _SUCCESS marker, so a torn or
+bit-rotten serial is detected and quarantined at load instead of
+restoring garbage). See docs/resilience.md.
+"""
+
+from .faults import (FaultInjected, FaultPlan, active_plan, crash_point,
+                     fire, reset)
+from .retry import RetryPolicy, resilient_reader, retry_call
+from . import manifest
+
+__all__ = [
+    "FaultInjected", "FaultPlan", "active_plan", "crash_point", "fire",
+    "reset", "RetryPolicy", "resilient_reader", "retry_call", "manifest",
+]

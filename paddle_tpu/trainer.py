@@ -12,7 +12,10 @@ equivalent.
 
 from __future__ import annotations
 
+import itertools
 import os
+import signal
+import threading
 from typing import Callable, Optional
 
 import numpy as np
@@ -99,6 +102,10 @@ class Trainer:
         self.parallel = parallel
         self.place = place
         self.checkpoint_cfg = checkpoint_config
+        #: set True when train() exited early on SIGTERM/SIGINT (after
+        #: checkpointing at the step boundary) — the preemption contract
+        self.preempted = False
+        self._preempt_signal: Optional[int] = None
         self.scope = Scope()
         self.startup_program = Program()
         self.train_program = Program()
@@ -143,18 +150,46 @@ class Trainer:
                 io_mod.load_persistables(self.exe, param_path,
                                          self.train_program, scope=self.scope)
             if self.checkpoint_cfg:
-                serial = io_mod.get_latest_checkpoint_serial(
-                    self.checkpoint_cfg.checkpoint_dir)
+                import jax
+                if jax.process_count() > 1:
+                    # ranks verifying independently could select DIFFERENT
+                    # serials (per-VM disks, racy shared FS) and resume
+                    # divergent state -> mismatched collectives. Rank 0
+                    # verifies/quarantines and broadcasts its pick — the
+                    # mirror of save_checkpoint's serial broadcast.
+                    from jax.experimental import multihost_utils
+                    local = (io_mod.get_latest_checkpoint_serial(
+                        self.checkpoint_cfg.checkpoint_dir)
+                        if jax.process_index() == 0 else -1)
+                    serial = int(multihost_utils.broadcast_one_to_all(
+                        np.int32(local)))
+                else:
+                    serial = io_mod.get_latest_checkpoint_serial(
+                        self.checkpoint_cfg.checkpoint_dir)
                 if serial >= 0:
                     self.checkpoint_cfg.load_serial = serial
                     import jax
+                    # verify=False: get_latest_checkpoint_serial above
+                    # already digest-verified this serial (re-verifying
+                    # would re-read the whole checkpoint)
                     args = io_mod.load_checkpoint(
                         self.exe, self.checkpoint_cfg.checkpoint_dir, serial,
                         self.train_program, trainer_id=jax.process_index(),
-                        scope=self.scope)
+                        scope=self.scope, verify=False)
                     if args:
                         self.checkpoint_cfg.epoch_id = args.get("epoch_id", 0)
-                        self.checkpoint_cfg.step_id = args.get("step_id", 0)
+                        step_id = args.get("step_id", 0)
+                        if args.get("args_version", 1) < 2 and step_id:
+                            # pre-resilience checkpoints recorded the LAST
+                            # COMPLETED step; v2 records the next one
+                            step_id += 1
+                        self.checkpoint_cfg.step_id = step_id
+                        # replaying the executor's run counter replays its
+                        # per-run rng streams (fold_in of the counter), so
+                        # a resumed run is bit-exact vs the uninterrupted
+                        # one even through stochastic ops
+                        self.exe._run_counter = int(
+                            args.get("run_counter", self.exe._run_counter))
 
     # -- distributed role dispatch (trainer.py:226) -------------------------
     def _dist_init_if_necessary(self):
@@ -175,7 +210,8 @@ class Trainer:
     # -- train loop ---------------------------------------------------------
     def train(self, num_epochs: int, event_handler: Callable,
               reader: Callable, feed_order: Optional[list] = None,
-              double_buffer: bool = True, steps_per_loop: int = 1):
+              double_buffer: bool = True, steps_per_loop: int = 1,
+              reader_retry: "int | RetryPolicy | None" = None):
         """double_buffer=True uploads the next batch to the device while
         the current one computes (≙ layers/io.py:556 double_buffer +
         create_double_buffer_reader_op.cc) — the host→device transfer is
@@ -185,8 +221,101 @@ class Trainer:
         (Executor.run_loop over stacked feeds) — the TPU fast path when
         host dispatch dominates. Events then fire once per window with
         metrics stacked to [n, ...]; consecutive batches are grouped only
-        while their shapes match (bucketed readers chunk per bucket)."""
+        while their shapes match (bucketed readers chunk per bucket).
+
+        reader_retry (an int or a resilience.RetryPolicy) bounds reader
+        restarts: an exception from the data source re-invokes the reader
+        and fast-forwards past already-delivered batches (exactly-once,
+        in order); exhaustion re-raises the original error. The wrapper
+        is installed regardless (with no retries when unset) — it hosts
+        the ``reader_raise`` fault-injection site, so chaos plans reach
+        the trainer data path (resilience/faults.py).
+
+        Preemption: while this loop runs (from the main thread), SIGTERM/
+        SIGINT request a checkpoint at the next step boundary followed by
+        a clean return with ``self.preempted = True`` — on preemptible
+        TPU slices the eviction notice becomes a resumable checkpoint
+        instead of a lost epoch. Resume restores (epoch_id, step_id) and
+        the executor run counter, and fast-forwards the reader, so a
+        resumed run matches the uninterrupted one bit-exactly for
+        deterministic readers."""
         from .reader.prefetch import DeviceFeeder
+        from .resilience import faults
+        from .resilience.retry import RetryPolicy, resilient_reader
+        if isinstance(reader_retry, RetryPolicy):
+            retry_policy = reader_retry
+        elif reader_retry:
+            retry_policy = RetryPolicy(retries=int(reader_retry))
+        else:
+            retry_policy = None
+        reader = resilient_reader(reader, policy=retry_policy)
+        self.preempted = False
+        self._preempt_signal = None
+        restore_handlers = {}
+        if threading.current_thread() is threading.main_thread():
+            def _request_preempt(signum, frame):
+                self._preempt_signal = signum
+                # one-shot: restore the previous disposition so a SECOND
+                # signal acts immediately (a step stuck in compile or a
+                # blocked reader queue never reaches the boundary check;
+                # the operator's second Ctrl-C must still break it)
+                signal.signal(signum,
+                              restore_handlers.get(signum, signal.SIG_DFL))
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    restore_handlers[sig] = signal.signal(
+                        sig, _request_preempt)
+                except (ValueError, OSError):  # pragma: no cover
+                    pass
+        try:
+            self._train_impl(num_epochs, event_handler, reader, feed_order,
+                             double_buffer, steps_per_loop, DeviceFeeder,
+                             faults)
+        finally:
+            for sig, old in restore_handlers.items():
+                signal.signal(sig, old)
+
+    def _preempt_exit(self, epoch_id: int, next_step: int,
+                      already_saved: bool, agree: bool = True) -> bool:
+        """At a step boundary: if a preemption signal arrived, checkpoint
+        (unless this boundary just saved) and request a clean exit.
+
+        Multi-host: the decision must be IDENTICAL on every rank — a
+        single rank diverting into save_checkpoint's barriers while the
+        others keep issuing training collectives deadlocks the slice. So
+        with >1 process the flag is agreed via a host broadcast of rank
+        0's value (preemption notices on a TPU slice hit all VMs; rank 0
+        is the decider — a signal delivered only to a non-zero rank is
+        ignored), and ONLY at `agree` boundaries — checkpoint-interval
+        crossings and epoch ends, where every rank provably calls in —
+        so the per-step hot path never pays a cross-host sync. Preemption
+        response latency in multi-host runs is therefore up to one
+        checkpoint interval. Single-process: plain flag check everywhere.
+
+        With no CheckpointConfig there is nothing to save: SIGTERM still
+        exits cleanly (graceful stop), but Ctrl-C re-raises
+        KeyboardInterrupt — returning as if training completed would let
+        caller code ship a half-trained model."""
+        import jax
+        flag = self._preempt_signal is not None
+        if jax.process_count() > 1:
+            if not agree:
+                return False
+            from jax.experimental import multihost_utils
+            flag = bool(int(multihost_utils.broadcast_one_to_all(
+                np.int32(flag))))
+        if not flag:
+            return False
+        if self.checkpoint_cfg:
+            if not already_saved:
+                self._save_checkpoint(epoch_id, next_step)
+        elif self._preempt_signal == signal.SIGINT:
+            raise KeyboardInterrupt
+        self.preempted = True
+        return True
+
+    def _train_impl(self, num_epochs, event_handler, reader, feed_order,
+                    double_buffer, steps_per_loop, DeviceFeeder, faults):
         with scope_guard(self.scope):
             feed_vars = self._feed_vars(feed_order)
             feeder = DataFeeder(feed_vars, program=self.train_program)
@@ -262,12 +391,23 @@ class Trainer:
                                         fetch_list=full)
                 return _apply_host_grads(outs)
             for epoch_id in range(start_epoch, num_epochs):
+                # mid-epoch resume: the checkpoint recorded the NEXT step
+                # to run; skip that many batches (undelivered — no events
+                # refire) and continue the step numbering, so the
+                # checkpoint-interval crossings and feeds line up with
+                # the uninterrupted run's
+                resume_step = (self.checkpoint_cfg.step_id
+                               if self.checkpoint_cfg
+                               and epoch_id == start_epoch else 0)
+                epoch_reader = reader if not resume_step else (
+                    lambda r=reader, n=resume_step:
+                    itertools.islice(r(), n, None))
                 event_handler(BeginEpochEvent(epoch_id))
-                batches = (DeviceFeeder(feeder, reader)
+                batches = (DeviceFeeder(feeder, epoch_reader)
                            if double_buffer and not self.parallel
                            and not use_loop
                            else (d if isinstance(d, dict) else feeder.feed(d)
-                                 for d in reader()))
+                                 for d in epoch_reader()))
                 if use_loop:
                     # full windows are stacked host-side to [n, ...]; with
                     # double_buffer the stacked upload overlaps the previous
@@ -287,8 +427,9 @@ class Trainer:
                         from .reader import prefetch as _prefetch
                         windows = _prefetch.double_buffer(
                             lambda: _stacked_windows())()
-                    step_id = 0
+                    step_id = resume_step
                     for window in windows:
+                        faults.crash_point("step_crash")
                         n_in_window = (steps_per_loop
                                        if isinstance(window, dict)
                                        else len(window))
@@ -310,29 +451,42 @@ class Trainer:
                         prev_step, step_id = step_id, step_id + n_in_window
                         iv = (self.checkpoint_cfg.step_interval
                               if self.checkpoint_cfg else 0)
-                        if iv and prev_step // iv != step_id // iv:
+                        saved = bool(iv and prev_step // iv != step_id // iv)
+                        if saved:
                             self._save_checkpoint(epoch_id, step_id)
+                        if self._preempt_exit(epoch_id, step_id, saved,
+                                              agree=saved):
+                            return
                     event_handler(EndEpochEvent(epoch_id))
-                    self._epoch_checkpoint(epoch_id)
+                    saved = self._epoch_checkpoint(epoch_id)
+                    if self._preempt_exit(epoch_id + 1, 0, saved):
+                        return
                     continue
-                for step_id, feed in enumerate(batches):
+                for step_id, feed in enumerate(batches, start=resume_step):
+                    faults.crash_point("step_crash")
                     begin = BeginStepEvent(epoch_id, step_id)
                     event_handler(begin)
                     fetch = self.train_func_outputs if begin.fetch_metrics else []
                     metrics = _run_one(feed, fetch)
                     event_handler(EndStepEvent(epoch_id, step_id, metrics))
                     # crossing semantics, matching the windowed path: fire
-                    # every `step_interval` COMPLETED steps — never at step
-                    # 0, whose save would carry one step of this epoch's
-                    # progress and poison an epoch-granularity resume
-                    # (a crash before the next epoch boundary would then
-                    # replay epoch steps on already-stepped state)
+                    # every `step_interval` COMPLETED steps. The args
+                    # record step_id+1 — the NEXT step to run — and resume
+                    # fast-forwards the reader to it, so a mid-epoch
+                    # checkpoint replays nothing (the pre-resilience code
+                    # replayed the whole epoch)
                     iv = (self.checkpoint_cfg.step_interval
                           if self.checkpoint_cfg else 0)
-                    if iv and step_id // iv != (step_id + 1) // iv:
-                        self._save_checkpoint(epoch_id, step_id)
+                    saved = bool(iv and step_id // iv != (step_id + 1) // iv)
+                    if saved:
+                        self._save_checkpoint(epoch_id, step_id + 1)
+                    if self._preempt_exit(epoch_id, step_id + 1, saved,
+                                          agree=saved):
+                        return
                 event_handler(EndEpochEvent(epoch_id))
-                self._epoch_checkpoint(epoch_id)
+                saved = self._epoch_checkpoint(epoch_id)
+                if self._preempt_exit(epoch_id + 1, 0, saved):
+                    return
 
     def test(self, reader: Callable, feed_order: Optional[list] = None):
         test_program = self.train_program.clone(for_test=True)
@@ -385,21 +539,29 @@ class Trainer:
             feed_vars = [block.var(n) for n in feed_order]
         return feed_vars
 
-    def _epoch_checkpoint(self, epoch_id):
+    def _epoch_checkpoint(self, epoch_id) -> bool:
         """End-of-epoch checkpoint (CheckpointConfig.epoch_interval). Saved
         with epoch_id+1 so auto-resume continues at the NEXT epoch — an
         epoch-boundary resume replays nothing and matches an uninterrupted
-        run exactly (mid-epoch step checkpoints replay their epoch)."""
+        run exactly (as do mid-epoch step checkpoints, which record the
+        next step and fast-forward the reader on resume)."""
         if (self.checkpoint_cfg and
                 (epoch_id + 1) % self.checkpoint_cfg.epoch_interval == 0):
             self._save_checkpoint(epoch_id + 1, 0)
+            return True
+        return False
 
     def _save_checkpoint(self, epoch_id, step_id):
+        """trainer_args record the RESUME POINT — the (epoch, step) the
+        next run should execute first — plus the executor run counter
+        (rng-stream replay; see __init__'s restore)."""
         import jax
         io_mod.save_checkpoint(
             self.exe, self.checkpoint_cfg.checkpoint_dir,
             trainer_id=jax.process_index(),
-            trainer_args={"epoch_id": epoch_id, "step_id": step_id},
+            trainer_args={"args_version": 2, "epoch_id": epoch_id,
+                          "step_id": step_id,
+                          "run_counter": self.exe._run_counter},
             main_program=self.train_program,
             max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
             scope=self.scope)

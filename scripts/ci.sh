@@ -2,7 +2,9 @@
 # CI entry (≙ paddle/scripts/paddle_build.sh: build + test in one place).
 # Runs the lint gate, the full suite on the 8-device virtual CPU mesh,
 # the multi-chip dryrun, and a bench sanity pass.
-# Usage: scripts/ci.sh [quick|lint]   (lint = just the lint gate)
+# Usage: scripts/ci.sh [quick|lint|chaos]
+#   lint  = just the lint gate
+#   chaos = lint gate + the resilience suite under two fixed fault seeds
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -10,6 +12,20 @@ echo "== lint gate (ruff + custom AST checks, tools/lint.py) =="
 python tools/lint.py
 if [[ "${1:-}" == "lint" ]]; then
   echo "LINT OK"
+  exit 0
+fi
+
+if [[ "${1:-}" == "chaos" ]]; then
+  # chaos leg: the resilience suite (fault injection, verified
+  # checkpoints, preemption/resume parity) replayed under two fixed
+  # seeds — probabilistic fault plans (site@pP) draw differently per
+  # seed, so the recovery invariants are exercised on two distinct
+  # failure schedules, both reproducible.
+  for seed in 0 7; do
+    echo "== chaos: resilience suite (PT_CHAOS_SEED=$seed) =="
+    PT_CHAOS_SEED=$seed python -m pytest tests/test_resilience.py -q
+  done
+  echo "CHAOS OK"
   exit 0
 fi
 

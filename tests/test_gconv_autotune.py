@@ -159,3 +159,35 @@ def test_save_remerges_concurrent_disk_entries(monkeypatch):
         final = json.load(f)
     assert ours in final and theirs in final
     assert gt.shape_key(4, 32, 14, 14, 32, 2, (1, 1), "float32", 3) in final
+
+
+def test_autotune_batch_hint_skips_host_table_rows(monkeypatch):
+    """ADVICE r5 low: the batch hint must come from the program's data
+    vars (symbolic -1 leading dim), never from a host-table rows feed
+    whose leading dim is the table capacity."""
+    from paddle_tpu.core.executor import _autotune_batch_hint
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        table = pt.HostEmbeddingTable("bh_tab", 64, 4, capacity=256)
+        ids = layers.data("ids", [1], dtype="int64")
+        emb = pt.host_embedding(ids, table)
+        loss = layers.mean(emb)
+    try:
+        block = main.global_block
+        # dict order adversarial: rows feed first
+        feeds = {
+            table.rows_name: np.zeros((256, 4), np.float32),
+            "ids": np.zeros((8, 1), np.int64),
+        }
+        assert _autotune_batch_hint(main, feeds, bdim=0) == 8
+        # rows-only feed falls back to the default, not to capacity
+        rows_only = {table.rows_name: np.zeros((256, 4), np.float32)}
+        assert _autotune_batch_hint(main, rows_only, bdim=0) == 8
+        assert block.var(table.rows_name).shape[0] == 256
+        # non-data fallback feeds still work when no data var matches
+        assert _autotune_batch_hint(
+            main, {"unknown_feed": np.zeros((16, 3), np.float32)},
+            bdim=0) == 16
+    finally:
+        table.unregister()
