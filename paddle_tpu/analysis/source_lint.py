@@ -19,6 +19,17 @@ Rules:
       declare_env_knob for PT_*). Undeclared knobs are invisible to
       FLAGS.help() and to the next maintainer; every env switch must be
       registered where the others live.
+
+  device-coercion  a numpy coercion (np.asarray/np.array/np.stack/
+      np.concatenate/np.ravel), a float() call, or an .item()/.tolist()
+      method call inside one of the HOT-LOOP FILES (the per-step train
+      path: trainer, executors, scope, prefetch, async_fetch). On a
+      device value each of these is a hidden host synchronization — the
+      exact overhead class the async hot path removed (a stray
+      np.asarray on a fetch re-serializes every step). Deliberate
+      materialization points carry a `# host-sync: ok` marker on the
+      call's line with a short justification; anything unmarked fails
+      the gate.
 """
 
 from __future__ import annotations
@@ -38,6 +49,29 @@ JOINED_GAP = 8
 #: env-var prefixes the knob-declaration rule governs. BENCH_*/FLASH_*
 #: and friends are bench-harness locals, out of scope by design.
 GOVERNED_PREFIXES = ("PT_", "FLAGS_")
+
+#: files the device-coercion rule governs — the per-step training hot
+#: path. metrics.py/evaluator.py are deliberately NOT governed: their
+#: update()/eval() methods are the documented read points where fetched
+#: values become host scalars (feeding them device values syncs there,
+#: by contract, once per update — not once per step primitive).
+HOT_LOOP_FILES = (
+    "paddle_tpu/trainer.py",
+    "paddle_tpu/core/executor.py",
+    "paddle_tpu/core/scope.py",
+    "paddle_tpu/core/async_fetch.py",
+    "paddle_tpu/parallel/parallel_executor.py",
+    "paddle_tpu/reader/prefetch.py",
+)
+
+#: suppression marker: a justified, deliberate materialization point
+HOST_SYNC_MARK = "host-sync: ok"
+
+#: numpy-module coercion functions that force a device->host sync
+COERCION_NP_FUNCS = ("asarray", "array", "stack", "concatenate", "ravel")
+
+#: method calls that force a device->host sync on a device value
+COERCION_METHODS = ("item", "tolist")
 
 
 @dataclass(frozen=True)
@@ -153,6 +187,60 @@ def declared_knobs_from_flags(flags_path: str) -> Set[str]:
 
 
 # ---------------------------------------------------------------------------
+# rule: device-coercion (hot-loop files only)
+# ---------------------------------------------------------------------------
+
+def is_hot_loop_file(path: str) -> bool:
+    norm = path.replace(os.sep, "/")
+    return any(norm.endswith(h) for h in HOT_LOOP_FILES)
+
+
+def check_device_coercion(path: str, src: str) -> List[LintFinding]:
+    if not is_hot_loop_file(path):
+        return []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    lines = src.splitlines()
+    findings: List[LintFinding] = []
+
+    def suppressed(node) -> bool:
+        """Marker accepted on the call's own line or the line above (long
+        expressions push the call mid-statement)."""
+        for ln in (node.lineno - 1, node.lineno - 2):
+            if 0 <= ln < len(lines) and HOST_SYNC_MARK in lines[ln]:
+                return True
+        return False
+
+    def flag(node, what):
+        findings.append(LintFinding(
+            path, node.lineno, node.col_offset, "device-coercion",
+            f"{what} in a hot-loop file forces a device->host sync per "
+            "step if it ever sees a device value; mark deliberate "
+            f"materialization points with `# {HOST_SYNC_MARK} — <why>` "
+            "or move the read out of the step loop"))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in COERCION_NP_FUNCS
+                and isinstance(f.value, ast.Name) and f.value.id == "np"):
+            if not suppressed(node):
+                flag(node, f"np.{f.attr}(...)")
+        elif (isinstance(f, ast.Name) and f.id == "float" and node.args
+                and not isinstance(node.args[0], ast.Constant)):
+            if not suppressed(node):
+                flag(node, "float(...)")
+        elif isinstance(f, ast.Attribute) and f.attr in COERCION_METHODS:
+            # args don't exempt: arr.item(3) syncs exactly like arr.item()
+            if not suppressed(node):
+                flag(node, f".{f.attr}()")
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -160,7 +248,8 @@ def lint_file(path: str, declared: Set[str]) -> List[LintFinding]:
     with open(path, encoding="utf-8") as f:
         src = f.read()
     return (check_joined_continuation(path, src)
-            + check_env_knobs(path, src, declared))
+            + check_env_knobs(path, src, declared)
+            + check_device_coercion(path, src))
 
 
 def default_targets(root: str) -> List[str]:

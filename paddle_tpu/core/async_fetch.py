@@ -1,0 +1,203 @@
+"""Lazy fetch handles + per-phase step timing for the async hot path.
+
+The reference pays a host round-trip per step by construction: Executor::Run
+materializes every fetch into a LoDTensor the Python side reads
+(executor.cc:230-294). Under the functional runtime the device work is
+dispatched asynchronously by JAX — the ONLY thing that forces the host to
+wait is converting a fetch to numpy. So the async hot path is not a new
+scheduler; it is *not converting*: `Executor.run(..., lazy=True)` returns
+`LazyFetch` handles and the host is immediately free to prep and dispatch
+step N+1 while N executes (state donation is already in place, so the
+param buffers alias forward). The handle blocks only when something
+actually reads it — numpy coercion, float(), .numpy().
+
+Per-phase timing (`PhaseTimer`) attributes wall time to:
+
+  host_prep   feed conversion, scope scan, cache key     (host, per run)
+  dispatch    the jitted call itself — returns when XLA   (host, per run)
+              has *enqueued* the computation
+  device      block_until_ready wait                      (device execute)
+  fetch       device->host materialization (np.asarray)   (transfer+convert)
+
+so an MFU gap is attributable by measurement: `host_overhead_pct` is the
+share of accounted time the host spent NOT waiting on the device — the
+number bench.py emits per config (BENCH r05 showed 31.0% MFU vs the 45%
+north star with the gap unattributed).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["LazyFetch", "PhaseTimer", "materialize"]
+
+
+class PhaseTimer:
+    """Per-phase wall-time accumulator (thread-safe: LazyFetch handles may
+    be read from any thread, e.g. a metrics logger)."""
+
+    PHASES = ("host_prep", "dispatch", "device", "fetch")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._s: Dict[str, float] = {p: 0.0 for p in self.PHASES}
+            self._runs = 0
+
+    def add(self, phase: str, seconds: float):
+        with self._lock:
+            self._s[phase] += seconds
+
+    def count_run(self):
+        with self._lock:
+            self._runs += 1
+
+    class _Span:
+        __slots__ = ("_timer", "_phase", "_t0")
+
+        def __init__(self, timer, phase):
+            self._timer, self._phase = timer, phase
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._timer.add(self._phase, time.perf_counter() - self._t0)
+            return False
+
+    def span(self, phase: str) -> "_Span":
+        return self._Span(self, phase)
+
+    def snapshot(self, reset: bool = False) -> dict:
+        """Accounted seconds per phase + derived host_overhead_pct.
+
+        host_overhead_pct = host-side share of ACCOUNTED time (prep +
+        dispatch + fetch vs device wait). With lazy fetches the phases
+        overlap device execution, so this is an attribution of where the
+        host spent its time, not a wall-clock decomposition — exactly
+        what "is the remaining MFU gap host or device" needs."""
+        with self._lock:
+            out = {f"{p}_s": round(self._s[p], 6) for p in self.PHASES}
+            out["runs"] = self._runs
+            host = (self._s["host_prep"] + self._s["dispatch"]
+                    + self._s["fetch"])
+            total = host + self._s["device"]
+            out["host_overhead_pct"] = (round(host / total * 100.0, 2)
+                                        if total > 0 else None)
+            if reset:
+                self._s = {p: 0.0 for p in self.PHASES}
+                self._runs = 0
+        return out
+
+
+class LazyFetch:
+    """Deferred fetch: wraps one fetch var's device value.
+
+    Reading it (np.asarray / float() / .numpy() / indexing) blocks until
+    the device value is ready and converts it to numpy ONCE (cached);
+    `.value()` hands back the raw device array without any sync. The
+    block is charged to the owning executor's device/fetch phases."""
+
+    __slots__ = ("_val", "_timer", "_np")
+
+    def __init__(self, value, timer: Optional[PhaseTimer] = None):
+        self._val = value
+        self._timer = timer
+        self._np = None
+
+    # -- non-blocking surface ----------------------------------------------
+    def value(self):
+        """The underlying device value; never blocks."""
+        return self._val
+
+    @property
+    def shape(self):
+        return tuple(np.shape(self._val))
+
+    @property
+    def dtype(self):
+        return np.dtype(jax.numpy.result_type(self._val))
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def is_ready(self) -> bool:
+        """True when the device computation has finished (never blocks)."""
+        if self._np is not None:
+            return True
+        ready = getattr(self._val, "is_ready", None)
+        return bool(ready()) if callable(ready) else True
+
+    # -- blocking reads -----------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        """Materialize to numpy (cached). THE synchronization point."""
+        if self._np is None:
+            if self._timer is not None:
+                with self._timer.span("device"):
+                    jax.block_until_ready(self._val)
+                with self._timer.span("fetch"):
+                    self._np = np.asarray(self._val)  # host-sync: ok — this IS the read
+            else:
+                jax.block_until_ready(self._val)
+                self._np = np.asarray(self._val)  # host-sync: ok — this IS the read
+        return self._np
+
+    def block_until_ready(self) -> "LazyFetch":
+        self.numpy()
+        return self
+
+    def __array__(self, dtype=None, copy=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(np.ravel(self.numpy())[0])  # host-sync: ok — explicit read
+
+    def __int__(self):
+        # host-sync: ok — explicit read
+        return int(np.ravel(self.numpy())[0])
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __getitem__(self, idx):
+        return self.numpy()[idx]
+
+    def __len__(self):
+        return len(self.numpy())
+
+    def __iter__(self):
+        return iter(self.numpy())
+
+    def __format__(self, spec):
+        # host-sync: ok — explicit read
+        return format(float(self) if spec and spec[-1] in "eEfFgGn%"
+                      else self.numpy(), spec)
+
+    def __repr__(self):
+        if self._np is None and not self.is_ready():
+            return (f"LazyFetch(shape={self.shape}, dtype={self.dtype}, "
+                    "pending)")
+        return f"LazyFetch({self.numpy()!r})"
+
+
+def materialize(obj):
+    """Recursively turn LazyFetch handles in lists/tuples/dicts into numpy
+    arrays (anything else passes through unchanged)."""
+    if isinstance(obj, LazyFetch):
+        return obj.numpy()
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(materialize(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: materialize(v) for k, v in obj.items()}
+    return obj

@@ -1,0 +1,96 @@
+"""Persistent XLA compile + autotune caching (PT_COMPILE_CACHE).
+
+The flagship transformer config pays a 43.5 s XLA compile EVERY process
+(BENCH_r05 `compile_s`); the reference never had this cost class — its
+executor interprets the program op-by-op (executor.cc:322) — so it is a
+TPU-runtime-native problem needing a TPU-native fix: JAX's persistent
+compilation cache. With `PT_COMPILE_CACHE` set, compiled executables are
+keyed by their (backend, HLO, flags) fingerprint and written to disk, so
+the compile is paid once per MACHINE, not once per process — the same
+amortization contract as the grouped-conv autotune artifacts
+(`PT_GCONV_CACHE`), which is why the default location sits beside them
+under ~/.cache/paddle_tpu/.
+
+Knob values:
+  unset / "" / "0"  off (in-process jit cache only — the status quo)
+  "1"               on, at the default path ~/.cache/paddle_tpu/xla_cache
+  any other string  on, at that directory (created if needed)
+
+Applied process-wide on first Executor/ParallelExecutor construction —
+jax.config is global, so a single call covers every jit in the process.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_applied: Optional[str] = None
+
+DEFAULT_DIR = os.path.join("~", ".cache", "paddle_tpu", "xla_cache")
+
+
+def cache_dir_from_env() -> Optional[str]:
+    """Resolved cache directory the knob asks for, or None when off."""
+    raw = os.environ.get("PT_COMPILE_CACHE", "").strip()
+    if raw in ("", "0", "false", "off"):
+        return None
+    return os.path.expanduser(DEFAULT_DIR if raw == "1" else raw)
+
+
+def ensure_compile_cache() -> Optional[str]:
+    """Idempotently point JAX's persistent compilation cache at the
+    PT_COMPILE_CACHE directory. Returns the active dir (None = off).
+
+    Threshold configs are zeroed so EVERY program qualifies: the bench
+    configs span 0.1 s (mnist) to 43.5 s (transformer) compiles, and a
+    min-compile-time gate would silently exclude the small ones from
+    warm starts. Re-checks the env var until the knob is seen on, so a
+    test that sets PT_COMPILE_CACHE after importing the package still
+    engages it; once applied the setting is process-final (jax.config
+    is global — flipping it mid-process would repoint live caches)."""
+    global _applied
+    if _applied is not None:
+        return _applied
+    path = cache_dir_from_env()
+    if path is None:
+        return None
+    os.makedirs(path, exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        # jax latches cache-off at the FIRST compile of the process
+        # (_cache_initialized): if anything compiled before this knob
+        # engaged (a test, an import-time jit), the latch must be reset
+        # or the config update is silently ignored. Pristine-state reset
+        # is the documented escape hatch; harmless when nothing compiled.
+        from jax._src import compilation_cache as _jcc
+        _jcc.reset_cache()
+    except Exception:  # pragma: no cover — internals moved; config stands
+        pass
+    _applied = path
+    return path
+
+
+def _cache_suffix() -> str:
+    """The persisted-executable filename suffix — jax's private
+    _CACHE_SUFFIX when importable (so a renamed constant is picked up),
+    else the jax 0.4.x literal."""
+    try:
+        from jax._src.lru_cache import _CACHE_SUFFIX
+        return _CACHE_SUFFIX
+    except Exception:  # pragma: no cover — layout moved; 0.4.x literal
+        return "-cache"
+
+
+def cache_entry_count(path: Optional[str] = None) -> int:
+    """Number of persisted executables in the cache dir (0 when off or
+    not yet created). Used by bench.py to label a config's compile as
+    warm (no new entries written) vs cold."""
+    path = path if path is not None else (_applied or cache_dir_from_env())
+    if not path or not os.path.isdir(path):
+        return 0
+    suffix = _cache_suffix()
+    return sum(1 for n in os.listdir(path) if n.endswith(suffix))

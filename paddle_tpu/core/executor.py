@@ -15,6 +15,7 @@ dict simply becomes jit arguments and fetches become return values — no ops.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -24,6 +25,8 @@ import numpy as np
 from .program import Program, VarDesc, default_main_program
 from .scope import Scope, global_scope
 from .types import device_dtype, np_dtype
+from .async_fetch import LazyFetch, PhaseTimer
+from .compile_cache import ensure_compile_cache
 from . import lowering
 
 
@@ -90,11 +93,48 @@ def _autotune_batch_hint(program: Program, feed_arrays: Dict[str, object],
     return fallback if fallback is not None else 8
 
 
-class Executor:
+class TimedExecutorMixin:
+    """Shared per-phase timing + compile accounting for Executor and
+    ParallelExecutor — one implementation so the charge policy (cold
+    dispatches go to compile_s, never the dispatch phase) cannot drift
+    between the single-chip and sharded paths."""
+
+    def _init_timing(self):
+        #: per-phase wall-time attribution (async_fetch.PhaseTimer);
+        #: read/reset via step_timings()
+        self._timings = PhaseTimer()
+        #: cumulative seconds spent inside first-call (compiling)
+        #: dispatches — kept OUT of the dispatch phase so a one-off 43 s
+        #: compile cannot masquerade as per-step host overhead
+        self.compile_s = 0.0
+        # persistent XLA compile cache (PT_COMPILE_CACHE): applied
+        # process-wide on first construction, before any jit call
+        ensure_compile_cache()
+
+    def _charge_dispatch(self, seconds: float, was_cached: bool):
+        if was_cached:
+            self._timings.add("dispatch", seconds)
+        else:
+            self.compile_s += seconds
+        self._timings.count_run()
+
+    def step_timings(self, reset: bool = False) -> dict:
+        """Per-phase accounted seconds since the last reset (host_prep /
+        dispatch / device / fetch + host_overhead_pct). `compile_s` rides
+        along so callers see amortized vs per-step cost separately."""
+        out = self._timings.snapshot(reset=reset)
+        out["compile_s"] = round(self.compile_s, 3)
+        if reset:
+            self.compile_s = 0.0
+        return out
+
+
+class Executor(TimedExecutorMixin):
     def __init__(self, place: Optional[Place] = None):
         self.place = place or Place("tpu")
         self._cache: Dict[tuple, _Compiled] = {}
         self._run_counter = 0
+        self._init_timing()
 
     # -- helpers ------------------------------------------------------------
     @staticmethod
@@ -141,7 +181,8 @@ class Executor:
                 out[seq_len_name] = jnp.asarray(lens)
             elif seq_len_name and seq_len_name not in feed:
                 # shape-only inspection: never np.asarray a device array
-                arr0 = val if hasattr(val, "shape") else np.asarray(val)
+                arr0 = val if hasattr(val, "shape") \
+                    else np.asarray(val)  # host-sync: ok — host list feed
                 # full-length sequences: [B, T, ...] -> lens [B]=T; with a
                 # leading step axis, [N, B, T, ...] -> lens [N, B]=T
                 if per_step:
@@ -160,7 +201,7 @@ class Executor:
                              or val.dtype == jnp.dtype(want)
                              else val.astype(want))
                 continue
-            arr = np.asarray(val)
+            arr = np.asarray(val)  # host-sync: ok — host feed conversion
             if var is not None:
                 want = np_dtype(device_dtype(var.dtype))
                 if arr.dtype != want:
@@ -189,10 +230,16 @@ class Executor:
 
     # -- main entry ---------------------------------------------------------
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
-                  build, key_extra, per_step_feed_prep=False):
+                  build, key_extra, per_step_feed_prep=False, lazy=False):
         """Shared body of run/run_loop: prep feeds/state, hit the jit cache
         (≙ the reference's program cache, executor.py:165), execute, write
-        new state back to the scope."""
+        new state back to the scope.
+
+        lazy=True returns LazyFetch handles instead of materialized
+        arrays: the call returns as soon as XLA has ENQUEUED the step, so
+        the caller can prep + dispatch step N+1 while N executes; a
+        handle blocks only when read (async_fetch.py)."""
+        t_prep = time.perf_counter()
         program = program if program is not None else default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -212,6 +259,7 @@ class Executor:
 
         from ..flags import FLAGS
         key = key + (FLAGS.check_nan_inf,)
+        self._timings.add("host_prep", time.perf_counter() - t_prep)
         compiled = self._cache.get(key)
         was_cached = compiled is not None
         if compiled is None:
@@ -259,41 +307,57 @@ class Executor:
         self._run_counter += 1
         rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._run_counter)
 
+        # jit compiles on FIRST call: a cold dispatch is charged to
+        # compile_s, never to the per-step dispatch phase
+        t0 = time.perf_counter()
+        fetches, new_state = compiled.fn(state, feed_arrays, rng)
+        self._charge_dispatch(time.perf_counter() - t0, was_cached)
         if FLAGS.benchmark:
             import logging
-            import time as _time
-            t0 = _time.time()
-            fetches, new_state = compiled.fn(state, feed_arrays, rng)
-            jax.block_until_ready((fetches, new_state))
+            with self._timings.span("device"):
+                jax.block_until_ready((fetches, new_state))
             logging.getLogger("paddle_tpu").warning(
                 "[benchmark] run %s: %.2f ms%s", program.fingerprint(),
-                (_time.time() - t0) * 1e3,
+                (time.perf_counter() - t0) * 1e3,
                 "" if was_cached else " (includes compile)")
-        else:
-            fetches, new_state = compiled.fn(state, feed_arrays, rng)
+        # device-resident write-back: new_state values are jax.Arrays
+        # (possibly still executing) — the scope never forces them to host
         for name, val in new_state.items():
             scope.set_var(name, val)
 
+        if lazy:
+            return [LazyFetch(f, self._timings) for f in fetches]
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            with self._timings.span("device"):
+                jax.block_until_ready(fetches)
+            with self._timings.span("fetch"):
+                # host-sync: ok — the sync return contract (return_numpy)
+                return [np.asarray(f) for f in fetches]
         return list(fetches)
 
     def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
             fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
-            return_numpy: bool = True, donate_state: bool = True):
+            return_numpy: bool = True, donate_state: bool = True,
+            lazy: bool = False):
+        """lazy=True: return LazyFetch handles (async_fetch.py) — the call
+        returns once the step is enqueued and a handle blocks only when
+        read, so back-to-back run() calls overlap step N+1's host prep +
+        dispatch with step N's device execution."""
         def build(program, feed_names, fetch_names, state_names):
             step, state_out = lowering.build_step_fn(
                 program, feed_names, fetch_names, state_names)
             return step, state_out, (0,) if donate_state else ()
 
         return self._run_impl(program, feed, fetch_list, scope, return_numpy,
-                              build, key_extra=("step", donate_state))
+                              build, key_extra=("step", donate_state),
+                              lazy=lazy)
 
     def run_loop(self, program: Optional[Program] = None,
                  feed: Optional[dict] = None,
                  fetch_list: Optional[Sequence] = None, n_steps: int = 1,
                  scope: Optional[Scope] = None, per_step_feeds: bool = False,
-                 return_numpy: bool = True, unroll: int = 2):
+                 return_numpy: bool = True, unroll: int = 2,
+                 lazy: bool = False):
         """Run `n_steps` training steps in ONE device dispatch (lax.scan).
 
         The reference pays host dispatch per step (executor.cc:322 interprets
@@ -322,7 +386,7 @@ class Executor:
         return self._run_impl(
             program, feed, fetch_list, scope, return_numpy, build,
             key_extra=("loop", n_steps, per_step_feeds, unroll),
-            per_step_feed_prep=per_step_feeds)
+            per_step_feed_prep=per_step_feeds, lazy=lazy)
 
     def close(self):
         self._cache.clear()

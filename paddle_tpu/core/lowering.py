@@ -100,7 +100,13 @@ def run_op(op: OpDesc, env: Dict[str, object], ctx: ExecContext, block: Block):
         from .selected_rows import maybe_dense
         ins = {slot: [maybe_dense(v) for v in vals]
                for slot, vals in ins.items()}
-    outs = impl.compute(ctx, ins, op.attrs)
+    # named_scope tags every primitive this op traces with the PROGRAM
+    # op's type+index, so a device profile (and an XLA dump) attributes
+    # hot HLO back to program IR ops — the device-side complement of the
+    # executor's host-phase timing. Trace-time-only; HLO opcodes are
+    # untouched (the collective-counting tests key on opcodes).
+    with jax.named_scope(f"{op.type}.{getattr(ctx, 'op_index', 0)}"):
+        outs = impl.compute(ctx, ins, op.attrs)
     for slot, names in op.outputs.items():
         vals = outs.get(slot)
         if vals is None:

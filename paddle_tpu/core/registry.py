@@ -112,6 +112,9 @@ class ExecContext:
         self._rng_key = rng_key
         self._rng_counter = 0
         self.is_test = is_test
+        #: index of the op currently tracing (run_op_range maintains it;
+        #: lowering.run_op uses it for jax.named_scope attribution)
+        self.op_index = 0
         # Mesh the enclosing jit is partitioned over (None single-chip).
         # Ops that lower into shard_map (ring attention) read this — the
         # functional stand-in for the reference's DeviceContextPool device

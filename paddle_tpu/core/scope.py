@@ -7,6 +7,16 @@ Intermediate activations never touch the Scope — they are values inside the
 traced computation, which is exactly the per-step local scope the reference
 creates and drops (executor.cc:332, scope_buffered_ssa_graph_executor.cc),
 realized at zero cost.
+
+Device residency contract (the async hot path): values written back by the
+executors are `jax.Array`s — possibly still EXECUTING on the device when
+set_var runs. The scope never forces them to host; numpy materialization
+happens only at the explicit read points (`get_numpy` here, the
+checkpoint/save paths in io.py), each of which blocks until the value is
+ready. Between steps the parameters therefore stay in HBM, donated
+buffer-to-buffer through consecutive jitted steps, and `resilience/`
+manifests keep seeing stable bytes because a checkpoint materializes a
+settled value exactly once.
 """
 
 from __future__ import annotations
@@ -59,10 +69,13 @@ class Scope:
         return iter(list(self._vars))
 
     def get_numpy(self, name: str) -> np.ndarray:
+        """Materialize one var to host numpy — an explicit scope read,
+        i.e. a deliberate device sync under the device-residency
+        contract. Use find_var for a sync-free device-array read."""
         v = self.find_var(name)
         if v is None:
             raise KeyError(f"variable {name!r} not found in scope")
-        return np.asarray(v)
+        return np.asarray(v)  # host-sync: ok — explicit scope read
 
 
 _global_scope = Scope()

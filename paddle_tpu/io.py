@@ -304,6 +304,15 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
             f"save_vars: {len(absent)} variable(s) have no value in the "
             f"scope (run the startup program first?): {absent[:5]}"
             f"{'...' if len(absent) > 5 else ''}")
+    # device-resident state: scope values are jax.Arrays that may still be
+    # executing (async dispatch). ONE collective wait here lets in-flight
+    # steps and D2H transfers overlap, instead of the per-var np.asarray
+    # below serializing a sync per array; it also pins the checkpoint
+    # semantics — bytes are materialized from a SETTLED step boundary, so
+    # the resilience manifests digest stable data.
+    import jax
+    jax.block_until_ready([v for v in values.values()
+                           if isinstance(v, jax.Array)])
     if filename is not None:
         cross = [n for n, v in values.items() if _is_cross_process(v)]
         if cross:
@@ -316,7 +325,6 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
         # copy suffices — and in a multi-process run all ranks share the
         # filesystem: concurrent np.savez of the SAME file would corrupt
         # the archive. Mirrors the per-var path's rank-0 gating.
-        import jax
         if jax.process_count() == 1 or jax.process_index() == 0:
             np.savez(os.path.join(dirname, filename),
                      **{n: np.asarray(v) for n, v in values.items()})

@@ -20,6 +20,7 @@ BuildStrategy parity (details/build_strategy.h:24-33):
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional, Sequence
 
 import numpy as np
@@ -31,7 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..core.program import (Program, VarDesc, default_main_program,
                             iter_optimizer_state_inputs)
 from ..core.scope import Scope, global_scope
-from ..core.executor import Executor, _Compiled
+from ..core.executor import Executor, TimedExecutorMixin, _Compiled
+from ..core.async_fetch import LazyFetch
 from ..core import lowering
 from .mesh import default_mesh, spec_for, DP
 
@@ -61,7 +63,7 @@ class ExecutionStrategy:
         self.num_iteration_per_drop_scope = 100
 
 
-class ParallelExecutor:
+class ParallelExecutor(TimedExecutorMixin):
     def __init__(self, use_cuda: bool = False, loss_name: Optional[str] = None,
                  main_program: Optional[Program] = None,
                  share_vars_from: Optional["ParallelExecutor"] = None,
@@ -77,6 +79,7 @@ class ParallelExecutor:
         self._loss_name = loss_name
         self._cache: Dict[tuple, _Compiled] = {}
         self._run_counter = 0
+        self._init_timing()
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
 
@@ -178,16 +181,18 @@ class ParallelExecutor:
                       loop: Optional[tuple] = None):
         """Build (or fetch from cache) the jitted sharded step for this
         (program, feed-shapes, fetches) signature. Returns
-        (compiled, state, feed_arrays). `loop` = (n_steps, per_step_feeds,
-        unroll) compiles a device-side lax.scan over the SAME sharded step
-        — the multi-device fast path (run_loop)."""
+        (compiled, state, feed_arrays, was_cached). `loop` = (n_steps,
+        per_step_feeds, unroll) compiles a device-side lax.scan over the
+        SAME sharded step — the multi-device fast path (run_loop)."""
         program = self._program
         block = program.global_block
+        t_prep = time.perf_counter()
         exe_helper = Executor()
         per_step = bool(loop and loop[1])
         fetch_names = [exe_helper._fetch_name(f) for f in fetch_list]
         feed_arrays = exe_helper._prep_feed(program, feed, per_step=per_step)
         state = exe_helper._state_for(program, self._scope)
+        self._timings.add("host_prep", time.perf_counter() - t_prep)
 
         feed_sig = tuple(sorted((k, v.shape, str(v.dtype))
                                 for k, v in feed_arrays.items()))
@@ -197,6 +202,7 @@ class ParallelExecutor:
                id(self._mesh), self._build_strategy.reduce_strategy, loop)
 
         compiled = self._cache.get(key)
+        was_cached = compiled is not None
         if compiled is None:
             from ..analysis import verify_enabled, verify_program
             if verify_enabled():
@@ -247,7 +253,7 @@ class ParallelExecutor:
                          donate_argnums=(0,))
             compiled = _Compiled(fn, sorted(state), state_out, fetch_names)
             self._cache[key] = compiled
-        return compiled, state, feed_arrays
+        return compiled, state, feed_arrays, was_cached
 
     def compiled_hlo(self, fetch_list: Sequence,
                      feed: Optional[dict] = None) -> str:
@@ -258,8 +264,8 @@ class ParallelExecutor:
         collective instructions (all-reduce / reduce-scatter /
         collective-permute / all-to-all) instead of assuming GSPMD chose
         the intended program (tests/test_collectives_emitted.py)."""
-        compiled, state, feed_arrays = self._get_compiled(fetch_list,
-                                                          feed or {})
+        compiled, state, feed_arrays, _ = self._get_compiled(fetch_list,
+                                                             feed or {})
         rng = jax.random.PRNGKey(0)
         with self._mesh:
             return compiled.fn.lower(state, feed_arrays,
@@ -268,7 +274,8 @@ class ParallelExecutor:
     # -- run ----------------------------------------------------------------
     def run_loop(self, fetch_list: Sequence, feed: Optional[dict] = None,
                  n_steps: int = 1, per_step_feeds: bool = False,
-                 unroll: int = 2, return_numpy: bool = True):
+                 unroll: int = 2, return_numpy: bool = True,
+                 lazy: bool = False):
         """Run `n_steps` SHARDED training steps in one device dispatch:
         lax.scan over the same GSPMD-partitioned step `run` executes.
 
@@ -282,27 +289,42 @@ class ParallelExecutor:
         per_step_feeds=True (the batch axis then dp-shards at dim 1).
         Fetches come back stacked [n_steps, ...]."""
         feed = feed or {}
-        compiled, state, feed_arrays = self._get_compiled(
+        compiled, state, feed_arrays, was_cached = self._get_compiled(
             fetch_list, feed, loop=(n_steps, per_step_feeds, unroll))
-        return self._execute(compiled, state, feed_arrays, return_numpy)
+        return self._execute(compiled, state, feed_arrays, return_numpy,
+                             was_cached, lazy=lazy)
 
     def run(self, fetch_list: Sequence, feed: Optional[dict] = None,
-            feed_dict: Optional[dict] = None, return_numpy: bool = True):
+            feed_dict: Optional[dict] = None, return_numpy: bool = True,
+            lazy: bool = False):
+        """lazy=True: LazyFetch handles, same contract as Executor.run —
+        the sharded step is enqueued and the host moves on."""
         feed = feed if feed is not None else (feed_dict or {})
-        compiled, state, feed_arrays = self._get_compiled(fetch_list, feed)
-        return self._execute(compiled, state, feed_arrays, return_numpy)
+        compiled, state, feed_arrays, was_cached = self._get_compiled(
+            fetch_list, feed)
+        return self._execute(compiled, state, feed_arrays, return_numpy,
+                             was_cached, lazy=lazy)
 
-    def _execute(self, compiled, state, feed_arrays, return_numpy):
+    def _execute(self, compiled, state, feed_arrays, return_numpy,
+                 was_cached=True, lazy=False):
         program = self._program
         seed = program.random_seed if program.random_seed is not None else 0
         self._run_counter += 1
         rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._run_counter)
+        t0 = time.perf_counter()
         with self._mesh:
             fetches, new_state = compiled.fn(state, feed_arrays, rng)
+        self._charge_dispatch(time.perf_counter() - t0, was_cached)
         for name, val in new_state.items():
             self._scope.set_var(name, val)
+        if lazy:
+            return [LazyFetch(f, self._timings) for f in fetches]
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            with self._timings.span("device"):
+                jax.block_until_ready(fetches)
+            with self._timings.span("fetch"):
+                # host-sync: ok — the sync return contract (return_numpy)
+                return [np.asarray(f) for f in fetches]
         return list(fetches)
 
     @property

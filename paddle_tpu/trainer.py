@@ -33,9 +33,11 @@ def _shape_chunks(batches, n: int):
     array shapes/dtypes (a shape change — e.g. a new length bucket —
     flushes the window so run_loop's stacked feed stays rectangular)."""
     def sig(feed):
-        return tuple(sorted((k, np.shape(v), str(np.asarray(v).dtype)
-                             if not hasattr(v, "dtype") else str(v.dtype))
-                            for k, v in feed.items()))
+        return tuple(sorted(
+            (k, np.shape(v),
+             str(np.asarray(v).dtype)  # host-sync: ok — dtype-less host rows
+             if not hasattr(v, "dtype") else str(v.dtype))
+            for k, v in feed.items()))
 
     window, cur = [], None
     for feed in batches:
@@ -211,7 +213,8 @@ class Trainer:
     def train(self, num_epochs: int, event_handler: Callable,
               reader: Callable, feed_order: Optional[list] = None,
               double_buffer: bool = True, steps_per_loop: int = 1,
-              reader_retry: "int | RetryPolicy | None" = None):
+              reader_retry: "int | RetryPolicy | None" = None,
+              log_every: int = 1):
         """double_buffer=True uploads the next batch to the device while
         the current one computes (≙ layers/io.py:556 double_buffer +
         create_double_buffer_reader_op.cc) — the host→device transfer is
@@ -230,6 +233,17 @@ class Trainer:
         is installed regardless (with no retries when unset) — it hosts
         the ``reader_raise`` fault-injection site, so chaos plans reach
         the trainer data path (resilience/faults.py).
+
+        log_every controls metric MATERIALIZATION, the hidden per-step
+        host sync of a feed-based loop: steps are always dispatched with
+        lazy fetch handles (core/async_fetch.py), and EndStepEvent
+        carries real numpy metrics only on steps where
+        ``step_id % log_every == 0`` (and on the step windows containing
+        one). In between, metrics are LazyFetch handles — reading one
+        from the event handler still works (it blocks right there), but
+        a handler that only logs every N steps lets step N+1's host prep
+        and dispatch overlap step N's device execution. The default
+        log_every=1 materializes every step — the pre-async behavior.
 
         Preemption: while this loop runs (from the main thread), SIGTERM/
         SIGINT request a checkpoint at the next step boundary followed by
@@ -270,7 +284,7 @@ class Trainer:
         try:
             self._train_impl(num_epochs, event_handler, reader, feed_order,
                              double_buffer, steps_per_loop, DeviceFeeder,
-                             faults)
+                             faults, max(int(log_every), 1))
         finally:
             for sig, old in restore_handlers.items():
                 signal.signal(sig, old)
@@ -315,7 +329,9 @@ class Trainer:
         return True
 
     def _train_impl(self, num_epochs, event_handler, reader, feed_order,
-                    double_buffer, steps_per_loop, DeviceFeeder, faults):
+                    double_buffer, steps_per_loop, DeviceFeeder, faults,
+                    log_every=1):
+        from .core.async_fetch import materialize
         with scope_guard(self.scope):
             feed_vars = self._feed_vars(feed_order)
             feeder = DataFeeder(feed_vars, program=self.train_program)
@@ -355,12 +371,14 @@ class Trainer:
             def _apply_host_grads(outs, stacked_steps=0):
                 """Split host-table rows-grads off the fetch results and
                 scatter them into the tables (FIFO order inside a stacked
-                window)."""
+                window). Host tables are host-RAM by definition, so the
+                grads materialize here — a deliberate sync."""
                 if not ht_fetch:
                     return outs
                 grads = outs[len(outs) - len(ht_fetch):]
                 outs = outs[:len(outs) - len(ht_fetch)]
                 for (t, _gv, _i), g in zip(self._host_tables, grads):
+                    g = np.asarray(g)  # host-sync: ok — host-RAM scatter
                     if stacked_steps:
                         for k in range(stacked_steps):
                             t.apply_grad(g[k])
@@ -371,24 +389,29 @@ class Trainer:
             def _run_window(feed, fetch, n):
                 # ParallelExecutor.run_loop scans the SAME sharded step
                 # (mesh-parallel fast path); Executor.run_loop is the
-                # single-chip one — same windowed semantics either way
+                # single-chip one — same windowed semantics either way.
+                # Fetches come back LAZY: window N+1's host-side stacking
+                # and upload overlap window N's device loop, and the
+                # handles materialize only at log_every boundaries.
                 full = list(fetch) + ht_fetch
                 if self.parallel:
                     outs = executor.run_loop(fetch_list=full, feed=feed,
-                                             n_steps=n, per_step_feeds=True)
+                                             n_steps=n, per_step_feeds=True,
+                                             lazy=True)
                 else:
                     outs = executor.run_loop(self.train_program, feed=feed,
                                              fetch_list=full, n_steps=n,
-                                             per_step_feeds=True)
+                                             per_step_feeds=True, lazy=True)
                 return _apply_host_grads(outs, stacked_steps=n)
 
             def _run_one(feed, fetch):
                 full = list(fetch) + ht_fetch
                 if self.parallel:
-                    outs = executor.run(fetch_list=full, feed=feed)
+                    outs = executor.run(fetch_list=full, feed=feed,
+                                        lazy=True)
                 else:
                     outs = executor.run(self.train_program, feed=feed,
-                                        fetch_list=full)
+                                        fetch_list=full, lazy=True)
                 return _apply_host_grads(outs)
             for epoch_id in range(start_epoch, num_epochs):
                 # mid-epoch resume: the checkpoint recorded the NEXT step
@@ -418,6 +441,7 @@ class Trainer:
                         # fragment (shape-change flush / epoch tail)
                         for window in _shape_chunks(batches, steps_per_loop):
                             if len(window) == steps_per_loop:
+                                # host-sync: ok — stacking host feed dicts
                                 yield {k: np.stack([f[k] for f in window])
                                        for k in window[0]}
                             else:
@@ -444,8 +468,15 @@ class Trainer:
                             # tail) run per-step: one compiled loop variant
                             # only, no per-length recompiles
                             per = [_run_one(f, fetch) for f in window]
+                            # host-sync: ok — fragment stacking (rare path)
                             metrics = [np.stack(ms) for ms in zip(*per)] \
                                 if per and fetch else []
+                        if (step_id % log_every == 0
+                                or step_id // log_every
+                                != (step_id + n_in_window - 1) // log_every):
+                            # window contains a log step: hand the event
+                            # handler real numpy, not lazy handles
+                            metrics = materialize(metrics)
                         event_handler(EndStepEvent(epoch_id, step_id,
                                                    metrics))
                         prev_step, step_id = step_id, step_id + n_in_window
@@ -468,6 +499,8 @@ class Trainer:
                     event_handler(begin)
                     fetch = self.train_func_outputs if begin.fetch_metrics else []
                     metrics = _run_one(feed, fetch)
+                    if step_id % log_every == 0:
+                        metrics = materialize(metrics)
                     event_handler(EndStepEvent(epoch_id, step_id, metrics))
                     # crossing semantics, matching the windowed path: fire
                     # every `step_interval` COMPLETED steps. The args
@@ -503,16 +536,30 @@ class Trainer:
                 batches = t.wrap_reader(batches, ids_key=ids_name,
                                         local_ids_key=ids_name,
                                         training=False)
-            totals = None
+            # device-side accumulation: per-batch fetches stay on device
+            # (return_numpy=False) as scalar handles — the eval loop pays
+            # ONE host sync at the end instead of one per batch (the
+            # audit's trainer.test finding). The final sum is a
+            # SEQUENTIAL left-fold over float64 on the host, matching
+            # the pre-async per-batch float() accumulation bit-for-bit
+            # (np.sum's pairwise order would differ in the last ulp,
+            # and a float32 running sum would drift ~1e-3 on long evals).
+            import jax.numpy as jnp
+            cols = None
             count = 0
             for feed in batches():
                 outs = self.exe.run(test_program, feed=feed,
-                                    fetch_list=self.train_func_outputs)
-                vals = [float(np.ravel(o)[0]) for o in outs]
-                totals = vals if totals is None else \
-                    [a + b for a, b in zip(totals, vals)]
+                                    fetch_list=self.train_func_outputs,
+                                    return_numpy=False)
+                vals = [jnp.ravel(o)[0] for o in outs]
+                if cols is None:
+                    cols = [[] for _ in vals]
+                for c, v in zip(cols, vals):
+                    c.append(v)
                 count += 1
-            return [t / max(count, 1) for t in (totals or [])]
+            # host-sync: ok — end-of-eval materialization
+            return [sum(np.asarray(jnp.stack(c), np.float64).tolist())
+                    / max(count, 1) for c in (cols or [])]
 
     def save_params(self, param_path: str):
         with scope_guard(self.scope):

@@ -2,9 +2,12 @@
 # CI entry (≙ paddle/scripts/paddle_build.sh: build + test in one place).
 # Runs the lint gate, the full suite on the 8-device virtual CPU mesh,
 # the multi-chip dryrun, and a bench sanity pass.
-# Usage: scripts/ci.sh [quick|lint|chaos]
+# Usage: scripts/ci.sh [quick|lint|chaos|perf]
 #   lint  = just the lint gate
 #   chaos = lint gate + the resilience suite under two fixed fault seeds
+#   perf  = lint gate + the async-hot-path suite (lazy fetches, per-phase
+#           timing, device-resident checkpoints, PT_COMPILE_CACHE warm
+#           starts, two-stage prefetch) + the learning-probe regression
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -29,6 +32,13 @@ if [[ "${1:-}" == "chaos" ]]; then
   exit 0
 fi
 
+if [[ "${1:-}" == "perf" ]]; then
+  echo "== perf: async hot path + compile cache + learning probe =="
+  python -m pytest tests/test_async_hotpath.py tests/test_transformer_learns.py -q
+  echo "PERF OK"
+  exit 0
+fi
+
 echo "== unit + integration tests (8-device virtual CPU mesh) =="
 # jax's "Explicitly requested dtype int64 ... truncated" warning is promoted
 # to an error: device dtypes must be chosen explicitly (32-bit), never left
@@ -39,8 +49,11 @@ echo "== multi-chip dryrun (dp x tp, dp x sp x tp, pp x dp, ep x dp) =="
 python __graft_entry__.py dryrun 8
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== bench sanity (tiny shapes) =="
-  BENCH_STEPS=1 BENCH_BATCH=2 python bench.py
+  echo "== bench sanity (tiny shapes, persistent compile cache on) =="
+  # PT_COMPILE_CACHE: the second CI run on a machine warm-starts every
+  # config's compile; per-config JSON carries compile_cache=cold|warm
+  PT_COMPILE_CACHE="${PT_COMPILE_CACHE:-${TMPDIR:-/tmp}/pt_ci_xla_cache}" \
+    BENCH_STEPS=1 BENCH_BATCH=2 python bench.py
 fi
 
 echo "CI OK"

@@ -360,6 +360,27 @@ def test_lint_flags_undeclared_env_knob():
     assert names == {"PT_TOTALLY_NEW_KNOB", "FLAGS_not_a_flag"}
 
 
+def test_lint_flags_device_coercion_in_hot_loop_files():
+    from paddle_tpu.analysis.source_lint import check_device_coercion
+    src = ('import numpy as np\n'
+           'def step(exe, feed, loss, scope):\n'
+           '    out = exe.run(feed=feed, fetch_list=[loss])\n'
+           '    a = np.asarray(out[0])\n'              # flagged
+           '    b = float(out[0])\n'                   # flagged
+           '    c = out[0].item()\n'                   # flagged
+           '    d = np.asarray(out[0])  # host-sync: ok — logging\n'
+           '    e = float("1e-3")\n'                   # literal: fine
+           '    f = out[0].item(3)\n'                  # args still sync 
+           '    return a, b, c, d, e, f\n')
+    # governed path: flags the unmarked coercions only
+    hot = check_device_coercion("paddle_tpu/trainer.py", src)
+    assert [f.line for f in hot] == [4, 5, 6, 9]
+    assert all(f.code == "device-coercion" for f in hot)
+    # ungoverned file: same source passes untouched
+    assert check_device_coercion("paddle_tpu/metrics.py", src) == []
+    assert check_device_coercion("bench.py", src) == []
+
+
 def test_repo_source_is_lint_clean():
     from paddle_tpu.analysis.source_lint import default_targets, lint_paths
     findings = lint_paths(default_targets(REPO),
