@@ -99,20 +99,56 @@ class PhaseTimer:
         return out
 
 
+def _attach_deferred_context(e: BaseException, prov: dict) -> None:
+    """Attach (epoch, step, fetch name) provenance to an error raised at
+    lazy materialization: the device computed it steps ago, and without
+    this the traceback points at an unrelated log line. add_note on
+    3.11+, args rewrite otherwise — the original exception TYPE is kept
+    either way (callers match on it)."""
+    if not prov:
+        return
+    note = ("deferred from device execution; in-flight fetch: "
+            + ", ".join(f"{k}={v!r}" for k, v in sorted(prov.items())))
+    add_note = getattr(e, "add_note", None)
+    if callable(add_note):
+        add_note(note)
+    elif e.args and isinstance(e.args[0], str):
+        e.args = (f"{e.args[0]}\n{note}",) + e.args[1:]
+    else:
+        e.args = e.args + (note,)
+
+
 class LazyFetch:
     """Deferred fetch: wraps one fetch var's device value.
 
     Reading it (np.asarray / float() / .numpy() / indexing) blocks until
     the device value is ready and converts it to numpy ONCE (cached);
     `.value()` hands back the raw device array without any sync. The
-    block is charged to the owning executor's device/fetch phases."""
+    block is charged to the owning executor's device/fetch phases.
 
-    __slots__ = ("_val", "_timer", "_np")
+    `provenance` carries (fetch name from the executor; epoch/step via
+    `annotate`) — a device error deferred to materialization re-raises
+    with that context attached, and the step watchdog
+    (resilience/watchdog.py, PT_STEP_DEADLINE_S) includes it in the
+    hang dump."""
 
-    def __init__(self, value, timer: Optional[PhaseTimer] = None):
+    __slots__ = ("_val", "_timer", "_np", "_prov")
+
+    def __init__(self, value, timer: Optional[PhaseTimer] = None,
+                 provenance: Optional[dict] = None):
         self._val = value
         self._timer = timer
         self._np = None
+        self._prov = dict(provenance) if provenance else {}
+
+    def annotate(self, **context) -> "LazyFetch":
+        """Merge provenance context (e.g. epoch=, step=); returns self."""
+        self._prov.update(context)
+        return self
+
+    @property
+    def provenance(self) -> dict:
+        return dict(self._prov)
 
     # -- non-blocking surface ----------------------------------------------
     def value(self):
@@ -140,16 +176,30 @@ class LazyFetch:
 
     # -- blocking reads -----------------------------------------------------
     def numpy(self) -> np.ndarray:
-        """Materialize to numpy (cached). THE synchronization point."""
+        """Materialize to numpy (cached). THE synchronization point —
+        which also makes it the step watchdog's boundary (an armed
+        PT_STEP_DEADLINE_S turns a hung device step into StepHungError
+        here) and where deferred device errors surface (re-raised with
+        provenance attached)."""
         if self._np is None:
-            if self._timer is not None:
-                with self._timer.span("device"):
-                    jax.block_until_ready(self._val)
-                with self._timer.span("fetch"):
+            from ..resilience import watchdog as _watchdog
+            try:
+                if self._timer is not None:
+                    with self._timer.span("device"):
+                        _watchdog.wait_until_ready(
+                            self._val, provenance=self._prov,
+                            timer=self._timer)
+                    with self._timer.span("fetch"):
+                        self._np = np.asarray(self._val)  # host-sync: ok — this IS the read
+                else:
+                    _watchdog.wait_until_ready(self._val,
+                                               provenance=self._prov)
                     self._np = np.asarray(self._val)  # host-sync: ok — this IS the read
-            else:
-                jax.block_until_ready(self._val)
-                self._np = np.asarray(self._val)  # host-sync: ok — this IS the read
+            except _watchdog.StepHungError:
+                raise  # dump already carries the provenance
+            except Exception as e:
+                _attach_deferred_context(e, self._prov)
+                raise
         return self._np
 
     def block_until_ready(self) -> "LazyFetch":

@@ -230,7 +230,8 @@ class Executor(TimedExecutorMixin):
 
     # -- main entry ---------------------------------------------------------
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
-                  build, key_extra, per_step_feed_prep=False, lazy=False):
+                  build, key_extra, per_step_feed_prep=False, lazy=False,
+                  guard=False, guard_steps=None):
         """Shared body of run/run_loop: prep feeds/state, hit the jit cache
         (≙ the reference's program cache, executor.py:165), execute, write
         new state back to the scope.
@@ -238,16 +239,37 @@ class Executor(TimedExecutorMixin):
         lazy=True returns LazyFetch handles instead of materialized
         arrays: the call returns as soon as XLA has ENQUEUED the step, so
         the caller can prep + dispatch step N+1 while N executes; a
-        handle blocks only when read (async_fetch.py)."""
+        handle blocks only when read (async_fetch.py).
+
+        guard=True (resilience/guard.py): the step-health scalar is
+        appended as the LAST fetch, the per-dispatch fault code rides the
+        reserved feed, and the compiled state output is the guarded
+        select. Exactly ONE numeric instrumentation applies per compile:
+        the guard wins over FLAGS.check_nan_inf (checkify), and the
+        cache key records which (plus the traced-in gnorm ceiling)."""
         t_prep = time.perf_counter()
         program = program if program is not None else default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()
 
+        from ..flags import FLAGS
         fetch_names = [self._fetch_name(f) for f in fetch_list]
         feed_arrays = self._prep_feed(program, feed,
                                       per_step=per_step_feed_prep)
+        if guard:
+            from ..resilience import guard as guard_mod
+            guard_mod.assert_instrumented(program)
+            fetch_names = fetch_names + [guard_mod.HEALTH_VAR]
+            feed_arrays[guard_mod.FAULT_FEED] = guard_mod.fault_feed(
+                guard_steps)
+            if FLAGS.check_nan_inf:
+                guard_mod.warn_checkify_conflict()
+            numeric_mode = ("guard", guard_mod.max_gnorm())
+        elif FLAGS.check_nan_inf:
+            numeric_mode = ("checkify",)
+        else:
+            numeric_mode = ()
         state = self._state_for(program, scope)
 
         feed_sig = tuple(sorted((k, v.shape, str(v.dtype))
@@ -255,10 +277,7 @@ class Executor(TimedExecutorMixin):
         state_sig = tuple(sorted((k, jnp.shape(v), str(jnp.result_type(v)))
                                  for k, v in state.items()))
         key = (program.fingerprint(), key_extra, feed_sig,
-               tuple(fetch_names), state_sig)
-
-        from ..flags import FLAGS
-        key = key + (FLAGS.check_nan_inf,)
+               tuple(fetch_names), state_sig, numeric_mode)
         self._timings.add("host_prep", time.perf_counter() - t_prep)
         compiled = self._cache.get(key)
         was_cached = compiled is not None
@@ -282,7 +301,7 @@ class Executor(TimedExecutorMixin):
             gconv_autotune.tune_program(program, bh)
             raw, state_out, donate = build(program, list(feed_arrays),
                                            fetch_names, sorted(state))
-            if FLAGS.check_nan_inf:
+            if FLAGS.check_nan_inf and not guard:
                 # ≙ FLAGS_check_nan_inf (operator.cc:590): every float
                 # primitive of the compiled step is instrumented; a nan/inf
                 # raises host-side naming the generating primitive. The
@@ -326,7 +345,11 @@ class Executor(TimedExecutorMixin):
             scope.set_var(name, val)
 
         if lazy:
-            return [LazyFetch(f, self._timings) for f in fetches]
+            # fetch-name provenance rides every handle: a deferred device
+            # error (or a watchdog dump) names WHAT was in flight; the
+            # Trainer annotates epoch/step on top
+            return [LazyFetch(f, self._timings, provenance={"fetch": n})
+                    for n, f in zip(compiled.fetch_names, fetches)]
         if return_numpy:
             with self._timings.span("device"):
                 jax.block_until_ready(fetches)
@@ -338,26 +361,31 @@ class Executor(TimedExecutorMixin):
     def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
             fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
             return_numpy: bool = True, donate_state: bool = True,
-            lazy: bool = False):
+            lazy: bool = False, guard: bool = False):
         """lazy=True: return LazyFetch handles (async_fetch.py) — the call
         returns once the step is enqueued and a handle blocks only when
         read, so back-to-back run() calls overlap step N+1's host prep +
-        dispatch with step N's device execution."""
+        dispatch with step N's device execution.
+
+        guard=True: guarded update + step-health flag appended as the
+        LAST fetch (resilience/guard.py; the program must carry the
+        `step_health` op — optimizer.minimize appends it under
+        PT_GUARD, or guard.instrument(program) on demand)."""
         def build(program, feed_names, fetch_names, state_names):
             step, state_out = lowering.build_step_fn(
-                program, feed_names, fetch_names, state_names)
+                program, feed_names, fetch_names, state_names, guard=guard)
             return step, state_out, (0,) if donate_state else ()
 
         return self._run_impl(program, feed, fetch_list, scope, return_numpy,
                               build, key_extra=("step", donate_state),
-                              lazy=lazy)
+                              lazy=lazy, guard=guard)
 
     def run_loop(self, program: Optional[Program] = None,
                  feed: Optional[dict] = None,
                  fetch_list: Optional[Sequence] = None, n_steps: int = 1,
                  scope: Optional[Scope] = None, per_step_feeds: bool = False,
                  return_numpy: bool = True, unroll: int = 2,
-                 lazy: bool = False):
+                 lazy: bool = False, guard: bool = False):
         """Run `n_steps` training steps in ONE device dispatch (lax.scan).
 
         The reference pays host dispatch per step (executor.cc:322 interprets
@@ -380,13 +408,18 @@ class Executor(TimedExecutorMixin):
         def build(program, feed_names, fetch_names, state_names):
             loop, state_out = lowering.build_loop_fn(
                 program, feed_names, fetch_names, state_names,
-                n_steps=n_steps, per_step_feeds=per_step_feeds, unroll=unroll)
+                n_steps=n_steps, per_step_feeds=per_step_feeds, unroll=unroll,
+                guard=guard)
             return loop, state_out, (0,)
 
+        # per-step feeds get a PER-STEP fault code ([n_steps] int32: the
+        # chaos plan addresses individual steps inside a window); a
+        # shared-feed loop draws one code for the whole window
         return self._run_impl(
             program, feed, fetch_list, scope, return_numpy, build,
             key_extra=("loop", n_steps, per_step_feeds, unroll),
-            per_step_feed_prep=per_step_feeds, lazy=lazy)
+            per_step_feed_prep=per_step_feeds, lazy=lazy, guard=guard,
+            guard_steps=n_steps if per_step_feeds else None)
 
     def close(self):
         self._cache.clear()

@@ -350,17 +350,46 @@ def run_block_with_autodiff(block: Block, env: Dict[str, object], ctx: ExecConte
         for w_name, rs in built.items():
             env[grad_of[w_name]] = rs
 
+    # guard fault injection (resilience/guard.py): a traced int32 code
+    # (0 none, 1 nan_loss, 2 nan_grad) poisons the bound loss/grads
+    # in-graph via SELECT — never arithmetic, so a code of 0 is bit-exact
+    # (adding 0.0 would already flip -0.0 to +0.0). The downstream
+    # step_health op and optimizer suffix then see exactly what a real
+    # anomalous batch would have produced.
+    fault = getattr(ctx, "guard_fault", None)
+    if fault is not None:
+        from .selected_rows import RowSparseGrad
+
+        def _poison(v, code):
+            if isinstance(v, RowSparseGrad):
+                return v._replace(values=_poison(v.values, code))
+            bad = jnp.full(jnp.shape(v), jnp.nan, jnp.result_type(v))
+            return jnp.where(fault == code, bad, v)
+
+        env[loss_name] = _poison(env[loss_name], 1)
+        for g_name in grad_of.values():
+            if g_name in env:
+                env[g_name] = _poison(env[g_name], 2)
+
     return run_op_range(ops, bwd_idx + 1, len(ops), env, ctx, block)
 
 
 def build_step_fn(program: Program, feed_names: Sequence[str],
                   fetch_names: Sequence[str], state_in_names: Sequence[str],
-                  is_test: bool = False, mesh=None):
+                  is_test: bool = False, mesh=None, guard: bool = False):
     """Build the pure step function for block 0 of `program`.
 
     Returns (step, state_out_names): state_out_names is the set of
     persistable vars the step returns as new state (inputs carried through +
     any persistable var an op writes — e.g. param updates, accumulators).
+
+    guard=True (resilience/guard.py; program must carry a `step_health`
+    op) makes the update GUARDED: every state output becomes
+    ``where(healthy, updated, old)``, so an anomalous step leaves all
+    persistable state bit-identical — the skip is inside the compiled
+    step, donation-safe, and valid under any GSPMD update sharding. The
+    reserved ``__guard_fault__`` feed threads the deterministic fault
+    code to the in-graph poisoning above.
     """
     block = program.global_block
     ops = block.ops
@@ -385,6 +414,9 @@ def build_step_fn(program: Program, feed_names: Sequence[str],
         ctx = ExecContext(rng, is_test=is_test, mesh=mesh)
         ctx.amp_dtype = program.amp_dtype
         ctx.live_out = set(fetch_names) | set(state_out_names)
+        if guard:
+            from ..resilience.guard import FAULT_FEED
+            ctx.guard_fault = feed.get(FAULT_FEED)
         env: Dict[str, object] = {}
         env.update(state)
         env.update(feed)
@@ -400,6 +432,17 @@ def build_step_fn(program: Program, feed_names: Sequence[str],
         env = run_block_with_autodiff(block, env, ctx)
         fetches = tuple(env[n] for n in fetch_names)
         new_state = {n: env[n] for n in state_out_names if n in env}
+        if guard:
+            from ..resilience.guard import HEALTH_VAR
+            healthy = env[HEALTH_VAR]
+            # guarded update: an unhealthy step keeps EVERY pre-step
+            # state value (params, accumulators, bn stats). SELECT reads
+            # the donated input before any aliasing write, so donation
+            # stays on. Vars the scope did not hold yet (no old value)
+            # keep the computed one.
+            new_state = {n: (jnp.where(healthy, v, state[n])
+                             if n in state else v)
+                         for n, v in new_state.items()}
         return fetches, new_state
 
     return step, state_out_names
@@ -408,7 +451,8 @@ def build_step_fn(program: Program, feed_names: Sequence[str],
 def build_loop_fn(program: Program, feed_names: Sequence[str],
                   fetch_names: Sequence[str], state_in_names: Sequence[str],
                   n_steps: int, is_test: bool = False, mesh=None,
-                  per_step_feeds: bool = False, unroll: int = 1):
+                  per_step_feeds: bool = False, unroll: int = 1,
+                  guard: bool = False):
     """Build a function running `n_steps` training steps in ONE dispatch.
 
     The reference amortizes host work with scope reuse
@@ -426,7 +470,7 @@ def build_loop_fn(program: Program, feed_names: Sequence[str],
     """
     step, state_out_names = build_step_fn(program, feed_names, fetch_names,
                                           state_in_names, is_test=is_test,
-                                          mesh=mesh)
+                                          mesh=mesh, guard=guard)
 
     def loop(state: Dict[str, object], feed: Dict[str, object], rng):
         feed = {k: jnp.asarray(v) for k, v in feed.items()}

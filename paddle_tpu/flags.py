@@ -161,6 +161,29 @@ declare_env_knob("PT_CHAOS_SEED",
                  "seed forwarded to the chaos suite's probabilistic "
                  "fault plans (scripts/ci.sh chaos runs the resilience "
                  "tests under two fixed values)")
+declare_env_knob("PT_GUARD",
+                 "training-guardrail recovery policy (resilience/"
+                 "guard.py): skip | rollback | raise (unset/0 = off). "
+                 "Arms the in-graph step-health flag + guarded weight "
+                 "update: an anomalous step (non-finite loss/grads, "
+                 "grad-norm over PT_GUARD_MAX_GNORM) never touches the "
+                 "weights. Must be set BEFORE the program is built "
+                 "(optimizer.minimize instruments it)")
+declare_env_knob("PT_GUARD_PATIENCE",
+                 "consecutive anomalous steps before PT_GUARD=raise "
+                 "raises / PT_GUARD=rollback restores the newest "
+                 "verified checkpoint (default 3)")
+declare_env_knob("PT_GUARD_MAX_GNORM",
+                 "global-gradient-norm ceiling of the step-health flag "
+                 "(default inf: only non-finite loss/grads trip the "
+                 "guard); measured on raw pre-clip grads, unscaled by "
+                 "the AMP loss_scale")
+declare_env_knob("PT_STEP_DEADLINE_S",
+                 "step watchdog (resilience/watchdog.py): a lazy fetch "
+                 "materialization that does not settle within this many "
+                 "seconds raises StepHungError with the stuck phase + "
+                 "in-flight fetch provenance instead of hanging forever "
+                 "(unset/0 = off)")
 declare_env_knob("PT_COMPILE_CACHE",
                  "persistent XLA compile cache (core/compile_cache.py): "
                  "unset/0 = off, 1 = ~/.cache/paddle_tpu/xla_cache, "

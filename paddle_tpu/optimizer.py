@@ -123,6 +123,13 @@ class Optimizer:
                                                  self.regularization)
         optimize_ops = self._create_optimization_pass(params_grads, loss,
                                                       startup_program)
+        # training guardrails (resilience/guard.py): with PT_GUARD armed,
+        # append the in-graph step-health op so the executor can run the
+        # update as new_state = where(healthy, updated, old). The norm it
+        # measures is the RAW @GRAD set from the autodiff boundary —
+        # pre-clip, so clip_by_global_norm cannot mask an explosion.
+        from .resilience.guard import maybe_instrument
+        maybe_instrument(default_main_program())
         return optimize_ops, params_grads
 
 

@@ -178,12 +178,18 @@ class ParallelExecutor(TimedExecutorMixin):
 
     # -- compile ------------------------------------------------------------
     def _get_compiled(self, fetch_list: Sequence, feed: dict,
-                      loop: Optional[tuple] = None):
+                      loop: Optional[tuple] = None, guard: bool = False):
         """Build (or fetch from cache) the jitted sharded step for this
         (program, feed-shapes, fetches) signature. Returns
         (compiled, state, feed_arrays, was_cached). `loop` = (n_steps,
         per_step_feeds, unroll) compiles a device-side lax.scan over the
-        SAME sharded step — the multi-device fast path (run_loop)."""
+        SAME sharded step — the multi-device fast path (run_loop).
+
+        guard=True: guarded update + the step-health fetch, same contract
+        as Executor (resilience/guard.py). The health scalar and the
+        fault-code feed are replicated; the guarded select runs INSIDE
+        the partitioned step, so it stays valid under whatever update
+        sharding GSPMD picks (ZeRO-1 sharded accumulators included)."""
         program = self._program
         block = program.global_block
         t_prep = time.perf_counter()
@@ -191,6 +197,15 @@ class ParallelExecutor(TimedExecutorMixin):
         per_step = bool(loop and loop[1])
         fetch_names = [exe_helper._fetch_name(f) for f in fetch_list]
         feed_arrays = exe_helper._prep_feed(program, feed, per_step=per_step)
+        if guard:
+            from ..resilience import guard as guard_mod
+            guard_mod.assert_instrumented(program)
+            fetch_names = fetch_names + [guard_mod.HEALTH_VAR]
+            feed_arrays[guard_mod.FAULT_FEED] = guard_mod.fault_feed(
+                loop[0] if per_step else None)
+            guard_key = ("guard", guard_mod.max_gnorm())
+        else:
+            guard_key = ()
         state = exe_helper._state_for(program, self._scope)
         self._timings.add("host_prep", time.perf_counter() - t_prep)
 
@@ -199,7 +214,8 @@ class ParallelExecutor(TimedExecutorMixin):
         state_sig = tuple(sorted((k, jnp.shape(v), str(jnp.result_type(v)))
                                  for k, v in state.items()))
         key = (program.fingerprint(), feed_sig, tuple(fetch_names), state_sig,
-               id(self._mesh), self._build_strategy.reduce_strategy, loop)
+               id(self._mesh), self._build_strategy.reduce_strategy, loop,
+               guard_key)
 
         compiled = self._cache.get(key)
         was_cached = compiled is not None
@@ -215,13 +231,14 @@ class ParallelExecutor(TimedExecutorMixin):
             if loop is None:
                 step, state_out = lowering.build_step_fn(
                     program, list(feed_arrays), fetch_names, sorted(state),
-                    mesh=self._mesh)
+                    mesh=self._mesh, guard=guard)
             else:
                 n_steps, per_step_feeds, unroll = loop
                 step, state_out = lowering.build_loop_fn(
                     program, list(feed_arrays), fetch_names, sorted(state),
                     n_steps=n_steps, mesh=self._mesh,
-                    per_step_feeds=per_step_feeds, unroll=unroll)
+                    per_step_feeds=per_step_feeds, unroll=unroll,
+                    guard=guard)
 
             def var_of(name):
                 try:
@@ -275,7 +292,7 @@ class ParallelExecutor(TimedExecutorMixin):
     def run_loop(self, fetch_list: Sequence, feed: Optional[dict] = None,
                  n_steps: int = 1, per_step_feeds: bool = False,
                  unroll: int = 2, return_numpy: bool = True,
-                 lazy: bool = False):
+                 lazy: bool = False, guard: bool = False):
         """Run `n_steps` SHARDED training steps in one device dispatch:
         lax.scan over the same GSPMD-partitioned step `run` executes.
 
@@ -290,18 +307,20 @@ class ParallelExecutor(TimedExecutorMixin):
         Fetches come back stacked [n_steps, ...]."""
         feed = feed or {}
         compiled, state, feed_arrays, was_cached = self._get_compiled(
-            fetch_list, feed, loop=(n_steps, per_step_feeds, unroll))
+            fetch_list, feed, loop=(n_steps, per_step_feeds, unroll),
+            guard=guard)
         return self._execute(compiled, state, feed_arrays, return_numpy,
                              was_cached, lazy=lazy)
 
     def run(self, fetch_list: Sequence, feed: Optional[dict] = None,
             feed_dict: Optional[dict] = None, return_numpy: bool = True,
-            lazy: bool = False):
+            lazy: bool = False, guard: bool = False):
         """lazy=True: LazyFetch handles, same contract as Executor.run —
-        the sharded step is enqueued and the host moves on."""
+        the sharded step is enqueued and the host moves on. guard=True:
+        guarded update + step-health fetch (resilience/guard.py)."""
         feed = feed if feed is not None else (feed_dict or {})
         compiled, state, feed_arrays, was_cached = self._get_compiled(
-            fetch_list, feed)
+            fetch_list, feed, guard=guard)
         return self._execute(compiled, state, feed_arrays, return_numpy,
                              was_cached, lazy=lazy)
 
@@ -318,7 +337,8 @@ class ParallelExecutor(TimedExecutorMixin):
         for name, val in new_state.items():
             self._scope.set_var(name, val)
         if lazy:
-            return [LazyFetch(f, self._timings) for f in fetches]
+            return [LazyFetch(f, self._timings, provenance={"fetch": n})
+                    for n, f in zip(compiled.fetch_names, fetches)]
         if return_numpy:
             with self._timings.span("device"):
                 jax.block_until_ready(fetches)

@@ -10,15 +10,24 @@ exponential backoff + the reader-restart wrapper), and the persistence
 side provable (manifest.py: per-file size+crc32 manifests that
 save_checkpoint commits *before* the _SUCCESS marker, so a torn or
 bit-rotten serial is detected and quarantined at load instead of
-restoring garbage). See docs/resilience.md.
+restoring garbage). The numerics side lives in guard.py (in-graph
+step-health flag + guarded weight update + the PT_GUARD recovery
+policies) and watchdog.py (PT_STEP_DEADLINE_S bound on a hung device
+step). See docs/resilience.md.
 """
 
 from .faults import (FaultInjected, FaultPlan, active_plan, crash_point,
                      fire, reset)
 from .retry import RetryPolicy, resilient_reader, retry_call
 from . import manifest
+from . import guard
+from . import watchdog
+from .guard import GuardConfigError, StepAnomalyError
+from .watchdog import StepHungError
 
 __all__ = [
     "FaultInjected", "FaultPlan", "active_plan", "crash_point", "fire",
     "reset", "RetryPolicy", "resilient_reader", "retry_call", "manifest",
+    "guard", "watchdog", "GuardConfigError", "StepAnomalyError",
+    "StepHungError",
 ]

@@ -36,6 +36,13 @@ error, not a silently-never-firing spec):
     reader_raise        per batch inside the resilient reader wrapper
                         (retry.resilient_reader — the trainer data path)
     step_crash          Trainer.train, at the top of each step
+    nan_loss            in-graph (guard.py): the step's loss becomes NaN
+                        — hit once per GUARDED dispatch
+    nan_grad            in-graph (guard.py): every parameter gradient
+                        becomes NaN — hit once per GUARDED dispatch
+    step_hang           watchdog.py: the device step never settles — hit
+                        only when PT_STEP_DEADLINE_S is armed (an
+                        unwatched injected hang would hang the run)
 """
 
 from __future__ import annotations
@@ -57,6 +64,10 @@ SITES: Dict[str, str] = {
     "commit_crash": "crash after checkpoint data, before _SUCCESS",
     "reader_raise": "raise from the reader iteration (retried region)",
     "step_crash": "crash at a trainer step boundary",
+    "nan_loss": "in-graph: the step's loss becomes NaN (guarded runs)",
+    "nan_grad": "in-graph: every parameter gradient becomes NaN "
+                "(guarded runs)",
+    "step_hang": "the device step never settles (armed watchdog only)",
 }
 
 ENV_VAR = "PT_FAULT_INJECT"

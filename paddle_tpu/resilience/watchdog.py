@@ -1,0 +1,155 @@
+"""Step watchdog: bound the wait on a dispatched device step.
+
+The async hot path dispatches step N+1 while N executes and only ever
+blocks at a LazyFetch materialization (core/async_fetch.py). If a device
+step never settles — a deadlocked collective on a sick slice, a runaway
+custom kernel, a wedged transfer over a flaky control plane — that
+materialization blocks the trainer FOREVER, with no indication of what
+was in flight. With ``PT_STEP_DEADLINE_S`` set, the blocking wait is
+delegated to a monitor thread and the caller waits on it with a
+deadline; on expiry the caller gets a `StepHungError` carrying the
+diagnosis instead of a silent hang:
+
+* which phase is stuck (always ``device`` at this boundary: dispatch
+  returned, ``block_until_ready`` never did),
+* the in-flight fetch's provenance — (epoch, step, fetch name) as
+  annotated by the Trainer,
+* the executor's accounted PhaseTimer phases, so "the device stopped
+  answering" is distinguishable from "we never dispatched".
+
+XLA offers no way to cancel an enqueued computation, so the hung wait is
+abandoned on its daemon thread — the point is a loud, attributable error
+the orchestration layer can act on (kill the worker, resume from the
+last verified checkpoint) instead of an eternal stall.
+
+The deterministic ``step_hang`` fault site (PT_FAULT_INJECT) simulates a
+hung step inside the monitor thread, so the watchdog path is provable in
+CI. The site is only reached when a deadline is armed — an injected hang
+with no watchdog would hang the suite itself.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Optional
+
+__all__ = ["StepHungError", "deadline", "wait_until_ready", "DEADLINE_ENV"]
+
+DEADLINE_ENV = "PT_STEP_DEADLINE_S"
+
+
+class StepHungError(RuntimeError):
+    """A dispatched step did not settle within PT_STEP_DEADLINE_S."""
+
+
+def deadline() -> Optional[float]:
+    """The armed deadline in seconds, or None (watchdog off). Read at
+    every materialization, so it can be armed/disarmed at runtime."""
+    raw = os.environ.get(DEADLINE_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        d = float(raw)
+    except ValueError as e:
+        raise ValueError(f"{DEADLINE_ENV}={raw!r}: not a float") from e
+    return d if d > 0 else None
+
+
+def _dump(provenance, timer, deadline_s: float) -> str:
+    lines = [
+        f"device step did not settle within {deadline_s:g}s "
+        f"({DEADLINE_ENV}) — stuck in phase 'device' (dispatch returned, "
+        "block_until_ready never did)",
+    ]
+    if provenance:
+        ctx = ", ".join(f"{k}={v!r}" for k, v in sorted(provenance.items()))
+        lines.append(f"in-flight fetch: {ctx}")
+    if timer is not None:
+        lines.append(f"accounted phases since last reset: {timer.snapshot()}")
+    lines.append("the hung wait was abandoned on its monitor thread (XLA "
+                 "cannot cancel an enqueued computation); resume from the "
+                 "newest verified checkpoint after restarting the worker")
+    return "\n".join(lines)
+
+
+class _Monitor:
+    """ONE persistent monitor thread serving all watchdog waits — a
+    thread per materialization would put thread spawn/teardown on the
+    very hot path the lazy-fetch design keeps sync-free. Waits are
+    serviced FIFO (the trainer materializes sequentially; concurrent
+    callers share the worker, so a caller's deadline includes any wait
+    queued ahead of it). A wait that times out ABANDONS the monitor —
+    the stuck thread keeps its hung block_until_ready, and the next
+    wait gets a fresh monitor; a late completion of an abandoned item
+    only sets an Event nobody is watching."""
+
+    def __init__(self):
+        self.requests: "queue.Queue" = queue.Queue()
+        self.thread = threading.Thread(target=self._loop, daemon=True,
+                                       name="pt-watchdog-monitor")
+        self.thread.start()
+
+    def _loop(self):
+        import jax
+        from . import faults
+        while True:
+            value, settled, err = self.requests.get()
+            try:
+                if faults.fire("step_hang") is not None:
+                    threading.Event().wait()  # simulated hung device step
+                jax.block_until_ready(value)
+            except BaseException as e:  # noqa: BLE001 — re-raised by caller
+                err.append(e)
+            finally:
+                settled.set()
+
+
+_monitor: Optional[_Monitor] = None
+_monitor_lock = threading.Lock()
+
+
+def _submit(value):
+    global _monitor
+    with _monitor_lock:
+        if _monitor is None:
+            _monitor = _Monitor()
+        mon = _monitor
+    settled = threading.Event()
+    err: list = []
+    mon.requests.put((value, settled, err))
+    return mon, settled, err
+
+
+def _abandon(mon: "_Monitor") -> None:
+    global _monitor
+    with _monitor_lock:
+        if _monitor is mon:
+            _monitor = None
+
+
+def wait_until_ready(value, deadline_s: Optional[float] = None,
+                     provenance: Optional[dict] = None, timer=None):
+    """block_until_ready(value) under the armed deadline.
+
+    With no deadline (PT_STEP_DEADLINE_S unset and deadline_s None) this
+    is a plain blocking wait. Otherwise the wait is delegated to the
+    persistent monitor thread; if it does not settle in time,
+    StepHungError carries the phase dump + provenance and the stuck
+    monitor is abandoned. Exceptions from the wait itself (deferred
+    device errors) propagate unchanged."""
+    import jax
+
+    d = deadline_s if deadline_s is not None else deadline()
+    if d is None:
+        jax.block_until_ready(value)
+        return value
+
+    mon, settled, err = _submit(value)
+    if not settled.wait(d):
+        _abandon(mon)
+        raise StepHungError(_dump(provenance, timer, d))
+    if err:
+        raise err[0]
+    return value

@@ -178,20 +178,26 @@ class Trainer:
                         self.exe, self.checkpoint_cfg.checkpoint_dir, serial,
                         self.train_program, trainer_id=jax.process_index(),
                         scope=self.scope, verify=False)
-                    if args:
-                        self.checkpoint_cfg.epoch_id = args.get("epoch_id", 0)
-                        step_id = args.get("step_id", 0)
-                        if args.get("args_version", 1) < 2 and step_id:
-                            # pre-resilience checkpoints recorded the LAST
-                            # COMPLETED step; v2 records the next one
-                            step_id += 1
-                        self.checkpoint_cfg.step_id = step_id
-                        # replaying the executor's run counter replays its
-                        # per-run rng streams (fold_in of the counter), so
-                        # a resumed run is bit-exact vs the uninterrupted
-                        # one even through stochastic ops
-                        self.exe._run_counter = int(
-                            args.get("run_counter", self.exe._run_counter))
+                    self._restore_trainer_args(args)
+
+    def _restore_trainer_args(self, args: Optional[dict]) -> None:
+        """Restore the resume point + executor rng stream from a
+        checkpoint's trainer_args (auto-resume in __init__ AND the
+        guard's rollback path — one implementation, one semantics)."""
+        if not args:
+            return
+        self.checkpoint_cfg.epoch_id = args.get("epoch_id", 0)
+        step_id = args.get("step_id", 0)
+        if args.get("args_version", 1) < 2 and step_id:
+            # pre-resilience checkpoints recorded the LAST COMPLETED
+            # step; v2 records the next one
+            step_id += 1
+        self.checkpoint_cfg.step_id = step_id
+        # replaying the executor's run counter replays its per-run rng
+        # streams (fold_in of the counter), so a resumed run is
+        # bit-exact vs the uninterrupted one even through stochastic ops
+        self.exe._run_counter = int(
+            args.get("run_counter", self.exe._run_counter))
 
     # -- distributed role dispatch (trainer.py:226) -------------------------
     def _dist_init_if_necessary(self):
@@ -245,6 +251,18 @@ class Trainer:
         and dispatch overlap step N's device execution. The default
         log_every=1 materializes every step — the pre-async behavior.
 
+        Training guardrails (PT_GUARD=skip|rollback|raise; resilience/
+        guard.py): every dispatched step carries an in-graph health flag
+        (finite loss ∧ finite global grad norm ∧ norm ≤
+        PT_GUARD_MAX_GNORM) and a guarded update — an anomalous batch
+        never touches the weights, at zero extra host syncs (the flag
+        rides the lazy fetch list and is consumed at log/checkpoint
+        boundaries). After PT_GUARD_PATIENCE consecutive anomalies:
+        `skip` keeps going, `raise` raises StepAnomalyError, `rollback`
+        restores the newest verified checkpoint serial and resumes
+        bit-exactly (reader fast-forward + rng replay). PT_GUARD must be
+        set before the Trainer is constructed. See docs/resilience.md.
+
         Preemption: while this loop runs (from the main thread), SIGTERM/
         SIGINT request a checkpoint at the next step boundary followed by
         a clean return with ``self.preempted = True`` — on preemptible
@@ -255,7 +273,32 @@ class Trainer:
         deterministic readers."""
         from .reader.prefetch import DeviceFeeder
         from .resilience import faults
+        from .resilience import guard as guard_mod
+        from .resilience import watchdog as watchdog_mod
         from .resilience.retry import RetryPolicy, resilient_reader
+        # -- training guardrails (PT_GUARD; resilience/guard.py) ----------
+        # validate the watchdog knob up front: a malformed deadline must
+        # fail HERE as a config error, not minutes later inside a lazy
+        # materialization dressed up as a deferred device error
+        watchdog_mod.deadline()
+        self._guard_policy = guard_mod.policy()
+        if self._guard_policy:
+            guard_mod.patience()  # validate the knob before training
+            if not guard_mod.is_instrumented(self.train_program):
+                raise guard_mod.GuardConfigError(
+                    "PT_GUARD is set but the training program carries no "
+                    "step-health instrumentation — set PT_GUARD before "
+                    "constructing the Trainer (optimizer.minimize "
+                    "instruments the program at build time)")
+            if self._guard_policy == "rollback" and not self.checkpoint_cfg:
+                raise guard_mod.GuardConfigError(
+                    "PT_GUARD=rollback restores the newest verified "
+                    "checkpoint serial: pass a CheckpointConfig (or use "
+                    "PT_GUARD=skip|raise)")
+        self._bad_streak = 0
+        self._pending_health = []
+        self._guard_rollbacks = 0
+        self._last_rollback_at = None
         if isinstance(reader_retry, RetryPolicy):
             retry_policy = reader_retry
         elif reader_retry:
@@ -282,9 +325,19 @@ class Trainer:
                 except (ValueError, OSError):  # pragma: no cover
                     pass
         try:
-            self._train_impl(num_epochs, event_handler, reader, feed_order,
-                             double_buffer, steps_per_loop, DeviceFeeder,
-                             faults, max(int(log_every), 1))
+            while True:
+                try:
+                    self._train_impl(num_epochs, event_handler, reader,
+                                     feed_order, double_buffer,
+                                     steps_per_loop, DeviceFeeder, faults,
+                                     max(int(log_every), 1))
+                    break
+                except guard_mod.RollbackSignal as rb:
+                    # PT_GUARD=rollback: restore the newest verified
+                    # serial and re-enter — resume fast-forwards the
+                    # reader and replays rng, exactly the crash-resume
+                    # machinery, so recovery is bit-exact-testable
+                    self._guard_rollback(rb)
         finally:
             for sig, old in restore_handlers.items():
                 signal.signal(sig, old)
@@ -322,16 +375,127 @@ class Trainer:
             return False
         if self.checkpoint_cfg:
             if not already_saved:
+                # same invariant as the step-interval save: pending
+                # anomalies are adjudicated BEFORE a serial commits, so a
+                # preemption checkpoint can't silently absorb a bad
+                # streak (and a patience trip still fires its policy)
+                self._drain_health()
                 self._save_checkpoint(epoch_id, next_step)
         elif self._preempt_signal == signal.SIGINT:
             raise KeyboardInterrupt
         self.preempted = True
         return True
 
+    # -- training guardrails (PT_GUARD; resilience/guard.py) ----------------
+    def _drain_health(self) -> None:
+        """Consume pending step-health fetches and apply the PT_GUARD
+        policy. Called only at log/checkpoint/epoch boundaries, so under
+        lazy dispatch detection piggybacks on syncs the loop already
+        pays — between boundaries the handles just accumulate.
+
+        Policy semantics on PT_GUARD_PATIENCE consecutive anomalies:
+        `skip` keeps going (the in-graph guarded update already kept the
+        weights clean — each anomaly is logged); `raise` raises
+        StepAnomalyError; `rollback` raises the internal RollbackSignal
+        that train() turns into a restore of the newest verified
+        checkpoint serial."""
+        if not self._pending_health:
+            return
+        from .resilience import guard as guard_mod
+        import logging
+        log = logging.getLogger("paddle_tpu")
+        patience = guard_mod.patience()
+        pend, self._pending_health = self._pending_health, []
+        for epoch_id, step0, _n, handle in pend:
+            # host-sync: ok — boundary-only health read (log/ckpt/epoch)
+            flags = np.ravel(np.asarray(handle)).astype(bool)
+            for i, ok in enumerate(flags):
+                if ok:
+                    self._bad_streak = 0
+                    continue
+                self._bad_streak += 1
+                log.warning(
+                    "[guard] anomalous step (epoch %d step %d): non-finite "
+                    "loss/grads or grad-norm over PT_GUARD_MAX_GNORM — "
+                    "update skipped in-graph (consecutive: %d/%d, "
+                    "policy=%s)", epoch_id, step0 + i, self._bad_streak,
+                    patience, self._guard_policy)
+                if self._bad_streak < patience:
+                    continue
+                if self._guard_policy == "raise":
+                    raise guard_mod.StepAnomalyError(
+                        f"{self._bad_streak} consecutive anomalous steps "
+                        f"(last: epoch {epoch_id} step {step0 + i}); "
+                        "weights were never touched (guarded update) — "
+                        "set FLAGS_check_nan_inf=1 to name the generating "
+                        "primitive, or PT_GUARD=skip|rollback to recover "
+                        "in place")
+                if self._guard_policy == "rollback":
+                    raise guard_mod.RollbackSignal(epoch_id, step0 + i,
+                                                   self._bad_streak)
+                # skip: nothing to undo — the select kept the old state
+
+    def _guard_rollback(self, rb) -> None:
+        """Restore the newest verified checkpoint serial + resume point.
+
+        A rollback that trips AGAIN at the same (epoch, step) is a
+        deterministically-replaying anomaly (bad input shard, diverged
+        config): restoring once more would replay into the identical
+        failure forever — even when the replay between the restore point
+        and the anomaly is healthy — so escalate to StepAnomalyError
+        instead of rollback-looping."""
+        import logging
+        import jax
+        from .resilience import guard as guard_mod
+        if self._last_rollback_at == (rb.epoch, rb.step):
+            raise guard_mod.StepAnomalyError(
+                f"the anomaly at epoch {rb.epoch} step {rb.step} recurred "
+                "after rolling back — the failure replays "
+                "deterministically (bad input shard or diverged config); "
+                "refusing to rollback-loop") from rb
+        ckpt_dir = self.checkpoint_cfg.checkpoint_dir
+        # verified selection: quarantines corrupt serials, falls back to
+        # the newest one that actually restores (PR 2 manifests)
+        serial = io_mod.get_latest_checkpoint_serial(ckpt_dir)
+        if serial < 0:
+            raise guard_mod.StepAnomalyError(
+                "PT_GUARD=rollback: no verified checkpoint serial to roll "
+                f"back to in {ckpt_dir!r}") from rb
+        self.checkpoint_cfg.epoch_id = 0
+        self.checkpoint_cfg.step_id = 0
+        with scope_guard(self.scope):
+            args = io_mod.load_checkpoint(
+                self.exe, ckpt_dir, serial, self.train_program,
+                trainer_id=jax.process_index(), scope=self.scope,
+                verify=False)
+        if not args:
+            # a serial without trainer_args (foreign/legacy writer) has
+            # no resume point: restoring its weights but restarting at
+            # epoch 0 step 0 would silently replay trained data with a
+            # shifted step numbering — the bit-exact contract is
+            # unsatisfiable, so fail loudly instead
+            raise guard_mod.StepAnomalyError(
+                f"PT_GUARD=rollback: checkpoint serial {serial} in "
+                f"{ckpt_dir!r} carries no trainer_args (resume point) — "
+                "cannot roll back bit-exactly to a checkpoint this "
+                "trainer did not write") from rb
+        self._restore_trainer_args(args)
+        self.checkpoint_cfg.load_serial = serial
+        self._pending_health = []
+        self._bad_streak = 0
+        self._guard_rollbacks += 1
+        self._last_rollback_at = (rb.epoch, rb.step)
+        logging.getLogger("paddle_tpu").warning(
+            "[guard] %d consecutive anomalous steps (epoch %d step %d): "
+            "rolled back to verified checkpoint serial %d — resuming at "
+            "epoch %d step %d", rb.streak, rb.epoch, rb.step, serial,
+            self.checkpoint_cfg.epoch_id, self.checkpoint_cfg.step_id)
+
     def _train_impl(self, num_epochs, event_handler, reader, feed_order,
                     double_buffer, steps_per_loop, DeviceFeeder, faults,
                     log_every=1):
-        from .core.async_fetch import materialize
+        from .core.async_fetch import materialize, LazyFetch
+        guard_on = bool(self._guard_policy)
         with scope_guard(self.scope):
             feed_vars = self._feed_vars(feed_order)
             feeder = DataFeeder(feed_vars, program=self.train_program)
@@ -368,25 +532,49 @@ class Trainer:
                                        local_ids_key=ids_name)
             ht_fetch = [gv for _t, gv, _i in self._host_tables]
 
-            def _apply_host_grads(outs, stacked_steps=0):
+            def _apply_host_grads(outs, stacked_steps=0, health=None):
                 """Split host-table rows-grads off the fetch results and
                 scatter them into the tables (FIFO order inside a stacked
                 window). Host tables are host-RAM by definition, so the
-                grads materialize here — a deliberate sync."""
+                grads materialize here — a deliberate sync. Under the
+                guard the same health flag gates each apply (a NaN
+                rows-grad must not scatter into the table); reading it
+                here costs nothing extra — this path already syncs."""
                 if not ht_fetch:
                     return outs
                 grads = outs[len(outs) - len(ht_fetch):]
                 outs = outs[:len(outs) - len(ht_fetch)]
+                gate = None
+                if health is not None:
+                    # host-sync: ok — host-RAM scatter (already per-step)
+                    gate = np.ravel(np.asarray(health)).astype(bool)
                 for (t, _gv, _i), g in zip(self._host_tables, grads):
                     g = np.asarray(g)  # host-sync: ok — host-RAM scatter
                     if stacked_steps:
                         for k in range(stacked_steps):
-                            t.apply_grad(g[k])
-                    else:
+                            if gate is None or gate[min(k, len(gate) - 1)]:
+                                t.apply_grad(g[k])
+                    elif gate is None or gate[0]:
                         t.apply_grad(g)
                 return outs
 
-            def _run_window(feed, fetch, n):
+            def _strip_health(outs, epoch_id, step0, n):
+                """Pop the guard's appended health fetch (always LAST),
+                queue it for the next boundary drain, and annotate every
+                handle with (epoch, step) provenance for deferred-error
+                context and watchdog dumps."""
+                health = None
+                if guard_on:
+                    health, outs = outs[-1], list(outs[:-1])
+                    self._pending_health.append((epoch_id, step0, n, health))
+                for m in outs:
+                    if isinstance(m, LazyFetch):
+                        m.annotate(epoch=epoch_id, step=step0)
+                if isinstance(health, LazyFetch):
+                    health.annotate(epoch=epoch_id, step=step0)
+                return outs, health
+
+            def _run_window(feed, fetch, n, epoch_id, step0):
                 # ParallelExecutor.run_loop scans the SAME sharded step
                 # (mesh-parallel fast path); Executor.run_loop is the
                 # single-chip one — same windowed semantics either way.
@@ -397,22 +585,27 @@ class Trainer:
                 if self.parallel:
                     outs = executor.run_loop(fetch_list=full, feed=feed,
                                              n_steps=n, per_step_feeds=True,
-                                             lazy=True)
+                                             lazy=True, guard=guard_on)
                 else:
                     outs = executor.run_loop(self.train_program, feed=feed,
                                              fetch_list=full, n_steps=n,
-                                             per_step_feeds=True, lazy=True)
-                return _apply_host_grads(outs, stacked_steps=n)
+                                             per_step_feeds=True, lazy=True,
+                                             guard=guard_on)
+                outs, health = _strip_health(outs, epoch_id, step0, n)
+                return _apply_host_grads(outs, stacked_steps=n,
+                                         health=health)
 
-            def _run_one(feed, fetch):
+            def _run_one(feed, fetch, epoch_id, step_id):
                 full = list(fetch) + ht_fetch
                 if self.parallel:
                     outs = executor.run(fetch_list=full, feed=feed,
-                                        lazy=True)
+                                        lazy=True, guard=guard_on)
                 else:
                     outs = executor.run(self.train_program, feed=feed,
-                                        fetch_list=full, lazy=True)
-                return _apply_host_grads(outs)
+                                        fetch_list=full, lazy=True,
+                                        guard=guard_on)
+                outs, health = _strip_health(outs, epoch_id, step_id, 1)
+                return _apply_host_grads(outs, health=health)
             for epoch_id in range(start_epoch, num_epochs):
                 # mid-epoch resume: the checkpoint recorded the NEXT step
                 # to run; skip that many batches (undelivered — no events
@@ -462,33 +655,45 @@ class Trainer:
                         fetch = (self.train_func_outputs
                                  if begin.fetch_metrics else [])
                         if isinstance(window, dict):
-                            metrics = _run_window(window, fetch, n_in_window)
+                            metrics = _run_window(window, fetch, n_in_window,
+                                                  epoch_id, step_id)
                         else:
                             # fragment windows (shape-change flush, epoch
                             # tail) run per-step: one compiled loop variant
                             # only, no per-length recompiles
-                            per = [_run_one(f, fetch) for f in window]
+                            per = [_run_one(f, fetch, epoch_id, step_id + k)
+                                   for k, f in enumerate(window)]
                             # host-sync: ok — fragment stacking (rare path)
                             metrics = [np.stack(ms) for ms in zip(*per)] \
                                 if per and fetch else []
-                        if (step_id % log_every == 0
-                                or step_id // log_every
-                                != (step_id + n_in_window - 1) // log_every):
+                        log_boundary = (
+                            step_id % log_every == 0
+                            or step_id // log_every
+                            != (step_id + n_in_window - 1) // log_every)
+                        if log_boundary:
                             # window contains a log step: hand the event
                             # handler real numpy, not lazy handles
                             metrics = materialize(metrics)
                         event_handler(EndStepEvent(epoch_id, step_id,
                                                    metrics))
+                        if log_boundary:
+                            self._drain_health()
                         prev_step, step_id = step_id, step_id + n_in_window
                         iv = (self.checkpoint_cfg.step_interval
                               if self.checkpoint_cfg else 0)
                         saved = bool(iv and prev_step // iv != step_id // iv)
                         if saved:
+                            # anomalies must be adjudicated BEFORE a new
+                            # serial commits: a rollback target saved
+                            # mid-bad-streak would skip the sacrificed
+                            # steps on replay
+                            self._drain_health()
                             self._save_checkpoint(epoch_id, step_id)
                         if self._preempt_exit(epoch_id, step_id, saved,
                                               agree=saved):
                             return
                     event_handler(EndEpochEvent(epoch_id))
+                    self._drain_health()
                     saved = self._epoch_checkpoint(epoch_id)
                     if self._preempt_exit(epoch_id + 1, 0, saved):
                         return
@@ -498,10 +703,12 @@ class Trainer:
                     begin = BeginStepEvent(epoch_id, step_id)
                     event_handler(begin)
                     fetch = self.train_func_outputs if begin.fetch_metrics else []
-                    metrics = _run_one(feed, fetch)
+                    metrics = _run_one(feed, fetch, epoch_id, step_id)
                     if step_id % log_every == 0:
                         metrics = materialize(metrics)
                     event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                    if step_id % log_every == 0:
+                        self._drain_health()
                     # crossing semantics, matching the windowed path: fire
                     # every `step_interval` COMPLETED steps. The args
                     # record step_id+1 — the NEXT step to run — and resume
@@ -512,11 +719,13 @@ class Trainer:
                           if self.checkpoint_cfg else 0)
                     saved = bool(iv and step_id // iv != (step_id + 1) // iv)
                     if saved:
+                        self._drain_health()
                         self._save_checkpoint(epoch_id, step_id + 1)
                     if self._preempt_exit(epoch_id, step_id + 1, saved,
                                           agree=saved):
                         return
                 event_handler(EndEpochEvent(epoch_id))
+                self._drain_health()
                 saved = self._epoch_checkpoint(epoch_id)
                 if self._preempt_exit(epoch_id + 1, 0, saved):
                     return

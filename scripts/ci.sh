@@ -20,13 +20,16 @@ fi
 
 if [[ "${1:-}" == "chaos" ]]; then
   # chaos leg: the resilience suite (fault injection, verified
-  # checkpoints, preemption/resume parity) replayed under two fixed
-  # seeds — probabilistic fault plans (site@pP) draw differently per
-  # seed, so the recovery invariants are exercised on two distinct
-  # failure schedules, both reproducible.
+  # checkpoints, preemption/resume parity) + the guardrail suite
+  # (in-graph step health, guarded updates, skip/rollback/raise
+  # policies, step watchdog) replayed under two fixed seeds —
+  # probabilistic fault plans (site@pP) draw differently per seed, so
+  # the recovery invariants are exercised on two distinct failure
+  # schedules, both reproducible.
   for seed in 0 7; do
-    echo "== chaos: resilience suite (PT_CHAOS_SEED=$seed) =="
-    PT_CHAOS_SEED=$seed python -m pytest tests/test_resilience.py -q
+    echo "== chaos: resilience + guardrail suites (PT_CHAOS_SEED=$seed) =="
+    PT_CHAOS_SEED=$seed python -m pytest tests/test_resilience.py \
+      tests/test_guardrails.py -q
   done
   echo "CHAOS OK"
   exit 0

@@ -1,0 +1,579 @@
+"""Training guardrails: in-graph step health, guarded updates, recovery
+policies, and the step watchdog (resilience/guard.py + watchdog.py).
+
+Every anomaly here is DRIVEN — the in-graph fault sites `nan_loss` /
+`nan_grad` and the watchdog's `step_hang` ride the same deterministic
+PT_FAULT_INJECT plans as the PR-2 chaos suite, so each recovery path is
+provable under seeds (scripts/ci.sh chaos replays this file under two
+PT_CHAOS_SEED values; the probabilistic-plan draw order is covered in
+test_resilience.py — here the plans are exact-step on purpose, the
+invariants are about WHAT recovery does, not when)."""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.async_fetch import LazyFetch
+from paddle_tpu.flags import FLAGS
+from paddle_tpu.resilience import (StepAnomalyError, StepHungError, faults,
+                                   guard, watchdog)
+
+CHAOS_SEED = int(os.environ.get("PT_CHAOS_SEED", "0"))
+
+
+@pytest.fixture(autouse=True)
+def fresh_fault_plan(monkeypatch):
+    """No armed plan, fresh hit counters, no leaked guard/watchdog env."""
+    for var in ("PT_FAULT_INJECT", "PT_GUARD", "PT_GUARD_PATIENCE",
+                "PT_GUARD_MAX_GNORM", "PT_STEP_DEADLINE_S"):
+        monkeypatch.delenv(var, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv("PT_FAULT_INJECT", spec)
+    faults.reset()
+
+
+def _build_program(instrumented=True):
+    """A tiny regression program; instrumented=True appends step_health
+    the way PT_GUARD does at minimize time."""
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+    if instrumented:
+        guard.instrument(main)
+    return main, startup, loss
+
+
+def _feed(seed=0, batch=4):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(batch, 4).astype(np.float32)
+    return {"x": x, "y": (x.sum(1, keepdims=True) * 0.3).astype(np.float32)}
+
+
+def _params(scope, main):
+    return {n: np.asarray(scope.find_var(n))
+            for n in sorted(main.global_block.vars)
+            if scope.has_var(n) and main.global_block.var(n).persistable}
+
+
+# ---------------------------------------------------------------------------
+# knobs + instrumentation plumbing
+# ---------------------------------------------------------------------------
+
+class TestKnobs:
+    def test_policy_parsing(self, monkeypatch):
+        assert guard.policy() is None
+        for off in ("", "0", "off", "none"):
+            monkeypatch.setenv("PT_GUARD", off)
+            assert guard.policy() is None
+        for pol in guard.POLICIES:
+            monkeypatch.setenv("PT_GUARD", pol)
+            assert guard.policy() == pol
+        monkeypatch.setenv("PT_GUARD", "retry")
+        with pytest.raises(guard.GuardConfigError, match="unknown policy"):
+            guard.policy()
+
+    def test_patience_and_gnorm_validation(self, monkeypatch):
+        assert guard.patience() == 3
+        assert guard.max_gnorm() == float("inf")
+        monkeypatch.setenv("PT_GUARD_PATIENCE", "0")
+        with pytest.raises(guard.GuardConfigError):
+            guard.patience()
+        monkeypatch.setenv("PT_GUARD_MAX_GNORM", "-1")
+        with pytest.raises(guard.GuardConfigError):
+            guard.max_gnorm()
+
+    def test_env_knobs_declared(self):
+        for knob in ("PT_GUARD", "PT_GUARD_PATIENCE", "PT_GUARD_MAX_GNORM",
+                     "PT_STEP_DEADLINE_S"):
+            assert knob in pt.flags.ENV_KNOBS
+
+    def test_minimize_instruments_only_under_pt_guard(self, monkeypatch):
+        main, _, _ = _build_program(instrumented=False)
+        assert not guard.is_instrumented(main)
+        monkeypatch.setenv("PT_GUARD", "skip")
+        main2, _, _ = _build_program(instrumented=False)
+        assert guard.is_instrumented(main2)
+        # idempotent: a second instrument leaves exactly one health op
+        guard.instrument(main2)
+        assert sum(op.type == guard.HEALTH_OP
+                   for op in main2.global_block.ops) == 1
+
+    def test_unguarded_program_raises_clearly(self):
+        main, startup, loss = _build_program(instrumented=False)
+        exe = pt.Executor()
+        exe.run(startup)
+        with pytest.raises(guard.GuardConfigError, match="step_health"):
+            exe.run(main, feed=_feed(), fetch_list=[loss], guard=True)
+
+
+# ---------------------------------------------------------------------------
+# in-graph health flag + guarded update (executor level)
+# ---------------------------------------------------------------------------
+
+class TestGuardedStep:
+    def _run_steps(self, n, guard_on, program_bits, seed0=0):
+        main, startup, loss = program_bits
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            healths = []
+            for i in range(n):
+                outs = exe.run(main, feed=_feed(seed0 + i), fetch_list=[loss],
+                               guard=guard_on, lazy=True)
+                if guard_on:
+                    healths.append(bool(np.asarray(outs[-1])))
+            return _params(scope, main), healths
+
+    def test_guard_on_matches_guard_off_bit_exact_when_healthy(self):
+        want, _ = self._run_steps(6, False, _build_program(False))
+        got, healths = self._run_steps(6, True, _build_program(True))
+        assert healths == [True] * 6
+        assert set(got) == set(want)
+        for name in want:
+            np.testing.assert_array_equal(
+                got[name], want[name],
+                err_msg=f"{name}: guarded update diverged on a healthy run")
+
+    @pytest.mark.parametrize("site", ["nan_loss", "nan_grad"])
+    def test_injected_anomaly_skips_update_exactly(self, monkeypatch, site):
+        main, startup, loss = _build_program()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            exe.run(main, feed=_feed(0), fetch_list=[loss], guard=True)
+            before = _params(scope, main)
+            _arm(monkeypatch, f"{site}@1")
+            outs = exe.run(main, feed=_feed(1), fetch_list=[loss],
+                           guard=True, lazy=True)
+            assert not bool(np.asarray(outs[-1]))
+            loss_val = float(outs[0])
+            if site == "nan_loss":
+                assert np.isnan(loss_val)
+            else:  # grads poisoned, the loss itself stays finite
+                assert np.isfinite(loss_val)
+            after = _params(scope, main)
+            for name in before:  # params AND momentum accumulators kept
+                np.testing.assert_array_equal(
+                    before[name], after[name],
+                    err_msg=f"{name}: anomalous step touched state")
+            _arm(monkeypatch, "")
+            exe.run(main, feed=_feed(2), fetch_list=[loss], guard=True)
+            resumed = _params(scope, main)
+            assert any(not np.array_equal(after[n], resumed[n])
+                       for n in after), "healthy step after skip must train"
+
+    def test_gnorm_ceiling_trips_guard_on_finite_grads(self, monkeypatch):
+        main, startup, loss = _build_program()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            monkeypatch.setenv("PT_GUARD_MAX_GNORM", "1e-12")
+            outs = exe.run(main, feed=_feed(0), fetch_list=[loss],
+                           guard=True, lazy=True)
+            assert np.isfinite(float(outs[0]))
+            assert not bool(np.asarray(outs[-1]))
+
+    def test_max_gnorm_change_recompiles_not_stale(self, monkeypatch):
+        main, startup, loss = _build_program()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            outs = exe.run(main, feed=_feed(0), fetch_list=[loss],
+                           guard=True, lazy=True)
+            assert bool(np.asarray(outs[-1]))
+            # the ceiling is traced in: a changed env value must hit a new
+            # cache entry, not replay the inf-threshold executable
+            monkeypatch.setenv("PT_GUARD_MAX_GNORM", "1e-12")
+            outs = exe.run(main, feed=_feed(0), fetch_list=[loss],
+                           guard=True, lazy=True)
+            assert not bool(np.asarray(outs[-1]))
+
+    def test_gnorm_is_measured_pre_clip(self, monkeypatch):
+        """Gradient clipping must not mask the explosion: the health op
+        sits BEFORE the clip rewrites of the @GRAD names, so the ceiling
+        sees the raw norm even when the update consumes a clipped one."""
+        pt.core.program.reset_unique_names()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            y = layers.data("y", [1])
+            pred = layers.fc(x, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.clip.set_gradient_clip(
+                pt.clip.GradientClipByGlobalNorm(clip_norm=1e-3))
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+        guard.instrument(main)
+        # autodiff -> health -> clip/update: position, not just dataflow
+        op_types = [op.type for op in main.global_block.ops]
+        assert (op_types.index(guard.HEALTH_OP)
+                == op_types.index("autodiff") + 1)
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            # ceiling sits between the clipped norm (<= 1e-3) and the raw
+            # norm: post-clip measurement would report healthy
+            monkeypatch.setenv("PT_GUARD_MAX_GNORM", "0.01")
+            outs = exe.run(main, feed=_feed(0), fetch_list=[loss],
+                           guard=True, lazy=True)
+            assert not bool(np.asarray(outs[-1]))
+
+    def test_run_loop_reports_per_step_health(self, monkeypatch):
+        main, startup, loss = _build_program()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            _arm(monkeypatch, "nan_loss@2")
+            stacked = {k: np.stack([_feed(i)[k] for i in range(3)])
+                       for k in _feed(0)}
+            outs = exe.run_loop(main, feed=stacked, fetch_list=[loss],
+                                n_steps=3, per_step_feeds=True, guard=True,
+                                lazy=True)
+            health = np.asarray(outs[-1])
+            assert health.tolist() == [True, False, True]
+            losses = np.asarray(outs[0]).ravel()
+            assert np.isnan(losses[1]) and np.isfinite(losses[[0, 2]]).all()
+
+    def test_guard_wins_over_checkify_and_warns_once(self, monkeypatch):
+        main, startup, loss = _build_program()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            monkeypatch.setattr(FLAGS, "check_nan_inf", True)
+            guard._checkify_warned.clear()
+            _arm(monkeypatch, "nan_loss@1")
+            with pytest.warns(UserWarning, match="check_nan_inf"):
+                outs = exe.run(main, feed=_feed(0), fetch_list=[loss],
+                               guard=True, lazy=True)
+            # checkify would RAISE on the NaN; the guard skips instead
+            assert not bool(np.asarray(outs[-1]))
+            monkeypatch.setattr(FLAGS, "check_nan_inf", False)
+
+
+class TestGuardedParallelStep:
+    def test_sharded_guarded_update_skips_anomalous_step(self, monkeypatch):
+        pt.core.program.reset_unique_names()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [8])
+            y = layers.data("y", [1])
+            pred = layers.fc(x, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+        guard.instrument(main)
+        exe = pt.Executor()
+        exe.run(startup)
+        pe = pt.ParallelExecutor(loss_name=loss.name, main_program=main)
+        rs = np.random.RandomState(CHAOS_SEED)
+        feed = {"x": rs.rand(8, 8).astype(np.float32),
+                "y": rs.rand(8, 1).astype(np.float32)}
+        outs = pe.run(fetch_list=[loss], feed=feed, lazy=True, guard=True)
+        assert bool(np.asarray(outs[-1]))
+        scope = pt.global_scope()
+        before = np.asarray(scope.find_var("fc_0.w_0"))
+        _arm(monkeypatch, "nan_grad@1")
+        outs = pe.run(fetch_list=[loss], feed=feed, lazy=True, guard=True)
+        assert not bool(np.asarray(outs[-1]))
+        np.testing.assert_array_equal(
+            before, np.asarray(scope.find_var("fc_0.w_0")),
+            err_msg="sharded anomalous step touched the weights")
+
+
+# ---------------------------------------------------------------------------
+# trainer policy engine
+# ---------------------------------------------------------------------------
+
+N_STEPS = 8
+BATCH = 4
+STEP_INTERVAL = 3
+
+
+def _det_reader():
+    rs = np.random.RandomState(97 + CHAOS_SEED)
+    data = [(rs.randn(4).astype(np.float32),
+             rs.randn(1).astype(np.float32) * 0.1)
+            for _ in range(N_STEPS * BATCH)]
+
+    def reader():
+        yield from data
+    return reader
+
+
+def _make_trainer(ckpt_dir=None, **cfg_kw):
+    pt.core.program.reset_unique_names()
+
+    def train_func():
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        return [layers.mean(layers.square_error_cost(pred, y))]
+
+    cfg = (pt.CheckpointConfig(ckpt_dir, step_interval=STEP_INTERVAL,
+                               **cfg_kw)
+           if ckpt_dir else None)
+    return pt.Trainer(train_func, lambda: pt.optimizer.SGDOptimizer(0.05),
+                      checkpoint_config=cfg)
+
+
+def _train(trainer, steps_seen=None, steps_per_loop=1, on_step=None):
+    def handler(event):
+        if isinstance(event, pt.EndStepEvent):
+            if steps_seen is not None:
+                steps_seen.append((event.epoch, event.step))
+            if on_step is not None:
+                on_step(event)
+    trainer.train(num_epochs=1, event_handler=handler,
+                  reader=pt.reader.batch(_det_reader(), BATCH),
+                  steps_per_loop=steps_per_loop)
+
+
+def _final_params(trainer):
+    with pt.scope_guard(trainer.scope):
+        return {v.name: np.asarray(trainer.scope.find_var(v.name))
+                for v in trainer.train_program.global_block.all_parameters()}
+
+
+class TestTrainerSkipPolicy:
+    def test_skip_sacrifices_the_batch_and_trains_on(self, monkeypatch,
+                                                     caplog):
+        monkeypatch.setenv("PT_GUARD", "skip")
+        tr = _make_trainer()
+        snaps = {}
+
+        def snap(event):
+            with pt.scope_guard(tr.scope):
+                snaps[event.step] = np.asarray(
+                    tr.scope.find_var("fc_0.w_0")).copy()
+        _arm(monkeypatch, "nan_loss@4")  # hit 4 = step index 3
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu"):
+            _train(tr, on_step=snap)
+        # the anomalous step's update was skipped in-graph ...
+        np.testing.assert_array_equal(snaps[3], snaps[2])
+        # ... while neighbors trained
+        assert not np.array_equal(snaps[2], snaps[1])
+        assert not np.array_equal(snaps[4], snaps[3])
+        assert any("anomalous step (epoch 0 step 3)" in r.message
+                   for r in caplog.records)
+
+    def test_windowed_path_reports_the_inner_step(self, monkeypatch, caplog):
+        monkeypatch.setenv("PT_GUARD", "skip")
+        tr = _make_trainer()
+        _arm(monkeypatch, "nan_loss@6")  # window 1 (steps 4..7), offset 1
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu"):
+            _train(tr, steps_per_loop=4)
+        assert any("anomalous step (epoch 0 step 5)" in r.message
+                   for r in caplog.records)
+
+    def test_guard_env_after_construction_is_a_config_error(self,
+                                                            monkeypatch):
+        tr = _make_trainer()  # built WITHOUT PT_GUARD
+        monkeypatch.setenv("PT_GUARD", "skip")
+        with pytest.raises(guard.GuardConfigError, match="before"):
+            _train(tr)
+
+
+class TestTrainerRaisePolicy:
+    def test_raises_after_patience_consecutive_anomalies(self, monkeypatch):
+        monkeypatch.setenv("PT_GUARD", "raise")
+        monkeypatch.setenv("PT_GUARD_PATIENCE", "2")
+        tr = _make_trainer()
+        _arm(monkeypatch, "nan_loss@3,nan_loss@4")
+        with pytest.raises(StepAnomalyError, match="2 consecutive"):
+            _train(tr)
+
+    def test_nonconsecutive_anomalies_do_not_raise(self, monkeypatch):
+        monkeypatch.setenv("PT_GUARD", "raise")
+        monkeypatch.setenv("PT_GUARD_PATIENCE", "2")
+        tr = _make_trainer()
+        _arm(monkeypatch, "nan_loss@2,nan_loss@5")  # streak never reaches 2
+        _train(tr)  # completes
+
+
+class TestTrainerRollbackPolicy:
+    def test_rollback_needs_checkpoint_config(self, monkeypatch):
+        monkeypatch.setenv("PT_GUARD", "rollback")
+        tr = _make_trainer()
+        with pytest.raises(guard.GuardConfigError, match="CheckpointConfig"):
+            _train(tr)
+
+    def test_rollback_resumes_bit_exact_vs_uninterrupted(self, tmp_path,
+                                                         monkeypatch):
+        monkeypatch.setenv("PT_GUARD", "rollback")
+        monkeypatch.setenv("PT_GUARD_PATIENCE", "2")
+        # A: clean guarded run
+        a = _make_trainer(str(tmp_path / "a"))
+        _train(a)
+        want = _final_params(a)
+
+        # B: steps 3 and 4 poisoned -> patience hit at the step-4 drain ->
+        # rollback to the serial committed at step boundary 3 -> steps
+        # 3..7 replay CLEAN (the one-shot plan hits are spent)
+        b = _make_trainer(str(tmp_path / "b"))
+        steps = []
+        _arm(monkeypatch, "nan_loss@4,nan_loss@5")
+        _train(b, steps_seen=steps)
+        assert b._guard_rollbacks == 1
+        # events: 0..4 pre-rollback, then the replay from the restored
+        # resume point
+        assert steps[:5] == [(0, s) for s in range(5)]
+        assert steps[5] == (0, STEP_INTERVAL)
+        assert steps[-1] == (0, N_STEPS - 1)
+        got = _final_params(b)
+        assert set(got) == set(want)
+        for name in want:
+            np.testing.assert_array_equal(
+                got[name], want[name],
+                err_msg=f"{name}: rollback recovery diverged from the "
+                        "uninterrupted run")
+
+    def test_rollback_without_any_serial_escalates(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv("PT_GUARD", "rollback")
+        monkeypatch.setenv("PT_GUARD_PATIENCE", "2")
+        tr = _make_trainer(str(tmp_path / "ck"))
+        _arm(monkeypatch, "nan_loss@1,nan_loss@2")  # before any checkpoint
+        with pytest.raises(StepAnomalyError, match="no verified checkpoint"):
+            _train(tr)
+
+    def test_persistent_anomaly_refuses_rollback_loop(self, tmp_path,
+                                                      monkeypatch):
+        monkeypatch.setenv("PT_GUARD", "rollback")
+        monkeypatch.setenv("PT_GUARD_PATIENCE", "2")
+        tr = _make_trainer(str(tmp_path / "ck"))
+        # every step from 3 on is anomalous: rollback once, replay is
+        # still anomalous with no healthy step in between -> escalate
+        _arm(monkeypatch, ",".join(f"nan_loss@{h}" for h in range(4, 12)))
+        with pytest.raises(StepAnomalyError, match="rollback-loop"):
+            _train(tr)
+        assert tr._guard_rollbacks == 1
+
+    def test_rollback_to_foreign_serial_fails_loudly(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setenv("PT_GUARD", "rollback")
+        monkeypatch.setenv("PT_GUARD_PATIENCE", "2")
+        tr = _make_trainer(str(tmp_path / "ck"))
+        # a verified serial WITHOUT trainer_args (foreign writer): its
+        # weights restore but there is no resume point — rolling back to
+        # it cannot be bit-exact, so the trainer must refuse
+        with pt.scope_guard(tr.scope):
+            pt.io.save_checkpoint(tr.exe, str(tmp_path / "ck"),
+                                  main_program=tr.train_program,
+                                  scope=tr.scope)
+        _arm(monkeypatch, "nan_loss@1,nan_loss@2")
+        with pytest.raises(StepAnomalyError, match="trainer_args"):
+            _train(tr)
+
+    def test_recurrence_after_healthy_replay_still_escalates(self, tmp_path,
+                                                             monkeypatch):
+        monkeypatch.setenv("PT_GUARD", "rollback")
+        monkeypatch.setenv("PT_GUARD_PATIENCE", "1")
+        tr = _make_trainer(str(tmp_path / "ck"))
+        # step 4 NaNs (hit 5) -> rollback to the step-3 serial; the
+        # replayed step 3 (hit 6) is HEALTHY, then step 4 NaNs again
+        # (hit 7): the anomaly recurred at the same (epoch, step), so a
+        # second rollback would loop deterministically — escalate even
+        # though healthy steps landed in between
+        _arm(monkeypatch, "nan_loss@5,nan_loss@7")
+        with pytest.raises(StepAnomalyError, match="recurred"):
+            _train(tr)
+        assert tr._guard_rollbacks == 1
+
+
+# ---------------------------------------------------------------------------
+# step watchdog + deferred-error provenance
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def test_malformed_deadline_fails_at_train_start(self, monkeypatch):
+        monkeypatch.setenv("PT_STEP_DEADLINE_S", "5s")
+        tr = _make_trainer()
+        with pytest.raises(ValueError, match="PT_STEP_DEADLINE_S"):
+            _train(tr)
+
+    def test_unarmed_watchdog_is_a_plain_wait(self):
+        main, startup, loss = _build_program()
+        exe = pt.Executor()
+        exe.run(startup)
+        (out,) = exe.run(main, feed=_feed(0), fetch_list=[loss], lazy=True)
+        assert np.isfinite(float(out))
+
+    def test_hung_step_raises_with_phase_and_provenance(self, monkeypatch):
+        main, startup, loss = _build_program()
+        exe = pt.Executor()
+        exe.run(startup)
+        (out,) = exe.run(main, feed=_feed(0), fetch_list=[loss], lazy=True)
+        monkeypatch.setenv("PT_STEP_DEADLINE_S", "0.3")
+        _arm(monkeypatch, "step_hang@1")
+        with pytest.raises(StepHungError) as ei:
+            out.annotate(epoch=1, step=41).numpy()
+        msg = str(ei.value)
+        assert "phase 'device'" in msg           # names the stuck phase
+        assert "epoch=1" in msg and "step=41" in msg
+        assert "fetch=" in msg                   # executor-named fetch
+        assert "dispatch_s" in msg               # PhaseTimer dump rode along
+
+    def test_settling_within_deadline_is_transparent(self, monkeypatch):
+        main, startup, loss = _build_program()
+        exe = pt.Executor()
+        exe.run(startup)
+        monkeypatch.setenv("PT_STEP_DEADLINE_S", "30")
+        (out,) = exe.run(main, feed=_feed(0), fetch_list=[loss], lazy=True)
+        assert np.isfinite(float(out))
+
+
+class TestDeferredErrorProvenance:
+    def test_materialization_error_names_epoch_step_fetch(self, monkeypatch):
+        class FakeDeviceError(RuntimeError):
+            pass
+
+        def boom(_):
+            raise FakeDeviceError("INTERNAL: device halted")
+
+        lf = LazyFetch(np.float32(1.0),
+                       provenance={"fetch": "mean_0.tmp_0"})
+        lf.annotate(epoch=2, step=17)
+        monkeypatch.setattr(jax, "block_until_ready", boom)
+        with pytest.raises(FakeDeviceError) as ei:  # type is preserved
+            lf.numpy()
+        text = str(ei.value) + "".join(getattr(ei.value, "__notes__", []))
+        assert "epoch=2" in text and "step=17" in text
+        assert "mean_0.tmp_0" in text
+
+    def test_trainer_annotates_lazy_metrics(self, monkeypatch):
+        monkeypatch.setenv("PT_GUARD", "skip")
+        tr = _make_trainer()
+        seen = []
+
+        def grab(event):
+            for m in event.metrics:
+                if isinstance(m, LazyFetch):
+                    seen.append(m.provenance)
+        tr.train(num_epochs=1, event_handler=lambda e: (
+                     grab(e) if isinstance(e, pt.EndStepEvent) else None),
+                 reader=pt.reader.batch(_det_reader(), BATCH),
+                 log_every=4)  # off-boundary steps stay lazy
+        assert seen, "expected lazy metrics between log boundaries"
+        assert all("fetch" in p and "epoch" in p and "step" in p
+                   for p in seen)
