@@ -43,6 +43,7 @@ from . import flags
 from .flags import FLAGS
 from . import debugger
 from . import resilience
+from . import serving
 from .utils import profiler
 from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent)

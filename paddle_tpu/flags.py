@@ -184,6 +184,26 @@ declare_env_knob("PT_STEP_DEADLINE_S",
                  "seconds raises StepHungError with the stuck phase + "
                  "in-flight fetch provenance instead of hanging forever "
                  "(unset/0 = off)")
+declare_env_knob("PT_SERVE_MAX_BATCH",
+                 "serving engine (paddle_tpu/serving/): micro-batch "
+                 "coalescing bound per dispatch (default: the serving "
+                 "artifact's exported batch size; always clamped to it)")
+declare_env_knob("PT_SERVE_MAX_WAIT_MS",
+                 "serving engine: how long the micro-batcher holds an "
+                 "under-filled batch open waiting for more requests "
+                 "before dispatching anyway (default 2 ms). Bounds "
+                 "added latency; raise it to trade p50 latency for "
+                 "batch fill under light load")
+declare_env_knob("PT_SERVE_QUEUE_DEPTH",
+                 "serving engine: bounded request queue per model "
+                 "(default 256). A full queue rejects fast with the "
+                 "typed Overloaded error instead of queuing into "
+                 "timeout")
+declare_env_knob("PT_SERVE_DEADLINE_MS",
+                 "serving engine: default per-request deadline (0 = "
+                 "none). Expired or provably-unmeetable deadlines shed "
+                 "fast with the typed DeadlineExceeded error; "
+                 "per-request deadline_ms overrides")
 declare_env_knob("PT_COMPILE_CACHE",
                  "persistent XLA compile cache (core/compile_cache.py): "
                  "unset/0 = off, 1 = ~/.cache/paddle_tpu/xla_cache, "

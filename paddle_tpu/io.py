@@ -14,7 +14,7 @@ import json
 import os
 import re
 import shutil
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -572,16 +572,30 @@ def load_inference_model(dirname: str, executor=None,
 
 def export_serving_model(dirname: str, feeded_var_names: Sequence[str],
                          target_vars, executor=None, main_program=None,
-                         scope: Optional[Scope] = None, batch_size: int = 1):
+                         scope: Optional[Scope] = None, batch_size: int = 1,
+                         length_buckets: Optional[Sequence[int]] = None):
     """Ahead-of-time serving export (≙ the deployment role of
     inference/analysis + PaddlePredictor, paddle_inference_api.h).
 
     Prunes the program to the targets, binds the trained weights as
     CONSTANTS, jit-compiles the forward, and serializes it with
     jax.export (StableHLO). The artifact is self-contained: serving needs
-    only jax + the two files written here — no program interpreter, no
+    only jax + the files written here — no program interpreter, no
     framework, no weight files. Shape-specialized to `batch_size` (XLA
     AOT is static-shape; export per served batch size).
+
+    `length_buckets`: a sorted set of pad bounds for feeds with a
+    symbolic (non-batch) length dim. One artifact is exported PER bucket
+    (``serving_len{L}.stablehlo``) with every symbolic length dim pinned
+    to the bound, so the online engine (paddle_tpu/serving/) serves
+    arbitrary lengths with a bounded executable set — the same lever as
+    reader/bucketing.py on the training side. Without it a symbolic
+    non-batch dim is an error, as before.
+
+    serving.json records, per bucket, the feed AND fetch specs (name /
+    shape / dtype, from the exported module's out_avals) so output
+    introspection exists without running the model and the serving
+    batcher can preallocate scatter buffers.
     """
     import jax
     import jax.numpy as jnp
@@ -613,33 +627,92 @@ def export_serving_model(dirname: str, feeded_var_names: Sequence[str],
         fetches, _ = step(state, env, key)
         return fetches
 
-    example = []
-    feed_meta = []
+    # per-feed shape templates: the leading -1 is layers.data's symbolic
+    # batch dim (pinned to batch_size); any OTHER -1 is a length dim that
+    # needs a bucket bound
+    templates = []
+    var_dims: Dict[str, List[int]] = {}
     for name in feeded_var_names:
         var = pruned.global_block.var(name)
         dims = tuple(int(s) for s in var.shape)
-        if dims and dims[0] == -1:   # layers.data's symbolic batch dim
-            shape = (batch_size,) + dims[1:]
-        else:                        # append_batch_size=False: static shape
-            shape = dims
-        if any(s < 0 for s in shape):
+        shape = list(dims)
+        if shape and shape[0] == -1:
+            shape[0] = batch_size
+        lens = [i for i, s in enumerate(shape) if s < 0]
+        if lens and not length_buckets:
             raise ValueError(
                 f"export_serving_model: feed {name!r} has symbolic dims "
                 f"{dims}; AOT export needs fully static shapes — pad or "
-                "declare the feed with concrete sizes")
-        dt = np_dtype(device_dtype(var.dtype))
-        example.append(jax.ShapeDtypeStruct(shape, dt))
-        feed_meta.append({"name": name, "shape": list(shape),
-                          "dtype": np.dtype(dt).name})
+                "declare the feed with concrete sizes, or pass "
+                "length_buckets=(...) to export one artifact per pad bound")
+        if lens:
+            var_dims[name] = lens
+        templates.append((name, shape, np_dtype(device_dtype(var.dtype)),
+                          bool(dims) and dims[0] == -1))
 
     from .core.compat import jax_export
-    exported = jax_export().export(jax.jit(serve))(*example)
+
+    def _export_one(length: Optional[int]):
+        example, alt, feeds_meta = [], [], []
+        for name, shape, dt, is_batch in templates:
+            concrete = [length if s < 0 else s for s in shape]
+            example.append(jax.ShapeDtypeStruct(tuple(concrete), dt))
+            bumped = list(concrete)
+            if is_batch:
+                bumped[0] = batch_size + 1
+            alt.append(jax.ShapeDtypeStruct(tuple(bumped), dt))
+            feeds_meta.append({"name": name, "shape": concrete,
+                               "dtype": np.dtype(dt).name,
+                               "batch_major": is_batch})
+        exported = jax_export().export(jax.jit(serve))(*example)
+        # ground-truth batch-major flags for the fetches: abstractly
+        # re-evaluate at batch_size+1 and keep only the fetches whose
+        # leading dim TRACKS the batch — a fetch whose leading dim merely
+        # coincides with batch_size must not be scattered per request
+        try:
+            alt_avals = list(jax.eval_shape(serve, *alt))
+        except Exception:  # program pins the batch: shape heuristic only
+            alt_avals = None
+        fetch_meta = []
+        for j, (n, aval) in enumerate(zip(target_names,
+                                          exported.out_avals)):
+            bm = bool(aval.shape) and int(aval.shape[0]) == batch_size
+            if bm and alt_avals is not None:
+                a = alt_avals[j].shape
+                bm = bool(a) and int(a[0]) == batch_size + 1
+            fetch_meta.append({"name": n,
+                               "shape": [int(s) for s in aval.shape],
+                               "dtype": np.dtype(aval.dtype).name,
+                               "batch_major": bm})
+        return exported.serialize(), feeds_meta, fetch_meta
+
     os.makedirs(dirname, exist_ok=True)
-    with open(os.path.join(dirname, "serving.stablehlo"), "wb") as f:
-        f.write(exported.serialize())
+    buckets_meta = []
+    if var_dims and length_buckets:
+        for bound in sorted(int(b) for b in length_buckets):
+            blob, feeds_meta, fetch_meta = _export_one(bound)
+            fn = f"serving_len{bound}.stablehlo"
+            with open(os.path.join(dirname, fn), "wb") as f:
+                f.write(blob)
+            buckets_meta.append({"length": bound, "file": fn,
+                                 "feeds": feeds_meta,
+                                 "fetches": fetch_meta})
+        # compat artifact for single-shape loaders (load_serving_model):
+        # the largest bucket, under the historical filename
+        with open(os.path.join(dirname, "serving.stablehlo"), "wb") as f:
+            f.write(blob)
+        base = buckets_meta[-1]
+    else:
+        blob, feeds_meta, fetch_meta = _export_one(None)
+        with open(os.path.join(dirname, "serving.stablehlo"), "wb") as f:
+            f.write(blob)
+        base = {"length": None, "file": "serving.stablehlo",
+                "feeds": feeds_meta, "fetches": fetch_meta}
+        buckets_meta = [base]
     with open(os.path.join(dirname, "serving.json"), "w") as f:
-        json.dump({"feeds": feed_meta, "fetch_names": target_names,
-                   "batch_size": batch_size}, f)
+        json.dump({"feeds": base["feeds"], "fetch_names": target_names,
+                   "fetches": base["fetches"], "batch_size": batch_size,
+                   "buckets": buckets_meta, "var_dims": var_dims}, f)
     return dirname
 
 

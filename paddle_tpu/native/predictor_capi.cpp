@@ -45,8 +45,10 @@ void set_error_from_python() {
 }
 
 struct Output {
-  std::vector<float> data;
+  std::vector<char> raw;       // fetch bytes in the fetch's OWN dtype
+  std::string dtype;           // numpy dtype name ("float32", "int32", ...)
   std::vector<int64_t> shape;
+  std::vector<float> fcache;   // lazy float32 view for the legacy accessor
 };
 
 struct Predictor {
@@ -54,6 +56,20 @@ struct Predictor {
   PyObject* module = nullptr;  // borrowed ref to paddle_tpu.serving_embed
   std::vector<Output> outputs;
 };
+
+template <typename T>
+void widen_to_float(const char* raw, size_t n, std::vector<float>* dst) {
+  const T* src = reinterpret_cast<const T*>(raw);
+  dst->resize(n);
+  for (size_t k = 0; k < n; ++k) (*dst)[k] = static_cast<float>(src[k]);
+}
+
+void write_shape(const Output& out, int64_t* shape_out, int* ndim) {
+  *ndim = static_cast<int>(out.shape.size());
+  for (size_t d = 0; d < out.shape.size() && d < 8; ++d) {
+    shape_out[d] = out.shape[d];
+  }
+}
 
 PyObject* serving_module() {
   if (!Py_IsInitialized()) {
@@ -125,18 +141,29 @@ int pt_predictor_run(void* pred, const void* const* feed_data,
   p->outputs.clear();
   const Py_ssize_t n_out = PyList_Size(result);
   for (Py_ssize_t i = 0; i < n_out; ++i) {
-    PyObject* entry = PyList_GetItem(result, i);  // (bytes, shape)
+    // (bytes, shape, dtype_name); pre-dtype-protocol builds sent 2-tuples
+    // of float32 bytes — tolerate both
+    PyObject* entry = PyList_GetItem(result, i);
     PyObject* raw = PyTuple_GetItem(entry, 0);
     PyObject* shape = PyTuple_GetItem(entry, 1);
     Output out;
+    out.dtype = "float32";
+    if (PyTuple_Size(entry) >= 3) {
+      const char* dt = PyUnicode_AsUTF8(PyTuple_GetItem(entry, 2));
+      if (dt != nullptr) {
+        out.dtype = dt;
+      } else {
+        PyErr_Clear();  // non-str dtype slot: keep the float32 fallback
+      }
+    }
     const Py_ssize_t ndim = PyTuple_Size(shape);
     for (Py_ssize_t d = 0; d < ndim; ++d) {
       out.shape.push_back(PyLong_AsLongLong(PyTuple_GetItem(shape, d)));
     }
     const char* buf = PyBytes_AsString(raw);
     const Py_ssize_t nbytes = PyBytes_Size(raw);
-    out.data.resize(nbytes / sizeof(float));
-    std::memcpy(out.data.data(), buf, nbytes);
+    out.raw.resize(nbytes);
+    std::memcpy(out.raw.data(), buf, nbytes);
     p->outputs.push_back(std::move(out));
   }
   Py_DECREF(result);
@@ -147,18 +174,55 @@ int pt_predictor_num_outputs(void* pred) {
   return static_cast<int>(static_cast<Predictor*>(pred)->outputs.size());
 }
 
-// Returns the i-th output buffer; writes its rank to *ndim and up to 8
-// dims to shape_out. Valid until the next run/destroy.
+// Dtype-preserving accessor: the i-th output's RAW bytes in its own
+// dtype; writes rank to *ndim, up to 8 dims to shape_out, and the numpy
+// dtype name to *dtype_out (owned by the predictor). Valid until the
+// next run/destroy.
+const void* pt_predictor_output_ex(void* pred, int i, int64_t* shape_out,
+                                   int* ndim, const char** dtype_out) {
+  Predictor* p = static_cast<Predictor*>(pred);
+  if (i < 0 || i >= static_cast<int>(p->outputs.size())) return nullptr;
+  const Output& out = p->outputs[i];
+  write_shape(out, shape_out, ndim);
+  if (dtype_out != nullptr) *dtype_out = out.dtype.c_str();
+  return out.raw.data();
+}
+
+// Legacy float32 accessor: returns the i-th output as float32, converting
+// integer/double fetches on demand (pre-dtype-protocol clients assumed
+// float everywhere — keep them working). Unconvertible dtypes return
+// nullptr; use pt_predictor_output_ex for the raw bytes. Valid until the
+// next run/destroy.
 const float* pt_predictor_output(void* pred, int i, int64_t* shape_out,
                                  int* ndim) {
   Predictor* p = static_cast<Predictor*>(pred);
   if (i < 0 || i >= static_cast<int>(p->outputs.size())) return nullptr;
-  const Output& out = p->outputs[i];
-  *ndim = static_cast<int>(out.shape.size());
-  for (size_t d = 0; d < out.shape.size() && d < 8; ++d) {
-    shape_out[d] = out.shape[d];
+  Output& out = p->outputs[i];
+  write_shape(out, shape_out, ndim);
+  if (out.dtype == "float32") {
+    return reinterpret_cast<const float*>(out.raw.data());
   }
-  return out.data.data();
+  if (out.fcache.empty()) {
+    if (out.dtype == "int32") {
+      widen_to_float<int32_t>(out.raw.data(), out.raw.size() / 4,
+                              &out.fcache);
+    } else if (out.dtype == "int64") {
+      widen_to_float<int64_t>(out.raw.data(), out.raw.size() / 8,
+                              &out.fcache);
+    } else if (out.dtype == "float64") {
+      widen_to_float<double>(out.raw.data(), out.raw.size() / 8,
+                             &out.fcache);
+    } else if (out.dtype == "uint8") {
+      widen_to_float<uint8_t>(out.raw.data(), out.raw.size(), &out.fcache);
+    } else if (out.dtype == "bool") {
+      widen_to_float<int8_t>(out.raw.data(), out.raw.size(), &out.fcache);
+    } else {
+      g_error = "pt_predictor_output: cannot widen dtype '" + out.dtype +
+                "' to float32; use pt_predictor_output_ex";
+      return nullptr;
+    }
+  }
+  return out.fcache.data();
 }
 
 void pt_predictor_destroy(void* pred) {

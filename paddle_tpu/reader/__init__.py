@@ -11,8 +11,8 @@ from .decorator import (map_readers, shuffle, chain, compose, buffered,
                         firstn, xmap_readers, cache)
 from .decorator import batch
 from .prefetch import double_buffer, DeviceFeeder
-from .bucketing import bucket_by_length, BucketedBatch
+from .bucketing import bucket_by_length, bucket_bound, BucketedBatch
 
 __all__ = ["map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
            "xmap_readers", "cache", "batch", "double_buffer", "DeviceFeeder",
-           "bucket_by_length", "BucketedBatch"]
+           "bucket_by_length", "bucket_bound", "BucketedBatch"]

@@ -14,7 +14,22 @@ from __future__ import annotations
 import bisect
 from typing import Callable, Optional, Sequence
 
-__all__ = ["BucketedBatch", "bucket_by_length"]
+__all__ = ["BucketedBatch", "bucket_bound", "bucket_by_length"]
+
+
+def bucket_bound(n: int, bounds: Sequence[int]) -> int:
+    """The pad length for a sample of length `n` under sorted `bounds`:
+    the smallest bound >= n, or — past the last bound — the next multiple
+    of the last bound (overflow shapes stay bounded: at most one per
+    multiple actually seen). Shared by the training-side bucketing below
+    and the serving micro-batcher (serving/batcher.py), so the two pad
+    the same length to the same shape and hit the same compiled
+    executable."""
+    i = bisect.bisect_left(bounds, n)
+    if i < len(bounds):
+        return bounds[i]
+    last = bounds[-1]
+    return ((n + last - 1) // last) * last  # overflow multiples
 
 
 class BucketedBatch(list):
@@ -44,15 +59,8 @@ def bucket_by_length(reader: Callable, batch_size: int,
     def bucketed():
         buckets = {}
 
-        def bound_for(n: int) -> int:
-            i = bisect.bisect_left(bounds, n)
-            if i < len(bounds):
-                return bounds[i]
-            last = bounds[-1]
-            return ((n + last - 1) // last) * last  # overflow multiples
-
         for sample in reader():
-            b = bound_for(key(sample))
+            b = bucket_bound(key(sample), bounds)
             bucket = buckets.setdefault(b, [])
             bucket.append(sample)
             if len(bucket) == batch_size:

@@ -43,6 +43,10 @@ error, not a silently-never-firing spec):
     step_hang           watchdog.py: the device step never settles — hit
                         only when PT_STEP_DEADLINE_S is armed (an
                         unwatched injected hang would hang the run)
+    serve_dispatch      serving/batcher.py: per flushed batch inside the
+                        micro-batcher's dispatcher loop — the batch's
+                        requests fail with a typed RequestFailed and the
+                        loop keeps serving
 """
 
 from __future__ import annotations
@@ -68,6 +72,9 @@ SITES: Dict[str, str] = {
     "nan_grad": "in-graph: every parameter gradient becomes NaN "
                 "(guarded runs)",
     "step_hang": "the device step never settles (armed watchdog only)",
+    "serve_dispatch": "crash inside the serving micro-batcher's "
+                      "dispatcher loop, per flushed batch "
+                      "(serving/batcher.py)",
 }
 
 ENV_VAR = "PT_FAULT_INJECT"

@@ -1,0 +1,157 @@
+"""paddle_tpu.serving — batched, multi-model online inference.
+
+The deployment story up to now ran ONE request at a time against ONE
+model (`io.load_serving_model`, the C API in serving_embed): correct,
+but on an accelerator it leaves most of every dispatch idle — XLA
+executables cost per dispatch, not per example. This subsystem is the
+throughput-oriented online layer over the same AOT `jax.export`
+artifacts (≙ the role of the reference's PaddlePredictor::Run, rebuilt
+around coalescing):
+
+    ServingEngine                 the facade: config + registry + metrics
+      ├── registry.ModelRegistry  named, versioned models; warmup-on-load;
+      │     ModelVersion          atomic drain-based hot reload
+      ├── batcher.MicroBatcher    bounded queue + dispatcher thread:
+      │                           coalesce -> bucket-pad -> run -> scatter
+      ├── admission               typed Overloaded/DeadlineExceeded errors,
+      │                           reject-fast load shedding
+      ├── metrics                 QPS, batch-fill, queue depth, phase
+      │                           latency percentiles (snapshot-able)
+      └── http                    stdlib ThreadingHTTPServer front end
+
+Both front ends — HTTP (serving/http.py) and the embedded C API
+(serving_embed.py) — reach the SAME engine, so batching, admission, and
+metrics behave identically regardless of how a request arrives.
+
+Engine-wide knobs (constructor args win; PT_SERVE_* env knobs supply
+deployment defaults; declared in paddle_tpu/flags.py):
+
+    PT_SERVE_MAX_BATCH     micro-batch bound (default: artifact batch)
+    PT_SERVE_MAX_WAIT_MS   batch close deadline, ms (default 2)
+    PT_SERVE_QUEUE_DEPTH   bounded queue per model (default 256)
+    PT_SERVE_DEADLINE_MS   default per-request deadline, 0 = none
+
+See docs/serving.md for architecture and tuning guidance.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .admission import (AdmissionController, DeadlineExceeded,
+                        InvalidRequest, ModelUnavailable, Overloaded,
+                        RequestFailed, ServingError, retryable)
+from .batcher import (DEFAULT_MAX_WAIT_MS, MicroBatcher, env_float,
+                      env_int)
+from .metrics import ServingMetrics
+from .registry import ModelRegistry, ModelVersion
+
+__all__ = ["ServingEngine", "ServingError", "Overloaded",
+           "DeadlineExceeded", "ModelUnavailable", "InvalidRequest",
+           "RequestFailed", "retryable", "MicroBatcher", "ModelRegistry",
+           "ModelVersion", "AdmissionController", "ServingMetrics"]
+
+
+class ServingEngine:
+    """In-process multi-model serving engine.
+
+    >>> engine = ServingEngine()
+    >>> engine.load_model("ranker", "/models/ranker_v7")
+    >>> out = engine.predict("ranker", {"x": example})       # blocking
+    >>> fut = engine.submit("ranker", {"x": example})        # async
+    >>> engine.load_model("ranker", "/models/ranker_v8")     # hot reload
+    >>> engine.metrics_snapshot()["models"]["ranker"]["qps"]
+    """
+
+    def __init__(self, max_batch_size: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None):
+        self.max_batch_size = max_batch_size  # None = per-model artifact
+        self.max_wait_ms = (env_float("PT_SERVE_MAX_WAIT_MS",
+                                      DEFAULT_MAX_WAIT_MS)
+                            if max_wait_ms is None else float(max_wait_ms))
+        self.queue_depth = (env_int("PT_SERVE_QUEUE_DEPTH", 256)
+                            if queue_depth is None else int(queue_depth))
+        self.deadline_ms = (env_float("PT_SERVE_DEADLINE_MS", 0.0)
+                            if deadline_ms is None else float(deadline_ms))
+        self.metrics = ServingMetrics()
+        self.registry = ModelRegistry(self._make_batcher)
+        self._closed = False
+
+    # -- wiring --------------------------------------------------------------
+    def _make_batcher(self, name: str, model: ModelVersion) -> MicroBatcher:
+        max_batch = self.max_batch_size
+        if max_batch is None:
+            max_batch = env_int("PT_SERVE_MAX_BATCH", model.batch_size)
+        admission = AdmissionController(
+            queue_depth=self.queue_depth,
+            max_batch_size=min(max_batch, model.batch_size),
+            default_deadline_ms=self.deadline_ms)
+        return MicroBatcher(model, max_batch_size=max_batch,
+                            max_wait_ms=self.max_wait_ms,
+                            admission=admission,
+                            metrics=self.metrics.model(name), name=name)
+
+    # -- model lifecycle -----------------------------------------------------
+    def load_model(self, name: str, model_dir: str,
+                   version: Optional[int] = None,
+                   warmup: bool = True) -> int:
+        """Load `name` from a serving artifact dir; if `name` is already
+        serving, this is an atomic hot reload (new version warmed before
+        the swap, old version drained after). Returns the version id."""
+        if self._closed:
+            raise ModelUnavailable("engine is shut down")
+        ver = self.registry.load(name, model_dir, version, warmup=warmup)
+        if ver > 1:
+            self.metrics.model(name).on_reload()
+        return ver
+
+    def unload_model(self, name: str) -> None:
+        self.registry.unload(name)
+
+    def models(self) -> Dict[str, dict]:
+        return self.registry.describe()
+
+    # -- the request path ----------------------------------------------------
+    def submit(self, name: str, feeds: Dict,
+               deadline_ms: Optional[float] = None):
+        """Async: admit + enqueue one example; returns a Future whose
+        result is {fetch_name: np.ndarray}. Typed admission errors raise
+        HERE (reject-fast), execution errors surface on the Future."""
+        if self._closed:
+            raise ModelUnavailable("engine is shut down")
+        entry = self.registry.get(name)
+        while True:
+            try:
+                return entry.batcher.submit(feeds, deadline_ms=deadline_ms)
+            except ModelUnavailable:
+                # raced a hot reload: the version we routed to closed
+                # between registry.get() and submit(). A reload swaps the
+                # routing pointer BEFORE draining the old batcher, so if
+                # the name now routes to a different version, retry there
+                # — the zero-drop contract covers this window too. A
+                # truly unloaded name re-raises (from get(), or because
+                # the routed entry is the one that just refused us).
+                nxt = self.registry.get(name)
+                if nxt is entry:
+                    raise
+                entry = nxt
+
+    def predict(self, name: str, feeds: Dict,
+                deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = None) -> Dict:
+        """Blocking single-request convenience over submit()."""
+        fut = self.submit(name, feeds, deadline_ms=deadline_ms)
+        if timeout is None and deadline_ms:
+            timeout = deadline_ms / 1000.0 + 30.0   # deadline + margin
+        return fut.result(timeout=timeout)
+
+    # -- observability / shutdown -------------------------------------------
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop all batchers. drain=True serves the backlog first."""
+        self._closed = True
+        self.registry.close(drain=drain)

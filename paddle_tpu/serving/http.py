@@ -1,0 +1,164 @@
+"""Thin stdlib HTTP front end over the ServingEngine.
+
+Deliberately ThreadingHTTPServer, not a framework: the container ships
+no web dependencies, and the engine already does the hard part — each
+handler thread blocks on its request's Future while the dispatcher
+coalesces across ALL handler threads, so concurrency here is free
+batching there. One handler thread per in-flight request is exactly the
+concurrency model the micro-batcher wants.
+
+Routes (JSON in/out):
+
+    POST /v1/models/<name>:predict   {"feeds": {name: nested-list},
+                                      "deadline_ms": optional}
+         -> {"fetches": {name: {"data","shape","dtype"}}, "model_version"}
+    POST /v1/models/<name>:reload    {"model_dir": path} -> {"version": N}
+    GET  /v1/models                  registry description
+    GET  /v1/metrics                 metrics snapshot
+
+Typed serving errors map to their http_status (429 Overloaded, 504
+DeadlineExceeded, 404 ModelUnavailable, 400 InvalidRequest, 500
+RequestFailed) with a JSON body naming the error type, so clients can
+key retry policy off the type exactly like in-process callers do
+(admission.retryable).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .admission import InvalidRequest, ServingError
+
+__all__ = ["make_server", "start_http_server"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the engine rides on the server object (make_server sets it)
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # tests must stay quiet
+        pass
+
+    # -- helpers -------------------------------------------------------------
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_typed(self, exc: BaseException) -> None:
+        status = getattr(exc, "http_status", 500)
+        self._send(status, {"error": type(exc).__name__,
+                            "message": str(exc)})
+
+    def _read_json(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        raw = self.rfile.read(n) if n else b""
+        if not raw:
+            return {}
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise InvalidRequest(f"request body is not JSON: {e}") from e
+        if not isinstance(body, dict):
+            raise InvalidRequest("request body must be a JSON object")
+        return body
+
+    def _model_route(self, suffix: str) -> Optional[Tuple[str, str]]:
+        prefix = "/v1/models/"
+        if not self.path.startswith(prefix) or \
+                not self.path.endswith(suffix):
+            return None
+        name = self.path[len(prefix):-len(suffix)]
+        return (name, suffix) if name else None
+
+    # -- routes --------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        engine = self.server.engine
+        try:
+            if self.path == "/v1/models":
+                self._send(200, {"models": engine.models()})
+            elif self.path == "/v1/metrics":
+                self._send(200, engine.metrics_snapshot())
+            else:
+                self._send(404, {"error": "NotFound",
+                                 "message": self.path})
+        except Exception as e:  # noqa: BLE001 — typed error boundary
+            self._send_error_typed(e)
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        engine = self.server.engine
+        try:
+            route = self._model_route(":predict")
+            if route is not None:
+                return self._predict(engine, route[0])
+            route = self._model_route(":reload")
+            if route is not None:
+                body = self._read_json()
+                model_dir = body.get("model_dir")
+                if not model_dir:
+                    raise InvalidRequest("reload needs {'model_dir': …}")
+                ver = engine.load_model(route[0], model_dir,
+                                        version=body.get("version"))
+                return self._send(200, {"model": route[0],
+                                        "version": ver})
+            self._send(404, {"error": "NotFound", "message": self.path})
+        except ServingError as e:
+            self._send_error_typed(e)
+        except Exception as e:  # noqa: BLE001 — boundary: never a 200
+            self._send_error_typed(e)
+
+    def _predict(self, engine, name: str) -> None:
+        body = self._read_json()
+        feeds_in = body.get("feeds")
+        if not isinstance(feeds_in, dict) or not feeds_in:
+            raise InvalidRequest("predict needs {'feeds': {name: value}}")
+        # one routing read, public surface only (ModelUnavailable -> 404)
+        model = engine.registry.get(name).model
+        # dtype-faithful conversion: the model's feed dtypes win over
+        # whatever JSON number type the client happened to send
+        dtypes = model.feed_dtypes()
+        feeds = {}
+        for k, v in feeds_in.items():
+            try:
+                feeds[k] = (np.asarray(v, dtype=dtypes[k])
+                            if k in dtypes else np.asarray(v))
+            except (TypeError, ValueError) as e:
+                raise InvalidRequest(
+                    f"feed {k!r} is not coercible: {e}") from e
+        fut = engine.submit(name, feeds,
+                            deadline_ms=body.get("deadline_ms"))
+        result = fut.result()   # engine deadline machinery bounds this
+        fetches = {
+            k: {"data": v.tolist(), "shape": list(v.shape),
+                "dtype": v.dtype.name}
+            for k, v in result.items()}
+        self._send(200, {"fetches": fetches,
+                         "model_version": model.version})
+
+
+def make_server(engine, host: str = "127.0.0.1",
+                port: int = 0) -> ThreadingHTTPServer:
+    """Build (without starting) the HTTP server; `server.engine` is set.
+    port=0 binds an ephemeral port (tests)."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    server.engine = engine
+    return server
+
+
+def start_http_server(engine, host: str = "127.0.0.1", port: int = 0):
+    """Start serving on a daemon thread. Returns (server, thread); stop
+    with server.shutdown()."""
+    server = make_server(engine, host, port)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="pt-serve-http")
+    thread.start()
+    return server, thread

@@ -1,0 +1,312 @@
+"""Multi-model registry: named, versioned models + atomic hot reload.
+
+A `ModelVersion` is one loaded serving artifact dir (io.py
+export_serving_model): the serving.json metadata plus one deserialized
+StableHLO executable PER shape bucket. Loading WARMS every bucket — a
+zero batch runs through each executable at load time, so the first real
+request never pays a compile (and with PT_COMPILE_CACHE on, the warmup
+itself hits the persistent disk cache after the first process on the
+machine).
+
+Hot reload is drain-based, not lock-based: the registry builds and warms
+the NEW version entirely off to the side, atomically swaps the routing
+pointer (one dict store under a mutex), then closes the OLD version's
+batcher with drain=True — the old dispatcher finishes every request that
+was already queued against it before the version is released. In-flight
+requests therefore never see the swap; new requests never see the old
+version. Zero requests are dropped by construction, which
+tests/test_serving.py asserts under a concurrent submit storm.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .admission import InvalidRequest, ModelUnavailable
+
+__all__ = ["ModelVersion", "ModelRegistry"]
+
+
+class _Bucket:
+    """One compiled shape bucket: the executable + its feed/fetch specs."""
+
+    __slots__ = ("length", "call", "feeds", "fetches")
+
+    def __init__(self, length: Optional[int], call, feeds: List[dict],
+                 fetches: Optional[List[dict]]):
+        self.length = length
+        self.call = call
+        self.feeds = feeds        # [{"name","shape","dtype"}...]
+        self.fetches = fetches    # same, or None on legacy artifacts
+
+
+class ModelVersion:
+    """One immutable loaded artifact. Owns bucket selection, batch
+    padding, execution, and scatter — the batcher only does queueing."""
+
+    def __init__(self, model_dir: str, meta: dict, buckets: Dict, *,
+                 version: int):
+        self.model_dir = model_dir
+        self.version = version
+        self.batch_size = int(meta["batch_size"])
+        self.fetch_names = list(meta["fetch_names"])
+        self.feed_names = [m["name"] for m in meta["feeds"]]
+        #: feed name -> indices of its bucketed (length) dims, full-shape
+        #: coords (0 is the batch dim)
+        self.var_dims: Dict[str, List[int]] = {
+            k: list(v) for k, v in meta.get("var_dims", {}).items()}
+        self._buckets = buckets                    # key(None|int) -> _Bucket
+        self.bounds = sorted(k for k in buckets if k is not None)
+        # the engine's whole padding/scatter model slices feeds on a
+        # leading batch axis; an artifact with a static
+        # (append_batch_size=False) feed cannot be coalesced — refuse at
+        # load instead of silently mis-serving (the direct
+        # load_serving_model path still serves such artifacts)
+        static = [m["name"] for m in self._base_bucket().feeds
+                  if m.get("batch_major") is False]
+        if static:
+            raise ValueError(
+                f"serving engine requires batch-major feeds; {static} "
+                "have no batch axis — serve this artifact via "
+                "io.load_serving_model instead")
+
+    # -- loading -------------------------------------------------------------
+    @classmethod
+    def load(cls, model_dir: str, *, version: int,
+             warmup: bool = True) -> "ModelVersion":
+        import json
+        from ..core.compat import jax_export
+        from ..core.compile_cache import ensure_compile_cache
+
+        ensure_compile_cache()
+        with open(os.path.join(model_dir, "serving.json")) as f:
+            meta = json.load(f)
+        entries = meta.get("buckets")
+        if not entries:
+            # legacy artifact: one bucket, the historical filenames, no
+            # fetch specs (scatter discovers shapes from the outputs)
+            entries = [{"length": None, "file": "serving.stablehlo",
+                        "feeds": meta["feeds"], "fetches": None}]
+        buckets: Dict = {}
+        for e in entries:
+            with open(os.path.join(model_dir, e["file"]), "rb") as f:
+                exported = jax_export().deserialize(bytearray(f.read()))
+            key = e["length"] if e["length"] is None else int(e["length"])
+            buckets[key] = _Bucket(key, exported.call, e["feeds"],
+                                   e.get("fetches"))
+        model = cls(model_dir, meta, buckets, version=version)
+        if warmup:
+            model.warmup()
+        return model
+
+    def warmup(self) -> None:
+        """Run a zero batch through EVERY bucket so each executable is
+        compiled (or loaded from the persistent compile cache) before the
+        first real request arrives."""
+        for b in self._buckets.values():
+            zeros = [np.zeros(tuple(m["shape"]), dtype=np.dtype(m["dtype"]))
+                     for m in b.feeds]
+            outs = self._normalize(b.call(*zeros))
+            for o in outs:
+                np.asarray(o)  # block: warmup must finish before serving
+
+    def _base_bucket(self) -> _Bucket:
+        return self._buckets[self.bounds[-1] if self.bounds else None]
+
+    def feed_dtypes(self) -> Dict[str, np.dtype]:
+        """{feed name: numpy dtype} — the public surface front ends use
+        for dtype-faithful request coercion."""
+        return {m["name"]: np.dtype(m["dtype"])
+                for m in self._base_bucket().feeds}
+
+    # -- request classification ---------------------------------------------
+    def bucket_of(self, feeds: Dict[str, np.ndarray]):
+        """The bucket key for one EXAMPLE (feeds carry no batch dim), or
+        raise InvalidRequest when no exported bucket can hold it."""
+        if set(feeds) != set(self.feed_names):
+            raise InvalidRequest(
+                f"feeds {sorted(feeds)} != model feeds "
+                f"{sorted(self.feed_names)}")
+        need = 0
+        for m in self._base_bucket().feeds:
+            name = m["name"]
+            ex = np.asarray(feeds[name])
+            want = list(m["shape"][1:])   # example coords: drop batch dim
+            if ex.ndim != len(want):
+                raise InvalidRequest(
+                    f"feed {name!r}: rank {ex.ndim} != {len(want)}")
+            if not np.can_cast(ex.dtype, np.dtype(m["dtype"]),
+                               casting="same_kind"):
+                raise InvalidRequest(
+                    f"feed {name!r}: dtype {ex.dtype} not same-kind "
+                    f"castable to {m['dtype']}")
+            var = set(d - 1 for d in self.var_dims.get(name, ()))
+            for d, (got, exp) in enumerate(zip(ex.shape, want)):
+                if d in var:
+                    need = max(need, int(got))
+                elif int(got) != int(exp):
+                    raise InvalidRequest(
+                        f"feed {name!r}: dim {d} is {got}, model wants "
+                        f"{exp}")
+        if not self.bounds:
+            return None
+        from ..reader.bucketing import bucket_bound
+        if need > self.bounds[-1]:
+            raise InvalidRequest(
+                f"length {need} exceeds the largest exported bucket "
+                f"{self.bounds[-1]} (buckets: {self.bounds})")
+        return bucket_bound(max(need, 1), self.bounds)
+
+    # -- execution -----------------------------------------------------------
+    @staticmethod
+    def _normalize(outs) -> list:
+        if isinstance(outs, dict):
+            return list(outs.values())
+        if not isinstance(outs, (list, tuple)):
+            return [outs]
+        return list(outs)
+
+    def execute_batch(self, bucket_key, examples: Sequence[Dict[str,
+                                                                np.ndarray]],
+                      timer=None):
+        """Pad `examples` (<= batch_size) into the bucket shape, run the
+        compiled executable once, scatter rows back per example. Returns
+        (results, phase_s): one {fetch_name: array} dict per example in
+        order, plus this batch's pad/device/scatter seconds. The same
+        spans land on `timer` (the model's cumulative phase accounting)
+        when given."""
+        import time as _time
+
+        b = self._buckets[bucket_key]
+        B = self.batch_size
+        if len(examples) > B:
+            raise ValueError(f"{len(examples)} examples > batch {B}")
+
+        phase_s: Dict[str, float] = {}
+
+        def _mark(phase: str, t0: float) -> None:
+            dt = _time.perf_counter() - t0
+            phase_s[phase] = dt
+            if timer is not None:
+                timer.add(phase, dt)
+
+        t0 = _time.perf_counter()
+        arrays = []
+        for m in b.feeds:
+            buf = np.zeros(tuple(m["shape"]), dtype=np.dtype(m["dtype"]))
+            for r, ex in enumerate(examples):
+                a = np.asarray(ex[m["name"]])
+                buf[(r,) + tuple(slice(0, s) for s in a.shape)] = a
+            arrays.append(buf)
+        _mark("pad", t0)
+
+        t0 = _time.perf_counter()
+        outs = self._normalize(b.call(*arrays))
+        outs = [np.asarray(o) for o in outs]  # the device sync
+        _mark("device", t0)
+
+        t0 = _time.perf_counter()
+        results: List[Dict[str, np.ndarray]] = []
+        # batch-major fetches scatter by row; others (reduced scalars,
+        # parameter fetches) are replicated. The export-recorded flag is
+        # authoritative — a fetch whose leading dim merely coincides with
+        # the batch size must NOT be split; the shape test is only the
+        # legacy-artifact fallback
+        metas = b.fetches or [None] * len(outs)
+        for r in range(len(examples)):
+            row = {}
+            for name, o, m in zip(self.fetch_names, outs, metas):
+                bm = (m["batch_major"] if m and "batch_major" in m
+                      else o.ndim >= 1 and o.shape[0] == B)
+                row[name] = o[r].copy() if bm else o.copy()
+            results.append(row)
+        _mark("scatter", t0)
+        return results, phase_s
+
+
+class _Entry:
+    __slots__ = ("name", "model", "batcher")
+
+    def __init__(self, name: str, model: ModelVersion, batcher):
+        self.name = name
+        self.model = model
+        self.batcher = batcher
+
+
+class ModelRegistry:
+    """name -> current (ModelVersion, batcher), with drain-on-swap
+    reloads. `make_batcher(name, model)` is injected by the engine so the
+    registry stays free of queueing policy."""
+
+    def __init__(self, make_batcher: Callable[[str, ModelVersion], object]):
+        self._make_batcher = make_batcher
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+        self._versions: Dict[str, int] = {}
+
+    def load(self, name: str, model_dir: str,
+             version: Optional[int] = None, *,
+             warmup: bool = True) -> int:
+        """Load (or hot-reload) `name` from `model_dir`. Returns the
+        version id. The new version is fully warmed BEFORE the swap; the
+        old version drains all queued requests before release."""
+        with self._lock:
+            if version is None:
+                version = self._versions.get(name, 0) + 1
+            # reserve NOW, not after the (slow, unlocked) model load —
+            # two concurrent reloads must get distinct version ids
+            self._versions[name] = max(self._versions.get(name, 0),
+                                       version)
+        model = ModelVersion.load(model_dir, version=version,
+                                  warmup=warmup)
+        batcher = self._make_batcher(name, model)
+        with self._lock:
+            old = self._entries.get(name)
+            self._entries[name] = _Entry(name, model, batcher)
+        if old is not None:
+            old.batcher.close(drain=True)
+        return version
+
+    def get(self, name: str) -> _Entry:
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise ModelUnavailable(f"no model named {name!r} is loaded")
+        return entry
+
+    def unload(self, name: str) -> None:
+        with self._lock:
+            entry = self._entries.pop(name, None)
+        if entry is not None:
+            entry.batcher.close(drain=True)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def describe(self) -> dict:
+        with self._lock:
+            entries = list(self._entries.values())
+        out = {}
+        for e in entries:
+            m = e.model
+            out[e.name] = {
+                "version": m.version,
+                "model_dir": m.model_dir,
+                "batch_size": m.batch_size,
+                "buckets": m.bounds if m.bounds else [None],
+                "feeds": m.feed_names,
+                "fetches": m.fetch_names,
+            }
+        return out
+
+    def close(self, drain: bool = True) -> None:
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+        for e in entries:
+            e.batcher.close(drain=drain)

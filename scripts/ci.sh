@@ -2,12 +2,15 @@
 # CI entry (≙ paddle/scripts/paddle_build.sh: build + test in one place).
 # Runs the lint gate, the full suite on the 8-device virtual CPU mesh,
 # the multi-chip dryrun, and a bench sanity pass.
-# Usage: scripts/ci.sh [quick|lint|chaos|perf]
+# Usage: scripts/ci.sh [quick|lint|chaos|perf|serve]
 #   lint  = just the lint gate
 #   chaos = lint gate + the resilience suite under two fixed fault seeds
 #   perf  = lint gate + the async-hot-path suite (lazy fetches, per-phase
 #           timing, device-resident checkpoints, PT_COMPILE_CACHE warm
 #           starts, two-stage prefetch) + the learning-probe regression
+#   serve = lint gate + the online-serving suite (micro-batching, shape
+#           buckets, hot reload, admission/shedding, metrics, HTTP front
+#           end) + the C-API serving drivers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -32,6 +35,13 @@ if [[ "${1:-}" == "chaos" ]]; then
       tests/test_guardrails.py -q
   done
   echo "CHAOS OK"
+  exit 0
+fi
+
+if [[ "${1:-}" == "serve" ]]; then
+  echo "== serve: online serving engine + C-API drivers =="
+  python -m pytest tests/test_serving.py tests/test_capi_serving.py -q
+  echo "SERVE OK"
   exit 0
 fi
 

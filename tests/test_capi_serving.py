@@ -130,3 +130,125 @@ def test_c_driver_serves_exported_model(tmp_path):
     got = np.array([float(v) for v in lines[1:]],
                    dtype=np.float32).reshape(ref.shape)
     np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+
+
+# dtype-preserving driver: reads every output through
+# pt_predictor_output_ex and prints its dtype NAME + values — int32
+# fetches (argmax) must cross the C boundary as int32 bytes, not be
+# mangled through float32 (the pre-fix serving_embed coerced everything)
+DRIVER_EX_C = r"""
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+extern void* pt_predictor_create(const char* model_dir);
+extern int pt_predictor_run(void*, const void* const*, const int64_t* const*,
+                            const int*, const int*, int);
+extern int pt_predictor_num_outputs(void*);
+extern const void* pt_predictor_output_ex(void*, int, int64_t*, int*,
+                                          const char**);
+extern void pt_predictor_destroy(void*);
+extern const char* pt_last_error(void);
+
+/* usage: driver MODEL_DIR N_ELEMS D0 D1 ...  (one f32 feed, ramp data) */
+int main(int argc, char** argv) {
+  if (argc < 4) return 2;
+  int64_t n = atoll(argv[2]);
+  int ndim = argc - 3;
+  int64_t shape[8];
+  for (int d = 0; d < ndim; ++d) shape[d] = atoll(argv[3 + d]);
+
+  float* data = (float*)malloc(n * sizeof(float));
+  for (int64_t i = 0; i < n; ++i) data[i] = (float)(i % 17) * 0.125f;
+
+  void* p = pt_predictor_create(argv[1]);
+  if (!p) { fprintf(stderr, "create: %s\n", pt_last_error()); return 3; }
+  const void* feed_data[1] = {data};
+  const int64_t* feed_shapes[1] = {shape};
+  int feed_ndims[1] = {ndim};
+  int feed_dtypes[1] = {0};
+  if (pt_predictor_run(p, feed_data, feed_shapes, feed_ndims,
+                       feed_dtypes, 1)) {
+    fprintf(stderr, "run: %s\n", pt_last_error());
+    return 4;
+  }
+  int n_out = pt_predictor_num_outputs(p);
+  printf("outputs %d\n", n_out);
+  for (int i = 0; i < n_out; ++i) {
+    int64_t oshape[8];
+    int ondim = 0;
+    const char* dtype = NULL;
+    const void* out = pt_predictor_output_ex(p, i, oshape, &ondim, &dtype);
+    int64_t elems = 1;
+    for (int d = 0; d < ondim; ++d) elems *= oshape[d];
+    printf("dtype %s elems %lld\n", dtype, (long long)elems);
+    for (int64_t k = 0; k < elems; ++k) {
+      if (strcmp(dtype, "int32") == 0)
+        printf("%d\n", ((const int32_t*)out)[k]);
+      else if (strcmp(dtype, "int64") == 0)
+        printf("%lld\n", (long long)((const int64_t*)out)[k]);
+      else
+        printf("%.8e\n", ((const float*)out)[k]);
+    }
+  }
+  pt_predictor_destroy(p);
+  free(data);
+  return 0;
+}
+"""
+
+
+def test_c_driver_preserves_int_fetch_dtype(tmp_path):
+    # -- model with a float fetch AND an int fetch (argmax labels) --
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6])
+        hid = layers.fc(input=x, size=8, act="relu")
+        probs = layers.fc(input=hid, size=3, act="softmax")
+        label = layers.argmax(probs, axis=1)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        pt.Executor().run(startup)
+        model_dir = str(tmp_path / "served_int")
+        pio.export_serving_model(model_dir, ["x"], [probs, label],
+                                 main_program=main, scope=scope,
+                                 batch_size=4)
+
+    predict, _, _ = pio.load_serving_model(model_dir)
+    feed = ((np.arange(24) % 17) * 0.125).astype("float32").reshape(4, 6)
+    ref = predict(feed)
+    ref_probs = np.asarray(ref[0], dtype=np.float32)
+    ref_label = np.asarray(ref[1])
+    assert ref_label.dtype == np.int32   # the dtype the wire must keep
+
+    from paddle_tpu import native
+    lib = native.load_library("predictor_capi", _python_embed_flags())
+    if lib is None:
+        pytest.skip("toolchain or libpython unavailable")
+    so = [os.path.join(native._BUILD, f) for f in os.listdir(native._BUILD)
+          if f.startswith("predictor_capi-")][0]
+    driver_src = tmp_path / "driver_ex.c"
+    driver_src.write_text(DRIVER_EX_C)
+    driver = tmp_path / "driver_ex"
+    subprocess.run(["gcc", str(driver_src), so, "-o", str(driver)]
+                   + _python_embed_flags(), check=True, capture_output=True)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([str(driver), model_dir, "24", "4", "6"], env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    lines = r.stdout.strip().splitlines()
+    assert lines[0] == "outputs 2"
+    assert lines[1] == "dtype float32 elems 12"
+    got_probs = np.array([float(v) for v in lines[2:14]],
+                         dtype=np.float32).reshape(4, 3)
+    assert lines[14] == "dtype int32 elems 4"
+    got_label = np.array([int(v) for v in lines[15:19]], dtype=np.int32)
+    np.testing.assert_allclose(got_probs, ref_probs, rtol=1e-6, atol=1e-7)
+    np.testing.assert_array_equal(got_label, ref_label)
