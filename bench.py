@@ -527,6 +527,39 @@ def bench_machine_translation(on_tpu, peak):
             **_mfu_fields(train_flops, ms if on_tpu else 0, peak, on_tpu)}
 
 
+#: learning-probe token pool: ids drawn from [0, LM_PROBE_POOL) inside
+#: the unchanged model vocab, so shapes/embedding/logits cost (and step
+#: timing) are identical while every class is seen often enough to
+#: separate within the 32-step probe window
+LM_PROBE_POOL = 64
+
+
+def lm_probe_feeds(i, batch, seqlen, vocab):
+    """The LM configs' learning-probe batch i: current-token copy rule
+    over a LM_PROBE_POOL-id pool (module-level so the tier-1 regression
+    test pins THIS function — the one the bench actually runs — not a
+    re-implementation of it).
+
+    History (why this is load-bearing): BENCH r04 and r05 both flagged
+    the transformer config FAILED_LEARNING with BIT-IDENTICAL losses
+    (10.43967 -> 10.41301) even though a probe fix was claimed between
+    them. The identical floats prove both rounds ran the same probe
+    data — i.e. the r05 bench binary still drew targets uniformly from
+    the FULL 32000-id vocab (verified against that round's bench.py:
+    `vrng.randint(0, vocab, ...)`); the pool fix existed only in a test
+    that re-implemented the probe instead of importing it. Unlearnable-
+    by-design full-vocab draws (~0.25 sightings/class/step) flatline at
+    any tested lr while the identical architecture learns a small-pool
+    task (docs/artifacts/loss_probe_diagnosis.json, transformer_r05).
+    tests/test_transformer_learns.py now imports THIS function, so the
+    probe design and the measured path can never diverge again.
+    """
+    vrng = np.random.RandomState(7000 + i)
+    src = vrng.randint(0, min(vocab, LM_PROBE_POOL),
+                       (batch, seqlen)).astype("int64")
+    return {"src_ids": src, "tgt_ids": src[..., None]}
+
+
 def _lm_bench(on_tpu, peak, batch, seqlen, d_model, n_layers, n_heads,
               d_ff, vocab, steps, remat, varied_steps=32):
     """Shared transformer-LM measurement: build, (optionally remat), train
@@ -546,21 +579,9 @@ def _lm_bench(on_tpu, peak, batch, seqlen, d_model, n_layers, n_heads,
         main_prog.amp_dtype = "bfloat16"
 
     def varied(i):
-        # current-token copy rule over a 64-id POOL (model vocab — and so
-        # shapes, embedding size, logits cost, step timing — unchanged).
-        # The r4/r5 full-vocab draw was the SAME probe-design artifact as
-        # the old lstm/mt tasks (loss_probe_diagnosis.json
-        # transformer_r05): 32000 one-shot classes each seen ~0.25x per
-        # step cannot separate within a 32-step window at lr 1e-4 — the
-        # CPU rerun shows the identical architecture falling 10.34 ->
-        # 9.62 on a 32-id pool and the L0-stripped model learning the
-        # full-vocab task, so gradients were never the problem. The
-        # flagship config was flagged FAILED_LEARNING for 2 rounds over
-        # its probe, not its training.
-        vrng = np.random.RandomState(7000 + i)
-        src = vrng.randint(0, min(vocab, 64),
-                           (batch, seqlen)).astype("int64")
-        return {"src_ids": src, "tgt_ids": src[..., None]}
+        # the shared pool probe — see lm_probe_feeds for why it is a
+        # module-level, test-pinned function
+        return lm_probe_feeds(i, batch, seqlen, vocab)
 
     ms, losses, compile_s, hot = _train_loop(main_prog, startup, avg,
                                              varied(0), steps,
@@ -1102,6 +1123,104 @@ def bench_serving(on_tpu, peak):
     return out
 
 
+def bench_decode(on_tpu, peak):
+    """Autoregressive decode: continuous batching over the paged KV
+    cache (serving/decode) vs the drain-to-empty static batcher — the
+    SAME two-artifact bundle, the same greedy sequences, the only
+    difference is whether a freed slot is refilled mid-flight.
+
+    Workload: mixed lengths, 3 short generations per 1 long — the mix
+    that exposes drain-to-empty waste (every slot whose sequence
+    finished early idles until the batch's longest sequence ends).
+    Acceptance: >= 2x tokens/s over the static baseline with
+    token-identical outputs; slot occupancy reported for both modes is
+    the explanation for the gap."""
+    import tempfile
+    import paddle_tpu as pt
+    from paddle_tpu import io as pio
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.serving.decode import DecodeEngine
+
+    slots = int(os.environ.get("BENCH_DECODE_SLOTS", 4))
+    n_seqs = int(os.environ.get("BENCH_DECODE_REQS", 16))
+    windows = int(os.environ.get("BENCH_DECODE_WINDOWS", 2))
+    long_new = int(os.environ.get("BENCH_DECODE_LONG_TOKENS", 100))
+    V, L, DM, H, FF, MAXC = 96, 2, 32, 2, 64, 128
+
+    pt.core.program.reset_unique_names()
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        tfm.transformer_lm_loss(vocab_size=V, seq_len=MAXC, n_layers=L,
+                                d_model=DM, n_heads=H, d_ff=FF,
+                                max_len=MAXC)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        pt.Executor().run(startup)
+        d = os.path.join(tempfile.mkdtemp(prefix="pt_bench_decode_"), "m")
+        pio.export_decode_model(
+            d, dict(vocab_size=V, n_layers=L, d_model=DM, n_heads=H,
+                    d_ff=FF, max_context=MAXC),
+            scope=scope, length_buckets=(8, 16), slots=slots,
+            block_size=8, pool_blocks=128)
+
+    rng = np.random.RandomState(0)
+    prompts = [[int(t) for t in rng.randint(1, V, rng.randint(2, 7))]
+               for _ in range(n_seqs)]
+    # generation lengths dominate prefills (prefill cost is identical in
+    # both modes and would otherwise dilute the slot-waste signal on a
+    # model this tiny, where one bucket-8 prefill costs ~6 decode steps)
+    max_new = [(long_new if i % 4 == 0 else 2) for i in range(n_seqs)]
+    total = sum(max_new)
+
+    def run(continuous):
+        # warmup-on-load compiles every prefill bucket + the decode
+        # step, so window timings are trace-free in BOTH modes
+        eng = DecodeEngine(d, name="decode_bench", continuous=continuous,
+                           queue_depth=4 * n_seqs)
+        try:
+            best, outs = float("inf"), None
+            for _ in range(windows):
+                t0 = time.time()
+                handles = [eng.generate(p, max_new_tokens=m)
+                           for p, m in zip(prompts, max_new)]
+                outs = [h.result(timeout=600)["tokens"] for h in handles]
+                best = min(best, time.time() - t0)
+            return outs, best, eng.metrics_snapshot()
+        finally:
+            eng.shutdown()
+
+    cont_out, cont_s, cont_snap = run(True)
+    stat_out, stat_s, stat_snap = run(False)
+    identical = cont_out == stat_out
+
+    out = {
+        "slots": slots,
+        "sequences": n_seqs,
+        "total_new_tokens": total,
+        "continuous_tokens_per_s": round(total / cont_s, 1),
+        "static_tokens_per_s": round(total / stat_s, 1),
+        "speedup_vs_static_batching": round(stat_s / cont_s, 2),
+        "continuous_slot_occupancy": cont_snap["slot_occupancy"],
+        "static_slot_occupancy": stat_snap["slot_occupancy"],
+        "decode_steps": {"continuous": cont_snap["decode_steps"] // windows,
+                         "static": stat_snap["decode_steps"] // windows},
+        "token_identical_vs_static": identical,
+        "evictions": cont_snap["evictions"],
+        "kv_high_water_blocks": cont_snap["kv_high_water"],
+    }
+    if not identical:
+        out["warning"] = ("DECODE-PARITY: continuous-batched outputs "
+                          "differ from the static-batch outputs")
+        print(f"bench_decode WARNING: {out['warning']}", file=sys.stderr)
+    if stat_s / cont_s < 2.0:
+        out["warning_speedup"] = (
+            f"continuous batching only {stat_s / cont_s:.2f}x the static "
+            "drain-to-empty baseline (target >= 2x)")
+        print(f"bench_decode WARNING: {out['warning_speedup']}",
+              file=sys.stderr)
+    return out
+
+
 def main():
     import jax
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
@@ -1129,6 +1248,7 @@ def main():
              ("data_pipeline",
               lambda: bench_data_pipeline(on_tpu, configs.get("resnet50"))),
              ("serving", lambda: bench_serving(on_tpu, peak)),
+             ("decode", lambda: bench_decode(on_tpu, peak)),
              ("transformer", lambda: bench_transformer(on_tpu, peak)),
              ("long_context", lambda: bench_long_context(on_tpu, peak)),
              ("long_context_32k",

@@ -204,6 +204,26 @@ declare_env_knob("PT_SERVE_DEADLINE_MS",
                  "none). Expired or provably-unmeetable deadlines shed "
                  "fast with the typed DeadlineExceeded error; "
                  "per-request deadline_ms overrides")
+declare_env_knob("PT_DECODE_BLOCK_SIZE",
+                 "decode bundle export (io.export_decode_model): tokens "
+                 "per paged-KV block (default 16). Fixed at export — the "
+                 "decode-step artifact's pool shape bakes it in")
+declare_env_knob("PT_DECODE_POOL_BLOCKS",
+                 "decode bundle export: preallocated KV-pool blocks per "
+                 "layer, INCLUDING the reserved null block 0 (default "
+                 "64). Usable cache capacity is (pool_blocks-1) x "
+                 "block_size tokens shared by all in-flight sequences; "
+                 "under pressure the scheduler evicts lowest-priority "
+                 "sequences")
+declare_env_knob("PT_DECODE_MAX_SLOTS",
+                 "decode bundle export: slot count of the fixed-shape "
+                 "decode step = max concurrently-decoding sequences "
+                 "(default 8). Continuous batching admits new sequences "
+                 "into free slots of the in-flight batch")
+declare_env_knob("PT_DECODE_MAX_NEW_TOKENS",
+                 "decode engine: default per-request generation budget "
+                 "when the request does not pass max_new_tokens "
+                 "(default 64); bounded by the artifact's max_context")
 declare_env_knob("PT_COMPILE_CACHE",
                  "persistent XLA compile cache (core/compile_cache.py): "
                  "unset/0 = off, 1 = ~/.cache/paddle_tpu/xla_cache, "

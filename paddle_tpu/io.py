@@ -29,7 +29,7 @@ __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "get_inference_program",
-    "export_serving_model", "load_serving_model",
+    "export_serving_model", "export_decode_model", "load_serving_model",
     "save_checkpoint", "load_checkpoint", "clean_checkpoint",
     "get_latest_checkpoint_serial", "CheckpointCorruptError",
 ]
@@ -713,6 +713,217 @@ def export_serving_model(dirname: str, feeded_var_names: Sequence[str],
         json.dump({"feeds": base["feeds"], "fetch_names": target_names,
                    "fetches": base["fetches"], "batch_size": batch_size,
                    "buckets": buckets_meta, "var_dims": var_dims}, f)
+    return dirname
+
+
+def export_decode_model(dirname: str, model_cfg: Dict, *,
+                        scope: Optional[Scope] = None,
+                        length_buckets: Sequence[int] = (64, 128),
+                        slots: Optional[int] = None,
+                        block_size: Optional[int] = None,
+                        pool_blocks: Optional[int] = None,
+                        prefill_batch_size: int = 1,
+                        eos_id: Optional[int] = None) -> str:
+    """Export the autoregressive-decode bundle (serving/decode): PREFILL
+    artifacts (one per length bucket, full causal attention over the
+    prompt, fetching logits + every layer's per-head K/V so the paged
+    cache can be seeded) plus ONE fixed-shape DECODE-STEP artifact (one
+    token per slot, reading/writing the paged KV pool through per-slot
+    block tables). Both are recorded in serving.json: the prefill side
+    uses the exact bucket schema `export_serving_model` writes (so
+    serving.ModelVersion serves it unchanged), and a ``decode`` section
+    carries the pool geometry + feed/fetch specs of the step artifact.
+
+    model_cfg: the transformer_lm architecture — vocab_size, n_layers,
+    d_model, n_heads, d_ff, and max_context (the trained sequence length;
+    sizes the shared pos_emb table and bounds every sequence's
+    prompt+generated length). Weights are bound by NAME from `scope`
+    (tok_emb, pos_emb, attn{i}_*, ffn{i}_*, ln*_{i}_*, lm_head_*) — the
+    names `models.transformer.transformer_lm` assigns in training.
+
+    slots / block_size / pool_blocks default from the PT_DECODE_MAX_SLOTS
+    / PT_DECODE_BLOCK_SIZE / PT_DECODE_POOL_BLOCKS env knobs (8 / 16 /
+    64). Block 0 of the pool is reserved as the null block; usable KV
+    capacity is (pool_blocks - 1) * block_size tokens.
+    """
+    import jax
+    import jax.numpy as jnp
+    from . import Program as _Program
+    from . import program_guard as _program_guard
+    from .core import lowering
+    from .core.compat import jax_export
+    from .models import transformer as _tfm
+
+    from .serving.batcher import env_int as _env_int
+
+    slots = slots or _env_int("PT_DECODE_MAX_SLOTS", 8)
+    block_size = block_size or _env_int("PT_DECODE_BLOCK_SIZE", 16)
+    pool_blocks = pool_blocks or _env_int("PT_DECODE_POOL_BLOCKS", 64)
+    scope = scope or global_scope()
+    cfg = dict(model_cfg)
+    vocab = int(cfg["vocab_size"])
+    n_layers = int(cfg["n_layers"])
+    d_model = int(cfg["d_model"])
+    n_heads = int(cfg["n_heads"])
+    d_ff = int(cfg["d_ff"])
+    max_context = int(cfg["max_context"])
+    if d_model % n_heads:
+        raise ValueError(f"d_model {d_model} not divisible by n_heads "
+                         f"{n_heads}")
+    head_dim = d_model // n_heads
+    buckets = sorted(int(b) for b in length_buckets)
+    if not buckets or buckets[-1] > max_context:
+        raise ValueError(f"length_buckets {buckets} must be non-empty and "
+                         f"bounded by max_context {max_context}")
+    if pool_blocks < 2:
+        raise ValueError("pool_blocks must be >= 2 (block 0 is the "
+                         "reserved null block)")
+    max_blocks_per_seq = -(-max_context // block_size)
+
+    def _bind_state(program):
+        state = {}
+        for var in program.list_vars():
+            if var.persistable and scope.has_var(var.name):
+                v = scope.find_var(var.name)
+                if v is not None:
+                    state[var.name] = jnp.asarray(v)
+        return state
+
+    def _trace(program, feed_names, target_names, shapes, dtypes,
+               alt_shapes=None):
+        """Trace+serialize one program; returns (blob, out_avals,
+        alt_avals) — alt for batch_major ground truth on the prefill."""
+        pruned = program.clone(for_test=True).prune(targets=target_names,
+                                                    feeds=feed_names)
+        state = _bind_state(pruned)
+        step, _ = lowering.build_step_fn(pruned, list(feed_names),
+                                         list(target_names), [],
+                                         is_test=True)
+        key = jax.random.PRNGKey(0)
+
+        def serve(*feeds):
+            env = dict(zip(feed_names, feeds))
+            fetches, _ = step(state, env, key)
+            return fetches
+
+        example = [jax.ShapeDtypeStruct(tuple(s), d)
+                   for s, d in zip(shapes, dtypes)]
+        exported = jax_export().export(jax.jit(serve))(*example)
+        alt_avals = None
+        if alt_shapes is not None:
+            alt = [jax.ShapeDtypeStruct(tuple(s), d)
+                   for s, d in zip(alt_shapes, dtypes)]
+            try:
+                alt_avals = list(jax.eval_shape(serve, *alt))
+            except Exception:
+                alt_avals = None
+        return exported.serialize(), list(exported.out_avals), alt_avals
+
+    os.makedirs(dirname, exist_ok=True)
+    from .core.types import device_dtype, np_dtype
+
+    ids_dt = np_dtype(device_dtype("int64"))
+    i32 = np_dtype(device_dtype("int32"))
+
+    # -- prefill: one full-attention artifact per length bucket ----------
+    kv_roles = [(f"k_{i}", f"v_{i}") for i in range(n_layers)]
+    fetch_roles = ["logits"] + [n for pair in kv_roles for n in pair]
+    buckets_meta = []
+    blob = None
+    for bound in buckets:
+        main, _startup = _Program(), _Program()
+        kvs: List = []
+        with _program_guard(main, _startup):
+            from .layers import data as _data
+            src = _data("src_ids", [bound], dtype="int64")
+            logits = _tfm.transformer_lm(
+                src, vocab, n_layers=n_layers, d_model=d_model,
+                n_heads=n_heads, d_ff=d_ff, max_len=max_context,
+                pos_table_len=max_context, collect_kv=kvs)
+        targets = [logits.name] + [n for k, v in kvs
+                                   for n in (k.name, v.name)]
+        B = prefill_batch_size
+        shapes = [(B, bound)]
+        blob, out_avals, alt_avals = _trace(
+            main, ["src_ids"], targets, shapes, [ids_dt],
+            alt_shapes=[(B + 1, bound)])
+        feeds_meta = [{"name": "src_ids", "shape": [B, bound],
+                       "dtype": np.dtype(ids_dt).name,
+                       "batch_major": True}]
+        fetch_meta = []
+        for j, (role, aval) in enumerate(zip(fetch_roles, out_avals)):
+            bm = bool(aval.shape) and int(aval.shape[0]) == B
+            if bm and alt_avals is not None:
+                a = alt_avals[j].shape
+                bm = bool(a) and int(a[0]) == B + 1
+            fetch_meta.append({"name": role,
+                               "shape": [int(s) for s in aval.shape],
+                               "dtype": np.dtype(aval.dtype).name,
+                               "batch_major": bm})
+        fn = f"prefill_len{bound}.stablehlo"
+        with open(os.path.join(dirname, fn), "wb") as f:
+            f.write(blob)
+        buckets_meta.append({"length": bound, "file": fn,
+                             "feeds": feeds_meta, "fetches": fetch_meta})
+    # compat artifact for single-shape loaders: the largest bucket
+    with open(os.path.join(dirname, "serving.stablehlo"), "wb") as f:
+        f.write(blob)
+
+    # -- the decode step: one fixed-shape artifact -----------------------
+    main, _startup = _Program(), _Program()
+    with _program_guard(main, _startup):
+        dlogits, pool_outs, dec_feed_names = _tfm.transformer_decode_step(
+            vocab, n_layers=n_layers, d_model=d_model, n_heads=n_heads,
+            d_ff=d_ff, max_context=max_context, slots=slots,
+            block_size=block_size, pool_blocks=pool_blocks,
+            max_blocks_per_seq=max_blocks_per_seq)
+    dec_targets = [dlogits.name] + [n for ko, vo in pool_outs
+                                    for n in (ko.name, vo.name)]
+    dec_fetch_roles = ["logits"] + [
+        n for i in range(n_layers)
+        for n in (f"k_cache_out_{i}", f"v_cache_out_{i}")]
+    pool_shape = [pool_blocks, block_size, n_heads, head_dim]
+    dec_shapes = [(slots,), (slots,), (slots, max_blocks_per_seq)]
+    dec_dtypes = [ids_dt, i32, i32]
+    for _ in range(n_layers):
+        dec_shapes += [tuple(pool_shape), tuple(pool_shape)]
+        dec_dtypes += [np.float32, np.float32]
+    dec_blob, dec_avals, _ = _trace(main, dec_feed_names, dec_targets,
+                                    dec_shapes, dec_dtypes)
+    with open(os.path.join(dirname, "decode.stablehlo"), "wb") as f:
+        f.write(dec_blob)
+    dec_feeds_meta = [
+        {"name": n, "shape": [int(x) for x in s],
+         "dtype": np.dtype(d).name}
+        for n, s, d in zip(dec_feed_names, dec_shapes, dec_dtypes)]
+    dec_fetch_meta = [
+        {"name": role, "shape": [int(x) for x in aval.shape],
+         "dtype": np.dtype(aval.dtype).name}
+        for role, aval in zip(dec_fetch_roles, dec_avals)]
+
+    base = buckets_meta[-1]
+    meta = {
+        "feeds": base["feeds"], "fetch_names": fetch_roles,
+        "fetches": base["fetches"], "batch_size": prefill_batch_size,
+        "buckets": buckets_meta, "var_dims": {"src_ids": [1]},
+        "decode": {
+            "file": "decode.stablehlo",
+            "feeds": dec_feeds_meta, "fetches": dec_fetch_meta,
+            "slots": slots, "block_size": block_size,
+            "pool_blocks": pool_blocks,
+            "max_blocks_per_seq": max_blocks_per_seq,
+            "max_context": max_context, "n_layers": n_layers,
+            "n_heads": n_heads, "head_dim": head_dim,
+            "vocab_size": vocab, "eos_id": eos_id,
+            "prefill_roles": {"logits": "logits",
+                              "kv": [list(p) for p in kv_roles]},
+            "model_cfg": {"vocab_size": vocab, "n_layers": n_layers,
+                          "d_model": d_model, "n_heads": n_heads,
+                          "d_ff": d_ff, "max_context": max_context},
+        },
+    }
+    with open(os.path.join(dirname, "serving.json"), "w") as f:
+        json.dump(meta, f)
     return dirname
 
 

@@ -443,6 +443,177 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
 
 # ---------------------------------------------------------------------------
+# Paged decode attention (the ragged-paged shape of this kernel family)
+#
+# Autoregressive serving keeps each sequence's K/V in fixed-size BLOCKS of a
+# preallocated pool ([num_blocks, block_size, H, D]); a per-sequence block
+# table maps logical positions to pool blocks, so sequences of ragged
+# lengths share one pool with no per-sequence reallocation (the "Ragged
+# Paged Attention" kernel shape, PAPERS.md). One decode step scores ONE new
+# query token per sequence against that sequence's pages.
+#
+# Two paths, same contract as the training kernel above:
+#   * Pallas TPU kernel — grid (seqs, pages); the block table and context
+#     lengths ride in scalar-prefetch refs so each page's pool index is
+#     known before the DMA is issued; pages past ceil(len/bs) are skipped.
+#   * gather-based XLA reference — k_pool[block_tables] + masked softmax;
+#     the CPU/tier-1 path and the numerics oracle.
+#
+# Layout: q [S, H, D] (one token per slot), pools [NB, BS, H, D],
+# block_tables [S, MB] int32, context_lens [S] int32 — the span INCLUDING
+# the newly written token. Block id 0 is reserved as the null block:
+# inactive slots (context_len 0) point every table entry at it and produce
+# zero output rather than NaN.
+# ---------------------------------------------------------------------------
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, context_lens,
+                              *, scale: Optional[float] = None):
+    """Gather-based XLA paged attention (CPU path + oracle)."""
+    s_n, h, d = q.shape
+    bs = k_pool.shape[1]
+    mb = block_tables.shape[1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    flat = block_tables.reshape(-1).astype(jnp.int32)
+    k = jnp.take(k_pool, flat, axis=0).reshape(s_n, mb * bs, h, d)
+    v = jnp.take(v_pool, flat, axis=0).reshape(s_n, mb * bs, h, d)
+    s = jnp.einsum("shd,skhd->shk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    kpos = jnp.arange(mb * bs, dtype=jnp.int32)[None, None, :]
+    mask = kpos < context_lens.astype(jnp.int32)[:, None, None]
+    s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.where(mask, jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    # all-masked rows (context_len 0: the null slot) divide by 1 -> zeros;
+    # any live row has l >= exp(0) = 1 at its own max
+    p = p / jnp.maximum(l, 1.0)
+    out = jnp.einsum("shk,skhd->shd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, scale, block_size, n_pages):
+    """One (sequence, page) grid step; online softmax over the pages."""
+    si = pl.program_id(0)
+    pi = pl.program_id(1)
+
+    @pl.when(pi == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx = len_ref[si]
+    pages = (ctx + block_size - 1) // block_size
+
+    @pl.when(pi < pages)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)                    # [H, D]
+        kt = jnp.swapaxes(k_ref[0], 0, 1).astype(jnp.float32)  # [H, BS, D]
+        s = jax.lax.dot_general(
+            q, kt, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale     # [H, BS]
+        h, bs = s.shape
+        kpos = pi * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (h, bs), 1)
+        s = jnp.where(kpos < ctx, s, DEFAULT_MASK_VALUE)
+        m_prev = m_ref[:]
+        m_cur = jnp.max(s, axis=1)[:, None]                 # [H, 1]
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)                             # [H, BS]
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1)[:, None]
+        m_ref[:] = m_next
+        vt = jnp.swapaxes(v_ref[0], 0, 1).astype(jnp.float32)  # [H, BS, D]
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, vt, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(pi == n_pages - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pool, v_pool, block_tables, context_lens,
+                            *, scale, interpret=False):
+    if not _HAS_PLTPU:
+        raise RuntimeError("pallas TPU backend unavailable; use "
+                           "paged_attention_reference")
+    s_n, h, d = q.shape
+    bs = k_pool.shape[1]
+    mb = block_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_n, mb),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda s, p, bt, ln: (s, 0, 0)),
+            # the page DMA reads its pool index straight from the
+            # scalar-prefetched block table — pages past the sequence's
+            # length resolve to the (always-valid) null block 0
+            pl.BlockSpec((1, bs, h, d),
+                         lambda s, p, bt, ln: (bt[s, p], 0, 0, 0)),
+            pl.BlockSpec((1, bs, h, d),
+                         lambda s, p, bt, ln: (bt[s, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda s, p, bt, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),    # acc
+            pltpu.VMEM((h, 1), jnp.float32),    # m
+            pltpu.VMEM((h, 1), jnp.float32),    # l
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, scale=scale,
+                               block_size=bs, n_pages=mb)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_n, h, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
+                           *, scale: Optional[float] = None,
+                           interpret: bool = False):
+    """Public paged-decode entry: Pallas on TPU-friendly shapes (lane dim
+    a multiple of 128, sublane of 8), gather-based XLA elsewhere."""
+    d = q.shape[-1]
+    bs = k_pool.shape[1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    tpu = _HAS_PLTPU and jax.default_backend() == "tpu"
+    if (interpret or tpu) and _HAS_PLTPU and d % 128 == 0 and bs % 8 == 0:
+        return _paged_attention_pallas(q, k_pool, v_pool, block_tables,
+                                       context_lens, scale=scale,
+                                       interpret=interpret)
+    return paged_attention_reference(q, k_pool, v_pool, block_tables,
+                                     context_lens, scale=scale)
+
+
+def paged_kv_update(k_pool, v_pool, k_new, v_new, block_tables,
+                    context_lens):
+    """Write one new K/V row per sequence into its page: position
+    context_len-1, block block_tables[s, pos // bs], offset pos % bs.
+    Inactive slots (context_len 0) write harmlessly into null block 0.
+    Returns the updated (k_pool, v_pool)."""
+    k_pool = jnp.asarray(k_pool)
+    v_pool = jnp.asarray(v_pool)
+    bs = k_pool.shape[1]
+    lens = jnp.asarray(context_lens).astype(jnp.int32)
+    pos = jnp.maximum(lens - 1, 0)
+    blk = jnp.take_along_axis(block_tables.astype(jnp.int32),
+                              (pos // bs)[:, None], axis=1)[:, 0]
+    blk = jnp.where(lens > 0, blk, 0)
+    off = pos % bs
+    k_pool = k_pool.at[blk, off].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[blk, off].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+# ---------------------------------------------------------------------------
 # Dispatch
 # ---------------------------------------------------------------------------
 

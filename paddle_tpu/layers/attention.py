@@ -12,7 +12,8 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
 
-__all__ = ["fused_attention", "multi_head_attention"]
+__all__ = ["fused_attention", "multi_head_attention", "paged_kv_write",
+           "paged_attention"]
 
 
 def fused_attention(q, k, v, bias=None, causal=False, scale=0.0,
@@ -29,16 +30,50 @@ def fused_attention(q, k, v, bias=None, causal=False, scale=0.0,
     return out
 
 
+def paged_kv_write(k_pool, v_pool, k, v, block_tables, context_lens,
+                   name=None):
+    """Write each slot's new K/V row ([S, 1, H, D]) into its page of the
+    paged pool ([NB, BS, H, D]). Returns the updated (k_pool, v_pool)
+    vars — the decode program fetches these as the next step's feeds."""
+    helper = LayerHelper("paged_kv_write", name=name)
+    k_out = helper.create_tmp_variable(k_pool.dtype)
+    v_out = helper.create_tmp_variable(v_pool.dtype)
+    helper.append_op("paged_kv_write",
+                     {"KPool": k_pool, "VPool": v_pool, "K": k, "V": v,
+                      "BlockTables": block_tables,
+                      "ContextLens": context_lens},
+                     {"KOut": k_out, "VOut": v_out}, {})
+    return k_out, v_out
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
+                    scale=0.0, name=None):
+    """One decode token per slot (q [S, 1, H, D]) attends through its
+    block table into the paged KV pool. Returns [S, 1, H, D]."""
+    helper = LayerHelper("paged_attention", name=name)
+    out = helper.create_tmp_variable(q.dtype)
+    helper.append_op("paged_attention",
+                     {"Q": q, "KPool": k_pool, "VPool": v_pool,
+                      "BlockTables": block_tables,
+                      "ContextLens": context_lens},
+                     {"Out": out}, {"scale": float(scale)})
+    return out
+
+
 def multi_head_attention(queries, keys=None, values=None, *, num_heads,
                          d_key=None, d_value=None, d_model=None,
                          causal=False, sp_mode="none", dropout_rate=0.0,
                          param_attr=None, bias_attr=None, tp_shard=False,
-                         name=None):
+                         kv_out=None, name=None):
     """Full MHA block on [B, S, d_model] vars: QKV projections → fused
     attention → output projection. Self-attention when keys/values omitted.
 
     tp_shard: mark projection weights Megatron-style (column-parallel QKV,
     row-parallel output) for the `tp` mesh axis.
+
+    kv_out: optional list — the per-head K and V vars ([B, S, H, d_key])
+    are appended as a (k, v) pair, so a prefill export can fetch them for
+    the paged decode cache (serving/decode).
     """
     from . import nn as L
     from .nn import dropout as drop_layer
@@ -85,6 +120,8 @@ def multi_head_attention(queries, keys=None, values=None, *, num_heads,
     qr = L.reshape(q, [0, 0, num_heads, d_key])
     kr = L.reshape(k, [0, 0, num_heads, d_key])
     vr = L.reshape(v, [0, 0, num_heads, d_value])
+    if kv_out is not None:
+        kv_out.append((kr, vr))
 
     ctx = fused_attention(qr, kr, vr, causal=causal, sp_mode=sp_mode,
                           name=name)

@@ -45,20 +45,37 @@ def _ffn(x, d_model, d_ff, idx, tp_shard):
 def transformer_lm(src_ids, vocab_size, n_layers=2, d_model=128, n_heads=4,
                    d_ff=512, max_len=2048, dropout_rate=0.0,
                    causal=True, sp_mode="none", tp_shard=False,
-                   remat=False):
-    """src_ids: [B, S] int64 var. Returns logits [B, S, vocab_size]."""
+                   remat=False, pos_table_len=None, collect_kv=None):
+    """src_ids: [B, S] int64 var. Returns logits [B, S, vocab_size].
+
+    pos_table_len: size the `pos_emb` parameter to this many rows and
+    slice the first S at use (default None keeps the historical
+    shape-[S, d] parameter). A prefill program built per length bucket
+    passes the trained sequence length here so every bucket shares the
+    one trained table.
+
+    collect_kv: optional list — each layer appends its per-head (k, v)
+    vars ([B, S, H, d_key]); the decode export fetches them to seed the
+    paged KV cache (serving/decode).
+    """
     seq_len = int(src_ids.shape[1])
     if seq_len > max_len:
         raise ValueError(f"sequence length {seq_len} exceeds max_len "
                          f"{max_len}; raise max_len")
+    pos_rows = seq_len if pos_table_len is None else int(pos_table_len)
+    if seq_len > pos_rows:
+        raise ValueError(f"sequence length {seq_len} exceeds the "
+                         f"pos_table_len {pos_rows} rows of pos_emb")
     emb = layers.embedding(src_ids, [vocab_size, d_model],
                            param_attr=ParamAttr(
                                name="tok_emb",
                                initializer=NormalInitializer(scale=0.02)))
-    pos = layers.create_parameter([seq_len, d_model],
+    pos = layers.create_parameter([pos_rows, d_model],
                                   dtype="float32", name="pos_emb",
                                   default_initializer=NormalInitializer(
                                       scale=0.02))
+    if pos_rows != seq_len:
+        pos = layers.slice(pos, axes=[0], starts=[0], ends=[seq_len])
     x = layers.elementwise_add(emb, pos)
     if dropout_rate:
         x = layers.dropout(x, dropout_prob=dropout_rate)
@@ -78,7 +95,8 @@ def transformer_lm(src_ids, vocab_size, n_layers=2, d_model=128, n_heads=4,
                                     bias_attr=ParamAttr(name=f"ln1_{i}_bias"))
             att = layers.multi_head_attention(
                 ln1, num_heads=n_heads, causal=causal, sp_mode=sp_mode,
-                dropout_rate=dropout_rate, tp_shard=tp_shard, name=f"attn{i}")
+                dropout_rate=dropout_rate, tp_shard=tp_shard,
+                kv_out=collect_kv, name=f"attn{i}")
             x = layers.elementwise_add(x, att)
             ln2 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln2_{i}",
                                     param_attr=ParamAttr(name=f"ln2_{i}_scale"),
@@ -104,3 +122,117 @@ def transformer_lm_loss(vocab_size=1000, seq_len=128, **kw):
     loss = layers.softmax_with_cross_entropy(logits, tgt)
     avg = layers.mean(loss)
     return avg, logits
+
+
+# ---------------------------------------------------------------------------
+# Autoregressive decode-step program (serving/decode)
+# ---------------------------------------------------------------------------
+
+def _decode_attention(x, idx, num_heads, d_key, d_model, k_pool, v_pool,
+                      block_tables, context_lens):
+    """One layer's decode attention: project the single new token per
+    slot, write its K/V row into the paged pool, attend through the block
+    table. Parameter names match multi_head_attention(name=f"attn{idx}")
+    so the decode program shares the trained weights by name."""
+    name = f"attn{idx}"
+
+    def proj(inp, width, tag):
+        return layers.fc(inp, size=width, num_flatten_dims=2,
+                         param_attr=ParamAttr(name=f"{name}_{tag}_w"),
+                         bias_attr=ParamAttr(name=f"{name}_{tag}_b"),
+                         name=f"{name}_{tag}")
+
+    q = proj(x, num_heads * d_key, "q")
+    k = proj(x, num_heads * d_key, "k")
+    v = proj(x, num_heads * d_key, "v")
+    qr = layers.reshape(q, [0, 0, num_heads, d_key])
+    kr = layers.reshape(k, [0, 0, num_heads, d_key])
+    vr = layers.reshape(v, [0, 0, num_heads, d_key])
+    k_out, v_out = layers.paged_kv_write(k_pool, v_pool, kr, vr,
+                                         block_tables, context_lens)
+    ctx = layers.paged_attention(qr, k_out, v_out, block_tables,
+                                 context_lens)
+    merged = layers.reshape(ctx, [0, 0, num_heads * d_key])
+    return proj(merged, d_model, "out"), k_out, v_out
+
+
+def transformer_decode_step(vocab_size, *, n_layers, d_model, n_heads,
+                            d_ff, max_context, slots, block_size,
+                            pool_blocks, max_blocks_per_seq):
+    """Build the fixed-shape continuous-batching decode step: ONE new
+    token per active slot against the paged KV pool.
+
+    Feeds (all static shape; no batch coalescing — the slot axis IS the
+    batch): token_ids [slots] int64, context_lens [slots] int32 (span
+    INCLUDING the new token; 0 = inactive slot), block_tables
+    [slots, max_blocks_per_seq] int32 (entries into the pool; 0 is the
+    reserved null block), and per layer k_cache_{i}/v_cache_{i}
+    [pool_blocks, block_size, H, d_key].
+
+    Returns (logits [slots, vocab], [(k_out, v_out) per layer],
+    feed_names) — the pool fetches are the next step's pool feeds.
+    """
+    d_key = d_model // n_heads
+    token_ids = layers.data("token_ids", [slots], dtype="int64",
+                            append_batch_size=False)
+    context_lens = layers.data("context_lens", [slots], dtype="int32",
+                               append_batch_size=False)
+    block_tables = layers.data("block_tables", [slots, max_blocks_per_seq],
+                               dtype="int32", append_batch_size=False)
+    feed_names = ["token_ids", "context_lens", "block_tables"]
+    pools = []
+    for i in range(n_layers):
+        shape = [pool_blocks, block_size, n_heads, d_key]
+        kp = layers.data(f"k_cache_{i}", shape, dtype="float32",
+                         append_batch_size=False)
+        vp = layers.data(f"v_cache_{i}", shape, dtype="float32",
+                         append_batch_size=False)
+        pools.append((kp, vp))
+        feed_names += [f"k_cache_{i}", f"v_cache_{i}"]
+
+    # [slots] ids -> [slots, d] rows -> [slots, 1, d]: the decode "batch"
+    # is the slot axis, the sequence axis is the single new token
+    emb = layers.unsqueeze(
+        layers.embedding(token_ids, [vocab_size, d_model],
+                         param_attr=ParamAttr(
+                             name="tok_emb",
+                             initializer=NormalInitializer(scale=0.02))),
+        [1])
+    pos_tab = layers.create_parameter([max_context, d_model],
+                                      dtype="float32", name="pos_emb",
+                                      default_initializer=NormalInitializer(
+                                          scale=0.02))
+    one = layers.fill_constant([slots], "int32", 1.0)
+    zero = layers.fill_constant([slots], "int32", 0.0)
+    # the new token sits at position context_len-1; inactive slots (len
+    # 0) clamp to row 0 — their rows only ever land in the null block
+    pos_ids = layers.elementwise_max(
+        layers.elementwise_sub(context_lens, one), zero)
+    pos_vec = layers.unsqueeze(layers.gather(pos_tab, pos_ids), [1])
+    x = layers.elementwise_add(emb, pos_vec)
+
+    pool_outs = []
+    for i in range(n_layers):
+        ln1 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln1_{i}",
+                                param_attr=ParamAttr(name=f"ln1_{i}_scale"),
+                                bias_attr=ParamAttr(name=f"ln1_{i}_bias"))
+        att, k_out, v_out = _decode_attention(
+            ln1, i, n_heads, d_key, d_model, pools[i][0], pools[i][1],
+            block_tables, context_lens)
+        pool_outs.append((k_out, v_out))
+        x = layers.elementwise_add(x, att)
+        ln2 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln2_{i}",
+                                param_attr=ParamAttr(name=f"ln2_{i}_scale"),
+                                bias_attr=ParamAttr(name=f"ln2_{i}_bias"))
+        ff = _ffn(ln2, d_model, d_ff, i, tp_shard=False)
+        x = layers.elementwise_add(x, ff)
+
+    x = layers.layer_norm(x, begin_norm_axis=2, name="ln_f",
+                          param_attr=ParamAttr(name="ln_f_scale"),
+                          bias_attr=ParamAttr(name="ln_f_bias"))
+    logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
+                       param_attr=ParamAttr(name="lm_head_w"),
+                       bias_attr=ParamAttr(name="lm_head_b"),
+                       name="lm_head")
+    logits = layers.reshape(logits, [slots, vocab_size])
+    return logits, pool_outs, feed_names

@@ -98,3 +98,52 @@ def scaled_dot_product_attention(ctx, ins, attrs):
     # degrading to full recompute under sp
     from jax.ad_checkpoint import checkpoint_name
     return {"Out": [checkpoint_name(fn(q, k, v), "flash_attn_out")]}
+
+
+# ---------------------------------------------------------------------------
+# Paged decode ops (serving/decode): one token per sequence slot against a
+# block-paged KV pool. Inference-only — no grad rule needed; the decode
+# program is built is_test and never differentiated.
+# ---------------------------------------------------------------------------
+
+def _paged_write_infer(op, block):
+    for pool_in, pool_out in (("KPool", "KOut"), ("VPool", "VOut")):
+        src = block.var(op.input(pool_in)[0])
+        dst = block.var(op.output(pool_out)[0])
+        dst.shape, dst.dtype = src.shape, src.dtype
+
+
+@register_op("paged_kv_write", infer_shape=_paged_write_infer)
+def paged_kv_write(ctx, ins, attrs):
+    """Scatter each slot's new K/V row ([S, 1, H, D]) into its page of the
+    pool ([NB, BS, H, D]) at position ContextLens-1. Slots with
+    ContextLens 0 write into the reserved null block 0."""
+    from ..kernels.flash_attention import paged_kv_update
+
+    k, v = ins["K"][0], ins["V"][0]
+    ko, vo = paged_kv_update(ins["KPool"][0], ins["VPool"][0],
+                             k[:, 0], v[:, 0],
+                             ins["BlockTables"][0], ins["ContextLens"][0])
+    return {"KOut": [ko], "VOut": [vo]}
+
+
+def _paged_attn_infer(op, block):
+    q = block.var(op.input("Q")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape, out.dtype = q.shape, q.dtype
+
+
+@register_op("paged_attention", infer_shape=_paged_attn_infer)
+def paged_attention(ctx, ins, attrs):
+    """Q: [S, 1, H, D] (one decode token per slot) against the paged pool
+    through the per-slot block table; ContextLens is the span INCLUDING
+    the just-written token. Pallas kernel on TPU shapes, gather-based XLA
+    reference elsewhere (kernels/flash_attention.py)."""
+    from ..kernels.flash_attention import paged_decode_attention
+
+    q = ins["Q"][0]
+    scale = attrs.get("scale", 0.0) or None
+    out = paged_decode_attention(q[:, 0], ins["KPool"][0], ins["VPool"][0],
+                                 ins["BlockTables"][0],
+                                 ins["ContextLens"][0], scale=scale)
+    return {"Out": [out[:, None]]}

@@ -77,6 +77,7 @@ class ServingEngine:
                             if deadline_ms is None else float(deadline_ms))
         self.metrics = ServingMetrics()
         self.registry = ModelRegistry(self._make_batcher)
+        self._decode: Dict[str, object] = {}
         self._closed = False
 
     # -- wiring --------------------------------------------------------------
@@ -111,7 +112,50 @@ class ServingEngine:
         self.registry.unload(name)
 
     def models(self) -> Dict[str, dict]:
-        return self.registry.describe()
+        out = self.registry.describe()
+        for name, eng in list(self._decode.items()):
+            out[name] = dict(out.get(name, {}), decode=eng.describe())
+        return out
+
+    # -- the generation plane (paged KV + continuous batching) ---------------
+    def load_decode_model(self, name: str, model_dir: str,
+                          warmup: bool = True, **opts) -> dict:
+        """Load (or hot-swap) a decode bundle (io.export_decode_model)
+        under `name`. The new engine is built and warmed off to the
+        side, the routing pointer swaps, then the old engine drains —
+        the reload contract of the one-shot plane, kept. opts pass
+        through to DecodeEngine (queue_depth, deadline_ms,
+        max_new_tokens, continuous)."""
+        if self._closed:
+            raise ModelUnavailable("engine is shut down")
+        from .decode import DecodeEngine
+        eng = DecodeEngine(model_dir, name=name, warmup=warmup,
+                           metrics=self.metrics.decode(name), **opts)
+        old = self._decode.get(name)
+        self._decode[name] = eng
+        if old is not None:
+            old.shutdown(drain=True)
+        return eng.describe()
+
+    def unload_decode_model(self, name: str) -> None:
+        eng = self._decode.pop(name, None)
+        if eng is not None:
+            eng.shutdown(drain=True)
+
+    def decode_engine(self, name: str):
+        eng = self._decode.get(name)
+        if eng is None:
+            raise ModelUnavailable(
+                f"no decode model named {name!r} is loaded")
+        return eng
+
+    def generate(self, name: str, prompt_ids, **kw):
+        """Admit one generation request; returns a GenerationHandle
+        (stream() for live tokens, result() for the final dict). Typed
+        admission errors raise here, reject-fast."""
+        if self._closed:
+            raise ModelUnavailable("engine is shut down")
+        return self.decode_engine(name).generate(prompt_ids, **kw)
 
     # -- the request path ----------------------------------------------------
     def submit(self, name: str, feeds: Dict,
@@ -152,6 +196,10 @@ class ServingEngine:
         return self.metrics.snapshot()
 
     def shutdown(self, drain: bool = True) -> None:
-        """Stop all batchers. drain=True serves the backlog first."""
+        """Stop all batchers + decode engines. drain=True serves the
+        backlog first."""
         self._closed = True
         self.registry.close(drain=drain)
+        for eng in list(self._decode.values()):
+            eng.shutdown(drain=drain)
+        self._decode.clear()

@@ -1,0 +1,49 @@
+"""paddle_tpu.serving.decode — autoregressive generation over the
+serving engine: paged KV cache + continuous batching.
+
+PR 5's micro-batcher coalesces fixed-shape one-shot requests — right for
+classifiers, wrong for LLM decode, where every sequence wants hundreds
+of dependent single-token dispatches and sequences finish at different
+times. This subsystem is the decode-shaped counterpart, layered on the
+same artifact plane:
+
+    DecodeEngine            facade: admission + scheduler + metrics
+      ├── DecodeModel       the two-artifact bundle
+      │                     (io.export_decode_model): length-bucketed
+      │                     PREFILL artifacts served through the PR-5
+      │                     ModelVersion, plus ONE fixed-shape
+      │                     DECODE-STEP artifact whose KV pools thread
+      │                     device-resident from fetch to feed
+      ├── DecodeScheduler   continuous batching: admit into free slots
+      │                     of the in-flight batch (no drain barrier),
+      │                     evict lowest-priority under pool pressure,
+      │                     deadline-aware shedding by remaining-token
+      │                     estimate (typed Overloaded /
+      │                     DeadlineExceeded)
+      └── KVBlockPool       host accounting for the paged device pool:
+                            fixed-size blocks, per-sequence block
+                            tables, alloc/free/defrag
+
+Correctness contract (tested): continuous-batched paged decode is
+token-identical to a sequential per-sequence reference decode under
+greedy sampling — including sequences admitted mid-flight and sequences
+evicted then resumed.
+
+Env knobs (export-time geometry + runtime budget; declared in
+paddle_tpu/flags.py):
+
+    PT_DECODE_BLOCK_SIZE      tokens per KV block (export default 16)
+    PT_DECODE_POOL_BLOCKS     pool blocks incl. the null block (64)
+    PT_DECODE_MAX_SLOTS       decode-step slot count (8)
+    PT_DECODE_MAX_NEW_TOKENS  default generation budget (64)
+"""
+
+from __future__ import annotations
+
+from .engine import DecodeEngine, DecodeModel
+from .kv_cache import KVBlockPool, PoolExhausted, blocks_for_tokens
+from .scheduler import DecodeScheduler, GenerationHandle, Sequence
+
+__all__ = ["DecodeEngine", "DecodeModel", "DecodeScheduler",
+           "GenerationHandle", "Sequence", "KVBlockPool", "PoolExhausted",
+           "blocks_for_tokens"]

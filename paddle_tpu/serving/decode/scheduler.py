@@ -1,0 +1,484 @@
+"""Continuous-batching scheduler: prefill/decode split, slot admission,
+eviction under KV-pool pressure.
+
+The shape insight (vLLM-style continuous batching, translated to AOT
+artifacts): the decode step is ONE fixed-shape dispatch — one token per
+slot — so sequences of wildly different lengths share a batch, and a
+sequence that finishes frees its slot for a WAITING sequence at the very
+next iteration. There is no drain-to-empty barrier: admission happens
+into the in-flight batch. The alternative (static batching: admit N,
+decode until ALL N finish) wastes every slot whose sequence finished
+early — the `decode` bench config measures exactly that gap, and this
+scheduler also implements the static mode (`continuous=False`) to BE the
+honest baseline.
+
+Split responsibilities:
+
+    prefill   the prompt runs ONCE through the length-bucketed
+              full-attention artifacts (the PR-5 ModelVersion, padding
+              and all), emitting the first token AND every layer's K/V
+              rows, which seed the sequence's pool blocks;
+    decode    each iteration advances every RUNNING sequence one token
+              through the paged decode-step artifact.
+
+Eviction/preemption: when a sequence needs a KV block and the pool has
+none, the lowest-priority (then youngest) victim is preempted — blocks
+freed, sequence re-queued at the waiting front. A resumed sequence
+re-prefills prompt+generated (greedy decode is a pure function of the
+prefix, so the continuation is token-identical — tested). Shedding is
+typed through PR-5's admission machinery: `Overloaded` (queue/pool
+pressure, retryable) and `DeadlineExceeded` (the remaining-token
+estimate — tokens left x EWMA step seconds — says the deadline is
+unmeetable, or it already passed).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence as Seq
+
+import numpy as np
+
+from ..admission import (AdmissionController, DeadlineExceeded,
+                         ModelUnavailable, Overloaded)
+from ..metrics import DecodeMetrics
+from .kv_cache import KVBlockPool, PoolExhausted, block_table_row
+
+__all__ = ["GenerationHandle", "Sequence", "DecodeScheduler"]
+
+_TOK, _DONE, _ERR = 0, 1, 2
+
+
+class GenerationHandle:
+    """The caller's view of one generation: a token stream plus a final
+    result. Tokens arrive on an internal queue as the scheduler emits
+    them; `stream()` yields them live, `result()` blocks to the end.
+    Terminal failures (typed serving errors) raise from either."""
+
+    def __init__(self, prompt_len: int):
+        self.prompt_len = prompt_len
+        self._q: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+        self._result: Optional[dict] = None
+        self._error: Optional[BaseException] = None
+
+    # -- scheduler side ------------------------------------------------------
+    def _put_token(self, tok: int) -> None:
+        self._q.put((_TOK, tok))
+
+    def _finish(self, result: dict) -> None:
+        self._result = result
+        self._done.set()
+        self._q.put((_DONE, result))
+
+    def _fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._done.set()
+        self._q.put((_ERR, exc))
+
+    # -- caller side ---------------------------------------------------------
+    def stream(self, timeout: Optional[float] = None):
+        """Yield tokens as they are generated; returns when the sequence
+        finishes, raises its typed error if it was shed/failed, raises
+        TimeoutError (like result()) when no token arrives in time."""
+        while True:
+            try:
+                kind, val = self._q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    "generation still in progress") from None
+            if kind == _TOK:
+                yield val
+            elif kind == _DONE:
+                return
+            else:
+                raise val
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        """Block until the sequence finishes; returns {"tokens",
+        "finish_reason", "evictions", "prompt_len"}."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation still in progress")
+        if self._error is not None:
+            raise self._error
+        return dict(self._result)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class Sequence:
+    """Scheduler-internal state of one generation request."""
+
+    __slots__ = ("sid", "prompt", "max_new", "deadline_t", "priority",
+                 "eos_id", "handle", "t_submit", "generated", "blocks",
+                 "slot", "cached_len", "evictions")
+
+    def __init__(self, sid: int, prompt: List[int], max_new: int,
+                 deadline_t: Optional[float], priority: int,
+                 eos_id: Optional[int], handle: GenerationHandle):
+        self.sid = sid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.deadline_t = deadline_t
+        self.priority = priority
+        self.eos_id = eos_id
+        self.handle = handle
+        self.t_submit = time.monotonic()
+        self.generated: List[int] = []
+        self.blocks: List[int] = []
+        self.slot: Optional[int] = None
+        #: pool positions holding this sequence's K/V; the LAST generated
+        #: token is never cached (it is the next step's input)
+        self.cached_len = 0
+        self.evictions = 0
+
+    @property
+    def tokens_so_far(self) -> List[int]:
+        return self.prompt + self.generated
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new - len(self.generated)
+
+
+class DecodeScheduler:
+    """One model's generation scheduler: a submission queue drained by
+    one scheduler thread that interleaves prefill admission with
+    fixed-shape decode steps over the in-flight slot batch.
+
+    model: DecodeModel-like — max_prompt_len, max_context, slots,
+    block_size, eos_id, prefill(tokens) -> (last_logits, kv_rows),
+    seed_sequence(blocks, kv_rows), decode_step(tokens, lens, tables)
+    -> logits [slots, vocab], free capacity given by the injected pool.
+    """
+
+    def __init__(self, model, pool: KVBlockPool,
+                 admission: AdmissionController,
+                 metrics: Optional[DecodeMetrics] = None, *,
+                 continuous: bool = True, name: str = "model"):
+        self.model = model
+        self.pool = pool
+        self.admission = admission
+        self.metrics = metrics or DecodeMetrics(name)
+        self.continuous = continuous
+        self.name = name
+        self._cv = threading.Condition()
+        self._incoming: List[Sequence] = []
+        self._waiting: List[Sequence] = []   # scheduler-thread-owned
+        self._running: List[Sequence] = []   # scheduler-thread-owned
+        self._load = 0                       # live sequences, any state
+        self._next_sid = 0
+        self._closed = False
+        self._drained = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"pt-decode[{name}]")
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------------
+    def queued(self) -> int:
+        with self._cv:
+            return self._load
+
+    def submit(self, prompt: Seq[int], max_new: int,
+               deadline_ms: Optional[float] = None, priority: int = 0,
+               eos_id: Optional[int] = None) -> GenerationHandle:
+        """Admit one generation request. Typed admission errors raise
+        HERE (reject-fast); later shedding surfaces on the handle."""
+        deadline_t = self.admission.deadline_for(deadline_ms)
+        handle = GenerationHandle(len(prompt))
+        with self._cv:
+            if self._closed:
+                raise ModelUnavailable(
+                    f"decode engine {self.name!r} is shut down")
+            try:
+                self.admission.admit(self._load, deadline_t,
+                                     model=self.name)
+            except DeadlineExceeded:
+                self.metrics.on_shed("deadline")
+                raise
+            except Exception:
+                self.metrics.on_shed("overload")
+                raise
+            seq = Sequence(self._next_sid, list(prompt), int(max_new),
+                           deadline_t, int(priority),
+                           eos_id if eos_id is not None
+                           else self.model.eos_id, handle)
+            self._next_sid += 1
+            self._incoming.append(seq)
+            self._load += 1
+            self.metrics.on_received()
+            self._cv.notify()
+        return handle
+
+    def while_idle(self, fn):
+        """Run fn() under the scheduler lock with ZERO live sequences —
+        submit() blocks on the same lock, so nothing can be admitted (and
+        no decode step can start) while fn mutates pool state. Raises if
+        any sequence is live in any state (incoming/waiting/running)."""
+        with self._cv:
+            if self._load:
+                raise RuntimeError(
+                    f"engine {self.name!r} has {self._load} live "
+                    "sequence(s); idle-only maintenance refused")
+            return fn()
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """drain=True generates every admitted sequence to completion
+        first; drain=False fails the backlog fast."""
+        with self._cv:
+            self._closed = True
+            self._drain_on_close = drain
+            self._cv.notify()
+        self._drained.wait(timeout)
+        self._thread.join(timeout)
+
+    # -- scheduler thread ----------------------------------------------------
+    def _loop(self) -> None:
+        self._drain_on_close = True
+        try:
+            while True:
+                with self._cv:
+                    while True:
+                        if self._incoming:
+                            self._waiting.extend(self._incoming)
+                            self._incoming.clear()
+                        if self._closed:
+                            break
+                        if self._waiting or self._running:
+                            break
+                        self._cv.wait()
+                    if self._closed and not self._drain_on_close:
+                        self._fail_backlog()
+                    if self._closed and not (self._waiting
+                                             or self._running):
+                        return
+                # heavy work outside the lock: only this thread touches
+                # _waiting/_running
+                self._shed_unmeetable()
+                self._admit()
+                self._step()
+                self._publish_gauges()
+        finally:
+            self._drained.set()
+
+    def _fail_backlog(self) -> None:
+        for seq in self._waiting + self._running:
+            self._terminate(seq, error=ModelUnavailable(
+                f"decode engine {self.name!r} shut down before "
+                "completion"))
+        self._waiting.clear()
+        self._running.clear()
+
+    def _publish_gauges(self) -> None:
+        self.metrics.set_gauges(
+            active=len(self._running), waiting=len(self._waiting),
+            blocks_in_use=self.pool.blocks_in_use,
+            blocks_capacity=self.pool.capacity,
+            high_water=self.pool.high_water)
+
+    # -- terminal transitions ------------------------------------------------
+    def _terminate(self, seq: Sequence, *, result: Optional[dict] = None,
+                   error: Optional[BaseException] = None) -> None:
+        """Free-on-finish: every block goes back to the pool, whatever
+        the outcome."""
+        if seq.blocks:
+            self.pool.free(seq.blocks)
+            seq.blocks = []
+        seq.slot = None
+        with self._cv:
+            self._load -= 1
+        if error is not None:
+            self.metrics.on_finished(False)
+            seq.handle._fail(error)
+        else:
+            self.metrics.on_finished(True)
+            seq.handle._finish(result)
+
+    def _finish(self, seq: Sequence, reason: str) -> None:
+        self._terminate(seq, result={
+            "tokens": list(seq.generated), "finish_reason": reason,
+            "evictions": seq.evictions, "prompt_len": len(seq.prompt)})
+
+    def _finish_reason(self, seq: Sequence, tok: int) -> Optional[str]:
+        if seq.eos_id is not None and tok == seq.eos_id:
+            return "eos"
+        if len(seq.generated) >= seq.max_new:
+            return "length"
+        return None
+
+    # -- deadline shedding ---------------------------------------------------
+    def _shed_unmeetable(self) -> None:
+        """Expired deadlines always shed; un-expired ones shed when the
+        remaining-token estimate (tokens left x EWMA step seconds) says
+        the deadline cannot be met — the cold engine (no estimate yet)
+        never sheds on a guess."""
+        now = time.monotonic()
+        est = self.admission.estimated_batch_s()
+        for lst in (self._waiting, self._running):
+            for seq in list(lst):
+                if seq.deadline_t is None:
+                    continue
+                expired = now >= seq.deadline_t
+                unmeetable = (est is not None and
+                              now + seq.remaining * est > seq.deadline_t)
+                if expired or unmeetable:
+                    lst.remove(seq)
+                    self.metrics.on_shed("deadline")
+                    why = ("deadline expired" if expired else
+                           f"~{seq.remaining} tokens x {est * 1000:.1f} "
+                           "ms/step exceed the deadline")
+                    self._terminate(seq, error=DeadlineExceeded(
+                        f"sequence shed: {why} (model {self.name!r})"))
+
+    # -- eviction ------------------------------------------------------------
+    def _evict(self, victim: Sequence) -> None:
+        """Preempt: free blocks+slot, requeue at the waiting FRONT. If
+        its grown context can no longer re-prefill (past the largest
+        bucket), shed instead — resuming would be impossible."""
+        self._running.remove(victim)
+        self.pool.free(victim.blocks)
+        victim.blocks = []
+        victim.slot = None
+        victim.cached_len = 0
+        victim.evictions += 1
+        self.metrics.on_evicted()
+        if len(victim.tokens_so_far) > self.model.max_prompt_len:
+            self.metrics.on_shed("overload")
+            self._terminate(victim, error=Overloaded(
+                f"evicted under KV-pool pressure and its context "
+                f"({len(victim.tokens_so_far)} tokens) exceeds the "
+                f"largest prefill bucket {self.model.max_prompt_len} — "
+                "cannot resume (model {0!r})".format(self.name)))
+        else:
+            self._waiting.insert(0, victim)
+
+    def _evict_for(self, seq: Sequence, need: int,
+                   allow_peers: bool) -> bool:
+        """Evict running sequences until `need` blocks are free. Victims
+        must rank strictly below `seq` — lower priority, or (only when
+        allow_peers, the mid-decode growth case, which guarantees the
+        oldest sequence always progresses) same priority but younger."""
+
+        def rank(s: Sequence):
+            return (s.priority, -s.t_submit)   # low priority, young first
+
+        while not self.pool.can_alloc(need):
+            victims = [s for s in self._running if s is not seq
+                       and (s.priority < seq.priority
+                            or (allow_peers
+                                and s.priority == seq.priority
+                                and s.t_submit > seq.t_submit))]
+            if not victims:
+                return False
+            self._evict(min(victims, key=rank))
+        return True
+
+    # -- admission (prefill) -------------------------------------------------
+    def _admit(self) -> None:
+        if not self._waiting:
+            return
+        if not self.continuous and self._running:
+            return   # the static baseline: drain-to-empty barrier
+        # priority first, then arrival order (evictees keep their
+        # original t_submit, so they resume before younger peers)
+        order = sorted(self._waiting, key=lambda s: (-s.priority,
+                                                     s.t_submit))
+        for seq in order:
+            if len(self._running) >= self.model.slots:
+                break
+            tokens = seq.tokens_so_far
+            need = self.pool.blocks_for_tokens(len(tokens))
+            if not self.pool.can_alloc(need) and \
+                    not self._evict_for(seq, need, allow_peers=False):
+                continue   # stays waiting; capacity frees as others end
+            self._waiting.remove(seq)
+            if seq.evictions:
+                self.metrics.on_resumed()
+            seq.blocks = self.pool.alloc(need)
+            t0 = time.monotonic()
+            try:
+                last_logits, kv_rows = self.model.prefill(tokens)
+                self.model.seed_sequence(seq.blocks, kv_rows)
+            except Exception as e:  # noqa: BLE001 — typed + delivered
+                self._terminate(seq, error=e if isinstance(
+                    e, (Overloaded, DeadlineExceeded)) else
+                    _request_failed(self.name, e))
+                continue
+            dt = time.monotonic() - t0
+            self.metrics.on_prefill(len(tokens), dt)
+            seq.cached_len = len(tokens)
+            tok = int(np.argmax(last_logits))
+            seq.generated.append(tok)
+            seq.handle._put_token(tok)
+            reason = self._finish_reason(seq, tok)
+            if reason is not None:
+                self._finish(seq, reason)
+                continue
+            free_slots = [i for i in range(self.model.slots)
+                          if all(r.slot != i for r in self._running)]
+            seq.slot = free_slots[0]
+            self._running.append(seq)
+
+    # -- one decode step -----------------------------------------------------
+    def _step(self) -> None:
+        if not self._running:
+            return
+        # grow block capacity in priority order so the important
+        # sequences claim blocks (and pick victims) first
+        for seq in sorted(list(self._running),
+                          key=lambda s: (-s.priority, s.t_submit)):
+            if seq not in self._running:
+                continue   # evicted by a higher-priority peer this pass
+            need = (self.pool.blocks_for_tokens(seq.cached_len + 1)
+                    - len(seq.blocks))
+            if need <= 0:
+                continue
+            if not self.pool.can_alloc(need) and \
+                    not self._evict_for(seq, need, allow_peers=True):
+                # no victims rank below it and the pool is dry: preempt
+                # ITSELF — resume when capacity frees. Progress is
+                # guaranteed: the oldest highest-priority sequence always
+                # either allocates or finds victims, so the pool drains
+                # toward completion rather than thrashing. (A sequence
+                # that can never fit at all was already shed typed at
+                # submit by the engine's peak-residency check.)
+                self._evict(seq)
+                continue
+            seq.blocks.extend(self.pool.alloc(need))
+        active = list(self._running)
+        if not active:
+            return
+        slots = self.model.slots
+        tokens = np.zeros(slots, np.int64)
+        lens = np.zeros(slots, np.int32)
+        tables = np.zeros((slots, self.model.max_blocks_per_seq), np.int32)
+        for seq in active:
+            tokens[seq.slot] = seq.generated[-1]
+            lens[seq.slot] = seq.cached_len + 1
+            tables[seq.slot] = block_table_row(
+                seq.blocks, self.model.max_blocks_per_seq)
+        t0 = time.monotonic()
+        logits = self.model.decode_step(tokens, lens, tables)
+        dt = time.monotonic() - t0
+        self.admission.observe_batch(dt)
+        self.metrics.on_step(len(active), slots, dt, len(active))
+        for seq in active:
+            tok = int(np.argmax(logits[seq.slot]))
+            seq.cached_len += 1
+            seq.generated.append(tok)
+            seq.handle._put_token(tok)
+            reason = self._finish_reason(seq, tok)
+            if reason is not None:
+                self._running.remove(seq)
+                self._finish(seq, reason)
+
+
+def _request_failed(name: str, cause: BaseException):
+    from ..admission import RequestFailed
+    return RequestFailed(
+        f"decode engine {name!r} failed running prefill: {cause}",
+        cause=cause)

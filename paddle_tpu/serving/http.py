@@ -12,27 +12,45 @@ Routes (JSON in/out):
     POST /v1/models/<name>:predict   {"feeds": {name: nested-list},
                                       "deadline_ms": optional}
          -> {"fetches": {name: {"data","shape","dtype"}}, "model_version"}
+    POST /v1/models/<name>:generate  {"prompt_ids": [ints],
+                                      "max_new_tokens", "deadline_ms",
+                                      "priority", "eos_id",
+                                      "stream": bool (default true)}
+         stream=true  -> chunked application/x-ndjson: one
+                         {"token": t, "index": i} line per generated
+                         token, then {"done": true, "tokens": [...],
+                         "finish_reason": ...}
+         stream=false -> one JSON body with the final result
     POST /v1/models/<name>:reload    {"model_dir": path} -> {"version": N}
     GET  /v1/models                  registry description
-    GET  /v1/metrics                 metrics snapshot
+    GET  /v1/metrics                 metrics snapshot (JSON)
+    GET  /v1/metrics?format=prometheus
+         (also /metrics)             Prometheus text exposition of the
+                                     same snapshot — both serving planes
+                                     (one-shot + decode) in one scrape
 
 Typed serving errors map to their http_status (429 Overloaded, 504
 DeadlineExceeded, 404 ModelUnavailable, 400 InvalidRequest, 500
 RequestFailed) with a JSON body naming the error type, so clients can
 key retry policy off the type exactly like in-process callers do
-(admission.retryable).
+(admission.retryable). A typed error that fires MID-STREAM (a sequence
+shed after its first tokens went out) arrives as a terminal
+{"error": type, "message": ...} NDJSON line — the status line already
+shipped, so the error type rides in-band.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 import numpy as np
 
 from .admission import InvalidRequest, ServingError
+from .metrics import render_prometheus
 
 __all__ = ["make_server", "start_http_server"]
 
@@ -82,11 +100,23 @@ class _Handler(BaseHTTPRequestHandler):
     # -- routes --------------------------------------------------------------
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         engine = self.server.engine
+        split = urllib.parse.urlsplit(self.path)
+        query = urllib.parse.parse_qs(split.query)
         try:
-            if self.path == "/v1/models":
+            if split.path == "/v1/models":
                 self._send(200, {"models": engine.models()})
-            elif self.path == "/v1/metrics":
-                self._send(200, engine.metrics_snapshot())
+            elif split.path in ("/v1/metrics", "/metrics"):
+                if query.get("format", [""])[0] == "prometheus":
+                    body = render_prometheus(
+                        engine.metrics_snapshot()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._send(200, engine.metrics_snapshot())
             else:
                 self._send(404, {"error": "NotFound",
                                  "message": self.path})
@@ -99,6 +129,9 @@ class _Handler(BaseHTTPRequestHandler):
             route = self._model_route(":predict")
             if route is not None:
                 return self._predict(engine, route[0])
+            route = self._model_route(":generate")
+            if route is not None:
+                return self._generate(engine, route[0])
             route = self._model_route(":reload")
             if route is not None:
                 body = self._read_json()
@@ -142,6 +175,59 @@ class _Handler(BaseHTTPRequestHandler):
             for k, v in result.items()}
         self._send(200, {"fetches": fetches,
                          "model_version": model.version})
+
+    def _generate(self, engine, name: str) -> None:
+        body = self._read_json()
+        prompt = body.get("prompt_ids")
+        if not isinstance(prompt, list) or not prompt:
+            raise InvalidRequest(
+                "generate needs {'prompt_ids': [int, ...]}")
+        kw = {}
+        for key in ("max_new_tokens", "deadline_ms", "priority",
+                    "eos_id"):
+            if body.get(key) is not None:
+                kw[key] = body[key]
+        # typed admission errors raise BEFORE any response bytes -> they
+        # map to their status like every other route
+        handle = engine.generate(name, prompt, **kw)
+        if not body.get("stream", True):
+            result = handle.result()
+            return self._send(200, result)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(payload: dict) -> None:
+            data = (json.dumps(payload) + "\n").encode()
+            self.wfile.write(f"{len(data):X}\r\n".encode() + data
+                             + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            i = 0
+            for tok in handle.stream():
+                chunk({"token": int(tok), "index": i})
+                i += 1
+            result = handle.result()
+            result["done"] = True
+            chunk(result)
+        except OSError:
+            # client hung up mid-stream: the status line already went
+            # out, so nothing more may be written to this socket (a
+            # second status line would be protocol garbage) — close
+            self.close_connection = True
+            return
+        except Exception as e:  # noqa: BLE001 — in-band terminal error
+            try:
+                chunk({"error": type(e).__name__, "message": str(e)})
+            except OSError:
+                self.close_connection = True
+                return
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            self.close_connection = True
 
 
 def make_server(engine, host: str = "127.0.0.1",

@@ -30,8 +30,8 @@ from typing import Callable, Dict, List, Optional
 
 from ..core.async_fetch import PhaseTimer
 
-__all__ = ["ServingPhaseTimer", "ModelMetrics", "ServingMetrics",
-           "PHASES"]
+__all__ = ["ServingPhaseTimer", "ModelMetrics", "DecodeMetrics",
+           "ServingMetrics", "PHASES", "render_prometheus"]
 
 PHASES = ("queue", "pad", "device", "scatter")
 
@@ -169,15 +169,140 @@ class ModelMetrics:
         return out
 
 
+class DecodeMetrics:
+    """One decode engine's counters: sequences, tokens, continuous-batch
+    slot occupancy, and KV-pool pressure. The decode axis is different
+    enough from the request/batch axis that it gets its own type —
+    tokens/s and slot occupancy are THE numbers for a generation engine,
+    where QPS and batch fill are the numbers for a one-shot one."""
+
+    def __init__(self, name: str,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._t0 = self._clock()
+            self.received = 0
+            self.completed = 0
+            self.failed = 0
+            self.shed_overload = 0
+            self.shed_deadline = 0
+            self.evictions = 0
+            self.resumes = 0
+            self.prefills = 0
+            self.prefill_tokens = 0
+            self.steps = 0
+            self.tokens_out = 0
+            self.slots_used_sum = 0
+            self.slots_capacity_sum = 0
+            self.prefill_s = 0.0
+            self.decode_s = 0.0
+            self.active = 0
+            self.waiting = 0
+            self.kv_blocks_in_use = 0
+            self.kv_blocks_capacity = 0
+            self.kv_high_water = 0
+
+    # -- recording ----------------------------------------------------------
+    def on_received(self) -> None:
+        with self._lock:
+            self.received += 1
+
+    def on_finished(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self.completed += 1
+            else:
+                self.failed += 1
+
+    def on_shed(self, kind: str) -> None:
+        with self._lock:
+            if kind == "overload":
+                self.shed_overload += 1
+            else:
+                self.shed_deadline += 1
+
+    def on_evicted(self) -> None:
+        with self._lock:
+            self.evictions += 1
+
+    def on_resumed(self) -> None:
+        with self._lock:
+            self.resumes += 1
+
+    def on_prefill(self, tokens: int, seconds: float) -> None:
+        with self._lock:
+            self.prefills += 1
+            self.prefill_tokens += tokens
+            self.prefill_s += seconds
+
+    def on_step(self, used: int, capacity: int, seconds: float,
+                tokens: int) -> None:
+        with self._lock:
+            self.steps += 1
+            self.slots_used_sum += used
+            self.slots_capacity_sum += capacity
+            self.decode_s += seconds
+            self.tokens_out += tokens
+
+    def set_gauges(self, *, active: int, waiting: int, blocks_in_use: int,
+                   blocks_capacity: int, high_water: int) -> None:
+        with self._lock:
+            self.active = active
+            self.waiting = waiting
+            self.kv_blocks_in_use = blocks_in_use
+            self.kv_blocks_capacity = blocks_capacity
+            self.kv_high_water = high_water
+
+    # -- reading ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            elapsed = max(self._clock() - self._t0, 1e-9)
+            occ = (self.slots_used_sum / self.slots_capacity_sum
+                   if self.slots_capacity_sum else None)
+            return {
+                "model": self.name,
+                "received": self.received,
+                "completed": self.completed,
+                "failed": self.failed,
+                "shed_overload": self.shed_overload,
+                "shed_deadline": self.shed_deadline,
+                "evictions": self.evictions,
+                "resumes": self.resumes,
+                "prefills": self.prefills,
+                "prefill_tokens": self.prefill_tokens,
+                "decode_steps": self.steps,
+                "tokens_out": self.tokens_out,
+                "tokens_per_sec": round(self.tokens_out / elapsed, 2),
+                "slot_occupancy": round(occ, 4) if occ is not None
+                else None,
+                "active": self.active,
+                "waiting": self.waiting,
+                "kv_blocks_in_use": self.kv_blocks_in_use,
+                "kv_blocks_capacity": self.kv_blocks_capacity,
+                "kv_high_water": self.kv_high_water,
+                "prefill_s": round(self.prefill_s, 6),
+                "decode_s": round(self.decode_s, 6),
+                "window_s": round(elapsed, 3),
+            }
+
+
 class ServingMetrics:
     """The engine-wide registry: one ModelMetrics per model NAME (metrics
     deliberately survive hot reloads — a reload is an event on the
-    model's timeline, not a new timeline)."""
+    model's timeline, not a new timeline). Decode engines report through
+    the same registry under their own axis (`decode(name)`), so ONE
+    snapshot — and one Prometheus scrape — covers both serving planes."""
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):
         self._clock = clock
         self._lock = threading.Lock()
         self._models: Dict[str, ModelMetrics] = {}
+        self._decode: Dict[str, DecodeMetrics] = {}
 
     def model(self, name: str) -> ModelMetrics:
         with self._lock:
@@ -187,7 +312,96 @@ class ServingMetrics:
                                                       clock=self._clock)
             return m
 
+    def decode(self, name: str) -> DecodeMetrics:
+        with self._lock:
+            m = self._decode.get(name)
+            if m is None:
+                m = self._decode[name] = DecodeMetrics(name,
+                                                       clock=self._clock)
+            return m
+
     def snapshot(self) -> dict:
         with self._lock:
             models = list(self._models.values())
-        return {"models": {m.name: m.snapshot() for m in models}}
+            decode = list(self._decode.values())
+        out = {"models": {m.name: m.snapshot() for m in models}}
+        if decode:
+            out["decode"] = {m.name: m.snapshot() for m in decode}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (the first brick of the ROADMAP's unified
+# observability plane): flatten a snapshot() dict into the standard
+# `name{labels} value` lines so any Prometheus-compatible scraper can
+# consume the serving metrics straight off the existing HTTP front end
+# (GET /v1/metrics?format=prometheus).
+# ---------------------------------------------------------------------------
+
+#: ModelMetrics counters exported as pt_serve_<key>; monotonic ones get
+#: the conventional _total suffix
+_SERVE_COUNTERS = ("received", "completed", "failed", "shed_overload",
+                   "shed_deadline", "batches", "reloads")
+_SERVE_GAUGES = ("queue_depth", "batch_fill_ratio", "qps")
+_DECODE_COUNTERS = ("received", "completed", "failed", "shed_overload",
+                    "shed_deadline", "evictions", "resumes", "prefills",
+                    "prefill_tokens", "decode_steps", "tokens_out")
+_DECODE_GAUGES = ("tokens_per_sec", "slot_occupancy", "active", "waiting",
+                  "kv_blocks_in_use", "kv_blocks_capacity",
+                  "kv_high_water")
+
+
+def render_prometheus(snapshot: dict) -> str:
+    """Render a ServingMetrics.snapshot() as Prometheus text exposition
+    (version 0.0.4). None values are omitted — absence is the Prometheus
+    idiom for 'no observation yet', not 0."""
+    lines: List[str] = []
+
+    def esc(v) -> str:
+        # the 0.0.4 format requires \ " and newline escaped in label
+        # values; model names are caller-controlled strings
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    def emit(metric: str, labels: Dict[str, str], value,
+             kind: str = "gauge") -> None:
+        if value is None:
+            return
+        if not any(ln.startswith(f"# TYPE {metric} ") for ln in lines):
+            lines.append(f"# TYPE {metric} {kind}")
+        lab = ",".join(f'{k}="{esc(v)}"' for k, v in labels.items())
+        # full precision: %g's 6 significant digits would freeze large
+        # counters between scrapes, breaking rate() on the very
+        # throughput series this exposition exists for
+        val = float(value)
+        # repr = shortest round-trip form: exact (unlike %g's 6 digits)
+        # without the .17g noise ("0.33329999999999999" for 0.3333)
+        text = str(int(val)) if val.is_integer() else repr(val)
+        lines.append(f"{metric}{{{lab}}} {text}")
+
+    for name, snap in sorted(snapshot.get("models", {}).items()):
+        for key in _SERVE_COUNTERS:
+            emit(f"pt_serve_{key}_total", {"model": name}, snap.get(key),
+                 "counter")
+        for key in _SERVE_GAUGES:
+            emit(f"pt_serve_{key}", {"model": name}, snap.get(key))
+        for phase, pcts in snap.get("latency", {}).items():
+            for q in ("p50", "p95", "p99"):
+                emit("pt_serve_latency_ms",
+                     {"model": name, "phase": phase, "quantile": q},
+                     pcts.get(f"{q}_ms"))
+        for key, val in snap.get("phases", {}).items():
+            if key.endswith("_s"):
+                emit("pt_serve_phase_seconds_total",
+                     {"model": name, "phase": key[:-2]}, val, "counter")
+    for name, snap in sorted(snapshot.get("decode", {}).items()):
+        for key in _DECODE_COUNTERS:
+            emit(f"pt_decode_{key}_total", {"model": name}, snap.get(key),
+                 "counter")
+        for key in _DECODE_GAUGES:
+            emit(f"pt_decode_{key}", {"model": name}, snap.get(key))
+        for key in ("prefill_s", "decode_s"):
+            emit("pt_decode_phase_seconds_total",
+                 {"model": name, "phase": key[:-2]}, snap.get(key),
+                 "counter")
+    return "\n".join(lines) + "\n"

@@ -10,7 +10,9 @@
 #           starts, two-stage prefetch) + the learning-probe regression
 #   serve = lint gate + the online-serving suite (micro-batching, shape
 #           buckets, hot reload, admission/shedding, metrics, HTTP front
-#           end) + the C-API serving drivers
+#           end) + the C-API serving drivers + the autoregressive decode
+#           suite (paged KV cache, continuous batching, eviction/resume
+#           token identity, streaming route, prometheus exposition)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -39,8 +41,9 @@ if [[ "${1:-}" == "chaos" ]]; then
 fi
 
 if [[ "${1:-}" == "serve" ]]; then
-  echo "== serve: online serving engine + C-API drivers =="
-  python -m pytest tests/test_serving.py tests/test_capi_serving.py -q
+  echo "== serve: online serving engine + C-API drivers + decode =="
+  python -m pytest tests/test_serving.py tests/test_capi_serving.py \
+    tests/test_decode.py -q
   echo "SERVE OK"
   exit 0
 fi

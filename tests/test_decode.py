@@ -1,0 +1,527 @@
+"""Autoregressive decode subsystem (paddle_tpu/serving/decode/): paged
+KV cache, continuous batching, eviction/preemption, the two-artifact
+export bundle, streaming HTTP, and the Prometheus exposition.
+
+Test planes:
+  * kernel — paged attention (gather XLA path + Pallas interpret) vs the
+    dense oracle; the paged write primitive;
+  * accounting — KVBlockPool alloc/free/defrag, null-block reservation;
+  * engine (the headline contract) — continuous-batched paged decode is
+    TOKEN-IDENTICAL to a sequential per-sequence reference decode under
+    greedy sampling, including sequences admitted mid-flight and
+    sequences evicted then resumed; typed shedding on pool exhaustion
+    and deadlines; free-on-finish returns every block;
+  * front end — streaming NDJSON generate route, prometheus metrics.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io as pio
+from paddle_tpu.kernels.flash_attention import (mha_reference,
+                                                paged_attention_reference,
+                                                paged_decode_attention,
+                                                paged_kv_update)
+from paddle_tpu.models import transformer as tfm
+from paddle_tpu.serving import (DeadlineExceeded, InvalidRequest,
+                                Overloaded, ServingEngine)
+from paddle_tpu.serving.decode import (DecodeEngine, DecodeModel,
+                                       KVBlockPool, PoolExhausted)
+from paddle_tpu.serving.http import start_http_server
+from paddle_tpu.serving.metrics import render_prometheus
+
+
+V, L, DM, H, FF, MAXC = 43, 2, 16, 2, 32, 48
+BLOCK, POOL, SLOTS = 4, 40, 3
+BUCKETS = (8, 16, 32)
+
+
+# ---------------------------------------------------------------------------
+# bundle (module-scoped: exports compile)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def bundle_dir(tmp_path_factory):
+    """One tiny trained-init transformer exported as a decode bundle."""
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        avg, _ = tfm.transformer_lm_loss(
+            vocab_size=V, seq_len=MAXC, n_layers=L, d_model=DM,
+            n_heads=H, d_ff=FF, max_len=MAXC)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        pt.Executor().run(startup)
+        d = str(tmp_path_factory.mktemp("decode") / "m")
+        pio.export_decode_model(
+            d, dict(vocab_size=V, n_layers=L, d_model=DM, n_heads=H,
+                    d_ff=FF, max_context=MAXC),
+            scope=scope, length_buckets=BUCKETS, slots=SLOTS,
+            block_size=BLOCK, pool_blocks=POOL)
+    return d
+
+
+@pytest.fixture(scope="module")
+def reference_decode(bundle_dir):
+    """Sequential per-sequence greedy oracle: re-prefill prompt+generated
+    each step through the full-attention bucketed artifacts."""
+    model = DecodeModel(bundle_dir, warmup=False)
+
+    def decode(prompt, max_new, eos_id=None):
+        toks, out = list(prompt), []
+        for _ in range(max_new):
+            logits, _ = model.prefill(toks)
+            t = int(np.argmax(logits))
+            out.append(t)
+            toks.append(t)
+            if eos_id is not None and t == eos_id:
+                break
+        return out
+
+    return decode
+
+
+def _prompts(seed, n, lo=2, hi=9):
+    rng = np.random.RandomState(seed)
+    return [list(int(t) for t in rng.randint(1, V, rng.randint(lo, hi)))
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def test_paged_attention_reference_matches_dense():
+    """Gather-path paged attention == dense attention per sequence, at
+    ragged lengths; the inactive slot (len 0) yields zeros, not NaN."""
+    rng = np.random.RandomState(0)
+    s, h, d, nb, bs, mb = 3, 2, 8, 10, 4, 4
+    import jax.numpy as jnp
+    kp = jnp.zeros((nb, bs, h, d), jnp.float32)
+    vp = jnp.zeros((nb, bs, h, d), jnp.float32)
+    lens = np.array([7, 1, 0], np.int32)
+    bt = np.zeros((s, mb), np.int32)
+    bt[0, :2] = [3, 5]
+    bt[1, 0] = 7
+    ks = {i: rng.randn(int(lens[i]), h, d).astype(np.float32)
+          for i in range(s)}
+    vs = {i: rng.randn(int(lens[i]), h, d).astype(np.float32)
+          for i in range(s)}
+    for pos in range(int(lens.max())):
+        knew = np.zeros((s, h, d), np.float32)
+        vnew = np.zeros((s, h, d), np.float32)
+        cl = np.zeros(s, np.int32)
+        for i in range(s):
+            if pos < lens[i]:
+                knew[i], vnew[i], cl[i] = ks[i][pos], vs[i][pos], pos + 1
+        kp, vp = paged_kv_update(kp, vp, jnp.asarray(knew),
+                                 jnp.asarray(vnew), jnp.asarray(bt),
+                                 jnp.asarray(cl))
+    q = rng.randn(s, h, d).astype(np.float32)
+    out = np.asarray(paged_attention_reference(
+        jnp.asarray(q), kp, vp, jnp.asarray(bt), jnp.asarray(lens)))
+    for i in range(s):
+        if lens[i] == 0:
+            assert np.all(out[i] == 0)
+            continue
+        ref = np.asarray(mha_reference(q[None, i:i + 1], ks[i][None],
+                                       vs[i][None]))[0, 0]
+        np.testing.assert_allclose(out[i], ref, atol=1e-5)
+
+
+def test_paged_attention_pallas_interpret_parity():
+    """The Pallas ragged-paged kernel (interpret mode on CPU) matches the
+    gather-path oracle bit-for-tolerance on TPU-legal shapes."""
+    rng = np.random.RandomState(1)
+    import jax.numpy as jnp
+    s, h, d, nb, bs, mb = 2, 2, 128, 6, 8, 3
+    kp = jnp.asarray(rng.randn(nb, bs, h, d).astype(np.float32))
+    vp = jnp.asarray(rng.randn(nb, bs, h, d).astype(np.float32))
+    bt = jnp.asarray(np.array([[1, 2, 0], [4, 0, 0]], np.int32))
+    lens = jnp.asarray(np.array([13, 5], np.int32))
+    q = jnp.asarray(rng.randn(s, h, d).astype(np.float32))
+    ref = np.asarray(paged_attention_reference(q, kp, vp, bt, lens))
+    out = np.asarray(paged_decode_attention(q, kp, vp, bt, lens,
+                                            interpret=True))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# KV pool accounting
+# ---------------------------------------------------------------------------
+
+def test_kv_pool_alloc_free_defrag():
+    pool = KVBlockPool(8, 4)               # blocks 1..7 usable
+    assert pool.capacity == 7
+    a = pool.alloc(3)
+    assert a == [1, 2, 3], "lowest-first allocation is the contract"
+    b = pool.alloc(2)
+    assert b == [4, 5]
+    assert pool.blocks_in_use == 5 and pool.high_water == 5
+    pool.free(a)
+    assert pool.blocks_free == 5
+    # freed low ids are reused first
+    assert pool.alloc(1) == [1]
+    pool.free([1])
+    # null block is never allocatable
+    with pytest.raises(PoolExhausted):
+        pool.alloc(99)
+    with pytest.raises(ValueError):
+        pool.free([0])
+    # defrag compacts the live tail [4, 5] onto [1, 2]
+    mapping = pool.defrag()
+    assert mapping == {4: 1, 5: 2}
+    assert pool.blocks_in_use == 2 and pool.alloc(1) == [3]
+
+
+def test_pool_blocks_for_tokens():
+    pool = KVBlockPool(8, 4)
+    assert [pool.blocks_for_tokens(t) for t in (0, 1, 4, 5, 8)] \
+        == [0, 1, 1, 2, 2]
+
+
+# ---------------------------------------------------------------------------
+# bundle layout
+# ---------------------------------------------------------------------------
+
+def test_export_bundle_layout(bundle_dir):
+    with open(os.path.join(bundle_dir, "serving.json")) as f:
+        meta = json.load(f)
+    assert [b["length"] for b in meta["buckets"]] == list(BUCKETS)
+    for b in meta["buckets"]:
+        assert os.path.exists(os.path.join(bundle_dir, b["file"]))
+    assert meta["fetch_names"][0] == "logits"
+    dec = meta["decode"]
+    assert os.path.exists(os.path.join(bundle_dir, dec["file"]))
+    assert (dec["slots"], dec["block_size"], dec["pool_blocks"]) \
+        == (SLOTS, BLOCK, POOL)
+    assert dec["max_blocks_per_seq"] == -(-MAXC // BLOCK)
+    names = [m["name"] for m in dec["feeds"]]
+    assert names[:3] == ["token_ids", "context_lens", "block_tables"]
+    assert names[3:5] == ["k_cache_0", "v_cache_0"]
+    assert [m["name"] for m in dec["fetches"]][0] == "logits"
+    # pool feeds and fetches agree on the paged shape
+    assert dec["feeds"][3]["shape"] == dec["fetches"][1]["shape"] \
+        == [POOL, BLOCK, H, DM // H]
+
+
+# ---------------------------------------------------------------------------
+# the headline contract: token-identity vs sequential reference
+# ---------------------------------------------------------------------------
+
+def test_continuous_decode_token_identical(bundle_dir, reference_decode):
+    """More sequences than slots, mixed lengths: every continuous-batched
+    paged generation equals its sequential full-recompute reference, and
+    finishing returns every KV block."""
+    eng = DecodeEngine(bundle_dir, name="lm")
+    try:
+        prompts = _prompts(11, 6)
+        max_new = [5, 9, 3, 12, 7, 4]
+        handles = [eng.generate(p, max_new_tokens=m)
+                   for p, m in zip(prompts, max_new)]
+        for p, m, hd in zip(prompts, max_new, handles):
+            r = hd.result(timeout=120)
+            assert r["tokens"] == reference_decode(p, m)
+            assert r["finish_reason"] == "length"
+        snap = eng.metrics_snapshot()
+        assert snap["completed"] == 6
+        assert snap["kv_blocks_in_use"] == 0, "free-on-finish leaked"
+        assert snap["slot_occupancy"] > 0.5
+    finally:
+        eng.shutdown()
+
+
+def test_mid_flight_admission_no_drain_barrier(bundle_dir,
+                                               reference_decode):
+    """A short sequence submitted while a long one is mid-decode must
+    finish BEFORE the long one — only possible if admission goes into
+    the in-flight batch (no drain-to-empty barrier) — and still match
+    its reference."""
+    eng = DecodeEngine(bundle_dir, name="lm")
+    try:
+        # 29 keeps the reference oracle inside the largest prefill
+        # bucket: its last re-prefill is len(prompt) + 28 = 32
+        long_p = _prompts(21, 1, 4, 5)[0]
+        long_h = eng.generate(long_p, max_new_tokens=29)
+        stream = long_h.stream(timeout=60)
+        next(stream)                      # the long seq is now in flight
+        short_p = _prompts(22, 1, 2, 4)[0]
+        short_h = eng.generate(short_p, max_new_tokens=3)
+        short_r = short_h.result(timeout=60)
+        assert not long_h.done(), \
+            "short seq should finish while the long one is still going"
+        assert short_r["tokens"] == reference_decode(short_p, 3)
+        long_r = long_h.result(timeout=120)
+        assert long_r["tokens"] == reference_decode(long_p, 29)
+    finally:
+        eng.shutdown()
+
+
+def test_eviction_resume_token_identical(bundle_dir, reference_decode):
+    """Pool pressure (restricted accounting) forces preemption; evicted
+    sequences resume by re-prefilling prompt+generated and their final
+    tokens are identical to the never-evicted reference. Blocks all
+    return at the end."""
+    eng = DecodeEngine(bundle_dir, name="lm", pool_blocks=9)
+    try:
+        prompts = _prompts(5, 3, 7, 8)
+        handles = [eng.generate(p, max_new_tokens=12, priority=pr)
+                   for p, pr in zip(prompts, [1, 0, 0])]
+        for p, hd in zip(prompts, handles):
+            r = hd.result(timeout=180)
+            assert r["tokens"] == reference_decode(p, 12)
+        snap = eng.metrics_snapshot()
+        assert snap["evictions"] > 0, "pool 8 must force eviction"
+        assert snap["resumes"] > 0
+        assert snap["kv_blocks_in_use"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_block_reuse_never_leaks_stale_kv(bundle_dir, reference_decode):
+    """Back-to-back single sequences reuse the same lowest-first block
+    ids; the second sequence's output must be unpolluted by the first's
+    stale K/V (every position below a sequence's mask is rewritten by
+    its own prefill/decode before any read)."""
+    eng = DecodeEngine(bundle_dir, name="lm", pool_blocks=6)
+    try:
+        a, b = _prompts(31, 2, 6, 8)
+        ra = eng.generate(a, max_new_tokens=8).result(timeout=60)
+        assert eng.pool.blocks_in_use == 0
+        rb = eng.generate(b, max_new_tokens=8).result(timeout=60)
+        assert ra["tokens"] == reference_decode(a, 8)
+        assert rb["tokens"] == reference_decode(b, 8)
+    finally:
+        eng.shutdown()
+
+
+def test_eos_stops_generation(bundle_dir, reference_decode):
+    """Declaring the reference's 2nd token as EOS stops generation there
+    with finish_reason 'eos' (the EOS token is included)."""
+    p = _prompts(41, 1, 5, 6)[0]
+    ref = reference_decode(p, 8)
+    eos = ref[1]
+    eng = DecodeEngine(bundle_dir, name="lm")
+    try:
+        r = eng.generate(p, max_new_tokens=8, eos_id=eos).result(
+            timeout=60)
+        assert r["finish_reason"] == "eos"
+        assert r["tokens"] == reference_decode(p, 8, eos_id=eos)
+        assert r["tokens"][-1] == eos and len(r["tokens"]) < 8
+    finally:
+        eng.shutdown()
+
+
+def test_static_mode_matches_but_occupies_less(bundle_dir,
+                                               reference_decode):
+    """The drain-to-empty baseline is also token-identical (it is the
+    same artifacts) but wastes slots on mixed lengths — the occupancy
+    gap the `decode` bench config quantifies."""
+    prompts = _prompts(51, 6)
+    max_new = [3, 12, 3, 12, 3, 12]
+    occ = {}
+    for mode in (True, False):
+        eng = DecodeEngine(bundle_dir, name="lm", continuous=mode)
+        try:
+            handles = [eng.generate(p, max_new_tokens=m)
+                       for p, m in zip(prompts, max_new)]
+            for p, m, hd in zip(prompts, max_new, handles):
+                assert hd.result(timeout=120)["tokens"] \
+                    == reference_decode(p, m)
+            occ[mode] = eng.metrics_snapshot()["slot_occupancy"]
+        finally:
+            eng.shutdown()
+    assert occ[True] > occ[False], occ
+
+
+# ---------------------------------------------------------------------------
+# typed shedding
+# ---------------------------------------------------------------------------
+
+def test_pool_exhaustion_sheds_typed(bundle_dir):
+    """A sequence whose peak KV residency can NEVER fit the pool is pool
+    exhaustion by construction: typed, retryable Overloaded at submit."""
+    eng = DecodeEngine(bundle_dir, name="lm", pool_blocks=4)
+    try:
+        with pytest.raises(Overloaded) as ei:
+            eng.generate(_prompts(61, 1, 8, 9)[0], max_new_tokens=30)
+        assert ei.value.retryable and ei.value.http_status == 429
+        assert eng.metrics_snapshot()["shed_overload"] == 1
+    finally:
+        eng.shutdown()
+
+
+def test_queue_depth_sheds_typed(bundle_dir):
+    eng = DecodeEngine(bundle_dir, name="lm", queue_depth=2)
+    try:
+        p = _prompts(62, 1, 4, 5)[0]
+        eng.generate(p, max_new_tokens=25)
+        eng.generate(p, max_new_tokens=25)
+        with pytest.raises(Overloaded):
+            for _ in range(8):   # the first two may already be running
+                eng.generate(p, max_new_tokens=25)
+    finally:
+        eng.shutdown()
+
+
+def test_expired_deadline_sheds_typed(bundle_dir):
+    """A microscopic deadline expires before the scheduler reaches the
+    sequence: DeadlineExceeded surfaces typed — reject-fast at submit
+    when admission already sees it expired, else on the handle."""
+    eng = DecodeEngine(bundle_dir, name="lm")
+    try:
+        with pytest.raises(DeadlineExceeded):
+            h = eng.generate(_prompts(63, 1, 4, 5)[0], max_new_tokens=20,
+                             deadline_ms=0.01)
+            h.result(timeout=60)
+        assert eng.metrics_snapshot()["shed_deadline"] >= 1
+        assert eng.metrics_snapshot()["kv_blocks_in_use"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_invalid_requests_typed(bundle_dir):
+    eng = DecodeEngine(bundle_dir, name="lm")
+    try:
+        with pytest.raises(InvalidRequest):
+            eng.generate([], max_new_tokens=4)
+        with pytest.raises(InvalidRequest):
+            eng.generate([1] * (BUCKETS[-1] + 1), max_new_tokens=4)
+        with pytest.raises(InvalidRequest):
+            eng.generate([V + 5], max_new_tokens=4)
+        with pytest.raises(InvalidRequest):
+            eng.generate([1, 2], max_new_tokens=MAXC)
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# defrag: compaction preserves attention outputs
+# ---------------------------------------------------------------------------
+
+def test_defrag_preserves_decode(bundle_dir):
+    """Drive the DecodeModel by hand: decode a few steps, defrag (pool
+    compaction + device permute + table remap), keep decoding — the
+    token stream must match an un-defragged run."""
+    prompt = _prompts(71, 1, 6, 8)[0]
+
+    def run(defrag_at):
+        model = DecodeModel(bundle_dir, warmup=False)
+        pool = KVBlockPool(model.pool_blocks, model.block_size)
+        # fragment the pool: park an allocation below ours, free later
+        parked = pool.alloc(3)
+        blocks = pool.alloc(pool.blocks_for_tokens(len(prompt)))
+        logits, kv = model.prefill(prompt)
+        model.seed_sequence(blocks, kv)
+        toks = [int(np.argmax(logits))]
+        cached = len(prompt)
+        out = []
+        for step in range(8):
+            if step == defrag_at:
+                pool.free(parked)
+                mapping = pool.defrag()
+                model.permute_blocks(mapping)
+                blocks = [mapping.get(b, b) for b in blocks]
+            need = pool.blocks_for_tokens(cached + 1) - len(blocks)
+            if need > 0:
+                blocks.extend(pool.alloc(need))
+            tokens = np.zeros(model.slots, np.int64)
+            lens = np.zeros(model.slots, np.int32)
+            tables = np.zeros((model.slots, model.max_blocks_per_seq),
+                              np.int32)
+            tokens[0] = toks[-1]
+            lens[0] = cached + 1
+            tables[0, :len(blocks)] = blocks
+            logits = model.decode_step(tokens, lens, tables)
+            cached += 1
+            toks.append(int(np.argmax(logits[0])))
+            out.append(toks[-1])
+        return out
+
+    assert run(defrag_at=4) == run(defrag_at=None)
+
+
+# ---------------------------------------------------------------------------
+# front end: ServingEngine integration, streaming HTTP, prometheus
+# ---------------------------------------------------------------------------
+
+def test_serving_engine_generate_and_swap(bundle_dir, reference_decode):
+    engine = ServingEngine()
+    try:
+        desc = engine.load_decode_model("lm", bundle_dir)
+        assert desc["slots"] == SLOTS
+        p = _prompts(81, 1, 4, 6)[0]
+        r = engine.generate("lm", p, max_new_tokens=5).result(timeout=60)
+        assert r["tokens"] == reference_decode(p, 5)
+        assert "decode" in engine.models()["lm"]
+        # hot swap: new engine in, old drains; requests keep serving
+        engine.load_decode_model("lm", bundle_dir)
+        r2 = engine.generate("lm", p, max_new_tokens=5).result(timeout=60)
+        assert r2["tokens"] == r["tokens"]
+        engine.unload_decode_model("lm")
+        with pytest.raises(Exception):
+            engine.generate("lm", p)
+    finally:
+        engine.shutdown()
+
+
+def test_http_generate_stream_and_prometheus(bundle_dir):
+    engine = ServingEngine()
+    server = None
+    try:
+        engine.load_decode_model("lm", bundle_dir)
+        server, _t = start_http_server(engine)
+        port = server.server_address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/lm:generate",
+            data=json.dumps({"prompt_ids": [3, 7, 9],
+                             "max_new_tokens": 5}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            assert r.headers["Content-Type"] == "application/x-ndjson"
+            lines = [json.loads(ln)
+                     for ln in r.read().decode().strip().splitlines()]
+        assert lines[-1]["done"] is True
+        assert [ln["token"] for ln in lines[:-1]] == lines[-1]["tokens"]
+        assert [ln["index"] for ln in lines[:-1]] == list(range(5))
+        # non-stream variant returns one body
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/lm:generate",
+            data=json.dumps({"prompt_ids": [3, 7], "max_new_tokens": 3,
+                             "stream": False}).encode())
+        with urllib.request.urlopen(req) as r:
+            body = json.loads(r.read())
+        assert len(body["tokens"]) == 3
+        # prometheus text exposition, on both route spellings
+        for path in ("/v1/metrics?format=prometheus",
+                     "/metrics?format=prometheus"):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}") as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+            assert 'pt_decode_tokens_out_total{model="lm"}' in text
+            assert 'pt_decode_slot_occupancy{model="lm"}' in text
+            assert "# TYPE pt_decode_tokens_out_total counter" in text
+        # JSON snapshot unchanged
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/metrics") as r:
+            snap = json.loads(r.read())
+        assert snap["decode"]["lm"]["completed"] >= 2
+    finally:
+        if server is not None:
+            server.shutdown()
+        engine.shutdown()
+
+
+def test_render_prometheus_omits_none():
+    text = render_prometheus(
+        {"models": {"m": {"received": 3, "batch_fill_ratio": None,
+                          "latency": {"queue": {"p50_ms": None}}}}})
+    assert "pt_serve_received_total" in text
+    assert "batch_fill_ratio" not in text and "latency" not in text

@@ -1,16 +1,23 @@
-"""Regression: the flagship transformer's learning probe actually falls.
+"""Regression: the flagship transformer's learning probe actually falls
+— pinned against bench.py's OWN probe function, not a copy of it.
 
 BENCH r04/r05 flagged the transformer config FAILED_LEARNING (10.440 ->
-10.413 over 50 steps, identical floats both rounds). The diagnosis
-(docs/artifacts/loss_probe_diagnosis.json, transformer_r05) found the
-probe, not the gradients, at fault: the copy task drew targets uniformly
-from the FULL 32000-token vocab, so each class was a one-shot example —
-unlearnable within a 32-step window at lr 1e-4 — while the identical
-architecture learns a small-pool copy task at the same lr, and the
-L0-stripped model learns even the full-vocab task. bench.py now draws
-probe tokens from a 64-id pool (model vocab and therefore step timing
-unchanged); this test pins the same task family at tiny scale so the
-probe can never regress to an unlearnable design again.
+10.413 over 50 steps) — and the floats were BIT-IDENTICAL in both
+rounds, even though a probe fix was claimed in between. The identical
+floats are the tell: both rounds ran the same probe data, so the r05
+bench still drew copy-task targets uniformly from the full 32000-id
+vocab (verified against that round's bench.py source — the fix lived
+only in a test that RE-IMPLEMENTED the probe instead of importing it).
+Full-vocab draws are unlearnable by design within the 32-step window
+(~0.25 sightings per class per step; docs/artifacts/
+loss_probe_diagnosis.json, transformer_r05), while the identical
+architecture learns a small-pool copy task at the bench lr.
+
+The lesson this file encodes: a regression test that re-implements the
+thing it guards can pass while the guarded path stays broken. Both
+tests below therefore go through ``bench.lm_probe_feeds`` — the exact
+function ``bench.py _lm_bench`` feeds the measured training loop — so
+the probe design and the measured path cannot silently diverge again.
 """
 
 import numpy as np
@@ -18,8 +25,32 @@ import numpy as np
 import paddle_tpu as pt
 from paddle_tpu import layers  # noqa: F401 — imported for parity with peers
 
+import bench
 
-VOCAB, SEQ, BATCH, STEPS, POOL = 512, 48, 4, 32, 32
+
+VOCAB, SEQ, BATCH, STEPS = 512, 48, 4, 32
+
+
+def test_bench_probe_is_pool_bounded_copy_task():
+    """The bench probe itself: ids bounded by the pool (learnable by
+    construction), targets the current-token copy rule, deterministic
+    per step index — asserted on the function the bench RUNS."""
+    for i in (0, 1, 7):
+        f = bench.lm_probe_feeds(i, BATCH, SEQ, 32000)
+        src, tgt = f["src_ids"], f["tgt_ids"]
+        assert src.shape == (BATCH, SEQ) and tgt.shape == (BATCH, SEQ, 1)
+        # the r04/r05 failure mode: full-vocab one-shot classes. The
+        # pool bound is what makes the task learnable in 32 steps.
+        assert src.max() < bench.LM_PROBE_POOL, (
+            f"probe ids reach {src.max()} — full-vocab draws regressed")
+        assert (tgt[..., 0] == src).all(), "copy-rule targets broke"
+        again = bench.lm_probe_feeds(i, BATCH, SEQ, 32000)
+        assert (again["src_ids"] == src).all(), "probe must be seeded"
+    # distinct steps draw distinct batches (a fixed batch would measure
+    # memorization, not learning)
+    a = bench.lm_probe_feeds(0, BATCH, SEQ, 32000)["src_ids"]
+    b = bench.lm_probe_feeds(1, BATCH, SEQ, 32000)["src_ids"]
+    assert (a != b).any()
 
 
 def test_tiny_transformer_copy_task_loss_falls():
@@ -31,15 +62,11 @@ def test_tiny_transformer_copy_task_loss_falls():
             n_heads=2, d_ff=128, max_len=SEQ)
         pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(avg)
 
-    def varied(i):
-        # bench.py _lm_bench's probe at tiny scale: current-token copy
-        # rule over a small id pool inside a larger vocab
-        vrng = np.random.RandomState(7000 + i)
-        src = vrng.randint(0, POOL, (BATCH, SEQ)).astype("int64")
-        return {"src_ids": src, "tgt_ids": src[..., None]}
-
-    stacked = {k: np.stack([varied(i)[k] for i in range(STEPS)])
-               for k in varied(0)}
+    # bench.py _lm_bench's probe AT TINY SCALE — same function, so this
+    # exercises the exact task family the bench measures
+    stacked = {k: np.stack([bench.lm_probe_feeds(i, BATCH, SEQ, VOCAB)[k]
+                            for i in range(STEPS)])
+               for k in ("src_ids", "tgt_ids")}
     scope = pt.Scope()
     with pt.scope_guard(scope):
         exe = pt.Executor()
