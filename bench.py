@@ -53,16 +53,12 @@ import numpy as np
 
 
 def peak_flops_per_chip(device) -> float:
-    """bf16 peak FLOP/s for the benchmarked chip."""
-    kind = getattr(device, "device_kind", "").lower()
-    table = {
-        "tpu v5 lite": 197e12, "tpu v5e": 197e12, "tpu v5": 459e12,
-        "tpu v4": 275e12, "tpu v6": 918e12,
-    }
-    for k, v in table.items():
-        if k in kind:
-            return v
-    return 197e12 if "tpu" in kind else 1e12  # cpu fallback keeps math sane
+    """bf16 peak FLOP/s for the benchmarked chip — delegates to the cost
+    model's PEAK_TABLE so measured MFU and predicted MFU share ONE
+    denominator (two drifting copies would silently skew the headline
+    measured-vs-predicted gap)."""
+    from paddle_tpu.analysis.cost import chip_spec_for
+    return chip_spec_for(getattr(device, "device_kind", "")).peak_flops
 
 
 def _as_bf16(a):
@@ -200,11 +196,38 @@ def _train_loop(main_prog, startup, fetch, feed, steps, unroll=2,
             import logging
             logging.getLogger("paddle_tpu").warning(
                 "guard overhead measurement skipped: %s", e)
+    # static roofline prediction (analysis/cost.py) beside the measured
+    # numbers: predicted_mfu_pct + the declared bound (compute|bandwidth|
+    # comm|host) attribute the 45%-gap per config, and the full
+    # prediction object carries the flops/bytes/per-leg times behind it.
+    # PT_COST_CHIP overrides the chip table entry (off-TPU runs predict
+    # for the deployment chip instead of the CPU fallback).
+    pred_fields = {}
+    try:
+        from paddle_tpu.analysis.cost import predict_step
+        from paddle_tpu.core.executor import _autotune_batch_hint
+        pred = predict_step(main_prog,
+                            batch=_autotune_batch_hint(main_prog, feed, 0))
+        # the static model cannot see host overhead; the PR-3 phase
+        # timers can. When the measured host share dominates the step,
+        # the config's attributed bound is "host" regardless of which
+        # device leg the roofline picked (prediction.bound keeps the
+        # static answer).
+        bound = pred.bound
+        host_pct = tm.get("host_overhead_pct")
+        if host_pct is not None and host_pct >= 50.0:
+            bound = "host"
+        pred_fields = {
+            "predicted_mfu_pct": round(pred.predicted_mfu * 100, 2),
+            "bound": bound,
+            "prediction": pred.to_dict()}
+    except Exception as e:  # a prediction failure must never cost a bench
+        pred_fields = {"prediction_error": f"{type(e).__name__}: {e}"}
     hot = {"host_overhead_pct": tm.get("host_overhead_pct"),
            "phase_s": {p: tm[f"{p}_s"]
                        for p in ("host_prep", "dispatch", "device", "fetch")},
            "guard_overhead_pct": guard_overhead_pct,
-           "compile_cache": compile_cache}
+           "compile_cache": compile_cache, **pred_fields}
     # flatten [steps, 1] fetches: float(arr[0]) on a size-1 ndarray is
     # deprecated (NumPy 1.25) and will raise once NumPy promotes it
     return (elapsed * 1000.0,

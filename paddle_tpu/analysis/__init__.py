@@ -1,28 +1,45 @@
-"""Static analysis: whole-program IR verification + artifact sanity.
+"""Static analysis: whole-program IR verification, artifact sanity, and
+the whole-program cost model.
 
 The compile-time checking layer the interpreted reference never had
-(executor.cc trusts the op stream). Three surfaces:
+(executor.cc trusts the op stream). Surfaces:
 
 * `verify_program(program, feeds=…, fetches=…, mesh=…)` — multi-pass
-  verifier over Program/Block/OpDesc (verifier.py). Runs as an executor
-  pre-pass when PT_VERIFY=1 (default-on in tests) and as a CLI
-  (tools/verify_program.py).
+  verifier over Program/Block/OpDesc (verifier.py + the collective-audit
+  pass in comm.py). Runs as an executor pre-pass when PT_VERIFY=1
+  (default-on in tests) and as a CLI (tools/verify_program.py).
 * `artifacts` — schema + physical-floor checks for measurement JSON
-  (autotune cache, bench output), applied at load AND save.
+  (autotune cache, bench output, cost reports), applied at load AND save.
+* `cost` / `memory` / `comm` — the static cost model: per-op FLOPs +
+  HBM bytes and the roofline MFU prediction (cost.py), liveness-based
+  peak-HBM estimation + the PT_MEM_BUDGET_GB pre-compile gate
+  (memory.py), and the sharding-aware collective audit (comm.py).
+  CLI: tools/cost_report.py.
 * `source_lint` — custom repo lint rules behind tools/lint.py (kept
   stdlib-only so the lint gate never imports jax).
 
 docs/analysis.md describes each pass, its defect class, and how to add
-a new one.
+a new one (verifier pass or cost entry).
 """
 
 from . import artifacts  # noqa: F401
 from .verifier import (Diagnostic, ProgramVerificationError,  # noqa: F401
                        VerifyResult, registered_passes, verifier_pass,
                        verify_enabled, verify_program)
+from .cost import (OpCost, Prediction, ProgramCost, op_cost,  # noqa: F401
+                   predict_step, program_cost)
+from .memory import (MemoryBudgetError, MemoryEstimate,  # noqa: F401
+                     enforce_budget, estimate_memory)
+from .comm import (Collective, CommReport, audit_collectives,  # noqa: F401
+                   mesh_axis_sizes)
 
 __all__ = [
     "Diagnostic", "ProgramVerificationError", "VerifyResult",
     "artifacts", "registered_passes", "verifier_pass", "verify_enabled",
     "verify_program",
+    "OpCost", "ProgramCost", "Prediction", "op_cost", "program_cost",
+    "predict_step",
+    "MemoryBudgetError", "MemoryEstimate", "enforce_budget",
+    "estimate_memory",
+    "Collective", "CommReport", "audit_collectives", "mesh_axis_sizes",
 ]

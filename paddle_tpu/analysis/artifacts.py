@@ -81,39 +81,137 @@ def filter_autotune_cache(cache: dict) -> Dict[str, dict]:
 
 _MS_KEY_MARKERS = ("_ms", "ms_per_batch", "ms_per_step")
 _RATIO_KEY_MARKERS = ("mfu", "hfu")
+#: keys marking MODEL OUTPUTS of the static cost model (analysis/cost.py)
+#: rather than instrument readings: the measurement band does not apply
+#: (a tiny CPU-shape config legitimately predicts microsecond steps) but
+#: negative/zero work or >100% predicted utilization is still impossible
+_PREDICTION_MARKERS = ("predict", "prediction")
+#: prediction fields that must be strictly positive: a step whose model
+#: says zero flops / zero HBM traffic / zero time was mis-analyzed, the
+#: cost-model analogue of the 0.0 ms autotune poisonings. (predicted_mfu
+#: itself may legitimately round to 0 — only the >100% side is impossible)
+_PRED_POSITIVE = ("flops", "hbm_bytes", "predicted_step_ms")
+_PRED_BOUNDS = ("compute", "bandwidth", "comm", "host")
 
 
-def validate_bench_json(doc, path: str = "$") -> List[str]:
+def _bad_pred_num(value) -> bool:
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return True
+    return not math.isfinite(v) or v < 0
+
+
+def validate_bench_json(doc, path: str = "$", pred: bool = False) -> List[str]:
     """Recursive floor checks over a bench.py-style JSON document.
 
     Any numeric field whose key names a millisecond reading must sit in
     the physical band; MFU/HFU-style ratios must be finite and
-    non-negative. Schema-agnostic on purpose: bench.py's layout drifts
+    non-negative. Cost-model prediction fields (keys/objects naming
+    "predicted"/"prediction") get prediction rules instead: finite and
+    non-negative everywhere, strictly positive flops / hbm_bytes /
+    predicted_step_ms (predicted_mfu may round to 0 but never exceeds
+    100%), bound in {compute, bandwidth, comm, host}. Schema-agnostic
+    on purpose: bench.py's layout drifts
     between rounds, impossible numbers never become legitimate.
     """
     problems: List[str] = []
     if isinstance(doc, dict):
         for k, v in doc.items():
             here = f"{path}.{k}"
-            if isinstance(v, (dict, list)):
-                problems.extend(validate_bench_json(v, here))
-                continue
             lk = str(k).lower()
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
-                if any(m in lk for m in _MS_KEY_MARKERS) and _bad_ms(v):
+            in_pred = pred or any(m in lk for m in _PREDICTION_MARKERS)
+            if isinstance(v, (dict, list)):
+                problems.extend(validate_bench_json(v, here, pred=in_pred))
+                continue
+            if lk == "bound" and isinstance(v, str):
+                # the declared roofline bound — checked wherever it
+                # appears: bench.py emits it at config level (where the
+                # measured-host override lands), not only inside the
+                # prediction object
+                if v not in _PRED_BOUNDS:
                     problems.append(
-                        f"{here}: {v!r} ms is outside the physical band "
-                        f"({MS_FLOOR}, {MS_CEILING})")
-                elif any(m in lk for m in _RATIO_KEY_MARKERS):
-                    # >100% hardware utilization is as impossible as a
-                    # 0.0 ms reading; percent-style keys (mfu_pct) cap at
-                    # 100, fraction-style at 1.0 (small slack for fp noise)
+                        f"{here}: declared bound {v!r} is not one of "
+                        f"{list(_PRED_BOUNDS)}")
+                continue
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            if in_pred:
+                if _bad_pred_num(v):
+                    problems.append(
+                        f"{here}: prediction value {v!r} is not a finite "
+                        "non-negative number")
+                elif any(m in lk for m in _PRED_POSITIVE) and float(v) <= 0:
+                    problems.append(
+                        f"{here}: {v!r} — zero/negative predicted work is "
+                        "a mis-analyzed program, not a prediction")
+                elif "mfu" in lk:
                     hi = 101.0 if "pct" in lk else 1.01
-                    if not math.isfinite(float(v)) or v < 0 or v > hi:
+                    if float(v) > hi:
                         problems.append(
-                            f"{here}: utilization ratio {v!r} is outside "
-                            f"[0, {hi}] — impossible reading")
+                            f"{here}: predicted utilization {v!r} exceeds "
+                            f"{hi} — over-100% MFU is impossible")
+            elif any(m in lk for m in _MS_KEY_MARKERS) and _bad_ms(v):
+                problems.append(
+                    f"{here}: {v!r} ms is outside the physical band "
+                    f"({MS_FLOOR}, {MS_CEILING})")
+            elif any(m in lk for m in _RATIO_KEY_MARKERS):
+                # >100% hardware utilization is as impossible as a
+                # 0.0 ms reading; percent-style keys (mfu_pct) cap at
+                # 100, fraction-style at 1.0 (small slack for fp noise)
+                hi = 101.0 if "pct" in lk else 1.01
+                if not math.isfinite(float(v)) or v < 0 or v > hi:
+                    problems.append(
+                        f"{here}: utilization ratio {v!r} is outside "
+                        f"[0, {hi}] — impossible reading")
     elif isinstance(doc, list):
         for i, v in enumerate(doc):
-            problems.extend(validate_bench_json(v, f"{path}[{i}]"))
+            problems.extend(validate_bench_json(v, f"{path}[{i}]",
+                                                pred=pred))
+    return problems
+
+
+_COST_REPORT_REQUIRED = ("program", "batch", "cost", "memory", "prediction")
+
+
+def validate_cost_report(doc) -> List[str]:
+    """Schema + floor checks for a tools/cost_report.py document
+    ([] = valid). Applied by the CLI itself under --check (the
+    scripts/ci.sh analyze leg) and safe to run on a loaded report."""
+    if not isinstance(doc, dict):
+        return [f"report root is {type(doc).__name__}, not an object"]
+    problems = [f"$.{k}: required section missing"
+                for k in _COST_REPORT_REQUIRED if k not in doc]
+    cost = doc.get("cost")
+    if isinstance(cost, dict):
+        for k in ("train_flops", "train_bytes"):
+            v = cost.get(k)
+            if not isinstance(v, (int, float)) or _bad_pred_num(v) or v <= 0:
+                problems.append(f"$.cost.{k}: {v!r} must be a positive "
+                                "finite number")
+    mem = doc.get("memory")
+    if isinstance(mem, dict):
+        v = mem.get("peak_bytes")
+        if not isinstance(v, (int, float)) or _bad_pred_num(v) or v <= 0:
+            problems.append(f"$.memory.peak_bytes: {v!r} must be a "
+                            "positive finite number")
+        for k, bv in (mem.get("breakdown") or {}).items():
+            if not isinstance(bv, (int, float)) or _bad_pred_num(bv):
+                problems.append(f"$.memory.breakdown.{k}: {bv!r} must be "
+                                "a finite non-negative number")
+    pred = doc.get("prediction")
+    if isinstance(pred, dict):
+        problems.extend(validate_bench_json(pred, "$.prediction",
+                                            pred=True))
+        for k in ("predicted_mfu", "bound"):
+            if k not in pred:
+                problems.append(f"$.prediction.{k}: required field missing")
+    for mesh_key, comm in (doc.get("comm") or {}).items():
+        if not isinstance(comm, dict):
+            problems.append(f"$.comm.{mesh_key}: not an object")
+            continue
+        v = comm.get("total_wire_bytes")
+        if not isinstance(v, (int, float)) or _bad_pred_num(v):
+            problems.append(f"$.comm.{mesh_key}.total_wire_bytes: {v!r} "
+                            "must be a finite non-negative number")
     return problems

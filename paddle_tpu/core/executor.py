@@ -290,14 +290,23 @@ class Executor(TimedExecutorMixin):
             if verify_enabled():
                 verify_program(program, feeds=list(feed_arrays),
                                fetches=fetch_names).raise_if_errors()
-            # grouped-conv autotune pre-pass (utils/gconv_autotune.py):
-            # the formulation choice inside the trace is cache-lookup
-            # only, so any un-tuned shape must be measured BEFORE tracing
-            from ..utils import gconv_autotune
             # per_step_feeds arrays carry a leading [n_steps] axis: the
             # batch lives at dim 1 there (dim 0 otherwise)
             bdim = 1 if per_step_feed_prep else 0
             bh = _autotune_batch_hint(program, feed_arrays, bdim)
+            # memory-budget gate (analysis/memory.py): under
+            # PT_MEM_BUDGET_GB the static peak-HBM estimate is checked
+            # BEFORE tracing — a breach raises the typed
+            # MemoryBudgetError with the per-category breakdown instead
+            # of compiling for minutes and dying RESOURCE_EXHAUSTED.
+            # Compile-miss only, pure host IR walk: a passing budget adds
+            # zero device syncs to the hot path.
+            from ..analysis.memory import enforce_budget
+            enforce_budget(program, batch=bh)
+            # grouped-conv autotune pre-pass (utils/gconv_autotune.py):
+            # the formulation choice inside the trace is cache-lookup
+            # only, so any un-tuned shape must be measured BEFORE tracing
+            from ..utils import gconv_autotune
             gconv_autotune.tune_program(program, bh)
             raw, state_out, donate = build(program, list(feed_arrays),
                                            fetch_names, sorted(state))

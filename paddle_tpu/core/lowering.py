@@ -211,6 +211,25 @@ def run_op_range(ops: Sequence[OpDesc], start: int, stop: int,
     return env
 
 
+def post_forward_reads(block: Block) -> set:
+    """Names the post-autodiff suffix (optimizer ops) reads, plus the
+    loss — the values that must survive the forward pass. ONE shared
+    definition for the traced lowering (run_block_with_autodiff seeds
+    needed_after from it) and the static memory estimator
+    (analysis/memory.py), so the liveness the estimator prices is the
+    liveness the lowering actually keeps. Empty set when the block has
+    no autodiff marker (inference programs)."""
+    ops = block.ops
+    bwd_idx = next((i for i, o in enumerate(ops)
+                    if o.type == AUTODIFF_OP), None)
+    if bwd_idx is None:
+        return set()
+    needed = {ops[bwd_idx].attrs["loss"]}
+    for op in ops[bwd_idx + 1:]:
+        needed.update(op.input_names())
+    return needed
+
+
 def _float_like(v):
     return jnp.issubdtype(jnp.result_type(v), jnp.floating)
 
@@ -287,13 +306,13 @@ def run_block_with_autodiff(block: Block, env: Dict[str, object], ctx: ExecConte
                 tuple(id_shapes[i].shape) + (wv.shape[-1],), sdt)
 
     # names still needed once the forward finishes: the loss, whatever the
-    # optimizer suffix reads, the step's fetches/state, and sparse ids.
+    # optimizer suffix reads (post_forward_reads — shared with the static
+    # memory estimator), the step's fetches/state, and sparse ids.
     # Anything else may die inside the forward — which is what lets remat
     # segments actually discard activations (their residuals must not be
     # aux outputs of the differentiated function).
-    needed_after = {loss_name} | set(getattr(ctx, "live_out", ()) or ())
-    for op in ops[bwd_idx + 1:]:
-        needed_after.update(op.input_names())
+    needed_after = post_forward_reads(block) | {loss_name} \
+        | set(getattr(ctx, "live_out", ()) or ())
     needed_after.update(ids_name for _, _, ids_name in sparse_ops)
 
     def fwd(diff):

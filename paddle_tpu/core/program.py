@@ -187,6 +187,12 @@ class VarDesc:
         self.trainable = True
         self.regularizer = None
         self.need_clip = True
+        # feed placeholder marker (layers.data). Serialized: clones must
+        # keep feed identity — the verifier's def-use seeding, the
+        # executor's batch hint, and the cost model's feed accounting all
+        # read it, and a clone that forgot it would mis-classify every
+        # feed as an unbound temporary.
+        self.is_data = False
         # partition spec: tuple of mesh-axis names (or None) per dim, set by
         # the sharding pass (parallel/transpiler.py) — the pjit-native
         # reading of the reference's DistributeTranspiler var slicing.
@@ -201,6 +207,7 @@ class VarDesc:
             "kind": self.kind, "persistable": self.persistable,
             "is_parameter": self.is_parameter, "stop_gradient": self.stop_gradient,
             "lod_level": self.lod_level, "trainable": self.trainable,
+            "is_data": self.is_data,
             "sharding": list(self.sharding) if self.sharding is not None else None,
             "seq_len_var": self.seq_len_var,
         }
@@ -211,6 +218,7 @@ class VarDesc:
                     d.get("persistable", False), d.get("is_parameter", False),
                     d.get("stop_gradient", False), d.get("lod_level", 0))
         v.trainable = d.get("trainable", True)
+        v.is_data = d.get("is_data", False)
         sh = d.get("sharding")
         v.sharding = tuple(sh) if sh is not None else None
         v.seq_len_var = d.get("seq_len_var")

@@ -224,6 +224,22 @@ declare_env_knob("PT_DECODE_MAX_NEW_TOKENS",
                  "decode engine: default per-request generation budget "
                  "when the request does not pass max_new_tokens "
                  "(default 64); bounded by the artifact's max_context")
+declare_env_knob("PT_MEM_BUDGET_GB",
+                 "static peak-HBM budget gate (analysis/memory.py): on "
+                 "every executor compile miss the liveness-based memory "
+                 "estimate runs BEFORE tracing, and an estimate over this "
+                 "many GB raises the typed MemoryBudgetError carrying the "
+                 "params/activations/grads/optimizer-state/kv-pool "
+                 "breakdown — instead of compiling for minutes and dying "
+                 "RESOURCE_EXHAUSTED on the device. PER-DEVICE gigabytes: "
+                 "under a mesh the estimate prices the per-device batch "
+                 "(dp feed split). Unset/0 = off; a passing budget adds "
+                 "zero syncs to the hot path")
+declare_env_knob("PT_COST_CHIP",
+                 "chip override for the roofline cost model (analysis/"
+                 "cost.py), e.g. 'tpu v5e' — lets an off-TPU host "
+                 "predict step time / MFU / bound for the deployment "
+                 "chip; default: the detected jax device kind")
 declare_env_knob("PT_COMPILE_CACHE",
                  "persistent XLA compile cache (core/compile_cache.py): "
                  "unset/0 = off, 1 = ~/.cache/paddle_tpu/xla_cache, "

@@ -223,11 +223,21 @@ class ParallelExecutor(TimedExecutorMixin):
             from ..analysis import verify_enabled, verify_program
             if verify_enabled():
                 # the mesh is known here, so the shard divisibility checks
-                # run concrete (the single-chip Executor can only check
-                # axis names against the alphabet)
+                # AND the collective audit run concrete (the single-chip
+                # Executor can only check axis names against the alphabet)
                 verify_program(program, feeds=list(feed_arrays),
                                fetches=fetch_names,
                                mesh=self._mesh).raise_if_errors()
+            # memory-budget pre-compile gate (analysis/memory.py). The
+            # mesh is known, so the estimate prices the PER-DEVICE batch
+            # (feeds' batch-dim shard factor divides it); params and
+            # optimizer state stay whole-program — replicated under pure
+            # dp, an upper bound under tp/ZeRO — conservative-safe.
+            from ..analysis.memory import enforce_budget
+            from ..core.executor import _autotune_batch_hint
+            enforce_budget(program, batch=_autotune_batch_hint(
+                program, feed_arrays, 1 if per_step else 0),
+                mesh=self._mesh)
             if loop is None:
                 step, state_out = lowering.build_step_fn(
                     program, list(feed_arrays), fetch_names, sorted(state),

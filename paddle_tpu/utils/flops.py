@@ -1,4 +1,4 @@
-"""Analytic FLOP counting from the program IR.
+"""Analytic FLOP counting from the program IR — shim over analysis/cost.py.
 
 ≙ the role of the reference's benchmark flop accounting (hand-written
 per-model constants in benchmark/fluid) — but derived from the compiled
@@ -7,9 +7,22 @@ SE-ResNeXt test net whose grouped stage is twice the standard width)
 cannot silently run against the wrong denominator. bench.py uses this
 for every feed-forward config's MFU.
 
-Counts FORWARD matmul-class flops only (convs + matmuls; elementwise and
-normalization are bandwidth, not MXU work — standard MFU practice).
-Training flops ≈ 3x forward (dW + dX each cost one forward-equivalent).
+Since PR 7 the per-op formulas live in `analysis/cost.py` (one cost
+surface for FLOPs, HBM bytes, liveness, and the roofline); this module
+is the stable MFU-convention API over it:
+
+* `program_forward_flops` / `program_train_flops` keep the MATMUL-CLASS
+  (MXU) count — 2 flops/MAC, the standard MFU numerator. Elementwise /
+  normalization / attention-softmax work is VECTOR (VPU) flops: real
+  hardware work but never MFU numerator, so the historical "undercount"
+  was a convention, not a bug — pass include_vector=True (or read
+  `program_cost(...)` directly) to see it. The cost model also covers
+  ops this module historically priced at zero (paged_attention, pool,
+  lookup_table traffic, optimizer updates).
+* Parity with the pre-PR-7 counter is pinned in
+  tests/test_cost_model.py (and the closed-form checks in
+  tests/test_flops_counter.py keep passing unchanged).
+
 Ops inside control-flow sub-blocks are NOT counted (trip counts are
 dynamic); the RNN benches use explicit per-config formulas instead.
 """
@@ -18,91 +31,20 @@ from __future__ import annotations
 
 from typing import Optional
 
-import numpy as np
-
-from ..core.program import Program, default_main_program
+from ..analysis.cost import program_cost
+from ..core.program import Program
 
 __all__ = ["program_forward_flops", "program_train_flops"]
 
 
-def _shape(block, name, batch):
-    v = block.var(name)
-    return tuple(batch if d == -1 else int(d) for d in v.shape)
-
-
-def _prod(xs):
-    return int(np.prod(xs, dtype=np.int64)) if xs else 1
-
-
-def _op_flops(op, block, batch) -> int:
-    t = op.type
-    if t in ("conv2d", "depthwise_conv2d", "conv3d"):
-        out = _shape(block, op.outputs["Output"][0], batch)
-        w = _shape(block, op.inputs["Filter"][0], batch)
-        # out [N, Cout, *spatial]; w [Cout, Cin/g, *k]
-        return 2 * _prod(out) * _prod(w[1:])
-    if t in ("conv2d_transpose", "conv3d_transpose"):
-        x = _shape(block, op.inputs["Input"][0], batch)
-        w = _shape(block, op.inputs["Filter"][0], batch)
-        # flops follow the INPUT spatial extent (the conv whose transpose
-        # this is): 2 * N*Cin*prod(sp_in) * Cout/g * prod(k)
-        return 2 * _prod(x) * _prod(w[1:])
-    if t == "mul":
-        x = _shape(block, op.inputs["X"][0], batch)
-        y = _shape(block, op.inputs["Y"][0], batch)
-        xn = (op.attrs or {}).get("x_num_col_dims", 1)
-        yn = (op.attrs or {}).get("y_num_col_dims", 1)
-        m = _prod(x[:xn])
-        k = _prod(x[xn:])
-        n = _prod(y[yn:])
-        return 2 * m * k * n
-    if t == "matmul":
-        x = _shape(block, op.inputs["X"][0], batch)
-        y = _shape(block, op.inputs["Y"][0], batch)
-        out = _shape(block, op.outputs["Out"][0], batch)
-        if (op.attrs or {}).get("transpose_X"):
-            k = x[-2] if len(x) >= 2 else x[-1]
-        else:
-            k = x[-1]
-        return 2 * _prod(out) * int(k)
-    if t == "fused_bottleneck":
-        # three convs over the same spatial extent: 1x1 Cin->C, 3x3 C->C,
-        # 1x1 C->Cin (ops/fused_ops.py); identical count to the op-by-op
-        # graph it replaces
-        x = _shape(block, op.inputs["X"][0], batch)
-        w1 = _shape(block, op.inputs["W1"][0], batch)
-        w2 = _shape(block, op.inputs["W2"][0], batch)
-        n, cin = x[0], x[1]
-        sp = _prod(x[2:])
-        c = w1[0]
-        k2 = _prod(w2[1:])
-        return 2 * n * sp * (cin * c + c * k2 + c * cin)
-    if t == "scaled_dot_product_attention":
-        q = _shape(block, op.inputs["Q"][0], batch)
-        kv = _shape(block, op.inputs["K"][0], batch)
-        # [B, Sq, H, D] x [B, Sk, H, D]: QK^T + PV
-        b, sq, h, d = q
-        sk = kv[1]
-        return 2 * 2 * b * h * sq * sk * d
-    return 0
-
-
-def program_forward_flops(program: Optional[Program] = None,
-                          batch: int = 1) -> int:
-    """Forward matmul-class flops of block 0 for one step at `batch`
-    (dynamic -1 dims substitute `batch`)."""
-    program = program or default_main_program()
-    block = program.global_block
-    total = 0
-    for op in block.ops:
-        if op.type == "autodiff":
-            break  # optimizer suffix follows; forward ends here
-        try:
-            total += _op_flops(op, block, batch)
-        except KeyError:
-            # var pruned/renamed (cloned program slices): skip that op
-            continue
-    return total
+def program_forward_flops(program: Optional[Program] = None, batch: int = 1,
+                          include_vector: bool = False) -> int:
+    """Forward flops of block 0 for one step at `batch` (dynamic -1 dims
+    substitute `batch`). Default: matmul-class (MXU) flops only — the
+    MFU-numerator convention; include_vector=True adds elementwise /
+    normalization / attention-softmax (VPU) work."""
+    fwd = program_cost(program, batch=batch).forward
+    return fwd.flops if include_vector else fwd.mxu_flops
 
 
 def program_train_flops(program: Optional[Program] = None, batch: int = 1,

@@ -2,7 +2,7 @@
 # CI entry (≙ paddle/scripts/paddle_build.sh: build + test in one place).
 # Runs the lint gate, the full suite on the 8-device virtual CPU mesh,
 # the multi-chip dryrun, and a bench sanity pass.
-# Usage: scripts/ci.sh [quick|lint|chaos|perf|serve]
+# Usage: scripts/ci.sh [quick|lint|chaos|perf|serve|analyze]
 #   lint  = just the lint gate
 #   chaos = lint gate + the resilience suite under two fixed fault seeds
 #   perf  = lint gate + the async-hot-path suite (lazy fetches, per-phase
@@ -13,6 +13,10 @@
 #           end) + the C-API serving drivers + the autoregressive decode
 #           suite (paged KV cache, continuous batching, eviction/resume
 #           token identity, streaming route, prometheus exposition)
+#   analyze = lint gate + the static cost-model suites + schema-checked
+#           tools/cost_report.py runs over the resnet / transformer /
+#           decode bench programs, incl. the collective audit on the
+#           MULTICHIP dryrun meshes (dp, dp x tp, dp x sp x tp)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -48,6 +52,21 @@ if [[ "${1:-}" == "serve" ]]; then
   exit 0
 fi
 
+if [[ "${1:-}" == "analyze" ]]; then
+  echo "== analyze: cost model + memory estimator + collective audit =="
+  python -m pytest tests/test_cost_model.py tests/test_analysis.py -q
+  echo "== analyze: schema-checked cost reports (bench programs) =="
+  for prog in resnet transformer decode; do
+    python tools/cost_report.py "$prog" --check > /dev/null
+  done
+  # the dryrun meshes: per-collective byte volumes reported and
+  # schema-checked on the transpiled transformer
+  python tools/cost_report.py transformer --check \
+    --mesh dp=8 --mesh dp=4,tp=2 --mesh dp=2,sp=2,tp=2 > /dev/null
+  echo "ANALYZE OK"
+  exit 0
+fi
+
 if [[ "${1:-}" == "perf" ]]; then
   echo "== perf: async hot path + compile cache + learning probe =="
   python -m pytest tests/test_async_hotpath.py tests/test_transformer_learns.py -q
@@ -68,8 +87,32 @@ if [[ "${1:-}" != "quick" ]]; then
   echo "== bench sanity (tiny shapes, persistent compile cache on) =="
   # PT_COMPILE_CACHE: the second CI run on a machine warm-starts every
   # config's compile; per-config JSON carries compile_cache=cold|warm
+  BENCH_SANITY_OUT="${TMPDIR:-/tmp}/pt_ci_bench_sanity.json"
   PT_COMPILE_CACHE="${PT_COMPILE_CACHE:-${TMPDIR:-/tmp}/pt_ci_xla_cache}" \
-    BENCH_STEPS=1 BENCH_BATCH=2 python bench.py
+    BENCH_STEPS=1 BENCH_BATCH=2 python bench.py | tee "$BENCH_SANITY_OUT"
+  # the static cost model must attribute EVERY training config: any
+  # config that reports a measured step (ms_per_batch) must carry the
+  # roofline prediction beside it (predicted_mfu_pct + declared bound)
+  python - "$BENCH_SANITY_OUT" <<'PY'
+import json, sys
+def docs(path):
+    # parse each line once; skip stray stdout lines that merely start
+    # with "{" (a dict repr in a warning must not crash the scan)
+    for l in open(path):
+        if not l.startswith("{"):
+            continue
+        try:
+            yield json.loads(l)
+        except json.JSONDecodeError:
+            continue
+doc = next(d for d in docs(sys.argv[1]) if "configs" in d)
+missing = [n for n, c in doc["configs"].items()
+           if isinstance(c, dict) and "ms_per_batch" in c
+           and not ("predicted_mfu_pct" in c and "bound" in c)]
+assert not missing, f"configs without roofline prediction: {missing}"
+print(f"bench sanity: predicted_mfu + bound present on all "
+      f"{sum(1 for c in doc['configs'].values() if isinstance(c, dict) and 'ms_per_batch' in c)} measured configs")
+PY
 fi
 
 echo "CI OK"

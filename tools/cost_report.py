@@ -1,0 +1,193 @@
+"""Whole-program static cost report CLI (analysis/cost.py + memory.py +
+comm.py).
+
+Builds one of the bench programs (program IR only — nothing compiles,
+nothing touches a device) and reports:
+
+  * per-op analytical cost totals: MXU/VPU FLOPs + HBM bytes, forward /
+    backward / optimizer split, uncovered-op list (coverage gaps are
+    visible, never silently zero);
+  * the liveness-based static peak-HBM estimate with its params /
+    activations / grads / optimizer-state / kv-pool breakdown;
+  * the roofline prediction: step time, MFU, and the declared bound
+    (compute | bandwidth | comm | host) for the detected chip — or the
+    PT_COST_CHIP override, so a laptop predicts for the deployment chip;
+  * per --mesh, the sharding-aware collective audit: every all-reduce /
+    all-gather / reduce-scatter with byte volumes, accidental resharding
+    flagged.
+
+Usage:
+    python tools/cost_report.py resnet --batch 4
+    python tools/cost_report.py transformer --mesh dp=2,tp=2 \
+        --mesh dp=2,sp=2,tp=2
+    python tools/cost_report.py decode --check      # schema-validated
+    python tools/cost_report.py transformer --infer
+
+--check validates the emitted document with
+analysis/artifacts.validate_cost_report (the scripts/ci.sh analyze leg)
+and exits non-zero on schema/floor problems. BENCH_TFM_* env knobs
+resize the transformer exactly like tools/remat_memory_report.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu.analysis.comm import audit_collectives  # noqa: E402
+from paddle_tpu.analysis.cost import (predict_step,  # noqa: E402
+                                      program_cost, resolve_chip)
+from paddle_tpu.analysis.memory import estimate_memory  # noqa: E402
+
+
+def build_resnet(train: bool):
+    from paddle_tpu.models import resnet
+    depth = int(os.environ.get("BENCH_RESNET_DEPTH", 50))
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        resnet.get_model(data_set="cifar10", depth=depth,
+                         fused_xent=True, is_test=not train)
+    return main
+
+
+def build_transformer(train: bool):
+    from paddle_tpu.models.transformer import transformer_lm_loss
+    cfg = dict(
+        vocab_size=int(os.environ.get("BENCH_TFM_VOCAB", 1000)),
+        seq_len=int(os.environ.get("BENCH_TFM_SEQ", 64)),
+        n_layers=int(os.environ.get("BENCH_TFM_LAYERS", 2)),
+        d_model=int(os.environ.get("BENCH_TFM_DMODEL", 64)),
+        n_heads=int(os.environ.get("BENCH_TFM_HEADS", 2)),
+    )
+    cfg["d_ff"] = int(os.environ.get("BENCH_TFM_DFF", 4 * cfg["d_model"]))
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        avg, _ = transformer_lm_loss(max_len=max(cfg["seq_len"], 128), **cfg)
+        if train:
+            pt.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(avg)
+    return main
+
+
+def build_decode(train: bool):
+    # the PR-6 decode step: paged_attention / paged_kv_write coverage
+    # (inference-only by construction; --train is ignored)
+    from paddle_tpu.models.transformer import transformer_decode_step
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        transformer_decode_step(
+            int(os.environ.get("BENCH_TFM_VOCAB", 1000)),
+            n_layers=2, d_model=64, n_heads=2, d_ff=256, max_context=128,
+            slots=4, block_size=16, pool_blocks=16, max_blocks_per_seq=8)
+    return main
+
+
+BUILDERS = {"resnet": build_resnet, "transformer": build_transformer,
+            "decode": build_decode}
+
+
+def parse_mesh(spec: str):
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        if not size:
+            raise SystemExit(f"--mesh {spec!r}: expected axis=size pairs")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("program", choices=sorted(BUILDERS))
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--infer", action="store_true",
+                    help="inference accounting (no backward/optimizer)")
+    ap.add_argument("--mesh", action="append", default=[],
+                    metavar="dp=2,tp=2",
+                    help="audit collectives on this mesh (repeatable)")
+    ap.add_argument("--zero", action="store_true",
+                    help="price ZeRO grad sync (reduce-scatter+all-gather)")
+    ap.add_argument("--check", action="store_true",
+                    help="schema-validate the report; exit 1 on problems")
+    ap.add_argument("--out", help="also write the JSON here")
+    args = ap.parse_args(argv)
+
+    # train=None auto-detects from the autodiff marker; --infer FORCES
+    # inference accounting even when the builder's model appends its own
+    # optimizer (resnet.get_model does)
+    train = False if args.infer else None
+    program = BUILDERS[args.program](not args.infer)
+    pc = program_cost(program, batch=args.batch, train=train)
+    est = estimate_memory(program, batch=args.batch, train=train)
+    chip = resolve_chip()
+    pred = predict_step(program, batch=args.batch, chip=chip, train=train)
+
+    def leg(c):
+        return {"mxu_flops": int(c.mxu_flops),
+                "vector_flops": int(c.vector_flops),
+                "bytes_read": int(c.bytes_read),
+                "bytes_written": int(c.bytes_written)}
+
+    report = {
+        "program": args.program,
+        "batch": args.batch,
+        "train": pc.has_backward,
+        "chip": chip.name,
+        "cost": {
+            "forward": leg(pc.forward), "backward": leg(pc.backward),
+            "optimizer": leg(pc.optimizer),
+            "train_flops": int(pc.train_flops),
+            "train_bytes": int(pc.train_bytes),
+            "remat_recompute_flops": int(pc.remat_recompute_flops),
+            "uncovered_ops": list(pc.uncovered_ops),
+        },
+        "memory": est.to_dict(),
+        "prediction": pred.to_dict(),
+    }
+    if args.mesh:
+        report["comm"] = {}
+        for spec in args.mesh:
+            axes = parse_mesh(spec)
+            # audit the TRANSPILED program: the sharding pass derives the
+            # placement facts (Megatron tp pairs, vocab-sharded tables,
+            # sp attention rewrites) the audit prices. A clone per mesh —
+            # transpile mutates — and a shape-duck mesh: the pass and the
+            # audit only read .shape, so no devices are needed.
+            from types import SimpleNamespace
+            from paddle_tpu.transpiler import TranspileStrategy, transpile
+            prog_m = program.clone()
+            strat = TranspileStrategy(
+                sp_mode="ring" if int(axes.get("sp", 1)) > 1 else None)
+            transpile(prog_m, mesh=SimpleNamespace(shape=axes),
+                      strategy=strat)
+            audit = audit_collectives(prog_m, axes, batch=args.batch,
+                                      zero=args.zero)
+            report["comm"][spec] = audit.to_dict()
+            report["comm"][spec]["prediction"] = predict_step(
+                prog_m, batch=args.batch, chip=chip, train=train,
+                comm_report=audit).to_dict()
+
+    text = json.dumps(report, indent=2)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.check:
+        from paddle_tpu.analysis.artifacts import validate_cost_report
+        problems = validate_cost_report(report)
+        if problems:
+            print("COST REPORT INVALID:\n  " + "\n  ".join(problems),
+                  file=sys.stderr)
+            return 1
+        print(f"cost report ok: {args.program} train={pc.has_backward} "
+              f"bound={pred.bound} uncovered={len(pc.uncovered_ops)}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
