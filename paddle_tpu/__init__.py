@@ -44,6 +44,7 @@ from .flags import FLAGS
 from . import debugger
 from . import resilience
 from . import serving
+from . import data
 from .utils import profiler
 from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent)

@@ -62,6 +62,7 @@ HOT_LOOP_FILES = (
     "paddle_tpu/core/async_fetch.py",
     "paddle_tpu/parallel/parallel_executor.py",
     "paddle_tpu/reader/prefetch.py",
+    "paddle_tpu/data/pipeline.py",
 )
 
 #: suppression marker: a justified, deliberate materialization point

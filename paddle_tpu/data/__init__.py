@@ -1,0 +1,28 @@
+"""Production data plane: the composable input-pipeline subsystem.
+
+    from paddle_tpu import data
+
+    pipe = (data.Dataset.from_recordio(shards)
+            .shard()                       # distributed defaults
+            .shuffle(buf_size=1024, seed=7)
+            .batch(128, drop_last=True)
+            .map_batches(decode_fn, workers=4)   # parallel decode
+            .augment(data.Augment(crop=224, flip_lr=True))
+            .device_prefetch(capacity=2)
+            .named("train"))
+    trainer.train(..., reader=pipe)        # a Dataset IS a reader
+
+See data/pipeline.py for the stage/determinism/resume contracts,
+data/augment.py for device-side augmentation, data/metrics.py for the
+per-stage occupancy metrics (exported as the pt_data_* Prometheus
+family via the serving HTTP front end), and docs/data.md for the
+operator-facing overview.
+"""
+
+from .pipeline import Dataset
+from .augment import Augment
+from .metrics import (PipelineMetrics, register, unregister,
+                      registry_snapshots)
+
+__all__ = ["Dataset", "Augment", "PipelineMetrics", "register",
+           "unregister", "registry_snapshots"]

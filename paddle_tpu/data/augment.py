@@ -1,0 +1,133 @@
+"""Device-side batch augmentation: crop / flip / normalize as traced ops.
+
+The per-sample Python augmentation of the reference's image loaders
+(dataset/image.py simple_transform: PIL resize + numpy crop/flip per
+sample) is host work in the hot loop — exactly the work the BENCH r05
+input-bound reading says must leave it. Here augmentation runs on the
+ALREADY-UPLOADED batch as one jitted function: the host pays a single
+dispatch (which overlaps the training step like any async device work)
+and the crop/flip/normalize arithmetic runs at device speed on the whole
+batch at once.
+
+Randomness is counter-based and checkpointable: every batch's draws come
+from ``fold_in(fold_in(PRNGKey(seed), epoch), cursor)`` where `cursor`
+is the pipeline's batches-delivered counter — so a resumed run replays
+the IDENTICAL crops and flips for batch N that the uninterrupted run
+applied (the bit-exact resume contract extends through augmentation),
+and two pipelines with the same seed augment identically.
+
+Layout contract: NCHW batches (B, C, H, W), the repo's image layout.
+`normalize` alone also accepts any rank >= 2 with channels on axis 1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = ["Augment"]
+
+
+class Augment:
+    """Composable device-side augmentation, applied batch-at-a-time.
+
+    Args:
+        crop: output spatial size (int or (h, w)). Each sample is
+            cropped at an independent random offset. With `pad`, the
+            batch is zero-padded first (the CIFAR translation idiom:
+            crop == input size + pad > 0 gives random shifts).
+        pad: pixels of zero padding added to each spatial edge before
+            cropping (only meaningful with `crop`).
+        flip_lr: random horizontal flip with p=0.5, per sample.
+        normalize: (mean, std) per channel — applied last, as
+            ``(x - mean) / std`` in the batch dtype.
+        image_key: which feed-dict key holds the image batch.
+        seed: base of the counter-derived rng (see module docstring).
+
+    Calling ``aug(batch_dict, cursor, epoch)`` returns a new dict with
+    the image entry replaced; other keys (labels) pass through. The
+    batch must already be on device (jax arrays) — the data pipeline's
+    upload stage guarantees that when the augment rides device_prefetch.
+    """
+
+    def __init__(self, *, crop: Union[int, Tuple[int, int], None] = None,
+                 pad: int = 0, flip_lr: bool = False,
+                 normalize: Optional[Tuple[Sequence[float],
+                                           Sequence[float]]] = None,
+                 image_key: str = "data", seed: int = 0):
+        if crop is not None and isinstance(crop, int):
+            crop = (crop, crop)
+        self.crop = crop
+        self.pad = int(pad)
+        self.flip_lr = bool(flip_lr)
+        self.normalize = normalize
+        self.image_key = image_key
+        self.seed = int(seed)
+        if self.pad and crop is None:
+            raise ValueError("pad without crop has no effect: pass "
+                             "crop=<output size> (crop == input size + "
+                             "pad > 0 gives random shifts)")
+        self._fn = None  # jitted lazily: jax import stays off module load
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        crop, pad, flip_lr = self.crop, self.pad, self.flip_lr
+        normalize, seed = self.normalize, self.seed
+
+        def apply(x, epoch_cursor):
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(seed),
+                                   epoch_cursor[0]), epoch_cursor[1])
+            if crop is not None:
+                if x.ndim != 4:
+                    raise ValueError(
+                        f"crop/flip need NCHW batches, got shape {x.shape}")
+                b, c = x.shape[0], x.shape[1]
+                xp = jnp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad))) \
+                    if pad else x
+                ch, cw = crop
+                if ch > xp.shape[2] or cw > xp.shape[3]:
+                    raise ValueError(
+                        f"crop {crop} larger than padded input "
+                        f"{xp.shape[2:]} (pad={pad})")
+                kh, kw, key = jax.random.split(key, 3)
+                oh = jax.random.randint(kh, (b,), 0, xp.shape[2] - ch + 1)
+                ow = jax.random.randint(kw, (b,), 0, xp.shape[3] - cw + 1)
+
+                def crop_one(img, i, j):
+                    return jax.lax.dynamic_slice(img, (0, i, j), (c, ch, cw))
+
+                x = jax.vmap(crop_one)(xp, oh, ow)
+            if flip_lr:
+                if x.ndim != 4:
+                    raise ValueError(
+                        f"crop/flip need NCHW batches, got shape {x.shape}")
+                kf, key = jax.random.split(key)
+                flips = jax.random.bernoulli(kf, 0.5, (x.shape[0],))
+                x = jnp.where(flips[:, None, None, None], x[..., ::-1], x)
+            if normalize is not None:
+                mean, std = normalize
+                shp = (1, -1) + (1,) * (x.ndim - 2)
+                mean = jnp.asarray(np.reshape(
+                    np.asarray(mean, np.float32), shp), x.dtype)
+                inv = jnp.asarray(np.reshape(
+                    1.0 / np.asarray(std, np.float32), shp), x.dtype)
+                x = (x - mean) * inv
+            return x
+
+        self._fn = jax.jit(apply)
+
+    def __call__(self, batch: dict, cursor: int, epoch: int = 0) -> dict:
+        if self._fn is None:
+            self._build()
+        x = batch[self.image_key]
+        # the counter rides as a tiny uint32 array: values stay out of the
+        # jit cache key, so every batch reuses one compiled program
+        ec = np.asarray([epoch & 0xFFFFFFFF, cursor & 0xFFFFFFFF],
+                        np.uint32)
+        out = dict(batch)
+        out[self.image_key] = self._fn(x, ec)
+        return out

@@ -240,6 +240,24 @@ declare_env_knob("PT_COST_CHIP",
                  "cost.py), e.g. 'tpu v5e' — lets an off-TPU host "
                  "predict step time / MFU / bound for the deployment "
                  "chip; default: the detected jax device kind")
+declare_env_knob("PT_DATA_WORKERS",
+                 "data pipeline (paddle_tpu/data/): decode worker-pool "
+                 "width of map_batches stages that don't pass an "
+                 "explicit workers= (default 2). Decode occupancy ~1.0 "
+                 "in the pt_data_* metrics means raise it")
+declare_env_knob("PT_DATA_BACKEND",
+                 "data pipeline: decode pool backend, thread (default) "
+                 "| process. Threads are right for the native decode "
+                 "kernels (they release the GIL); the process pool "
+                 "exists for GIL-bound pure-Python decoders, needs a "
+                 "picklable decode fn, and is NOT exercised by tier-1 "
+                 "tests (sandbox multiprocess limits)")
+declare_env_knob("PT_DATA_PREFETCH",
+                 "data pipeline: bounded queue depth of decoded batches "
+                 "between the decode pool and the consumer (default "
+                 "2 x workers). Bounds host RAM held in decoded "
+                 "batches; too low re-serializes decode behind the "
+                 "consumer")
 declare_env_knob("PT_COMPILE_CACHE",
                  "persistent XLA compile cache (core/compile_cache.py): "
                  "unset/0 = off, 1 = ~/.cache/paddle_tpu/xla_cache, "

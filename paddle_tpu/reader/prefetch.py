@@ -23,13 +23,42 @@ import queue
 import threading
 from typing import Callable, Iterable, Optional
 
-__all__ = ["double_buffer", "DeviceFeeder"]
+__all__ = ["double_buffer", "DeviceFeeder", "bounded_put"]
 
 _STOP = object()
 
 
+def bounded_put(q: "queue.Queue", item, stop: "threading.Event",
+                timeout: float = 0.1) -> bool:
+    """Bounded put that gives up when `stop` is set — the one stop-aware
+    queue-handoff primitive shared by every pipeline stage thread here
+    and in data/pipeline.py. Without the stop check, an abandoned
+    consumer (exception/break in the train loop) would pin producer
+    threads, their file handles, and queued device batches forever."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=timeout)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+class _NullSpan:
+    """No-op timing span for the uninstrumented (default) path."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_null_span = _NullSpan()
+
+
 def double_buffer(reader: Callable, place=None, capacity: int = 2,
-                  retry_policy=None):
+                  retry_policy=None, transform=None, instrument=None):
     """Wrap a feed-dict reader so device uploads overlap compute.
 
     reader() yields dicts of numpy arrays (or anything jax.device_put
@@ -43,12 +72,37 @@ def double_buffer(reader: Callable, place=None, capacity: int = 2,
     and fast-forwarded past delivered batches, so the consumer never sees
     a duplicate; exhaustion propagates the original error as before.
     (The Trainer installs its own wrapper upstream — don't pass a policy
-    there too, or each error spends two retry budgets.)
+    there too, or each error spends two retry budgets. Stacking is now
+    DETECTED: a reader already carrying an armed resilient wrapper is
+    not re-wrapped — one warning, one budget; see docs/resilience.md.)
+
+    transform(batch, idx): applied in the upload thread AFTER device_put
+    (idx = 0-based batch index of this iteration) — the data pipeline's
+    device-side augmentation hook: the traced call dispatches off the
+    consumer's critical path and its execution overlaps compute.
+
+    instrument: a data.metrics.PipelineMetrics (duck-typed: span()) —
+    the upload/augment stages report their busy time through it.
     """
     import jax
     if retry_policy is not None:
-        from ..resilience.retry import resilient_reader
-        reader = resilient_reader(reader, policy=retry_policy)
+        if getattr(reader, "_pt_resilient", False):
+            # the double-retry-budget footgun (docs/resilience.md): this
+            # reader is ALREADY an armed resilient wrapper — wrapping it
+            # again would make every reader error spend two budgets
+            # (retries_outer x retries_inner restarts). Dedupe to the
+            # existing layer and say so, once, loudly.
+            import warnings
+            warnings.warn(
+                "double_buffer(retry_policy=...) received a reader that "
+                "already carries an armed resilient_reader wrapper "
+                "(e.g. Trainer.train(reader_retry=...)): ignoring the "
+                "double_buffer policy — stacked wrappers would multiply "
+                "retry budgets. Pick one layer (docs/resilience.md).",
+                stacklevel=2)
+        else:
+            from ..resilience.retry import resilient_reader
+            reader = resilient_reader(reader, policy=retry_policy)
 
     def buffered():
         q_host: "queue.Queue" = queue.Queue(maxsize=capacity)
@@ -57,17 +111,7 @@ def double_buffer(reader: Callable, place=None, capacity: int = 2,
         err = []
 
         def put(q, item) -> bool:
-            """Bounded put that gives up when the consumer went away —
-            otherwise an abandoned epoch (exception/break in the train
-            loop) would pin these threads, the reader's file handles, and
-            `capacity` device batches forever."""
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+            return bounded_put(q, item, stop)
 
         def get(q):
             """Bounded get for the MIDDLE stage (the consumer's own get
@@ -93,18 +137,30 @@ def double_buffer(reader: Callable, place=None, capacity: int = 2,
                 put(q_host, _STOP)
 
         def upload_worker():
-            """Stage 2: stage batches onto the device. A single thread,
-            so batch order is preserved end to end."""
+            """Stage 2: stage batches onto the device (then run the
+            optional transform — device-side augmentation — on the
+            uploaded batch). A single thread, so batch order is
+            preserved end to end."""
+            idx = 0
             try:
                 while True:
                     item = get(q_host)
                     if item is _STOP:
                         return
-                    if isinstance(item, dict):
-                        item = {k: jax.device_put(v)
-                                for k, v in item.items()}
-                    else:
-                        item = jax.device_put(item)
+                    span = (instrument.span("upload") if instrument
+                            else _null_span)
+                    with span:
+                        if isinstance(item, dict):
+                            item = {k: jax.device_put(v)
+                                    for k, v in item.items()}
+                        else:
+                            item = jax.device_put(item)
+                    if transform is not None:
+                        span = (instrument.span("augment") if instrument
+                                else _null_span)
+                        with span:
+                            item = transform(item, idx)
+                    idx += 1
                     if not put(q_dev, item):
                         return
             except BaseException as e:  # noqa: BLE001 — re-raised below
@@ -127,6 +183,10 @@ def double_buffer(reader: Callable, place=None, capacity: int = 2,
         finally:
             stop.set()  # unblock + terminate both workers on early exit
 
+    #: stacking detection (docs/resilience.md double-retry footgun):
+    #: Trainer.train(reader_retry=...) checks this mark so a policy baked
+    #: in here is never silently multiplied by a trainer-level budget
+    buffered._pt_retry_policy = retry_policy
     return buffered
 
 

@@ -126,19 +126,33 @@ def resilient_reader(reader: Callable, policy: Optional[RetryPolicy] = None,
     Fast-forward replays the source's batches without delivering them:
     correct for deterministic readers (files, RecordIO, seeded shuffles);
     a nondeterministic source resumes on a *different* stream, which is
-    exactly what it would give a fresh process too."""
+    exactly what it would give a fresh process too. A reader exposing
+    ``iter_from(n)`` (the data-pipeline protocol, data/pipeline.py) fast-
+    forwards through it instead — the skipped batches are never decoded.
 
-    def wrapped():
-        delivered = 0
+    The wrapper is itself skippable (``wrapped.iter_from(n)`` starts with
+    n batches already delivered — the Trainer's mid-epoch resume path)
+    and forwards the pipeline's ``set_epoch``/``state`` surface, so a
+    wrapped pipeline keeps its deterministic-resume contract."""
+
+    cheap_skip = hasattr(reader, "iter_from")
+
+    def wrapped(start: int = 0):
+        delivered = int(start)
         attempts = _Attempts(policy, on_retry)
         while True:
             try:
                 # freeze the fast-forward target: `delivered` grows as
                 # this attempt yields, but only batches delivered by
-                # PRIOR attempts are skipped
+                # PRIOR attempts (or the caller's `start`) are skipped
                 to_skip = delivered
-                skipped = 0
-                for item in reader():
+                if to_skip and cheap_skip:
+                    it = reader.iter_from(to_skip)
+                    skipped = to_skip
+                else:
+                    it = reader()
+                    skipped = 0
+                for item in it:
                     if skipped < to_skip:
                         skipped += 1
                         continue
@@ -149,4 +163,12 @@ def resilient_reader(reader: Callable, policy: Optional[RetryPolicy] = None,
             except BaseException as e:  # noqa: BLE001 — filtered below
                 attempts.backoff_or_reraise(e)
 
+    wrapped.iter_from = wrapped
+    for attr in ("set_epoch", "state", "restore", "metrics_snapshot"):
+        if hasattr(reader, attr):
+            setattr(wrapped, attr, getattr(reader, attr))
+    #: True only when a budget is ARMED — double_buffer's stacking
+    #: detection keys on this (a policy-less wrapper just hosts the
+    #: fault site and stacks harmlessly)
+    wrapped._pt_resilient = policy is not None
     return wrapped

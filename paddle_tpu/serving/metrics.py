@@ -327,6 +327,14 @@ class ServingMetrics:
         out = {"models": {m.name: m.snapshot() for m in models}}
         if decode:
             out["decode"] = {m.name: m.snapshot() for m in decode}
+        # the data plane reports through the same snapshot (and so the
+        # same Prometheus scrape): any live, named input pipeline in
+        # this process (data/metrics.py registry) rides along as the
+        # pt_data_* family — trainer and serving report through one pane
+        from ..data.metrics import registry_snapshots
+        pipelines = registry_snapshots()
+        if pipelines:
+            out["data"] = pipelines
         return out
 
 
@@ -349,6 +357,10 @@ _DECODE_COUNTERS = ("received", "completed", "failed", "shed_overload",
 _DECODE_GAUGES = ("tokens_per_sec", "slot_occupancy", "active", "waiting",
                   "kv_blocks_in_use", "kv_blocks_capacity",
                   "kv_high_water")
+#: data-plane (input pipeline) counters/gauges exported as pt_data_*
+#: (data/metrics.py PipelineMetrics.snapshot)
+_DATA_COUNTERS = ("batches", "samples")
+_DATA_GAUGES = ("batches_per_sec", "samples_per_sec", "workers")
 
 
 def render_prometheus(snapshot: dict) -> str:
@@ -404,4 +416,16 @@ def render_prometheus(snapshot: dict) -> str:
             emit("pt_decode_phase_seconds_total",
                  {"model": name, "phase": key[:-2]}, snap.get(key),
                  "counter")
+    for name, snap in sorted(snapshot.get("data", {}).items()):
+        for key in _DATA_COUNTERS:
+            emit(f"pt_data_{key}_total", {"pipeline": name},
+                 snap.get(key), "counter")
+        for key in _DATA_GAUGES:
+            emit(f"pt_data_{key}", {"pipeline": name}, snap.get(key))
+        for stage, st in snap.get("stages", {}).items():
+            emit("pt_data_stage_seconds_total",
+                 {"pipeline": name, "stage": stage}, st.get("busy_s"),
+                 "counter")
+            emit("pt_data_stage_occupancy",
+                 {"pipeline": name, "stage": stage}, st.get("occupancy"))
     return "\n".join(lines) + "\n"

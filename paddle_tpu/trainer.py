@@ -305,6 +305,23 @@ class Trainer:
             retry_policy = RetryPolicy(retries=int(reader_retry))
         else:
             retry_policy = None
+        if (retry_policy is not None
+                and getattr(reader, "_pt_retry_policy", None) is not None):
+            # the double-retry-budget footgun (docs/resilience.md): this
+            # reader is a double_buffer(retry_policy=...) chain that
+            # already restarts the source — stacking a trainer budget on
+            # top would multiply the two (outer x inner restarts per
+            # error). Dedupe: the layer closest to the fault wins; the
+            # trainer wrapper still installs (it hosts the reader_raise
+            # fault site) but with no budget of its own.
+            import warnings
+            warnings.warn(
+                "Trainer.train(reader_retry=...) over a "
+                "double_buffer(retry_policy=...) reader: dropping the "
+                "trainer-level budget — stacked wrappers would multiply "
+                "retry budgets. Pick one layer (docs/resilience.md).",
+                stacklevel=2)
+            retry_policy = None
         reader = resilient_reader(reader, policy=retry_policy)
         self.preempted = False
         self._preempt_signal = None
@@ -496,6 +513,12 @@ class Trainer:
                     log_every=1):
         from .core.async_fetch import materialize, LazyFetch
         guard_on = bool(self._guard_policy)
+        # data-pipeline epoch pinning (data/pipeline.py): captured BEFORE
+        # any host-table rewrap — the underlying pipeline object is shared
+        # by every downstream closure, so pinning it here steers them all.
+        # Restored epoch ids come from trainer_args, so a resumed run's
+        # per-epoch reshuffle matches the uninterrupted one's exactly.
+        pipeline_set_epoch = getattr(reader, "set_epoch", None)
         with scope_guard(self.scope):
             feed_vars = self._feed_vars(feed_order)
             feeder = DataFeeder(feed_vars, program=self.train_program)
@@ -615,9 +638,15 @@ class Trainer:
                 resume_step = (self.checkpoint_cfg.step_id
                                if self.checkpoint_cfg
                                and epoch_id == start_epoch else 0)
+                if pipeline_set_epoch is not None:
+                    pipeline_set_epoch(epoch_id)
+                # a reader with the pipeline's iter_from skips CHEAPLY
+                # (raw records scanned, never decoded/uploaded); plain
+                # readers replay-and-discard through islice as before
                 epoch_reader = reader if not resume_step else (
                     lambda r=reader, n=resume_step:
-                    itertools.islice(r(), n, None))
+                    (r.iter_from(n) if hasattr(r, "iter_from")
+                     else itertools.islice(r(), n, None)))
                 event_handler(BeginEpochEvent(epoch_id))
                 batches = (DeviceFeeder(feeder, epoch_reader)
                            if double_buffer and not self.parallel

@@ -2,7 +2,7 @@
 # CI entry (≙ paddle/scripts/paddle_build.sh: build + test in one place).
 # Runs the lint gate, the full suite on the 8-device virtual CPU mesh,
 # the multi-chip dryrun, and a bench sanity pass.
-# Usage: scripts/ci.sh [quick|lint|chaos|perf|serve|analyze]
+# Usage: scripts/ci.sh [quick|lint|chaos|perf|serve|analyze|data]
 #   lint  = just the lint gate
 #   chaos = lint gate + the resilience suite under two fixed fault seeds
 #   perf  = lint gate + the async-hot-path suite (lazy fetches, per-phase
@@ -17,6 +17,12 @@
 #           tools/cost_report.py runs over the resnet / transformer /
 #           decode bench programs, incl. the collective audit on the
 #           MULTICHIP dryrun meshes (dp, dp x tp, dp x sp x tp)
+#   data  = lint gate + the production data-plane suite (pipeline
+#           determinism, sharding disjointness, parallel shard readers,
+#           cheap skip + checkpointable state, device-side augmentation,
+#           exactly-once under reader faults, mid-epoch resume
+#           bit-exactness, pt_data_* metrics) + the legacy reader /
+#           dataset-parser / double-buffer suite — all thread-backend
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -41,6 +47,14 @@ if [[ "${1:-}" == "chaos" ]]; then
       tests/test_guardrails.py -q
   done
   echo "CHAOS OK"
+  exit 0
+fi
+
+if [[ "${1:-}" == "data" ]]; then
+  echo "== data: production data plane + legacy reader chain =="
+  python -m pytest tests/test_data_pipeline.py \
+    tests/test_data_plane.py -q -m 'not slow'
+  echo "DATA OK"
   exit 0
 fi
 

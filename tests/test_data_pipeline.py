@@ -1,0 +1,690 @@
+"""Production data plane (paddle_tpu/data/): pipeline determinism,
+sharding disjointness/completeness, cheap skip, parallel-decode ordering,
+device-side augmentation, checkpointable state, exactly-once under
+injected reader faults, mid-epoch resume bit-exactness, per-stage
+metrics + the pt_data_* Prometheus family, and the double-retry-budget
+footgun detection.
+
+Everything here runs the THREAD decode backend — the tier-1 sandbox has
+known multiprocess limits, and the process pool (PT_DATA_BACKEND=
+process) exists behind its knob without being exercised here.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import data as pt_data
+from paddle_tpu import layers
+from paddle_tpu.data.pipeline import Dataset
+from paddle_tpu.resilience import FaultInjected, RetryPolicy, faults
+from paddle_tpu.resilience.retry import resilient_reader
+
+
+@pytest.fixture(autouse=True)
+def fresh_fault_plan(monkeypatch):
+    monkeypatch.delenv("PT_FAULT_INJECT", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv("PT_FAULT_INJECT", spec)
+    faults.reset()
+
+
+def _samples(n=24, dim=2):
+    return [np.full((dim,), i, np.float32) for i in range(n)]
+
+
+def _ids(batches):
+    """First column of every delivered batch — the stream fingerprint."""
+    return [b["x"][:, 0].tolist() for b in batches]
+
+
+def _pipe(samples=None, seed=3, batch=4, workers=2, decode_log=None):
+    samples = _samples() if samples is None else samples
+
+    def decode(rows):
+        if decode_log is not None:
+            decode_log.append(len(rows))
+        return {"x": np.stack(rows)}
+
+    return (Dataset.from_samples(samples)
+            .shuffle(buf_size=8, seed=seed)
+            .batch(batch, drop_last=True)
+            .map_batches(decode, workers=workers))
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+
+class TestDeterminism:
+    def test_same_seed_same_stream(self):
+        a = _ids(_pipe()())
+        b = _ids(_pipe()())
+        assert a == b
+        assert len(a) == 24 // 4
+
+    def test_stream_independent_of_worker_count(self):
+        # the ordered handoff means parallelism can never reorder: the
+        # stream is a pure function of (data, seed, epoch), not of the
+        # pool width or scheduling
+        assert _ids(_pipe(workers=1)()) == _ids(_pipe(workers=4)())
+
+    def test_order_preserved_under_skewed_decode_times(self):
+        # adversarial: later batches decode much faster than earlier
+        # ones — delivery order must still be submission order
+        def decode(rows):
+            time.sleep(0.05 if rows[0][0] < 8 else 0.0)
+            return {"x": np.stack(rows)}
+
+        base = (Dataset.from_samples(_samples())
+                .batch(4, drop_last=True))
+        seq = _ids(base.map_batches(decode, workers=1)())
+        par = _ids(base.map_batches(decode, workers=4)())
+        assert par == seq
+
+    def test_epoch_reshuffle_deterministic(self):
+        p = _pipe()
+        e0 = _ids(p())
+        p.set_epoch(1)
+        e1 = _ids(p())
+        assert e0 != e1
+        p.set_epoch(0)
+        assert _ids(p()) == e0
+
+    def test_reshuffle_off_pins_one_order(self):
+        p = (Dataset.from_samples(_samples())
+             .shuffle(buf_size=8, seed=3, reshuffle_each_epoch=False)
+             .batch(4)
+             .map_batches(lambda rows: {"x": np.stack(rows)}))
+        e0 = _ids(p())
+        p.set_epoch(5)
+        assert _ids(p()) == e0
+
+    def test_shuffle_never_touches_global_random(self):
+        import random
+        random.seed(7)
+        want = random.random()
+        random.seed(7)
+        list(_pipe()())
+        assert random.random() == want
+
+
+# ---------------------------------------------------------------------------
+# sharding
+# ---------------------------------------------------------------------------
+
+class TestSharding:
+    def test_disjoint_and_complete(self):
+        base = Dataset.from_samples(list(range(17)))
+        shards = [list(base.shard(4, i)()) for i in range(4)]
+        flat = [x for s in shards for x in s]
+        assert len(flat) == 17
+        assert sorted(flat) == list(range(17))
+        for i in range(4):
+            for j in range(i + 1, 4):
+                assert not (set(shards[i]) & set(shards[j]))
+
+    def test_distributed_defaults_single_process(self):
+        # jax.process_count()==1 in tests: default shard is the identity
+        assert list(Dataset.from_samples(list(range(5))).shard()()) \
+            == list(range(5))
+
+    def test_bad_args_raise(self):
+        base = Dataset.from_samples([1])
+        with pytest.raises(ValueError, match="num_shards"):
+            base.shard(4)
+        with pytest.raises(ValueError, match="index"):
+            base.shard(2, 2)
+
+
+# ---------------------------------------------------------------------------
+# parallel shard-file reading (RecordIO interleave)
+# ---------------------------------------------------------------------------
+
+class TestRecordIOInterleave:
+    def _write_shards(self, tmp_path, counts):
+        from paddle_tpu import recordio
+        paths = []
+        k = 0
+        for s, n in enumerate(counts):
+            p = str(tmp_path / f"shard-{s}.rio")
+            with recordio.Writer(p) as w:
+                for _ in range(n):
+                    w.write(np.int64(k).tobytes())
+                    k += 1
+            paths.append(p)
+        return paths
+
+    def test_parallel_scan_deterministic_and_complete(self, tmp_path):
+        paths = self._write_shards(tmp_path, [40, 40, 40])
+        seq = [int(np.frombuffer(r, np.int64)[0]) for r in
+               Dataset.from_recordio(paths)()]
+        par1 = [int(np.frombuffer(r, np.int64)[0]) for r in
+                Dataset.from_recordio(paths, parallel_files=3)()]
+        par2 = [int(np.frombuffer(r, np.int64)[0]) for r in
+                Dataset.from_recordio(paths, parallel_files=3)()]
+        assert par1 == par2                      # timing-independent
+        assert sorted(par1) == sorted(seq)       # complete, no dupes
+
+    def test_uneven_shards_drop_out_deterministically(self, tmp_path):
+        paths = self._write_shards(tmp_path, [70, 10, 35])
+        par = [int(np.frombuffer(r, np.int64)[0]) for r in
+               Dataset.from_recordio(paths, parallel_files=3)()]
+        assert sorted(par) == list(range(115))
+        assert par == [int(np.frombuffer(r, np.int64)[0]) for r in
+                       Dataset.from_recordio(paths, parallel_files=3)()]
+
+    def test_more_files_than_width_hand_over(self, tmp_path):
+        paths = self._write_shards(tmp_path, [20, 20, 20, 20, 20])
+        par = [int(np.frombuffer(r, np.int64)[0]) for r in
+               Dataset.from_recordio(paths, parallel_files=2)()]
+        assert sorted(par) == list(range(100))
+
+    def test_scan_error_propagates(self, tmp_path):
+        paths = self._write_shards(tmp_path, [30, 30])
+        data = bytearray(open(paths[1], "rb").read())
+        data[40] ^= 0xFF
+        open(paths[1], "wb").write(bytes(data))
+        with pytest.raises(IOError):
+            list(Dataset.from_recordio(paths, parallel_files=2)())
+
+
+# ---------------------------------------------------------------------------
+# cheap skip + checkpointable state
+# ---------------------------------------------------------------------------
+
+class TestSkipAndState:
+    def test_iter_from_matches_tail(self):
+        p = _pipe()
+        full = _ids(p())
+        assert _ids(p.iter_from(2)) == full[2:]
+
+    def test_iter_from_skips_decode_work(self):
+        log = []
+        p = _pipe(decode_log=log)
+        full = _ids(p())
+        n_full = len(log)
+        log.clear()
+        assert _ids(p.iter_from(4)) == full[4:]
+        # the skipped 4 batches were assembled from raw items but never
+        # handed to the decode stage
+        assert len(log) == n_full - 4
+
+    def test_state_restore_resumes_stream(self):
+        p = _pipe()
+        full = _ids(p())
+        it = p()
+        got = [next(it)["x"][:, 0].tolist() for _ in range(3)]
+        st = p.state()
+        assert st["delivered"] == 3
+        q = _pipe()
+        q.restore(st)
+        got += _ids(q())
+        assert got == full
+
+    def test_restore_refuses_foreign_signature(self):
+        st = _pipe().state()
+        other = (Dataset.from_samples(_samples()).batch(4)
+                 .map_batches(lambda r: {"x": np.stack(r)}))
+        with pytest.raises(ValueError, match="signature"):
+            other.restore(st)
+
+    def test_iter_from_on_unbatched_shard_keeps_stride_parity(self):
+        # regression: the skip must discard SHARD OUTPUTS, not raw
+        # source items — discarding upstream shifts the stride parity
+        # and re-delivers an already-delivered item
+        p = Dataset.from_samples(list(range(12))).shard(2, 0)
+        assert list(p()) == [0, 2, 4, 6, 8, 10]
+        assert list(p.iter_from(2)) == [4, 6, 8, 10]
+
+    def test_iter_from_on_unbatched_shuffle_matches_tail(self):
+        # regression: the skip must discard SHUFFLED outputs — feeding
+        # the pool a pre-skipped raw stream yields a different order
+        p = Dataset.from_samples(list(range(8))).shuffle(4, seed=0)
+        full = list(p())
+        assert list(p.iter_from(2)) == full[2:]
+
+    def test_iter_from_source_only(self):
+        p = Dataset.from_samples(list(range(6)))
+        assert list(p.iter_from(4)) == [4, 5]
+
+    def test_state_tracks_epoch(self):
+        p = _pipe()
+        p.set_epoch(2)
+        list(p())
+        st = p.state()
+        assert st["epoch"] == 2
+        q = _pipe()
+        q.restore(st)
+        assert q._epoch == 2
+
+
+# ---------------------------------------------------------------------------
+# parallel decode: errors, early exit, backend knob
+# ---------------------------------------------------------------------------
+
+class TestParallelDecode:
+    def test_decode_error_surfaces_in_order(self):
+        def decode(rows):
+            if rows[0][0] == 8:          # the third batch of 0..3,4..7,8..11
+                raise RuntimeError("bad shard")
+            return {"x": np.stack(rows)}
+
+        p = (Dataset.from_samples(_samples(16)).batch(4)
+             .map_batches(decode, workers=3))
+        it = p()
+        assert next(it)["x"][0, 0] == 0
+        assert next(it)["x"][0, 0] == 4
+        with pytest.raises(RuntimeError, match="bad shard"):
+            next(it)
+
+    def test_upstream_error_surfaces(self):
+        def bad_source():
+            yield np.zeros(2, np.float32)
+            raise IOError("disk gone")
+
+        p = (Dataset.from_reader(bad_source).batch(1)
+             .map_batches(lambda r: {"x": np.stack(r)}, workers=2))
+        with pytest.raises(IOError, match="disk gone"):
+            list(p())
+
+    def test_early_exit_terminates_workers(self):
+        import threading
+        before = {t.name for t in threading.enumerate()}
+        p = _pipe(samples=_samples(200), workers=2)
+        it = p()
+        next(it)
+        it.close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            alive = {t.name for t in threading.enumerate()} - before
+            if not any(n.startswith("pt-data") for n in alive):
+                break
+            time.sleep(0.05)
+        alive = {t.name for t in threading.enumerate()} - before
+        assert not any(n.startswith("pt-data") for n in alive), alive
+
+    def test_backend_knob_validated(self, monkeypatch):
+        monkeypatch.setenv("PT_DATA_BACKEND", "fork-bomb")
+        with pytest.raises(ValueError, match="thread|process"):
+            list(_pipe()())
+
+    def test_worker_knob_default(self, monkeypatch):
+        monkeypatch.setenv("PT_DATA_WORKERS", "5")
+        p = (Dataset.from_samples(_samples()).batch(4)
+             .map_batches(lambda r: {"x": np.stack(r)}))
+        list(p())
+        assert p.metrics_snapshot()["workers"] == 5
+
+
+# ---------------------------------------------------------------------------
+# device-side augmentation
+# ---------------------------------------------------------------------------
+
+class TestAugment:
+    def _batches(self, n=5, b=4, px=8):
+        rng = np.random.RandomState(0)
+        return [{"data": rng.rand(b, 3, px, px).astype(np.float32),
+                 "label": np.arange(b)[:, None]} for _ in range(n)]
+
+    def test_deterministic_per_cursor_and_seed(self):
+        aug = pt_data.Augment(crop=8, pad=2, flip_lr=True, seed=5)
+        batches = self._batches()
+        a = [np.asarray(aug(b, i)["data"]) for i, b in enumerate(batches)]
+        b2 = [np.asarray(aug(b, i)["data"]) for i, b in enumerate(batches)]
+        for x, y in zip(a, b2):
+            np.testing.assert_array_equal(x, y)
+        # different cursors draw different crops/flips
+        assert not np.array_equal(a[0], np.asarray(
+            aug(batches[0], 1)["data"]))
+
+    def test_normalize_matches_numpy(self):
+        mean, std = [0.4, 0.5, 0.6], [0.2, 0.25, 0.3]
+        aug = pt_data.Augment(normalize=(mean, std))
+        batch = self._batches(1)[0]
+        got = np.asarray(aug(batch, 0)["data"])
+        want = ((batch["data"] - np.reshape(mean, (1, 3, 1, 1)))
+                * (1.0 / np.reshape(std, (1, 3, 1, 1))))
+        np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-6)
+
+    def test_crop_is_a_true_window(self):
+        # no flip/normalize: every output row must be an exact spatial
+        # window of the padded input
+        aug = pt_data.Augment(crop=6, seed=1)
+        batch = self._batches(1, b=2, px=8)[0]
+        out = np.asarray(aug(batch, 0)["data"])
+        assert out.shape == (2, 3, 6, 6)
+        x = batch["data"]
+        for i in range(2):
+            found = any(
+                np.array_equal(out[i], x[i, :, oh:oh + 6, ow:ow + 6])
+                for oh in range(3) for ow in range(3))
+            assert found
+
+    def test_labels_pass_through_untouched(self):
+        aug = pt_data.Augment(flip_lr=True, seed=0)
+        batch = self._batches(1)[0]
+        out = aug(batch, 0)
+        assert out["label"] is batch["label"]
+
+    def test_pad_without_crop_rejected(self):
+        with pytest.raises(ValueError, match="pad without crop"):
+            pt_data.Augment(pad=4)
+
+    def test_pipeline_cursor_alignment_after_skip(self):
+        aug = pt_data.Augment(crop=8, pad=2, flip_lr=True, seed=9)
+        p = (Dataset.from_samples(self._batches())
+             .augment(aug).device_prefetch(2))
+        full = [np.asarray(b["data"]) for b in p()]
+        tail = [np.asarray(b["data"]) for b in p.iter_from(2)]
+        assert len(tail) == len(full) - 2
+        for x, y in zip(full[2:], tail):
+            np.testing.assert_array_equal(x, y)
+
+    def test_device_prefetch_hoists_augment_and_yields_device_arrays(self):
+        import jax
+        aug = pt_data.Augment(flip_lr=True, seed=0)
+        p = (Dataset.from_samples(self._batches())
+             .augment(aug).device_prefetch(2))
+        got = list(p())
+        assert all(isinstance(b["data"], jax.Array) for b in got)
+        # hoisted call reports through the augment stage metric
+        assert p.metrics_snapshot()["stages"]["augment"]["items"] > 0
+
+
+# ---------------------------------------------------------------------------
+# resilience: exactly-once under injected reader faults
+# ---------------------------------------------------------------------------
+
+class TestFaultExactlyOnce:
+    def test_reader_raise_faults_replay_exactly_once(self, monkeypatch):
+        clean = _ids(_pipe()())
+        _arm(monkeypatch, "reader_raise@2,reader_raise@5")
+        pol = RetryPolicy(retries=3, base_delay=0.0, jitter=0.0,
+                          sleep=lambda s: None)
+        wrapped = resilient_reader(_pipe(), policy=pol)
+        assert _ids(wrapped()) == clean
+
+    def test_fault_restart_uses_cheap_skip(self, monkeypatch):
+        decoded = []
+
+        def decode(rows):
+            decoded.append(int(rows[0][0]))   # batch fingerprint
+            return {"x": np.stack(rows)}
+
+        def make():
+            return (Dataset.from_samples(_samples())
+                    .shuffle(buf_size=8, seed=3)
+                    .batch(4, drop_last=True)
+                    .map_batches(decode, workers=2))
+
+        clean = _ids(make()())
+        first_two = {int(b[0]) for b in clean[:2]}
+        decoded.clear()
+        _arm(monkeypatch, "reader_raise@3")
+        pol = RetryPolicy(retries=2, base_delay=0.0, jitter=0.0,
+                          sleep=lambda s: None)
+        wrapped = resilient_reader(make(), policy=pol)
+        assert _ids(wrapped()) == clean
+        # the fault fired while delivering batch 2; the restart skipped
+        # the 2 already-delivered batches WITHOUT re-decoding them — they
+        # were decoded exactly once, in the first attempt (the decode
+        # pool may speculate ahead within an attempt, never across one)
+        for fp in first_two:
+            assert decoded.count(fp) == 1, decoded
+
+    def test_exhaustion_reraises_fault(self, monkeypatch):
+        _arm(monkeypatch, "reader_raise@*")
+        pol = RetryPolicy(retries=2, base_delay=0.0, jitter=0.0,
+                          sleep=lambda s: None)
+        wrapped = resilient_reader(_pipe(), policy=pol)
+        with pytest.raises(FaultInjected):
+            list(wrapped())
+
+
+# ---------------------------------------------------------------------------
+# trainer integration: mid-epoch resume bit-exactness
+# ---------------------------------------------------------------------------
+
+N_STEPS = 12
+STEP_INTERVAL = 4
+
+
+def _train_pipeline(seed=11):
+    rs = np.random.RandomState(4321)
+    data = [(rs.randn(4).astype(np.float32),
+             rs.randn(1).astype(np.float32)) for _ in range(N_STEPS * 4)]
+
+    def decode(rows):
+        return {"x": np.stack([r[0] for r in rows]),
+                "y": np.stack([r[1] for r in rows])}
+
+    return (Dataset.from_samples(data)
+            .shuffle(buf_size=16, seed=seed)
+            .batch(4, drop_last=True)
+            .map_batches(decode, workers=2))
+
+
+def _make_trainer(ckpt_dir):
+    pt.core.program.reset_unique_names()
+
+    def train_func():
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        return [layers.mean(layers.square_error_cost(pred, y))]
+
+    cfg = pt.CheckpointConfig(ckpt_dir, step_interval=STEP_INTERVAL)
+    return pt.Trainer(train_func, lambda: pt.optimizer.SGDOptimizer(0.05),
+                      checkpoint_config=cfg)
+
+
+def _final_params(trainer):
+    with pt.scope_guard(trainer.scope):
+        return {v.name: np.array(trainer.scope.find_var(v.name))
+                for v in
+                trainer.train_program.global_block.all_parameters()}
+
+
+def _run(trainer, reader, steps_seen=None, epochs=2):
+    def handler(event):
+        if steps_seen is not None and isinstance(event, pt.EndStepEvent):
+            steps_seen.append((event.epoch, event.step))
+    trainer.train(num_epochs=epochs, event_handler=handler, reader=reader)
+
+
+class TestTrainerResume:
+    def test_mid_epoch_crash_resume_is_bit_exact(self, tmp_path,
+                                                 monkeypatch):
+        # A: uninterrupted, two epochs with per-epoch reshuffle
+        a = _make_trainer(str(tmp_path / "a"))
+        _run(a, _train_pipeline())
+        want = _final_params(a)
+
+        # B: killed mid-epoch-0 by an injected crash
+        b = _make_trainer(str(tmp_path / "b"))
+        _arm(monkeypatch, "step_crash@7")
+        with pytest.raises(FaultInjected):
+            _run(b, _train_pipeline())
+        _arm(monkeypatch, "")
+
+        # C: fresh process resumes from B's checkpoint; the pipeline's
+        # set_epoch + iter_from fast-forward replay epoch 0's shuffle
+        # exactly, then epoch 1 reshuffles identically to run A
+        steps = []
+        c = _make_trainer(str(tmp_path / "b"))
+        assert c.checkpoint_cfg.step_id == STEP_INTERVAL
+        _run(c, _train_pipeline(), steps_seen=steps)
+        assert steps[0] == (0, STEP_INTERVAL)
+        got = _final_params(c)
+        assert set(got) == set(want)
+        for name in want:
+            np.testing.assert_array_equal(
+                got[name], want[name],
+                err_msg=f"{name}: resumed params diverge from "
+                        "uninterrupted run")
+
+    def test_preemption_resume_is_bit_exact(self, tmp_path):
+        a = _make_trainer(str(tmp_path / "a"))
+        _run(a, _train_pipeline())
+        want = _final_params(a)
+
+        kill_after = 5
+
+        def handler(event):
+            if isinstance(event, pt.EndStepEvent) \
+                    and (event.epoch, event.step) == (0, kill_after):
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        b = _make_trainer(str(tmp_path / "b"))
+        b.train(num_epochs=2, event_handler=handler,
+                reader=_train_pipeline())
+        assert b.preempted
+
+        c = _make_trainer(str(tmp_path / "b"))
+        _run(c, _train_pipeline())
+        got = _final_params(c)
+        for name in want:
+            np.testing.assert_array_equal(got[name], want[name])
+
+    def test_epoch_reshuffle_actually_varies_between_epochs(self):
+        p = _train_pipeline()
+        p.set_epoch(0)
+        e0 = [b["x"][0, 0] for b in p()]
+        p.set_epoch(1)
+        e1 = [b["x"][0, 0] for b in p()]
+        assert e0 != e1
+
+
+# ---------------------------------------------------------------------------
+# double-retry-budget footgun (docs/resilience.md)
+# ---------------------------------------------------------------------------
+
+class TestRetryStackingFootgun:
+    def test_double_buffer_dedupes_armed_resilient_reader(self):
+        from paddle_tpu.reader.prefetch import double_buffer
+        pol = RetryPolicy(retries=2, base_delay=0.0, jitter=0.0,
+                          sleep=lambda s: None)
+        inner = resilient_reader(_pipe(), policy=pol)
+        with pytest.warns(UserWarning, match="retry budgets"):
+            db = double_buffer(inner, retry_policy=pol)
+        # deduped, not stacked: the stream still flows exactly once
+        assert len(list(db())) == 24 // 4
+
+    def test_policyless_wrapper_stacks_silently(self):
+        import warnings
+        from paddle_tpu.reader.prefetch import double_buffer
+        inner = resilient_reader(_pipe(), policy=None)  # fault-site host
+        pol = RetryPolicy(retries=1, base_delay=0.0, jitter=0.0,
+                          sleep=lambda s: None)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            db = double_buffer(inner, retry_policy=pol)
+        assert len(list(db())) == 24 // 4
+
+    def test_trainer_drops_budget_over_armed_double_buffer(self, tmp_path):
+        from paddle_tpu.reader.prefetch import double_buffer
+        pol = RetryPolicy(retries=2, base_delay=0.0, jitter=0.0,
+                          sleep=lambda s: None)
+        db = double_buffer(_train_pipeline(), retry_policy=pol)
+        t = _make_trainer(str(tmp_path / "ck"))
+        with pytest.warns(UserWarning, match="retry budgets"):
+            t.train(num_epochs=1, event_handler=lambda e: None,
+                    reader=db, reader_retry=3, double_buffer=False)
+
+
+# ---------------------------------------------------------------------------
+# metrics + prometheus exposition
+# ---------------------------------------------------------------------------
+
+class TestMetrics:
+    def test_snapshot_shape_and_occupancy_bounds(self):
+        p = _pipe().named("t-metrics")
+        list(p())
+        snap = p.metrics_snapshot()
+        assert snap["batches"] == 6
+        assert snap["samples"] == 24
+        assert set(snap["stages"]) == {"decode", "queue_wait", "upload",
+                                       "augment"}
+        for st in snap["stages"].values():
+            assert 0.0 <= st["occupancy"] <= 1.0
+        assert snap["stages"]["decode"]["items"] == 6
+
+    def test_snapshot_reset_zeroes_window(self):
+        p = _pipe()
+        list(p())
+        p.metrics_snapshot(reset=True)
+        assert p.metrics_snapshot()["batches"] == 0
+
+    def test_named_pipeline_lands_in_prometheus_exposition(self):
+        from paddle_tpu.serving.metrics import (ServingMetrics,
+                                                render_prometheus)
+        p = _pipe().named("train-pipe")
+        list(p())
+        text = render_prometheus(ServingMetrics().snapshot())
+        assert 'pt_data_batches_total{pipeline="train-pipe"} 6' in text
+        assert 'pt_data_samples_total{pipeline="train-pipe"} 24' in text
+        assert 'pt_data_stage_occupancy{pipeline="train-pipe",' \
+               'stage="decode"}' in text
+        pt_data.unregister("train-pipe")
+
+    def test_registry_is_weak(self):
+        p = _pipe().named("ephemeral")
+        assert "ephemeral" in pt_data.registry_snapshots()
+        del p
+        import gc
+        gc.collect()
+        assert "ephemeral" not in pt_data.registry_snapshots()
+
+    def test_training_queue_wait_attributes_input_boundness(self):
+        # a slow decode (input-bound consumer) must show up as high
+        # queue_wait occupancy; a slow consumer must not
+        def slow_decode(rows):
+            time.sleep(0.01)
+            return {"x": np.stack(rows)}
+
+        p = (Dataset.from_samples(_samples(32)).batch(4)
+             .map_batches(slow_decode, workers=1))
+        list(p())
+        bound = p.metrics_snapshot()["stages"]["queue_wait"]["occupancy"]
+        assert bound > 0.5
+
+        q = _pipe(samples=_samples(32))
+        for _ in q():
+            time.sleep(0.01)       # consumer is the slow side
+        free = q.metrics_snapshot()["stages"]["queue_wait"]["occupancy"]
+        assert free < 0.5
+
+
+# ---------------------------------------------------------------------------
+# reader-protocol interop
+# ---------------------------------------------------------------------------
+
+class TestReaderInterop:
+    def test_dataset_is_a_reader_for_device_feeder(self):
+        import jax
+        from paddle_tpu.reader.prefetch import double_buffer
+        got = list(double_buffer(_pipe())())
+        assert len(got) == 6
+        assert isinstance(got[0]["x"], jax.Array)
+
+    def test_map_stage_runs_per_item(self):
+        p = (Dataset.from_samples(list(range(6)))
+             .map(lambda v: v * 10)
+             .batch(3)
+             .map_batches(lambda rows: {"x": np.asarray(rows)}))
+        got = [b["x"].tolist() for b in p()]
+        assert got == [[0, 10, 20], [30, 40, 50]]
+
+    def test_from_recordio_requires_paths(self):
+        with pytest.raises(ValueError, match="no paths"):
+            Dataset.from_recordio([])
