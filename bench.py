@@ -764,9 +764,9 @@ def bench_transpiler_sanity(on_tpu, peak):
                                    num_microbatches=1)
             pt.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(avg)
         if transpiled:
-            from paddle_tpu.parallel import make_mesh
+            from paddle_tpu.parallel import DP, make_mesh
             pt.transpiler.transpile(
-                main, mesh=make_mesh({"dp": 1}, devices=jax.devices()[:1]))
+                main, mesh=make_mesh({DP: 1}, devices=jax.devices()[:1]))
         if on_tpu:
             main.amp_dtype = "bfloat16"
         return main, startup, avg
@@ -1258,6 +1258,59 @@ def bench_serving(on_tpu, peak):
     return out
 
 
+def bench_planner(on_tpu, peak):
+    """Static placement planner (analysis/planner.py): search the bench
+    transformer's placement space for an 8-chip topology of the current
+    platform class and report search cost + the winning plan. Pure
+    host-side static analysis — no compile, no device touch — so the
+    numbers are search-loop wall time, not step measurements. The plan
+    artifact is floor-checked in-line (validate_plan), the static
+    analogue of the bench-JSON floors every measured config gets."""
+    import paddle_tpu as pt
+    from paddle_tpu.analysis import planner
+    from paddle_tpu.analysis.artifacts import validate_plan
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.parallel.mesh import Topology
+
+    chip = os.environ.get("PT_COST_CHIP", "") or \
+        ("tpu v5e" if on_tpu else "cpu")
+    topo = Topology(chip=chip, n_devices=8)
+    batch = int(os.environ.get("BENCH_BATCH", 8))
+    if batch % 8:
+        batch = 8  # the searched dp sizes need a splittable batch
+    pt.core.program.reset_unique_names()
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        avg, _ = tfm.transformer_lm_loss(vocab_size=1000, seq_len=64,
+                                         n_layers=2, d_model=64, n_heads=2,
+                                         d_ff=256, max_len=128)
+        pt.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(avg)
+    t0 = time.perf_counter()
+    art = planner.plan_placement(main_prog, topo, batch=batch,
+                                 program_name="bench_transformer")
+    search_s = time.perf_counter() - t0
+    problems = validate_plan(art.doc)
+    top = art.top
+    return {
+        "topology": art.doc["topology"],
+        "batch": batch,
+        "search_ms": round(search_s * 1e3, 2),
+        "candidates": art.doc["search"]["candidates"],
+        "scored": art.doc["search"]["scored"],
+        "rejected": art.doc["search"]["rejected"],
+        "plan_schema_ok": not problems,
+        "top": {"mesh": top["mesh"], "zero": top["zero"],
+                "sp_mode": top["sp_mode"],
+                "predicted_step_ms":
+                    round(top["prediction"]["predicted_step_ms"], 4),
+                "predicted_mfu_pct":
+                    round(top["prediction"]["predicted_mfu"] * 100, 2),
+                "bound": top["prediction"]["bound"],
+                "peak_hbm_gb": round(top["peak_hbm_bytes"] / 1e9, 3),
+                "wire_mb": round(top["wire_bytes"] / 1e6, 3)},
+    }
+
+
 def bench_decode(on_tpu, peak):
     """Autoregressive decode: continuous batching over the paged KV
     cache (serving/decode) vs the drain-to-empty static batcher — the
@@ -1383,6 +1436,7 @@ def main():
              ("data_pipeline",
               lambda: bench_data_pipeline(on_tpu, configs.get("resnet50"))),
              ("serving", lambda: bench_serving(on_tpu, peak)),
+             ("planner", lambda: bench_planner(on_tpu, peak)),
              ("decode", lambda: bench_decode(on_tpu, peak)),
              ("transformer", lambda: bench_transformer(on_tpu, peak)),
              ("long_context", lambda: bench_long_context(on_tpu, peak)),

@@ -15,6 +15,12 @@ The compile-time checking layer the interpreted reference never had
   peak-HBM estimation + the PT_MEM_BUDGET_GB pre-compile gate
   (memory.py), and the sharding-aware collective audit (comm.py).
   CLI: tools/cost_report.py.
+* `planner` — the static auto-parallelism placement planner: cost-model
+  driven mesh/placement search over {dp, ep, sp, tp} x ZeRO for a device
+  topology (parallel/mesh.py Topology), emitting ranked, floor-checked
+  PlacementPlan artifacts that ParallelExecutor(plan=...) and
+  transpile(plan=...) execute. CLI: tools/plan.py. Loaded lazily — the
+  search layer sits on top of cost/memory/comm and the parallel package.
 * `source_lint` — custom repo lint rules behind tools/lint.py (kept
   stdlib-only so the lint gate never imports jax).
 
@@ -42,4 +48,23 @@ __all__ = [
     "MemoryBudgetError", "MemoryEstimate", "enforce_budget",
     "estimate_memory",
     "Collective", "CommReport", "audit_collectives", "mesh_axis_sizes",
+    "planner", "plan_placement", "apply_plan", "PlanArtifact",
+    "NoFeasiblePlacementError",
 ]
+
+_PLANNER_NAMES = frozenset({"planner", "plan_placement", "apply_plan",
+                            "PlanArtifact", "NoFeasiblePlacementError"})
+
+
+def __getattr__(name):
+    # planner sits ABOVE the parallel package (it imports Topology and
+    # the host-span predicate), so it loads lazily: eagerly importing it
+    # here would couple every verify_enabled() pre-pass check to the
+    # full parallel import chain
+    if name in _PLANNER_NAMES:
+        import importlib
+        _planner = importlib.import_module(__name__ + ".planner")
+        if name == "planner":
+            return _planner
+        return getattr(_planner, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

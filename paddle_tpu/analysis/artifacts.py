@@ -171,6 +171,85 @@ def validate_bench_json(doc, path: str = "$", pred: bool = False) -> List[str]:
     return problems
 
 
+_PLAN_REQUIRED = ("schema_version", "kind", "batch", "topology", "ranked")
+_PLAN_ENTRY_REQUIRED = ("mesh", "specs", "prediction", "peak_hbm_bytes")
+
+
+def validate_plan(doc) -> List[str]:
+    """Floor checks for a placement-plan artifact (analysis/planner.py),
+    applied at plan SAVE and LOAD like the gconv-autotune floors
+    ([] = valid): schema-versioned, non-empty ranked list, and for every
+    ranked plan a non-empty per-var spec table, finite strictly-positive
+    predicted step time, predicted MFU <= 100%, and a per-device
+    peak-HBM at or under the topology's declared chip HBM. A plan that
+    fails these is the placement analogue of a 0.0 ms autotune reading —
+    it must never be applied."""
+    if not isinstance(doc, dict):
+        return [f"plan root is {type(doc).__name__}, not an object"]
+    problems = [f"$.{k}: required field missing"
+                for k in _PLAN_REQUIRED if k not in doc]
+    if doc.get("kind") not in (None, "placement_plan"):
+        problems.append(f"$.kind: {doc.get('kind')!r} is not "
+                        "'placement_plan'")
+    if "schema_version" in doc and doc["schema_version"] != 1:
+        problems.append(f"$.schema_version: {doc['schema_version']!r} is "
+                        "not a known version (1)")
+    hbm_budget = None
+    topo = doc.get("topology")
+    if isinstance(topo, dict):
+        gb = topo.get("hbm_gb")
+        if isinstance(gb, (int, float)) and not isinstance(gb, bool) \
+                and math.isfinite(float(gb)) and gb > 0:
+            hbm_budget = float(gb) * 1e9
+        else:
+            problems.append(f"$.topology.hbm_gb: {gb!r} must be a "
+                            "positive finite number of gigabytes")
+    ranked = doc.get("ranked")
+    if "ranked" in doc and not isinstance(ranked, list):
+        problems.append(f"$.ranked: {type(ranked).__name__}, not a list")
+    if isinstance(ranked, list) and not ranked:
+        problems.append("$.ranked: empty — a plan artifact must rank at "
+                        "least one feasible placement")
+    for i, plan in enumerate(ranked if isinstance(ranked, list) else ()):
+        here = f"$.ranked[{i}]"
+        if not isinstance(plan, dict):
+            problems.append(f"{here}: not an object")
+            continue
+        problems.extend(f"{here}.{k}: required field missing"
+                        for k in _PLAN_ENTRY_REQUIRED if k not in plan)
+        mesh = plan.get("mesh")
+        if isinstance(mesh, dict):
+            for a, s in mesh.items():
+                if not isinstance(s, int) or isinstance(s, bool) or s < 1:
+                    problems.append(f"{here}.mesh.{a}: size {s!r} must be "
+                                    "a positive integer")
+        specs = plan.get("specs")
+        if isinstance(specs, dict) and not specs:
+            problems.append(f"{here}.specs: empty per-var spec table — "
+                            "a plan that places nothing is not a plan")
+        pred = plan.get("prediction")
+        if isinstance(pred, dict):
+            problems.extend(validate_bench_json(pred, f"{here}.prediction",
+                                                pred=True))
+            mfu = pred.get("predicted_mfu")
+            if not isinstance(mfu, (int, float)) or isinstance(mfu, bool) \
+                    or not math.isfinite(float(mfu)):
+                problems.append(f"{here}.prediction.predicted_mfu: "
+                                f"{mfu!r} is not a finite number")
+        peak = plan.get("peak_hbm_bytes")
+        if not isinstance(peak, (int, float)) or isinstance(peak, bool) \
+                or not math.isfinite(float(peak)) or peak <= 0:
+            problems.append(f"{here}.peak_hbm_bytes: {peak!r} must be a "
+                            "positive finite byte count")
+        elif hbm_budget is not None and float(peak) > hbm_budget:
+            problems.append(
+                f"{here}.peak_hbm_bytes: {float(peak) / 1e9:.2f} GB "
+                f"exceeds the declared chip HBM "
+                f"{hbm_budget / 1e9:.2f} GB — an over-budget plan must "
+                "never rank")
+    return problems
+
+
 _COST_REPORT_REQUIRED = ("program", "batch", "cost", "memory", "prediction")
 
 

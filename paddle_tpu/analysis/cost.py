@@ -49,7 +49,7 @@ AUTODIFF_OP = "autodiff"
 
 __all__ = ["OpCost", "ProgramCost", "ChipSpec", "Prediction", "cost_entry",
            "op_cost", "program_cost", "chip_spec_for", "resolve_chip",
-           "predict_step", "PEAK_TABLE"]
+           "predict_step", "roofline_step", "PEAK_TABLE"]
 
 
 # ---------------------------------------------------------------------------
@@ -500,24 +500,26 @@ def program_cost(program: Optional[Program] = None, batch: int = 1,
 class ChipSpec:
     """Per-chip peaks. Flops are the bf16 MXU peak (the benched dtype);
     hbm_gbps is the published HBM bandwidth; ici_gbps the per-link ICI
-    bandwidth used for collective time."""
+    bandwidth used for collective time; hbm_gb the per-chip HBM capacity
+    (the placement planner's per-device memory budget)."""
 
     name: str
     peak_flops: float
     hbm_gbps: float
     ici_gbps: float
+    hbm_gb: float = 16.0
 
 
 #: published per-chip peaks; the CPU entry exists so off-TPU runs emit
 #: finite (clearly-labeled) predictions instead of crashing the report
 PEAK_TABLE: Tuple[ChipSpec, ...] = (
-    ChipSpec("tpu v5 lite", 197e12, 819.0, 186.0),
-    ChipSpec("tpu v5e", 197e12, 819.0, 186.0),
-    ChipSpec("tpu v5p", 459e12, 2765.0, 600.0),
-    ChipSpec("tpu v5", 459e12, 2765.0, 600.0),
-    ChipSpec("tpu v4", 275e12, 1228.0, 268.0),
-    ChipSpec("tpu v6", 918e12, 1640.0, 448.0),
-    ChipSpec("cpu", 1e12, 50.0, 10.0),
+    ChipSpec("tpu v5 lite", 197e12, 819.0, 186.0, 16.0),
+    ChipSpec("tpu v5e", 197e12, 819.0, 186.0, 16.0),
+    ChipSpec("tpu v5p", 459e12, 2765.0, 600.0, 95.0),
+    ChipSpec("tpu v5", 459e12, 2765.0, 600.0, 95.0),
+    ChipSpec("tpu v4", 275e12, 1228.0, 268.0, 32.0),
+    ChipSpec("tpu v6", 918e12, 1640.0, 448.0, 32.0),
+    ChipSpec("cpu", 1e12, 50.0, 10.0, 16.0),
 )
 
 
@@ -542,6 +544,33 @@ def resolve_chip(device=None) -> ChipSpec:
         import jax
         device = jax.devices()[0]
     return chip_spec_for(getattr(device, "device_kind", str(device)))
+
+
+def roofline_step(hw_mxu_flops: float, hbm_bytes: float,
+                  model_mxu_flops: float, n_dev: int, chip: ChipSpec,
+                  t_comm_s: float):
+    """The shared roofline: per-device compute/HBM legs vs an
+    already-priced comm leg, overlap-as-max step time, the bound
+    tie-break, and predicted MFU. ONE definition — predict_step and the
+    placement planner (analysis/planner.py) must price the same
+    roofline, or search rankings silently diverge from the
+    bench/cost_report predictions for the identical program.
+
+    Returns (t_compute_s, t_hbm_s, t_step_s, bound, predicted_mfu).
+    hw_mxu_flops is hardware MXU work (model + remat recompute);
+    model_mxu_flops is the MFU numerator (recompute excluded)."""
+    t_compute = (hw_mxu_flops / n_dev) / chip.peak_flops
+    t_hbm = (hbm_bytes / n_dev) / (chip.hbm_gbps * 1e9)
+    t = max(t_compute, t_hbm, t_comm_s, 1e-12)
+    # tie-break: compute wins any tie; comm beats bandwidth only strictly
+    if t_compute >= t_hbm and t_compute >= t_comm_s:
+        bound = "compute"
+    elif t_comm_s > t_hbm:
+        bound = "comm"
+    else:
+        bound = "bandwidth"
+    mfu = min((model_mxu_flops / n_dev) / (t * chip.peak_flops), 1.0)
+    return t_compute, t_hbm, t, bound, mfu
 
 
 @dataclass
@@ -604,21 +633,12 @@ def predict_step(program: Optional[Program] = None, batch: int = 1,
         n_dev = max(1, _prod(list(axes.values())))
         report = audit_collectives(program, axes, batch=batch)
         comm_bytes = report.total_bytes
-    t_compute = (mxu / n_dev) / chip.peak_flops
-    t_hbm = (hbm / n_dev) / (chip.hbm_gbps * 1e9)
     t_comm = comm_bytes / (chip.ici_gbps * 1e9)
-    t = max(t_compute, t_hbm, t_comm, 1e-12)
-    # tie-break: compute wins any tie; comm beats bandwidth only strictly
-    if t_compute >= t_hbm and t_compute >= t_comm:
-        bound = "compute"
-    elif t_comm > t_hbm:
-        bound = "comm"
-    else:
-        bound = "bandwidth"
-    mfu = (pc.train.mxu_flops / n_dev) / (t * chip.peak_flops)
+    t_compute, t_hbm, t, bound, mfu = roofline_step(
+        mxu, hbm, pc.train.mxu_flops, n_dev, chip, t_comm)
     return Prediction(flops=flops, hbm_bytes=hbm, comm_bytes=comm_bytes,
                       t_compute_ms=t_compute * 1e3,
                       t_bandwidth_ms=t_hbm * 1e3, t_comm_ms=t_comm * 1e3,
                       predicted_step_ms=t * 1e3,
-                      predicted_mfu=min(mfu, 1.0), bound=bound,
+                      predicted_mfu=mfu, bound=bound,
                       chip=chip.name)

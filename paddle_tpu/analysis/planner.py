@@ -1,0 +1,678 @@
+"""Static auto-parallelism planner: cost-model-driven mesh/placement search.
+
+PR 7 built every ingredient as a static analysis — per-op flops/bytes
+(cost.py), liveness-based peak-HBM (memory.py), sharding propagation
+with per-device wire bytes (comm.py), and the roofline predict_step —
+but they only *score* a placement a human already chose. This module
+*searches*: given a Program and a device-topology description
+(parallel/mesh.py Topology: chip count, ICI-vs-DCI bandwidth tiers,
+per-chip HBM from cost.PEAK_TABLE), it
+
+  1. enumerates legal mesh factorizations over {dp, ep, sp, tp} x
+     {ZeRO on/off} (outermost-first axis order, so the cheap-to-sync dp
+     axis is the one that lands on the cross-host DCN hop; pp is a
+     program REWRITE, not an annotation, so pipeline placements are
+     taken as given rather than searched),
+  2. derives each candidate's per-var placement by running the sharding
+     transpiler on a clone plus explicit defaults (dp feed split, ZeRO
+     accumulator shards) so the emitted plan is the COMPLETE placement
+     truth, not "transpiler output plus executor defaults",
+  3. prunes candidates in order: structural (axis unusable by this
+     program / batch indivisible) -> shard legality (the PR-1 shard-check
+     verifier pass) -> per-device peak-HBM vs the topology's chip HBM
+     (memory.py) -> accidental-resharding audit (comm.py flagged
+     collectives),
+  4. scores survivors with the roofline (compute / HBM / comm legs), the
+     comm leg priced HIERARCHICALLY: a collective whose axes stay inside
+     one host pays ICI bandwidth, one that spans hosts pays the
+     topology's DCI tier (parallel/distributed.py axis_spans_hosts),
+  5. emits a ranked PlacementPlan artifact (JSON: mesh shape + axis
+     names, per-var PartitionSpecs, predicted step ms / MFU / peak-HBM /
+     wire bytes, and the rejection log for every pruned candidate),
+     floor-checked by artifacts.validate_plan at save AND load.
+
+Nothing compiles and no device is touched — the whole search is host-
+side IR walks (tested: build_step_fn must not run during planning). The
+winning plan is EXECUTABLE: ParallelExecutor(plan=...) and
+transpile(plan=...) apply the recorded specs, and re-scoring an applied
+plan reproduces the recorded prediction exactly (no search/score drift
+— the property tests/test_planner.py pins).
+
+Knobs: PT_PLAN_BEAM (ranked plans kept in the artifact),
+PT_PLAN_TOPOLOGY (default topology, 'chip:chips_per_host[xhosts]'
+format — see Topology.parse). CLI: tools/plan.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..core.program import (Program, default_main_program,
+                            iter_optimizer_state_inputs)
+from ..parallel.distributed import axis_spans_hosts
+from ..parallel.mesh import DP, EP, SP, TP, Topology
+from .comm import _normalize, _spec_factor, audit_collectives
+from .cost import _prod, program_cost, roofline_step
+from .memory import (_classify, batch_shard_factor, estimate_memory,
+                     safe_nbytes_raw)
+
+__all__ = ["PlacementRejected", "NoFeasiblePlacementError", "PlanArtifact",
+           "Topology", "plan_placement", "score_mesh", "apply_plan",
+           "resolve_plan", "rescore_plan", "rank_correlation",
+           "default_topology", "SEARCH_AXES", "PLAN_SCHEMA_VERSION"]
+
+#: searched mesh axes, OUTERMOST first — the order make_mesh lays devices
+#: out, so under a multi-host topology the leading axes are the ones
+#: whose collectives cross the DCN hop
+SEARCH_AXES: Tuple[str, ...] = (DP, EP, SP, TP)
+
+PLAN_SCHEMA_VERSION = 1
+
+_ATTENTION_OP = "scaled_dot_product_attention"
+
+
+class PlacementRejected(Exception):
+    """One candidate failed a pruning stage (recorded, never fatal)."""
+
+    def __init__(self, stage: str, reason: str):
+        self.stage = stage
+        self.reason = reason
+        super().__init__(f"[{stage}] {reason}")
+
+
+class NoFeasiblePlacementError(RuntimeError):
+    """Every candidate was pruned. Carries the rejection log so the
+    caller sees WHY (the typical causes: batch indivisible by every
+    usable dp size, or the per-chip HBM budget refusing everything)."""
+
+    def __init__(self, rejections: List[dict]):
+        self.rejections = list(rejections)
+        head = "; ".join(f"{r['mesh']}: {r['reason']}"
+                         for r in rejections[:3])
+        super().__init__(
+            f"no feasible placement: all {len(rejections)} candidates "
+            f"pruned (first rejections: {head})")
+
+
+class _DuckMesh:
+    """Shape-only mesh stand-in: the transpiler and the analyses read
+    nothing but .shape, so the search never builds device meshes."""
+
+    __slots__ = ("shape",)
+
+    def __init__(self, sizes: Dict[str, int]):
+        self.shape = dict(sizes)
+
+
+def default_topology() -> Topology:
+    """PT_PLAN_TOPOLOGY when set, else a single-host 8-chip description
+    of the local platform class (cpu — the planner must stay usable on a
+    laptop with zero devices, so nothing here queries jax)."""
+    raw = os.environ.get("PT_PLAN_TOPOLOGY", "").strip()
+    if raw:
+        return Topology.parse(raw)
+    return Topology(chip="cpu", n_devices=8)
+
+
+def _beam_width(beam: Optional[int]) -> int:
+    if beam is not None:
+        return max(1, int(beam))
+    raw = os.environ.get("PT_PLAN_BEAM", "").strip()
+    return max(1, int(raw)) if raw else 8
+
+
+# ---------------------------------------------------------------------------
+# candidate enumeration
+# ---------------------------------------------------------------------------
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def _mesh_candidates(n_devices: int) -> Iterable[Dict[str, int]]:
+    """Ordered mesh factorizations over SEARCH_AXES, for every device
+    count that divides the topology (a plan may leave chips idle when
+    the program cannot use them — e.g. batch 4 on an 8-chip host); the
+    single-chip {dp: 1} mesh is the always-feasible floor."""
+    seen = set()
+    for total in sorted(_divisors(n_devices), reverse=True):
+        for dp in _divisors(total):
+            for ep in _divisors(total // dp):
+                for sp in _divisors(total // (dp * ep)):
+                    tp = total // (dp * ep * sp)
+                    axes = {a: s for a, s in
+                            zip(SEARCH_AXES, (dp, ep, sp, tp)) if s > 1}
+                    if not axes:
+                        axes = {DP: 1}
+                    key = tuple(axes.items())
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield axes
+
+
+@dataclass
+class _Traits:
+    has_attention: bool
+    ep_dims: Tuple[int, ...]
+    feed_dims: Tuple[Tuple[str, int], ...]  # (name, batch-substituted dim0)
+
+
+def _traits(program: Program, batch: int) -> _Traits:
+    block = program.global_block
+    has_attn = any(op.type == _ATTENTION_OP for op in block.ops)
+    ep_dims = []
+    for v in block.vars.values():
+        spec = v.sharding or ()
+        for entry in spec:
+            axes = entry if isinstance(entry, (list, tuple)) else (entry,)
+            if EP in axes and v.shape:
+                ep_dims.append(int(v.shape[0]))
+                break
+    feed_dims = []
+    for v in block.vars.values():
+        if getattr(v, "is_data", False) and v.shape:
+            d0 = batch if int(v.shape[0]) == -1 else int(v.shape[0])
+            feed_dims.append((v.name, d0))
+    return _Traits(has_attn, tuple(ep_dims), tuple(feed_dims))
+
+
+# ---------------------------------------------------------------------------
+# candidate preparation: transpile + explicit placement defaults
+# ---------------------------------------------------------------------------
+
+def _annotate_defaults(program: Program, sizes: Dict[str, int], zero: bool,
+                       batch: int) -> None:
+    """Make the implicit executor placements EXPLICIT on the clone, so
+    the emitted spec table is the complete placement truth: dp feed
+    batch-split (ParallelExecutor._feed_spec's default) and, under ZeRO,
+    the dp-sharded optimizer accumulators (_state_spec's Reduce branch).
+    """
+    block = program.global_block
+    dp = int(sizes.get(DP, 1))
+    if DP in sizes:
+        # recorded even at dp=1 (a size-1 axis is a no-op split), so the
+        # spec table always states the feed layout — a plan is the
+        # COMPLETE placement truth, including "batch over dp"
+        for v in block.vars.values():
+            if not getattr(v, "is_data", False) or v.sharding is not None:
+                continue
+            if not v.shape:
+                continue
+            d0 = batch if int(v.shape[0]) == -1 else int(v.shape[0])
+            if d0 % dp == 0:
+                v.sharding = (DP,) + (None,) * (len(v.shape) - 1)
+    if zero and dp > 1:
+        for _p, acc_name in iter_optimizer_state_inputs(block):
+            try:
+                acc = block.var(acc_name)
+            except KeyError:
+                continue
+            if acc.is_parameter or acc.sharding is not None:
+                continue
+            for i, s in enumerate(acc.shape or ()):
+                if int(s) % dp == 0 and int(s) >= dp:
+                    acc.sharding = (None,) * i + (DP,)
+                    break
+    program.invalidate_cache()
+
+
+def _spec_json(sharding, sizes: Dict[str, int]) -> Optional[list]:
+    """Record the EFFECTIVE placement: axes the candidate mesh lacks are
+    dropped (the lowering would drop them anyway — spec_for), so applied
+    plans re-verify without mesh-axis-dropped warnings. Returns None for
+    a spec that normalizes to fully-replicated (no entry recorded:
+    replication is the default)."""
+    out = []
+    any_axis = False
+    for e in sharding:
+        axes = e if isinstance(e, (list, tuple)) else (e,)
+        kept = tuple(a for a in axes if a is not None and a in sizes)
+        if not kept:
+            out.append(None)
+        elif len(kept) == 1:
+            out.append(kept[0])
+            any_axis = True
+        else:
+            out.append(list(kept))
+            any_axis = True
+    return out if any_axis else None
+
+
+def _collect_specs(program: Program,
+                   sizes: Dict[str, int]) -> Dict[str, list]:
+    specs = {}
+    for v in program.global_block.vars.values():
+        if v.sharding is None:
+            continue
+        spec = _spec_json(v.sharding, sizes)
+        if spec is not None:
+            specs[v.name] = spec
+    return specs
+
+
+def _prepare(program: Program, axes: Dict[str, int], batch: int,
+             zero: bool, sp_mode: Optional[str],
+             traits: _Traits) -> Tuple[Program, Dict[str, list]]:
+    """Clone + transpile + explicit defaults for one candidate; raises
+    PlacementRejected at the first failed legality stage."""
+    sizes = {a: int(s) for a, s in axes.items()}
+    dp = sizes.get(DP, 1)
+    # -- structural -------------------------------------------------------
+    if dp > 1:
+        if not traits.feed_dims:
+            raise PlacementRejected("structural", "no feed vars to "
+                                    f"batch-split over dp={dp}")
+        for name, d0 in traits.feed_dims:
+            if d0 % dp:
+                raise PlacementRejected(
+                    "structural", f"feed {name!r} batch dim {d0} is not "
+                    f"divisible by dp={dp}")
+    if sizes.get(SP, 1) > 1 and not traits.has_attention:
+        raise PlacementRejected("structural", "sp axis needs attention "
+                                "ops to rewrite (none in the program)")
+    if sizes.get(EP, 1) > 1 and not traits.ep_dims:
+        raise PlacementRejected("structural", "ep axis needs expert-"
+                                "stacked parameters (none annotated)")
+    # -- derive the placement ---------------------------------------------
+    from ..transpiler import TranspileStrategy, transpile
+    from .verifier import ProgramVerificationError
+    clone = program.clone()
+    try:
+        transpile(clone, mesh=_DuckMesh(sizes),
+                  strategy=TranspileStrategy(sp_mode=sp_mode))
+    except ProgramVerificationError as e:
+        # the transpiler's own shard-check post-condition (PT_VERIFY);
+        # anything else is a genuine transpiler defect and must surface,
+        # not drown in the rejection log
+        raise PlacementRejected("shard-check", str(e).splitlines()[0][:200])
+    _annotate_defaults(clone, sizes, zero, batch)
+    # -- axis usability: an axis no var is sharded over buys nothing ------
+    used = set()
+    for v in clone.global_block.vars.values():
+        for dim_axes in _normalize(v.sharding, len(v.shape or ()), sizes):
+            used |= dim_axes
+    for a, s in sizes.items():
+        if s > 1 and a not in used:
+            raise PlacementRejected(
+                "structural", f"mesh axis {a}={s} is unused by the "
+                "derived placement (program has nothing to shard over it)")
+    # -- shard legality (the PR-1 verifier pass, PT_VERIFY-independent).
+    # uneven-shard is only a WARNING to the runtime (it degrades to
+    # replication), but a candidate whose requested distribution silently
+    # degrades is NOT the placement the scorer would price — reject.
+    from . import verify_program
+    result = verify_program(clone, mesh=sizes, passes=["shard-check"])
+    if not result.ok:
+        raise PlacementRejected("shard-check",
+                                str(result.errors[0])[:200])
+    uneven = [d for d in result.diagnostics if d.code == "uneven-shard"]
+    if uneven:
+        raise PlacementRejected("shard-check", str(uneven[0])[:200])
+    return clone, _collect_specs(clone, sizes)
+
+
+# ---------------------------------------------------------------------------
+# memory + roofline scoring
+# ---------------------------------------------------------------------------
+
+def _plan_memory(program_t: Program, sizes: Dict[str, int],
+                 batch: int) -> Tuple[int, Dict[str, int]]:
+    """Per-device peak-HBM for a prepared candidate: activations/feeds
+    priced at the per-device batch (the feed vars' dim-0 shard factor),
+    params/optimizer state divided by each var's OWN spec factor (tp
+    slices, ZeRO dp shards — the explicit specs carry both). Grads and
+    transients stay whole-program: conservative-safe upper bound."""
+    shard = batch_shard_factor(program_t, sizes)
+    per_dev_batch = batch
+    if shard > 1 and batch % shard == 0:
+        per_dev_batch = batch // shard
+    est = estimate_memory(program_t, batch=per_dev_batch)
+    block = program_t.global_block
+    params, acc, _kv, _kv_storage = _classify(program_t)
+
+    def sharded_bytes(names) -> int:
+        total = 0
+        for n in names:
+            try:
+                v = block.var(n)
+            except KeyError:
+                continue
+            spec = _normalize(v.sharding, len(v.shape or ()), sizes)
+            total += safe_nbytes_raw(block, n, per_dev_batch) \
+                // max(1, _spec_factor(spec, sizes))
+        return total
+
+    params_sh = sharded_bytes(params)
+    opt_sh = sharded_bytes(acc)
+    peak = (est.peak_bytes - est.breakdown.get("params", 0)
+            - est.breakdown.get("optimizer_state", 0) + params_sh + opt_sh)
+    breakdown = dict(est.breakdown, params=params_sh,
+                     optimizer_state=opt_sh)
+    return int(peak), {k: int(v) for k, v in breakdown.items()}
+
+
+def _score(program_t: Program, axes: Dict[str, int], topology: Topology,
+           batch: int, zero: bool) -> Tuple[dict, int, Dict[str, int]]:
+    """Memory gate -> collective audit -> hierarchical roofline. Returns
+    (prediction, peak_hbm_bytes, memory_breakdown); raises
+    PlacementRejected on a failed gate. Pure host-side dict math — this
+    is the function an applied plan re-scores through (rescore_plan), so
+    it must stay deterministic."""
+    sizes = {a: int(s) for a, s in axes.items()}
+    peak, breakdown = _plan_memory(program_t, sizes, batch)
+    budget = topology.hbm_bytes()
+    if peak > budget:
+        raise PlacementRejected(
+            "memory", f"per-device peak-HBM {peak / 1e9:.2f} GB exceeds "
+            f"the chip's {budget / 1e9:.2f} GB "
+            f"(params={breakdown.get('params', 0) / 1e9:.2f} GB, "
+            f"activations={breakdown.get('activations', 0) / 1e9:.2f} GB)")
+    report = audit_collectives(program_t, sizes, batch=batch, zero=zero)
+    if report.flagged:
+        c = report.flagged[0]
+        raise PlacementRejected("collective-audit",
+                                f"accidental resharding: {c.reason}")
+
+    chip = topology.chip_spec()
+    n_dev = max(1, _prod(list(sizes.values())))
+    pc = program_cost(program_t, batch=batch)
+    mxu = pc.train.mxu_flops + pc.remat_recompute_mxu_flops
+    flops = pc.train.mxu_flops + pc.train.vector_flops
+    hbm = pc.train_bytes
+    wire_ici = 0
+    wire_dci = 0
+    for c in report.collectives:
+        crosses = any(axis_spans_hosts(sizes, a, topology.chips_per_host)
+                      for a in c.axes)
+        if crosses:
+            wire_dci += c.wire_bytes
+        else:
+            wire_ici += c.wire_bytes
+    # the ONLY departure from predict_step: the comm leg is priced per
+    # tier (intra-host ICI vs cross-host DCI) instead of one bandwidth
+    t_comm = (wire_ici / (topology.ici_bandwidth_gbps() * 1e9)
+              + wire_dci / (topology.dci_gbps * 1e9))
+    t_compute, t_hbm, t, bound, mfu = roofline_step(
+        mxu, hbm, pc.train.mxu_flops, n_dev, chip, t_comm)
+    prediction = {
+        "flops": int(flops), "hbm_bytes": int(hbm),
+        "comm_bytes": int(wire_ici + wire_dci),
+        "comm_bytes_dci": int(wire_dci),
+        "t_compute_ms": t_compute * 1e3, "t_bandwidth_ms": t_hbm * 1e3,
+        "t_comm_ms": t_comm * 1e3, "predicted_step_ms": t * 1e3,
+        "predicted_mfu": mfu, "bound": bound, "chip": chip.name,
+    }
+    return prediction, peak, breakdown
+
+
+def score_mesh(program: Program, axes: Dict[str, int], topology: Topology,
+               batch: int = 1, zero: bool = False,
+               sp_mode: Optional[str] = None) -> dict:
+    """Prepare + score ONE candidate placement (the search's inner loop,
+    exposed for the rank-correlation gate and tests). Raises
+    PlacementRejected when the candidate fails a pruning stage."""
+    traits = _traits(program, batch)
+    program_t, specs = _prepare(program, axes, batch, zero, sp_mode, traits)
+    prediction, peak, breakdown = _score(program_t, axes, topology, batch,
+                                         zero)
+    return {
+        "mesh": {a: int(s) for a, s in axes.items()},
+        "zero": bool(zero), "sp_mode": sp_mode,
+        "devices_used": int(_prod([int(s) for s in axes.values()])),
+        "batch": int(batch),
+        "specs": specs,
+        "prediction": prediction,
+        "peak_hbm_bytes": int(peak),
+        "memory_breakdown": breakdown,
+        "wire_bytes": int(prediction["comm_bytes"]),
+        "wire_bytes_dci": int(prediction["comm_bytes_dci"]),
+        "program_fingerprint": program.fingerprint(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the search
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PlanArtifact:
+    """The ranked PlacementPlan document (see module docstring schema).
+    ranked[0] is the winner; save/load floor-check via
+    artifacts.validate_plan (the gconv-autotune pattern: validated at
+    save AND load, poisoned artifacts never apply)."""
+
+    doc: dict
+
+    @property
+    def ranked(self) -> List[dict]:
+        return self.doc["ranked"]
+
+    @property
+    def top(self) -> dict:
+        return self.doc["ranked"][0]
+
+    @property
+    def rejections(self) -> List[dict]:
+        return self.doc.get("rejections", [])
+
+    @property
+    def scored(self) -> List[dict]:
+        return self.doc.get("scored", [])
+
+    def to_dict(self) -> dict:
+        return self.doc
+
+    def save(self, path: str) -> None:
+        from .artifacts import validate_plan
+        problems = validate_plan(self.doc)
+        if problems:
+            raise ValueError("refusing to save an invalid plan artifact:\n  "
+                             + "\n  ".join(problems))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load(path: str) -> "PlanArtifact":
+        from .artifacts import validate_plan
+        with open(path) as f:
+            doc = json.load(f)
+        problems = validate_plan(doc)
+        if problems:
+            raise ValueError(f"plan artifact {path!r} fails its floors:\n  "
+                             + "\n  ".join(problems))
+        return PlanArtifact(doc)
+
+
+def plan_placement(program: Optional[Program] = None,
+                   topology: Optional[Topology] = None, batch: int = 1,
+                   *, zero_options: Sequence[bool] = (False, True),
+                   sp_modes: Sequence[str] = ("ring",),
+                   beam: Optional[int] = None,
+                   program_name: str = "") -> PlanArtifact:
+    """Search placements for `program` on `topology` at global `batch`.
+
+    Pure host-side static analysis: candidates are transpiled CLONES,
+    nothing compiles, no device is touched. Returns the ranked
+    PlanArtifact; raises NoFeasiblePlacementError when every candidate
+    prunes (the artifact-level analogue of MemoryBudgetError)."""
+    program = program or default_main_program()
+    topology = topology or default_topology()
+    width = _beam_width(beam)
+    plans: List[dict] = []
+    scored: List[dict] = []
+    rejections: List[dict] = []
+    n_candidates = 0
+    for axes in _mesh_candidates(topology.n_devices):
+        dp = int(axes.get(DP, 1))
+        zeros = [z for z in dict.fromkeys(bool(z) for z in zero_options)
+                 if not (z and dp <= 1)] or [False]
+        modes: Sequence[Optional[str]] = (
+            tuple(sp_modes) if int(axes.get(SP, 1)) > 1 else (None,))
+        for zero in zeros:
+            for sp_mode in modes:
+                n_candidates += 1
+                desc = {"mesh": dict(axes), "zero": zero,
+                        "sp_mode": sp_mode}
+                try:
+                    cand = score_mesh(program, axes, topology, batch,
+                                      zero=zero, sp_mode=sp_mode)
+                except PlacementRejected as e:
+                    rejections.append(dict(desc, stage=e.stage,
+                                           reason=e.reason))
+                    continue
+                plans.append(cand)
+                p = cand["prediction"]
+                scored.append(dict(
+                    desc, devices_used=cand["devices_used"],
+                    predicted_step_ms=p["predicted_step_ms"],
+                    predicted_mfu=p["predicted_mfu"], bound=p["bound"],
+                    peak_hbm_bytes=cand["peak_hbm_bytes"],
+                    wire_bytes=cand["wire_bytes"],
+                    wire_bytes_dci=cand["wire_bytes_dci"]))
+    if not plans:
+        raise NoFeasiblePlacementError(rejections)
+    order = sorted(
+        range(len(plans)),
+        key=lambda i: (plans[i]["prediction"]["predicted_step_ms"],
+                       plans[i]["peak_hbm_bytes"],
+                       sorted(plans[i]["mesh"].items()),
+                       plans[i]["zero"]))
+    doc = {
+        "schema_version": PLAN_SCHEMA_VERSION,
+        "kind": "placement_plan",
+        "program": program_name or "<unnamed>",
+        "program_fingerprint": program.fingerprint(),
+        "batch": int(batch),
+        "topology": topology.to_dict(),
+        "search": {"candidates": n_candidates, "scored": len(plans),
+                   "rejected": len(rejections), "beam": width},
+        "ranked": [plans[i] for i in order[:width]],
+        "scored": [scored[i] for i in order],
+        "rejections": rejections[:200],
+        "rejections_truncated": max(0, len(rejections) - 200),
+    }
+    return PlanArtifact(doc)
+
+
+# ---------------------------------------------------------------------------
+# plan application
+# ---------------------------------------------------------------------------
+
+def resolve_plan(plan) -> dict:
+    """Normalize any plan-ish input — a path, a PlanArtifact, an artifact
+    dict, or a single ranked entry — to one plan dict (the winner when
+    given a whole artifact). Paths are floor-checked on load."""
+    if isinstance(plan, str):
+        plan = PlanArtifact.load(plan)
+    if isinstance(plan, PlanArtifact):
+        plan = plan.top
+    if isinstance(plan, dict) and "ranked" in plan:
+        from .artifacts import validate_plan
+        problems = validate_plan(plan)
+        if problems:
+            raise ValueError("plan artifact fails its floors:\n  "
+                             + "\n  ".join(problems))
+        plan = plan["ranked"][0]
+    if not isinstance(plan, dict) or "mesh" not in plan \
+            or "specs" not in plan:
+        raise TypeError("plan must be a PlanArtifact, an artifact/plan "
+                        f"dict, or a path — got {type(plan).__name__}")
+    return plan
+
+
+def apply_plan(program: Program, plan) -> Dict[str, int]:
+    """Write the plan's placement onto `program` (in place): per-var
+    sharding specs + the sp attention rewrite. Returns the plan's
+    ordered {axis: size} so callers can build the mesh
+    (parallel/mesh.py mesh_from_plan). The program should be the same
+    UNtranspiled program the plan was searched for — a fingerprint
+    mismatch warns (shape drift makes the recorded placement stale)."""
+    plan = resolve_plan(plan)
+    block = program.global_block
+    fp = plan.get("program_fingerprint")
+    if fp and program.fingerprint() != fp:
+        warnings.warn(
+            "plan was searched for a different program (fingerprint "
+            "mismatch) — applying anyway; re-plan if shapes changed",
+            stacklevel=2)
+    missing = []
+    for name, spec in plan["specs"].items():
+        try:
+            v = block.var(name)
+        except KeyError:
+            missing.append(name)
+            continue
+        v.sharding = tuple(tuple(e) if isinstance(e, list) else e
+                           for e in spec)
+    if missing:
+        warnings.warn(f"plan names {len(missing)} var(s) this program "
+                      f"lacks (first: {missing[0]!r}) — their placements "
+                      "were skipped", stacklevel=2)
+    if plan.get("sp_mode"):
+        for op in block.ops:
+            if op.type == _ATTENTION_OP:
+                op.attrs["sp_mode"] = plan["sp_mode"]
+    program.invalidate_cache()
+    return {str(a): int(s) for a, s in plan["mesh"].items()}
+
+
+def rescore_plan(program: Program, plan, topology: Optional[Topology] = None,
+                 batch: Optional[int] = None) -> dict:
+    """Apply `plan` to a CLONE of `program` and re-run the scoring leg.
+    The returned prediction must equal the plan's recorded one — the
+    no-search/score-drift property tests/test_planner.py pins."""
+    plan = resolve_plan(plan)
+    topology = topology or default_topology()
+    clone = program.clone()
+    axes = apply_plan(clone, plan)
+    b = int(plan.get("batch", 1)) if batch is None else batch
+    prediction, peak, breakdown = _score(clone, axes, topology, b,
+                                         bool(plan.get("zero")))
+    return {"prediction": prediction, "peak_hbm_bytes": peak,
+            "memory_breakdown": breakdown}
+
+
+# ---------------------------------------------------------------------------
+# rank correlation (the predicted-vs-measured gate)
+# ---------------------------------------------------------------------------
+
+def rank_correlation(xs: Sequence[float], ys: Sequence[float]) -> float:
+    """Spearman rank correlation with average ranks on ties. The
+    dryrun/CI gate: predicted step-time ordering over the hand-picked
+    meshes must match the measured ordering (rho >= 0.49 tolerates one
+    adjacent transposition among three meshes, nothing worse)."""
+    if len(xs) != len(ys) or len(xs) < 2:
+        raise ValueError("rank_correlation needs two equal-length "
+                         "sequences of >= 2 readings")
+
+    def ranks(v: Sequence[float]) -> List[float]:
+        order = sorted(range(len(v)), key=lambda i: v[i])
+        out = [0.0] * len(v)
+        i = 0
+        while i < len(order):
+            j = i
+            while j + 1 < len(order) and v[order[j + 1]] == v[order[i]]:
+                j += 1
+            avg = (i + j) / 2.0 + 1.0
+            for k in range(i, j + 1):
+                out[order[k]] = avg
+            i = j + 1
+        return out
+
+    rx, ry = ranks(list(xs)), ranks(list(ys))
+    n = len(rx)
+    mx, my = sum(rx) / n, sum(ry) / n
+    cov = sum((a - mx) * (b - my) for a, b in zip(rx, ry))
+    vx = sum((a - mx) ** 2 for a in rx)
+    vy = sum((b - my) ** 2 for b in ry)
+    if vx == 0 or vy == 0:
+        return 0.0
+    return cov / (vx * vy) ** 0.5

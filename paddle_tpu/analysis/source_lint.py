@@ -30,6 +30,17 @@ Rules:
       materialization points carry a `# host-sync: ok` marker on the
       call's line with a short justification; anything unmarked fails
       the gate.
+
+  hardcoded-axis-spec  a mesh-axis-name string literal ("dp"/"tp"/"sp"/
+      "ep"/"pp") outside parallel/mesh.py and paddle_tpu/analysis/.
+      Placement truth lives in exactly two places — mesh.py's axis
+      constants (DP/TP/PP/SP/EP) and the planner's PlacementPlan
+      artifacts — so any other file spelling an axis name is either
+      hand-picking a placement the planner should own or typo-prone
+      stringly-typed code; import the constant instead. Deliberate
+      exceptions (a CLI parsing user-typed axis names, a launch-script
+      compat shim) carry `# spec: ok` on the literal's line or the line
+      above with a short justification.
 """
 
 from __future__ import annotations
@@ -73,6 +84,19 @@ COERCION_NP_FUNCS = ("asarray", "array", "stack", "concatenate", "ravel")
 
 #: method calls that force a device->host sync on a device value
 COERCION_METHODS = ("item", "tolist")
+
+#: the mesh-axis alphabet the hardcoded-axis-spec rule polices (kept
+#: literal: this module must import without the package, and these ARE
+#: the canonical spellings mesh.py's constants bind)
+AXIS_NAMES = frozenset({"dp", "tp", "pp", "sp", "ep"})
+
+#: files allowed to spell axis names: the constants' home and the
+#: analysis layer (whose planner/audit/verifier literally reason ABOUT
+#: axis names as data)
+AXIS_SPEC_EXEMPT = ("paddle_tpu/parallel/mesh.py", "paddle_tpu/analysis/")
+
+#: suppression marker for deliberate axis-name literals
+SPEC_OK_MARK = "spec: ok"
 
 
 @dataclass(frozen=True)
@@ -242,6 +266,57 @@ def check_device_coercion(path: str, src: str) -> List[LintFinding]:
 
 
 # ---------------------------------------------------------------------------
+# rule: hardcoded-axis-spec
+# ---------------------------------------------------------------------------
+
+def is_axis_spec_exempt(path: str) -> bool:
+    norm = path.replace(os.sep, "/")
+    return any((e.endswith("/") and e in norm) or norm.endswith(e)
+               for e in AXIS_SPEC_EXEMPT)
+
+
+def check_axis_spec_literals(path: str, src: str) -> List[LintFinding]:
+    if is_axis_spec_exempt(path):
+        return []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    lines = src.splitlines()
+    findings: List[LintFinding] = []
+    # docstrings are Expr-statement constants: an axis name can only
+    # collide there as a whole two-letter docstring, which nothing writes
+    doc_nodes = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Expr) and isinstance(node.value,
+                                                     ast.Constant):
+            doc_nodes.add(id(node.value))
+
+    def suppressed(node) -> bool:
+        for ln in (node.lineno - 1, node.lineno - 2):
+            if 0 <= ln < len(lines) and SPEC_OK_MARK in lines[ln]:
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value in AXIS_NAMES):
+            continue
+        if id(node) in doc_nodes or suppressed(node):
+            continue
+        findings.append(LintFinding(
+            path, node.lineno, node.col_offset, "hardcoded-axis-spec",
+            f"mesh-axis literal {node.value!r} outside parallel/mesh.py "
+            "and analysis/ — placement truth belongs to mesh.py's axis "
+            "constants and planner-emitted plans; import the constant "
+            "(from paddle_tpu.parallel.mesh import "
+            f"{node.value.upper()}) or mark a deliberate exception with "
+            f"`# {SPEC_OK_MARK} — <why>`"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -250,7 +325,8 @@ def lint_file(path: str, declared: Set[str]) -> List[LintFinding]:
         src = f.read()
     return (check_joined_continuation(path, src)
             + check_env_knobs(path, src, declared)
-            + check_device_coercion(path, src))
+            + check_device_coercion(path, src)
+            + check_axis_spec_literals(path, src))
 
 
 def default_targets(root: str) -> List[str]:

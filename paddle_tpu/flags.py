@@ -264,3 +264,17 @@ declare_env_knob("PT_COMPILE_CACHE",
                  "else = that directory. Compiles are then paid once per "
                  "machine, not per process (the transformer bench "
                  "config's 43.5 s cold compile warm-starts in seconds)")
+declare_env_knob("PT_PLAN_BEAM",
+                 "placement planner (analysis/planner.py): how many "
+                 "ranked plans the emitted PlacementPlan artifact keeps "
+                 "(default 8). The full candidate space is still "
+                 "searched; the artifact's rejection log is capped at "
+                 "200 entries (rejections_truncated records the "
+                 "overflow, search.rejected counts them all)")
+declare_env_knob("PT_PLAN_TOPOLOGY",
+                 "placement planner: default device-topology override, "
+                 "'chip:chips_per_host[xhosts][@dci=][@ici=][@hbm=]' — "
+                 "e.g. v5e:8, v5p:4x2@dci=50 (parallel/mesh.py "
+                 "Topology.parse). Lets an off-TPU host plan for the "
+                 "deployment pod, like PT_COST_CHIP does for the "
+                 "roofline")

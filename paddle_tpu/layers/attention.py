@@ -133,6 +133,7 @@ def multi_head_attention(queries, keys=None, values=None, *, num_heads,
     if tp_shard:
         # Megatron layout: QKV weights column-parallel (heads split over tp),
         # output weight row-parallel (tp contributions psum'd by GSPMD)
+        from ..parallel.mesh import TP
         for var, row_parallel in new_weights:
-            var.sharding = ("tp", None) if row_parallel else (None, "tp")
+            var.sharding = (TP, None) if row_parallel else (None, TP)
     return out

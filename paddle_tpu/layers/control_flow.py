@@ -611,10 +611,11 @@ class Pipeline:
             else:
                 fi = fo = int(shape[0])
             default = XavierInitializer(fan_in=fi, fan_out=fo)
+        from ..parallel.mesh import PP
         stacked = self.helper.create_parameter(
             attr, [self.num_stages] + list(shape), dtype, is_bias=is_bias,
             default_initializer=default)
-        stacked.sharding = ("pp",) + (None,) * len(shape)
+        stacked.sharding = (PP,) + (None,) * len(shape)
         inner = self.sub_block.create_var(
             unique_name("pipe_p"), shape=tuple(shape), dtype=dtype)
         self._stacked.append(stacked)

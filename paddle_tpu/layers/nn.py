@@ -122,7 +122,8 @@ def embedding(input, size: Sequence[int], is_sparse: bool = False,
     if is_distributed:
         # vocab (dim 0) sharded over tp and/or dp — whichever axes the
         # runtime mesh actually has (spec_for drops absent axes)
-        w.sharding = (("tp", "dp"), None)
+        from ..parallel.mesh import DP, TP
+        w.sharding = ((TP, DP), None)
     tmp = helper.create_tmp_variable(dtype)
     padding_idx = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx)
@@ -860,7 +861,8 @@ def moe_ffn(input, num_experts, hidden_size, top_k=1, capacity_factor=1.25,
             is_bias=is_bias,
             default_initializer=None if is_bias
             else _Xavier(fan_in=fan_in, fan_out=fan_out))
-        p.sharding = ("ep",) + (None,) * len(shape)
+        from ..parallel.mesh import EP
+        p.sharding = (EP,) + (None,) * len(shape)
         return p
 
     gate_w = helper.create_parameter(_attr("gate"), [d, num_experts],

@@ -33,12 +33,13 @@ def _ffn(x, d_model, d_ff, idx, tp_shard):
         bias_attr=ParamAttr(name=f"ffn{idx}_out_b"),
         name=f"ffn{idx}_out"))
     if tp_shard:
+        from ..parallel.mesh import TP
         for v in up_params:
             if len(v.shape) == 2:
-                v.sharding = (None, "tp")     # column-parallel up-proj
+                v.sharding = (None, TP)      # column-parallel up-proj
         for v in down_params:
             if len(v.shape) == 2:
-                v.sharding = ("tp", None)     # row-parallel down-proj
+                v.sharding = (TP, None)      # row-parallel down-proj
     return out
 
 

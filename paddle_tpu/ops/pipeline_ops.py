@@ -74,11 +74,12 @@ def pipeline_op(ctx, ins, attrs):
         out, _ = jax.lax.scan(body, xmb, tuple(p_slices), unroll=True)
         return out
 
+    from ..parallel.mesh import PP
     mesh = ctx.mesh
     params = tuple(stacked)
-    if mesh is not None and "pp" in mesh.axis_names \
-            and int(mesh.shape["pp"]) > 1:
-        pp = int(mesh.shape["pp"])
+    if mesh is not None and PP in mesh.axis_names \
+            and int(mesh.shape[PP]) > 1:
+        pp = int(mesh.shape[PP])
         if pp != s:
             raise ValueError(f"pipeline: {s} stages but pp axis size {pp}")
         xs = x.reshape((m, b // m) + tuple(x.shape[1:]))

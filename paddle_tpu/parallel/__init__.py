@@ -6,6 +6,6 @@ over a jax.sharding.Mesh.
 """
 
 from .mesh import (make_mesh, default_mesh, set_default_mesh, spec_for, named,
-                   DP, TP, PP, SP, EP)
+                   mesh_from_plan, Topology, DP, TP, PP, SP, EP)
 from .parallel_executor import (ParallelExecutor, BuildStrategy,
                                 ExecutionStrategy, ReduceStrategy)

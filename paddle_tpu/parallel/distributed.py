@@ -59,6 +59,58 @@ def initialize_from_env():
     initialize(coord, trainers, trainer_id)
 
 
+def axis_spans_hosts(axis_sizes, axis: str, chips_per_host: int) -> bool:
+    """Does mesh axis `axis` connect devices on DIFFERENT hosts?
+
+    make_mesh lays devices out row-major over the ordered axis dict, so
+    an axis's communication groups stride by the product of the sizes of
+    the axes AFTER it; the group spans `stride * size` consecutive
+    device ids. Hosts own contiguous id ranges (jax.distributed device
+    enumeration), so the group stays on one host iff that span fits in
+    chips_per_host. This is the planner's ICI-vs-DCI pricing predicate
+    (analysis/planner.py) and the multi-host reading of the mesh axis
+    convention in mesh.py.
+    """
+    names = list(axis_sizes)
+    if axis not in names:
+        return False
+    sizes = [int(axis_sizes[a]) for a in names]
+    i = names.index(axis)
+    if sizes[i] <= 1:
+        return False
+    stride = 1
+    for s in sizes[i + 1:]:
+        stride *= s
+    # a group along the axis occupies one contiguous id block of width
+    # stride * size (ids decompose hi*span + mid*stride + lo; the group
+    # fixes hi and lo). The mesh occupies device ids [0, total); a
+    # sub-mesh that fits on the first host never crosses. Beyond that,
+    # every block stays on one host iff the blocks tile the host ranges
+    # evenly — span <= chips_per_host alone is NOT enough when it does
+    # not divide (a span-2 block can straddle two 3-chip hosts)
+    cph = max(1, int(chips_per_host))
+    total = 1
+    for s in sizes:
+        total *= s
+    if total <= cph:
+        return False
+    span = stride * sizes[i]
+    return span > cph or cph % span != 0
+
+
+def host_axis_split(axis_sizes, chips_per_host: int):
+    """Partition ordered mesh axes into (dcn_axes, ici_axes): the axes
+    whose collectives cross the host boundary vs the ones that stay on
+    intra-host ICI. The planner prices collectives with this split; a
+    launch script can use it to sanity-check that only the cheap-to-sync
+    axes (dp grad-sync once a step) land on DCN."""
+    dcn = [a for a in axis_sizes
+           if axis_spans_hosts(axis_sizes, a, chips_per_host)]
+    ici = [a for a in axis_sizes
+           if int(axis_sizes[a]) > 1 and a not in dcn]
+    return dcn, ici
+
+
 def process_count() -> int:
     return jax.process_count()
 

@@ -70,11 +70,31 @@ class ParallelExecutor(TimedExecutorMixin):
                  exec_strategy: Optional[ExecutionStrategy] = None,
                  build_strategy: Optional[BuildStrategy] = None,
                  num_trainers: int = 1, trainer_id: int = 0,
-                 scope: Optional[Scope] = None, mesh: Optional[Mesh] = None):
+                 scope: Optional[Scope] = None, mesh: Optional[Mesh] = None,
+                 plan=None):
+        """plan: a PlacementPlan (analysis/planner.py) — artifact object,
+        plan/artifact dict, or a saved-artifact path. Applies the plan's
+        per-var specs + sp rewrite to `main_program` in place, builds the
+        mesh from the plan's axes when `mesh` is not given, and switches
+        to ReduceStrategy.Reduce when the plan says ZeRO — so the
+        planner-chosen placement executes with zero per-model code."""
         self._program = main_program if main_program is not None else default_main_program()
         self._scope = scope or global_scope()
-        self._mesh = mesh or default_mesh()
         self._build_strategy = build_strategy or BuildStrategy()
+        if plan is not None:
+            from ..analysis.planner import apply_plan, resolve_plan
+            from .mesh import mesh_from_plan
+            plan = resolve_plan(plan)
+            apply_plan(self._program, plan)
+            if mesh is None:
+                mesh = mesh_from_plan(plan)
+            if plan.get("zero"):
+                # copy before flipping: a caller-supplied BuildStrategy
+                # must not leak Reduce into executors built without a plan
+                import copy
+                self._build_strategy = copy.copy(self._build_strategy)
+                self._build_strategy.reduce_strategy = ReduceStrategy.Reduce
+        self._mesh = mesh or default_mesh()
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._loss_name = loss_name
         self._cache: Dict[tuple, _Compiled] = {}

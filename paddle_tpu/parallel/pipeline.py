@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import DP, PP
+
 __all__ = ["gpipe", "sequential_stages"]
 
 
@@ -35,7 +37,7 @@ def sequential_stages(stage_fn: Callable, params, x):
     return out
 
 
-def gpipe(stage_fn: Callable, params, xs, *, mesh: Mesh, axis: str = "pp"):
+def gpipe(stage_fn: Callable, params, xs, *, mesh: Mesh, axis: str = PP):
     """Run GPipe over `mesh`'s `axis`.
 
     stage_fn(param_slice, x[mb, ...]) -> y[mb, ...] (same shape: stages
@@ -50,8 +52,8 @@ def gpipe(stage_fn: Callable, params, xs, *, mesh: Mesh, axis: str = "pp"):
     # split the per-microbatch batch dim over 'dp' when present so data-
     # parallel replicas pipeline their own slice instead of redundantly
     # recomputing the full batch
-    dp = int(mesh.shape.get("dp", 1))
-    x_spec = P(None, "dp") if dp > 1 and xs.shape[1] % dp == 0 else P()
+    dp = int(mesh.shape.get(DP, 1))
+    x_spec = P(None, DP) if dp > 1 and xs.shape[1] % dp == 0 else P()
 
     def body(local_params, xs_full):
         p = jax.tree.map(lambda a: a[0], local_params)  # this stage's slice

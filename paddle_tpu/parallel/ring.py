@@ -29,6 +29,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from .mesh import SP
+
 NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -50,7 +52,7 @@ def _block_attn(q, k, v, scale, mask):
     return num, m, l
 
 
-def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
+def ring_attention(q, k, v, *, axis_name: str = SP, causal: bool = False,
                    scale: Optional[float] = None):
     """Ring attention over sequence shards. q,k,v: [B, S_local, H, D]."""
     if scale is None:
@@ -94,7 +96,7 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
     return out.astype(q.dtype)
 
 
-def ulysses_attention(q, k, v, *, axis_name: str = "sp",
+def ulysses_attention(q, k, v, *, axis_name: str = SP,
                       causal: bool = False, scale: Optional[float] = None,
                       attn_fn=None):
     """DeepSpeed-Ulysses-style SP. q,k,v: [B, S_local, H, D]; requires

@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Set
 
 from ..core.program import (Program, VarDesc, default_main_program,
                             iter_optimizer_state_inputs)
+from ..parallel.mesh import DP, SP, TP
 
 # ops a tp-sharded activation may flow through without breaking the
 # column→row Megatron pairing; values = input slots the trace follows
@@ -80,13 +81,30 @@ def _mesh_axis_size(mesh, axis: str) -> int:
 
 
 def transpile(program: Optional[Program] = None, mesh=None,
-              strategy: Optional[TranspileStrategy] = None) -> Program:
-    """Annotate `program` for the mesh; mutates in place and returns it."""
+              strategy: Optional[TranspileStrategy] = None,
+              plan=None) -> Program:
+    """Annotate `program` for the mesh; mutates in place and returns it.
+
+    plan: a PlacementPlan (analysis/planner.py — artifact object, dict,
+    or saved path). When given, the plan's recorded per-var specs + sp
+    rewrite are applied VERBATIM instead of re-deriving placements here
+    — the plan is the placement truth, this pass is only its applicator
+    (the post-condition gate still runs against the plan's mesh axes).
+    """
     program = program if program is not None else default_main_program()
+    if plan is not None:
+        from ..analysis.planner import apply_plan, resolve_plan
+        plan = resolve_plan(plan)
+        axes = apply_plan(program, plan)
+        from ..analysis import verify_enabled, verify_program
+        if verify_enabled():
+            verify_program(program, mesh=mesh if mesh is not None else axes,
+                           passes=["shard-check"]).raise_if_errors()
+        return program
     strategy = strategy or TranspileStrategy()
     block = program.global_block
-    tp_size = _mesh_axis_size(mesh, "tp")
-    sp_size = _mesh_axis_size(mesh, "sp")
+    tp_size = _mesh_axis_size(mesh, TP)
+    sp_size = _mesh_axis_size(mesh, SP)
 
     def var(name) -> Optional[VarDesc]:
         try:
@@ -168,16 +186,16 @@ def transpile(program: Optional[Program] = None, mesh=None,
                                 if (is_trainable_param(bv)
                                         and len(bv.shape) == 1
                                         and bv.shape[0] == w1.shape[1]):
-                                    bv.sharding = bv.sharding or ("tp",)
+                                    bv.sharding = bv.sharding or (TP,)
         conflicts = col & row
         for name in col - conflicts:
             v = var(name)
             if v.sharding is None:
-                v.sharding = (None, "tp")
+                v.sharding = (None, TP)
         for name in row - conflicts:
             v = var(name)
             if v.sharding is None:
-                v.sharding = ("tp", None)
+                v.sharding = (TP, None)
 
     # -- embeddings --------------------------------------------------------
     if strategy.shard_embeddings:
@@ -186,7 +204,7 @@ def transpile(program: Optional[Program] = None, mesh=None,
                 continue
             w = var(op.inputs["W"][0])
             if is_trainable_param(w) and w.sharding is None:
-                w.sharding = (("tp", "dp"), None)
+                w.sharding = ((TP, DP), None)
 
     # -- sequence parallelism: actual op rewrite ---------------------------
     if strategy.sp_mode and sp_size > 1:
@@ -210,7 +228,7 @@ def transpile(program: Optional[Program] = None, mesh=None,
             if (getattr(v, "is_data", False) and v.sharding is None
                     and len(v.shape) >= 2 and int(v.shape[1]) in seq_lens
                     and v.shape[1] % sp_size == 0):
-                v.sharding = ("dp", "sp") + (None,) * (len(v.shape) - 2)
+                v.sharding = (DP, SP) + (None,) * (len(v.shape) - 2)
         # ... and pin the intermediate activations too: GSPMD does not
         # reliably carry the feed sharding through embedding/reshape
         # chains, so [B, S, ...] float temporaries in the main block get
@@ -230,7 +248,7 @@ def transpile(program: Optional[Program] = None, mesh=None,
                        "flatten", "flatten2", "split", "concat", "stack"}
         pinned = {v.name for v in block.vars.values()
                   if v.sharding is not None and len(v.shape) >= 2
-                  and v.sharding[:2] == ("dp", "sp")}
+                  and v.sharding[:2] == (DP, SP)}
         for op in block.ops:
             if op.type in axis_movers:
                 continue
@@ -252,7 +270,7 @@ def transpile(program: Optional[Program] = None, mesh=None,
                         src_ok = True
                         break
                 if src_ok:
-                    v.sharding = ("dp", "sp") + (None,) * (len(v.shape) - 2)
+                    v.sharding = (DP, SP) + (None,) * (len(v.shape) - 2)
                     pinned.add(v.name)
 
     # -- optimizer accumulators follow their param -------------------------

@@ -37,6 +37,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from ..core.program import Program, default_main_program, unique_name
+from ..parallel.mesh import PP
 
 __all__ = ["pipeline_transpile", "find_repeated_region"]
 
@@ -300,7 +301,7 @@ def pipeline_transpile(program: Optional[Program] = None,
                                    dtype=pv.dtype, persistable=True)
         stacked.is_parameter = True
         stacked.trainable = getattr(pv, "trainable", True)
-        stacked.sharding = ("pp",) + (None,) * len(pv.shape)
+        stacked.sharding = (PP,) + (None,) * len(pv.shape)
         stacked_names.append(stacked.name)
         if startup_program is not None:
             sblock = startup_program.global_block

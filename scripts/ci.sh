@@ -16,7 +16,10 @@
 #   analyze = lint gate + the static cost-model suites + schema-checked
 #           tools/cost_report.py runs over the resnet / transformer /
 #           decode bench programs, incl. the collective audit on the
-#           MULTICHIP dryrun meshes (dp, dp x tp, dp x sp x tp)
+#           MULTICHIP dryrun meshes (dp, dp x tp, dp x sp x tp) + the
+#           placement planner (tools/plan.py): schema-checked plans for
+#           all three builders, plus the predicted-vs-measured
+#           rank-correlation gate over the hand-picked dryrun meshes
 #   data  = lint gate + the production data-plane suite (pipeline
 #           determinism, sharding disjointness, parallel shard readers,
 #           cheap skip + checkpointable state, device-side augmentation,
@@ -68,7 +71,8 @@ fi
 
 if [[ "${1:-}" == "analyze" ]]; then
   echo "== analyze: cost model + memory estimator + collective audit =="
-  python -m pytest tests/test_cost_model.py tests/test_analysis.py -q
+  python -m pytest tests/test_cost_model.py tests/test_analysis.py \
+    tests/test_planner.py -q
   echo "== analyze: schema-checked cost reports (bench programs) =="
   for prog in resnet transformer decode; do
     python tools/cost_report.py "$prog" --check > /dev/null
@@ -77,6 +81,15 @@ if [[ "${1:-}" == "analyze" ]]; then
   # schema-checked on the transpiled transformer
   python tools/cost_report.py transformer --check \
     --mesh dp=8 --mesh dp=4,tp=2 --mesh dp=2,sp=2,tp=2 > /dev/null
+  echo "== analyze: placement planner (schema-checked plans) =="
+  # decode is inference-shaped (batch = engine slots); the training
+  # builders plan at a dp-splittable batch
+  python tools/plan.py resnet --batch 8 --check > /dev/null
+  python tools/plan.py transformer --batch 8 --check > /dev/null
+  python tools/plan.py decode --batch 2 --infer --check > /dev/null
+  echo "== analyze: planner rank-correlation gate (predicted vs measured"
+  echo "   step-time ordering over the hand-picked dryrun meshes) =="
+  python tools/plan.py transformer --rank-gate
   echo "ANALYZE OK"
   exit 0
 fi

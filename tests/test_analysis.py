@@ -447,6 +447,29 @@ def test_lint_flags_device_coercion_in_hot_loop_files():
     assert check_device_coercion("bench.py", src) == []
 
 
+def test_lint_flags_hardcoded_axis_spec():
+    from paddle_tpu.analysis.source_lint import check_axis_spec_literals
+    src = ('from jax.sharding import PartitionSpec\n'
+           'spec = PartitionSpec("dp", None)\n'           # flagged
+           'v_sharding = (None, "tp")\n'                  # flagged
+           'axes = {"ep": 4}\n'                           # flagged
+           'ok = ("sp",)  # spec: ok — CLI parses user axis names\n'
+           '# spec: ok — marker on the line above also suppresses\n'
+           'ok2 = ("pp",)\n'
+           'other = "dpx"\n'                              # not an axis name
+           'slot = "X"\n')
+    findings = check_axis_spec_literals("paddle_tpu/layers/foo.py", src)
+    assert [f.line for f in findings] == [2, 3, 4]
+    assert all(f.code == "hardcoded-axis-spec" for f in findings)
+    # placement truth's own homes are exempt
+    assert check_axis_spec_literals(
+        "paddle_tpu/parallel/mesh.py", src) == []
+    assert check_axis_spec_literals(
+        "/abs/repo/paddle_tpu/analysis/planner.py", src) == []
+    # a module docstring that IS an axis name does not trip the rule
+    assert check_axis_spec_literals("x.py", '"""dp"""\n') == []
+
+
 def test_repo_source_is_lint_clean():
     from paddle_tpu.analysis.source_lint import default_targets, lint_paths
     findings = lint_paths(default_targets(REPO),

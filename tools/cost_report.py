@@ -46,13 +46,18 @@ from paddle_tpu.analysis.memory import estimate_memory  # noqa: E402
 
 
 def build_resnet(train: bool):
+    """Returns (main, startup) — tools/plan.py reuses these builders and
+    needs the startup program to init state for measured-arm runs.
+    Unique names reset per build: a plan emitted for a builder program
+    must name the SAME vars a later in-process rebuild gets."""
     from paddle_tpu.models import resnet
+    pt.core.program.reset_unique_names()
     depth = int(os.environ.get("BENCH_RESNET_DEPTH", 50))
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
         resnet.get_model(data_set="cifar10", depth=depth,
                          fused_xent=True, is_test=not train)
-    return main
+    return main, startup
 
 
 def build_transformer(train: bool):
@@ -65,25 +70,27 @@ def build_transformer(train: bool):
         n_heads=int(os.environ.get("BENCH_TFM_HEADS", 2)),
     )
     cfg["d_ff"] = int(os.environ.get("BENCH_TFM_DFF", 4 * cfg["d_model"]))
+    pt.core.program.reset_unique_names()
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
         avg, _ = transformer_lm_loss(max_len=max(cfg["seq_len"], 128), **cfg)
         if train:
             pt.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(avg)
-    return main
+    return main, startup
 
 
 def build_decode(train: bool):
     # the PR-6 decode step: paged_attention / paged_kv_write coverage
     # (inference-only by construction; --train is ignored)
     from paddle_tpu.models.transformer import transformer_decode_step
+    pt.core.program.reset_unique_names()
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
         transformer_decode_step(
             int(os.environ.get("BENCH_TFM_VOCAB", 1000)),
             n_layers=2, d_model=64, n_heads=2, d_ff=256, max_context=128,
             slots=4, block_size=16, pool_blocks=16, max_blocks_per_seq=8)
-    return main
+    return main, startup
 
 
 BUILDERS = {"resnet": build_resnet, "transformer": build_transformer,
@@ -111,6 +118,11 @@ def main(argv=None):
                     help="audit collectives on this mesh (repeatable)")
     ap.add_argument("--zero", action="store_true",
                     help="price ZeRO grad sync (reduce-scatter+all-gather)")
+    ap.add_argument("--plan", default=None, metavar="PLAN_JSON",
+                    help="audit + re-score the program under a saved "
+                         "placement plan (tools/plan.py artifact); the "
+                         "plan's own prediction is reported beside the "
+                         "re-derived one so drift is visible")
     ap.add_argument("--check", action="store_true",
                     help="schema-validate the report; exit 1 on problems")
     ap.add_argument("--out", help="also write the JSON here")
@@ -120,7 +132,7 @@ def main(argv=None):
     # inference accounting even when the builder's model appends its own
     # optimizer (resnet.get_model does)
     train = False if args.infer else None
-    program = BUILDERS[args.program](not args.infer)
+    program, _startup = BUILDERS[args.program](not args.infer)
     pc = program_cost(program, batch=args.batch, train=train)
     est = estimate_memory(program, batch=args.batch, train=train)
     chip = resolve_chip()
@@ -160,8 +172,9 @@ def main(argv=None):
             from types import SimpleNamespace
             from paddle_tpu.transpiler import TranspileStrategy, transpile
             prog_m = program.clone()
+            from paddle_tpu.parallel.mesh import SP
             strat = TranspileStrategy(
-                sp_mode="ring" if int(axes.get("sp", 1)) > 1 else None)
+                sp_mode="ring" if int(axes.get(SP, 1)) > 1 else None)
             transpile(prog_m, mesh=SimpleNamespace(shape=axes),
                       strategy=strat)
             audit = audit_collectives(prog_m, axes, batch=args.batch,
@@ -170,6 +183,25 @@ def main(argv=None):
             report["comm"][spec]["prediction"] = predict_step(
                 prog_m, batch=args.batch, chip=chip, train=train,
                 comm_report=audit).to_dict()
+    if args.plan:
+        from paddle_tpu.analysis.planner import (PlanArtifact, rescore_plan,
+                                                 resolve_plan)
+        from paddle_tpu.parallel.mesh import Topology
+        art = PlanArtifact.load(args.plan)
+        topo = Topology.from_dict(art.doc["topology"])
+        entry = resolve_plan(art)
+        # re-score at the plan's RECORDED batch (batch=None), not
+        # --batch: the drift comparison is only meaningful apples-to-
+        # apples, and a mismatched batch could even flunk the HBM gate
+        rescored = rescore_plan(program, entry, topology=topo)
+        report["plan"] = {
+            "path": args.plan, "mesh": entry["mesh"],
+            "batch": entry.get("batch"),
+            "zero": entry["zero"], "sp_mode": entry["sp_mode"],
+            "recorded_prediction": entry["prediction"],
+            "prediction": rescored["prediction"],
+            "peak_hbm_bytes": rescored["peak_hbm_bytes"],
+        }
 
     text = json.dumps(report, indent=2)
     print(text)

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Placement-planner CLI (analysis/planner.py): search, emit, validate,
+and measure-validate PlacementPlan artifacts.
+
+Search a bench program's placement space for a device topology and emit
+the ranked plan artifact (pure host-side static analysis — nothing
+compiles, no device is touched):
+
+    python tools/plan.py transformer --batch 8 --topology v5e:8 \
+        --out plan.json --check
+    python tools/plan.py resnet --batch 8 --topology v5p:4x2@dci=50
+    PT_PLAN_TOPOLOGY=cpu:8 python tools/plan.py decode --batch 2
+
+The rank-correlation gate (scripts/ci.sh analyze + the dryrun harness)
+MEASURES the hand-picked dryrun meshes on the 8-virtual-device CPU mesh
+and asserts the planner's predicted step-time ordering matches the
+measured ordering (Spearman rho >= --min-rho; 0.49 tolerates one
+adjacent transposition among three meshes, nothing worse):
+
+    python tools/plan.py transformer --rank-gate
+
+The gate transformer is activation-heavy on purpose (small vocab, long
+sequence): there the wire-byte ordering the static model prices and the
+collective-overhead ordering the CPU fabric charges AGREE, so the gate
+checks the model rather than the emulation's scheduling noise. The gate
+topology prices ICI at the virtual fabric's effective ~1 GB/s
+(Topology ici override), not a TPU spec-sheet number.
+
+Exit status: 0 ok, 1 failed check/gate, 2 usage problems.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+#: the hand-picked MULTICHIP dryrun meshes the gate validates against
+#: (axis names typed by the dryrun harness, mirrored here as data)
+GATE_MESHES = (
+    {"dp": 8},                      # spec: ok — the hand-picked dryrun meshes under test
+    {"dp": 4, "tp": 2},             # spec: ok — ditto
+    {"dp": 2, "sp": 2, "tp": 2},    # spec: ok — ditto
+)
+
+#: activation-heavy gate transformer (see module docstring)
+GATE_CFG = dict(vocab_size=64, seq_len=256, n_layers=2, d_model=64,
+                n_heads=4, d_ff=256, max_len=256)
+GATE_BATCH = 8
+GATE_TOPOLOGY = "cpu:8@ici=1"
+
+
+def _force_virtual_mesh(n: int) -> None:
+    """The measured arm needs n virtual devices — set up BEFORE jax
+    imports (same dance as __graft_entry__.dryrun_multichip)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def _build_gate_program():
+    import paddle_tpu as pt
+    from paddle_tpu.models.transformer import transformer_lm_loss
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        avg, _ = transformer_lm_loss(**GATE_CFG)
+        pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(avg)
+    return main, startup, avg
+
+
+def rank_gate(n_devices: int = 8, min_rho: float = 0.49,
+              windows: int = 6, steps: int = 8) -> int:
+    """Predicted-vs-measured step-time ordering over GATE_MESHES.
+
+    For each hand-picked mesh: score statically (score_mesh — the same
+    inner loop plan_placement runs), then apply the scored placement and
+    measure min-of-`windows` run_loop windows of `steps` sharded steps
+    on the virtual device mesh. Asserts Spearman(predicted, measured)
+    >= min_rho and that the planner's top-ranked plan predicts <= the
+    best hand-picked mesh's prediction (the search must never lose to
+    its own candidate set)."""
+    _force_virtual_mesh(n_devices)
+    import time
+
+    import numpy as np
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.analysis import planner
+    from paddle_tpu.parallel import ParallelExecutor, make_mesh
+    from paddle_tpu.parallel.mesh import SP, Topology
+
+    topo = Topology.parse(GATE_TOPOLOGY)
+    rng = np.random.RandomState(0)
+    seq = GATE_CFG["seq_len"]
+    ids = rng.randint(0, GATE_CFG["vocab_size"],
+                      (GATE_BATCH, seq)).astype(np.int64)
+    tgt = np.roll(ids, -1, 1).reshape(GATE_BATCH, seq, 1)
+    window = {"src_ids": np.stack([ids] * steps),
+              "tgt_ids": np.stack([tgt] * steps)}
+
+    preds, meas = [], []
+    for axes in GATE_MESHES:
+        main, _startup, _avg = _build_gate_program()
+        sp_mode = "ring" if int(axes.get(SP, 1)) > 1 else None
+        cand = planner.score_mesh(main, axes, topo, batch=GATE_BATCH,
+                                  sp_mode=sp_mode)
+        preds.append(cand["prediction"]["predicted_step_ms"])
+        main2, startup2, avg2 = _build_gate_program()
+        planner.apply_plan(main2, cand)
+        n_mesh = int(np.prod(list(axes.values())))
+        mesh = make_mesh(dict(axes), devices=jax.devices()[:n_mesh])
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup2)
+            pe = ParallelExecutor(loss_name=avg2.name, main_program=main2,
+                                  mesh=mesh, scope=scope)
+            pe.run_loop([avg2], feed=window, n_steps=steps,
+                        per_step_feeds=True)  # compile + warm
+            best = float("inf")
+            for _ in range(windows):
+                t0 = time.perf_counter()
+                pe.run_loop([avg2], feed=window, n_steps=steps,
+                            per_step_feeds=True)
+                best = min(best, (time.perf_counter() - t0) / steps * 1e3)
+        meas.append(best)
+        print(f"rank-gate {axes}: predicted {preds[-1]:.3f} ms, "
+              f"measured {best:.2f} ms/step "
+              f"(bound={cand['prediction']['bound']})")
+
+    rho = planner.rank_correlation(preds, meas)
+    # the search itself must rank at least as well as the best
+    # hand-picked mesh it was given (same program, same topology)
+    art = planner.plan_placement(_build_gate_program()[0], topo,
+                                 batch=GATE_BATCH)
+    top_ms = art.top["prediction"]["predicted_step_ms"]
+    best_hand = min(preds)
+    print(f"rank-gate: spearman(predicted, measured) = {rho:.2f} "
+          f"(gate >= {min_rho}); planner top {art.top['mesh']} predicts "
+          f"{top_ms:.3f} ms vs best hand-picked {best_hand:.3f} ms")
+    ok = rho >= min_rho and top_ms <= best_hand + 1e-9
+    if not ok:
+        print("RANK GATE FAILED", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("program", choices=["resnet", "transformer", "decode"])
+    ap.add_argument("--batch", type=int, default=8,
+                    help="global batch the placement is planned for")
+    ap.add_argument("--topology", default=None,
+                    metavar="chip:N[xH][@dci=][@ici=][@hbm=]",
+                    help="device topology (default: PT_PLAN_TOPOLOGY or "
+                         "cpu:8)")
+    ap.add_argument("--infer", action="store_true",
+                    help="plan the inference program (no backward)")
+    ap.add_argument("--beam", type=int, default=None,
+                    help="ranked plans kept in the artifact "
+                         "(default PT_PLAN_BEAM or 8)")
+    ap.add_argument("--out", help="write the plan artifact here "
+                                  "(validated at save)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the artifact floors; exit 1 on "
+                         "problems")
+    ap.add_argument("--rank-gate", action="store_true",
+                    help="measure the hand-picked dryrun meshes on the "
+                         "8-virtual-device mesh and gate predicted-vs-"
+                         "measured step-time ordering")
+    ap.add_argument("--min-rho", type=float, default=0.49,
+                    help="rank-gate Spearman threshold (default 0.49)")
+    args = ap.parse_args(argv)
+
+    if args.rank_gate:
+        # the gate runs a FIXED config (GATE_CFG/GATE_BATCH/GATE_TOPOLOGY
+        # — the hand-picked dryrun meshes are only meaningful on it);
+        # refuse arguments that would silently not apply
+        if args.program != "transformer":
+            ap.error("--rank-gate always gates the built-in transformer "
+                     "config; pass 'transformer'")
+        if args.batch != 8 or args.topology or args.beam is not None \
+                or args.out or args.check or args.infer:
+            ap.error("--rank-gate uses the fixed gate config; --batch/"
+                     "--topology/--beam/--out/--check/--infer do not "
+                     "apply")
+        return rank_gate(min_rho=args.min_rho)
+
+    from cost_report import BUILDERS
+    from paddle_tpu.analysis import planner
+    from paddle_tpu.analysis.artifacts import validate_plan
+    from paddle_tpu.parallel.mesh import Topology
+
+    topology = (Topology.parse(args.topology) if args.topology
+                else planner.default_topology())
+    program, _startup = BUILDERS[args.program](not args.infer)
+    try:
+        art = planner.plan_placement(program, topology, batch=args.batch,
+                                     beam=args.beam,
+                                     program_name=args.program)
+    except planner.NoFeasiblePlacementError as e:
+        print(f"plan: {e}", file=sys.stderr)
+        for r in e.rejections[:20]:
+            print(f"  {r['mesh']} zero={r['zero']}: [{r['stage']}] "
+                  f"{r['reason']}", file=sys.stderr)
+        return 1
+    print(json.dumps(art.doc, indent=2))
+    if args.out:
+        art.save(args.out)
+    if args.check:
+        problems = validate_plan(art.doc)
+        if problems:
+            print("PLAN INVALID:\n  " + "\n  ".join(problems),
+                  file=sys.stderr)
+            return 1
+        top = art.top
+        print(f"plan ok: {args.program} top={top['mesh']} "
+              f"zero={top['zero']} sp={top['sp_mode']} "
+              f"predicted={top['prediction']['predicted_step_ms']:.3f} ms "
+              f"({art.doc['search']['scored']} scored, "
+              f"{art.doc['search']['rejected']} rejected)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

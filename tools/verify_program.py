@@ -12,6 +12,19 @@ measurement JSON:
     python tools/verify_program.py --autotune-cache ~/.cache/paddle_tpu/gconv_autotune.json
     python tools/verify_program.py --bench BENCH_r05.json
 
+The collective-audit pass needs a mesh AND derived placements — before
+this CLI grew --builder/--transpile/--plan it only ever fired inside
+executor pre-passes. Now it runs standalone on a transpiled clone:
+
+    # sharding pass on a clone of the bench transformer, then ALL
+    # passes incl. collective-audit against the mesh
+    python tools/verify_program.py --builder transformer \
+        --mesh dp=2,sp=2,tp=2 --transpile
+    # apply a planner artifact instead of deriving (mesh comes from
+    # the plan)
+    python tools/verify_program.py --builder transformer --plan plan.json
+    python tools/verify_program.py program.json --plan plan.json
+
 Exit status: 0 clean (warnings allowed), 1 any error-severity finding,
 2 usage/IO problems.
 """
@@ -52,15 +65,34 @@ def main(argv=None) -> int:
                     help="a var name that will be fetched (repeatable)")
     ap.add_argument("--passes", default=None,
                     help="comma-separated subset of verifier passes")
+    ap.add_argument("--builder", default=None,
+                    choices=["resnet", "transformer", "decode"],
+                    help="build this bench program (tools/cost_report.py "
+                         "builders) instead of loading a program JSON")
+    ap.add_argument("--transpile", action="store_true",
+                    help="run the sharding transpiler on a clone before "
+                         "verifying (requires --mesh) — makes the "
+                         "collective-audit pass runnable standalone")
+    ap.add_argument("--plan", default=None, metavar="PLAN_JSON",
+                    help="apply a planner artifact (tools/plan.py) to a "
+                         "clone before verifying; the mesh defaults to "
+                         "the plan's axes")
     ap.add_argument("--autotune-cache", default=None,
                     help="validate a gconv autotune cache JSON")
     ap.add_argument("--bench", default=None,
                     help="floor-check a bench.py output JSON")
     args = ap.parse_args(argv)
 
-    if not (args.program or args.autotune_cache or args.bench):
-        ap.error("nothing to do: give a program JSON, --autotune-cache, "
-                 "or --bench")
+    if not (args.program or args.builder or args.autotune_cache
+            or args.bench):
+        ap.error("nothing to do: give a program JSON, --builder, "
+                 "--autotune-cache, or --bench")
+    if args.transpile and args.plan:
+        ap.error("--transpile and --plan are mutually exclusive: a plan "
+                 "records its placements, nothing is left to derive")
+    if args.transpile and args.mesh is None:
+        ap.error("--transpile needs --mesh (the axes the sharding pass "
+                 "derives placements for)")
 
     rc = 0
 
@@ -85,19 +117,45 @@ def main(argv=None) -> int:
             else:
                 print(f"{path}: artifact verifies clean")
 
-    if args.program:
+    if args.program or args.builder:
         from paddle_tpu.analysis import verify_program
         from paddle_tpu.core.program import Program
-        try:
-            with open(args.program) as f:
-                program = Program.from_json(f.read())
-        except (OSError, ValueError, KeyError) as e:
-            print(f"{args.program}: cannot load program: {e}",
-                  file=sys.stderr)
-            return 2
+        if args.builder:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from cost_report import BUILDERS
+            program, _startup = BUILDERS[args.builder](True)
+        else:
+            try:
+                with open(args.program) as f:
+                    program = Program.from_json(f.read())
+            except (OSError, ValueError, KeyError) as e:
+                print(f"{args.program}: cannot load program: {e}",
+                      file=sys.stderr)
+                return 2
+        mesh = args.mesh
+        if args.plan:
+            from paddle_tpu.analysis.planner import apply_plan
+            program = program.clone()
+            try:
+                axes = apply_plan(program, args.plan)
+            except (OSError, ValueError, TypeError) as e:
+                print(f"{args.plan}: cannot apply plan: {e}",
+                      file=sys.stderr)
+                return 2
+            if mesh is None:
+                mesh = axes
+        elif args.transpile:
+            from types import SimpleNamespace
+            from paddle_tpu.parallel.mesh import SP
+            from paddle_tpu.transpiler import TranspileStrategy, transpile
+            program = program.clone()
+            strat = TranspileStrategy(
+                sp_mode="ring" if int(mesh.get(SP, 1)) > 1 else None)
+            transpile(program, mesh=SimpleNamespace(shape=dict(mesh)),
+                      strategy=strat)
         passes = args.passes.split(",") if args.passes else None
         result = verify_program(program, feeds=args.feed,
-                                fetches=args.fetch, mesh=args.mesh,
+                                fetches=args.fetch, mesh=mesh,
                                 passes=passes)
         print(result.report())
         if not result.ok:
