@@ -294,3 +294,66 @@ def validate_cost_report(doc) -> List[str]:
             problems.append(f"$.comm.{mesh_key}.total_wire_bytes: {v!r} "
                             "must be a finite non-negative number")
     return problems
+
+
+# ---------------------------------------------------------------------------
+# on-wire feed codec A/B floors (bench.py data_codec config)
+# ---------------------------------------------------------------------------
+
+#: required per-policy arm fields of the codec A/B
+_CODEC_ARM_REQUIRED = ("wire_bytes_ratio", "delivered_images_per_sec")
+
+
+def validate_codec_ab(doc) -> List[str]:
+    """Floor checks for bench.py's `data_codec` staged A/B ([] = valid),
+    the gconv pattern applied to the codec bench: an impossible reading
+    must never be committed as a measurement.
+
+      * every measured arm's wire_bytes_ratio is finite and >= 1.0 — a
+        codec that INFLATES its wire bytes (or a NaN from a zero-byte
+        window) is a broken measurement, not a result;
+      * delivered rates are finite and positive;
+      * the end-to-end parity delta is RECORDED and finite (int8 input
+        quantization is lossy by design, so the gate is a calibrated
+        tolerance band — but an unrecorded or NaN delta means the parity
+        leg never ran, and the ratio alone proves nothing).
+    """
+    if not isinstance(doc, dict):
+        return [f"codec A/B root is {type(doc).__name__}, not an object"]
+    problems: List[str] = []
+    arms = doc.get("arms")
+    if not isinstance(arms, dict) or not arms:
+        problems.append("$.arms: no measured codec arms recorded")
+        arms = {}
+    for policy, arm in arms.items():
+        here = f"$.arms.{policy}"
+        if not isinstance(arm, dict):
+            problems.append(f"{here}: not an object")
+            continue
+        for k in _CODEC_ARM_REQUIRED:
+            if k not in arm:
+                problems.append(f"{here}.{k}: required field missing")
+        ratio = arm.get("wire_bytes_ratio")
+        if ratio is not None:
+            if _bad_pred_num(ratio) or float(ratio) < 1.0:
+                problems.append(
+                    f"{here}.wire_bytes_ratio: {ratio!r} — a wire ratio "
+                    "below 1x (or non-finite) is an impossible codec "
+                    "measurement")
+        rate = arm.get("delivered_images_per_sec")
+        if rate is not None and (_bad_pred_num(rate) or float(rate) <= 0):
+            problems.append(f"{here}.delivered_images_per_sec: {rate!r} "
+                            "must be finite and positive")
+    parity = doc.get("parity")
+    if not isinstance(parity, dict):
+        problems.append("$.parity: end-to-end parity leg not recorded")
+    else:
+        delta = parity.get("loss_delta_rel")
+        if delta is None or _bad_pred_num(delta):
+            problems.append(
+                f"$.parity.loss_delta_rel: {delta!r} — the parity delta "
+                "must be recorded as a finite non-negative number")
+        if "tolerance" not in parity:
+            problems.append("$.parity.tolerance: declared tolerance band "
+                            "missing")
+    return problems

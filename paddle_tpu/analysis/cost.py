@@ -49,7 +49,8 @@ AUTODIFF_OP = "autodiff"
 
 __all__ = ["OpCost", "ProgramCost", "ChipSpec", "Prediction", "cost_entry",
            "op_cost", "program_cost", "chip_spec_for", "resolve_chip",
-           "predict_step", "roofline_step", "PEAK_TABLE"]
+           "predict_step", "roofline_step", "PEAK_TABLE",
+           "program_feed_bytes", "feed_wire_mbps"]
 
 
 # ---------------------------------------------------------------------------
@@ -165,10 +166,40 @@ def var_bytes(block, name, batch, amp=None) -> int:
 class _Ctx:
     """Bound helpers handed to cost entries."""
 
-    __slots__ = ("block", "batch", "amp")
+    __slots__ = ("block", "batch", "amp", "_wire_narrow")
 
     def __init__(self, block, batch, amp):
         self.block, self.batch, self.amp = block, batch, amp
+        self._wire_narrow = None
+
+    @property
+    def wire_narrow(self):
+        """{decoded-var name: wire dtype} for feed_dequant outputs
+        (data/codec.py). XLA fuses the elementwise dequant into each
+        consumer, so every read of the decoded batch is PHYSICALLY a
+        read of the narrow payload from HBM — pricing those reads at the
+        wire dtype models the fusion, the same way RESHAPE_ALIAS_OPS
+        zero-pricing models bitcasts. Lazily built once per walk."""
+        if self._wire_narrow is None:
+            wn = {}
+            for op in self.block.ops:
+                if op.type == "feed_dequant":
+                    try:
+                        x = self.block.var(op.inputs["X"][0])
+                    except KeyError:
+                        continue
+                    for out in op.output_names():
+                        wn[out] = str(x.dtype)
+                elif op.type in RESHAPE_ALIAS_OPS and op.inputs.get("X"):
+                    # bitcasts carry the fused narrow read through: a
+                    # flatten of the decoded batch is still the int8
+                    # payload in HBM
+                    src = wn.get(op.inputs["X"][0])
+                    if src is not None:
+                        for out in op.output_names():
+                            wn[out] = src
+            self._wire_narrow = wn
+        return self._wire_narrow
 
     def shape(self, name):
         return _shape(self.block, name, self.batch)
@@ -177,6 +208,9 @@ class _Ctx:
         return _prod(self.shape(name))
 
     def nbytes(self, name):
+        wire = self.wire_narrow.get(name)
+        if wire is not None:
+            return self.elems(name) * dtype_nbytes(wire)
         return var_bytes(self.block, name, self.batch, self.amp)
 
     def io_bytes(self, op, read_slots=None, write_slots=None):
@@ -249,13 +283,9 @@ _ELEMENTWISE_OPS = frozenset({
 })
 
 
-def op_cost(op, block, batch: int = 1, amp: Optional[str] = None) -> OpCost:
-    """Forward cost of one op. Ops without a registered entry are
-    modeled as pure elementwise traffic; covered=False only for op types
-    outside the curated elementwise/weighted tables."""
+def _op_cost_ctx(op, ctx: _Ctx) -> OpCost:
     if op.type in _FREE_OPS:
         return OpCost()
-    ctx = _Ctx(block, batch, amp)
     fn = _COST.get(op.type)
     if fn is not None:
         return fn(op, ctx)
@@ -265,6 +295,13 @@ def op_cost(op, block, batch: int = 1, amp: Optional[str] = None) -> OpCost:
     known = op.type in _VECTOR_WEIGHT or op.type in _ELEMENTWISE_OPS
     return OpCost(vector_flops=out_elems * weight, bytes_read=r,
                   bytes_written=w, covered=known)
+
+
+def op_cost(op, block, batch: int = 1, amp: Optional[str] = None) -> OpCost:
+    """Forward cost of one op. Ops without a registered entry are
+    modeled as pure elementwise traffic; covered=False only for op types
+    outside the curated elementwise/weighted tables."""
+    return _op_cost_ctx(op, _Ctx(block, batch, amp))
 
 
 # ---------------------------------------------------------------------------
@@ -384,6 +421,20 @@ def _paged_write_cost(op, ctx):
     return OpCost(bytes_read=row_bytes + idx, bytes_written=row_bytes)
 
 
+@cost_entry("feed_dequant")
+def _feed_dequant_cost(op, ctx):
+    # the wire-codec boundary (data/codec.py): reads the feed at its
+    # RECORDED wire dtype (int8/bf16 — that is the whole point) plus the
+    # tiny scale. The decoded output — and every downstream read of it —
+    # is priced at the wire dtype too (ctx.wire_narrow): XLA fuses the
+    # elementwise dequant into its consumers, so the f32 batch never
+    # round-trips HBM as its own buffer. ~2 vector flops/element
+    # (cast + scale multiply).
+    r, w = ctx.io_bytes(op)
+    return OpCost(vector_flops=2 * ctx.elems(op.outputs["Out"][0]),
+                  bytes_read=r, bytes_written=w)
+
+
 @cost_entry("lookup_table")
 def _lookup_cost(op, ctx):
     ids = ctx.elems(op.inputs["Ids"][0])
@@ -455,11 +506,12 @@ def program_cost(program: Optional[Program] = None, batch: int = 1,
     remat_mxu = 0
     per_op: List[Tuple[int, str, OpCost]] = []
     uncovered: List[str] = []
-    for i, op in enumerate(block.ops):
+    ctx = _Ctx(block, batch, amp)  # one walk context: the wire-narrow
+    for i, op in enumerate(block.ops):  # map builds once, not per op
         if op.type == AUTODIFF_OP:
             continue
         try:
-            c = op_cost(op, block, batch, amp)
+            c = _op_cost_ctx(op, ctx)
         except KeyError:
             # var pruned/renamed (cloned program slices): skip that op
             continue
@@ -585,6 +637,15 @@ class Prediction:
     predicted_mfu: float
     bound: str
     chip: str
+    #: bytes one step's feeds push through the host->device pipe, at the
+    #: feeds' RECORDED dtype — the wire dtype for codec-rewritten
+    #: programs (data/codec.py), so the model sees the codec's win
+    #: before it is measured
+    feed_wire_bytes: int = 0
+    #: the host-pipe leg: feed_wire_bytes / PT_FEED_WIRE_MBPS (0 when
+    #: the knob is unset — co-located hosts upload at PCIe rates and the
+    #: leg vanishes under the device legs)
+    t_feed_ms: float = 0.0
 
     def to_dict(self) -> dict:
         return {
@@ -596,7 +657,52 @@ class Prediction:
             "predicted_step_ms": round(self.predicted_step_ms, 4),
             "predicted_mfu": round(self.predicted_mfu, 4),
             "bound": self.bound, "chip": self.chip,
+            "feed_wire_bytes": int(self.feed_wire_bytes),
+            "t_feed_ms": round(self.t_feed_ms, 4),
         }
+
+
+def program_feed_bytes(program: Optional[Program] = None,
+                       batch: int = 1) -> int:
+    """Bytes one step's feeds push through the host->device pipe, at
+    each feed's RECORDED dtype — the wire dtype for codec-rewritten
+    programs (data/codec.py apply_wire_codec), and deliberately NOT the
+    AMP device dtype: the entry cast happens on device, after the wire.
+    Paged KV pools are device-resident (fetch->feed threading) and never
+    cross the pipe, so they are excluded like memory.py's feed
+    breakdown."""
+    program = program or default_main_program()
+    block = program.global_block
+    pool_names = set()
+    for op in block.ops:
+        if op.type in ("paged_attention", "paged_kv_write"):
+            for slot in ("KPool", "VPool"):
+                pool_names.update(op.inputs.get(slot, ()))
+    total = 0
+    for v in block.vars.values():
+        if getattr(v, "is_data", False) and v.name not in pool_names:
+            try:
+                total += _prod(_shape(block, v.name, batch)) \
+                    * dtype_nbytes(v.dtype)
+            except KeyError:
+                continue
+    return total
+
+
+def feed_wire_mbps() -> float:
+    """PT_FEED_WIRE_MBPS: the modeled host->device pipe rate in MB/s
+    (0/unset = pipe not modeled — the feed leg drops out). Lets a
+    thin-pipe rig (the r05 ~15 MB/s tunnel) see the codec's win in
+    predict_step before measuring it."""
+    raw = os.environ.get("PT_FEED_WIRE_MBPS", "").strip()
+    if not raw:
+        return 0.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f"malformed PT_FEED_WIRE_MBPS={raw!r}: not a "
+                         "number of MB/s") from None
+    return v if v > 0 else 0.0
 
 
 def predict_step(program: Optional[Program] = None, batch: int = 1,
@@ -605,13 +711,19 @@ def predict_step(program: Optional[Program] = None, batch: int = 1,
                  comm_report=None) -> Prediction:
     """Roofline prediction for one step of block 0.
 
-    The three legs overlap on real hardware (XLA's latency-hiding
+    The device legs overlap on real hardware (XLA's latency-hiding
     scheduler), so the step estimate is the MAX, and the bound is the
     leg that set it. predicted_mfu = model_flops / (t * peak) is <= the
     hardware ceiling by construction. With a mesh, per-device flops and
     bytes divide by the device count and comm comes from the collective
     audit (comm.py); pass an already-computed `comm_report` (CommReport)
     to reuse it instead of re-auditing.
+
+    Under PT_FEED_WIRE_MBPS a fourth leg models the host->device feed
+    pipe at the feeds' wire dtype (program_feed_bytes): when it sets the
+    max, the declared bound is `host` — the thin-pipe reading BENCH r05
+    measured, now predicted. Unset, the leg is 0 and predictions are
+    byte-identical to before.
     """
     chip = chip or resolve_chip()
     pc = program_cost(program, batch=batch, train=train)
@@ -636,9 +748,22 @@ def predict_step(program: Optional[Program] = None, batch: int = 1,
     t_comm = comm_bytes / (chip.ici_gbps * 1e9)
     t_compute, t_hbm, t, bound, mfu = roofline_step(
         mxu, hbm, pc.train.mxu_flops, n_dev, chip, t_comm)
+    feed_bytes = program_feed_bytes(program, batch=batch)
+    mbps = feed_wire_mbps()
+    t_feed = feed_bytes / (mbps * 1e6) if mbps else 0.0
+    if t_feed > t:
+        # the pipe is one serial host leg (not per-device): when it
+        # dominates even the overlapped device legs, the step is
+        # host-bound and MFU re-derives against the longer step
+        t = t_feed
+        bound = "host"
+        mfu = min((pc.train.mxu_flops / n_dev) / (t * chip.peak_flops),
+                  1.0)
     return Prediction(flops=flops, hbm_bytes=hbm, comm_bytes=comm_bytes,
                       t_compute_ms=t_compute * 1e3,
                       t_bandwidth_ms=t_hbm * 1e3, t_comm_ms=t_comm * 1e3,
                       predicted_step_ms=t * 1e3,
                       predicted_mfu=mfu, bound=bound,
-                      chip=chip.name)
+                      chip=chip.name,
+                      feed_wire_bytes=feed_bytes,
+                      t_feed_ms=t_feed * 1e3)

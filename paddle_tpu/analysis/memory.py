@@ -13,7 +13,11 @@ resident, WITHOUT compiling anything:
                         value some backward rule needs, minus what remat
                         segments recompute instead of save
   * kv_pools          — paged decode KV pools (KPool/VPool slots)
-  * feeds             — per-step input arrays
+  * feeds             — per-step input arrays, priced at each feed's
+                        RECORDED dtype — which for wire-codec programs
+                        (data/codec.py apply_wire_codec) is the narrow
+                        wire dtype, so the estimate sees the codec's
+                        resident-feed saving for free
 
 The estimate is cross-checked against `tools/remat_memory_report.py`'s
 compiled `memory_analysis()` artifacts (docs/artifacts/remat_memory_*)
@@ -88,6 +92,9 @@ _SAVES_NOTHING = frozenset({
     "reduce_mean", "sum", "fill_constant", "dropout", "pool2d",
     "embedding", "one_hot", "top_k", "accuracy", "assign", "shape",
     "pad", "pad2d", "uniform_random", "gaussian_random",
+    # wire-codec dequant (data/codec.py): its inputs are stop-gradient
+    # feeds — the backward needs nothing from it
+    "feed_dequant",
 })
 
 

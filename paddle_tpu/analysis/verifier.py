@@ -509,6 +509,86 @@ def _check_sharding(program: Program, ctx: _Ctx) -> List[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# pass 6: on-wire feed codec boundary
+# ---------------------------------------------------------------------------
+
+@verifier_pass("wire-codec")
+def _check_wire_codec(program: Program, ctx: _Ctx) -> List[Diagnostic]:
+    """The dtype-narrowed feed boundary (data/codec.py apply_wire_codec):
+    a wire-codec var's recorded dtype must BE its policy's wire dtype
+    (the executor feeds it encoded and the feed_dequant op recovers f32
+    in-trace), int8 dequants must carry their f32 scale companion, and
+    the policy itself must be known. dtype-prop separately re-derives
+    the decoded var's dtype through feed_dequant's infer fn — together
+    the two passes pin both sides of the boundary."""
+    from ..core.types import CODEC_SCALE_SUFFIX, WIRE_DTYPES
+
+    diags: List[Diagnostic] = []
+    block = program.global_block
+    for v in block.vars.values():
+        pol = getattr(v, "wire_codec", None)
+        if not pol:
+            continue
+        wdt = WIRE_DTYPES.get(pol)
+        if wdt is None:
+            diags.append(Diagnostic(
+                ERROR, "wire-codec-policy",
+                f"var {v.name!r} declares unknown wire codec {pol!r} "
+                f"(know {sorted(WIRE_DTYPES)})", block.idx, None, None,
+                v.name))
+            continue
+        if str(v.dtype) != wdt:
+            diags.append(Diagnostic(
+                ERROR, "wire-dtype-mismatch",
+                f"var {v.name!r} declares wire codec {pol!r} (wire dtype "
+                f"{wdt}) but records dtype {v.dtype} — the executor would "
+                "encode to a dtype the compiled step does not expect",
+                block.idx, None, None, v.name))
+    for i, op in enumerate(block.ops):
+        if op.type != "feed_dequant":
+            continue
+        pol = str(op.attrs.get("policy", "none"))
+        wdt = WIRE_DTYPES.get(pol)
+        if wdt is None and pol != "none":
+            diags.append(Diagnostic(
+                ERROR, "wire-codec-policy",
+                f"feed_dequant declares unknown policy {pol!r}",
+                block.idx, i, op.type))
+            continue
+        if pol == "int8":
+            scales = op.inputs.get("Scale", [])
+            if not scales:
+                diags.append(Diagnostic(
+                    ERROR, "wire-scale-missing",
+                    "int8 feed_dequant has no Scale input — per-channel "
+                    "dequantization is impossible without it",
+                    block.idx, i, op.type))
+            else:
+                try:
+                    sv = block.var(scales[0])
+                except KeyError:
+                    sv = None
+                if sv is not None and str(sv.dtype) != "float32":
+                    diags.append(Diagnostic(
+                        ERROR, "wire-scale-dtype",
+                        f"dequant scale {scales[0]!r} must be float32, "
+                        f"got {sv.dtype}", block.idx, i, op.type,
+                        scales[0]))
+        # suffix convention: the executor materializes '<x>__codec_scale'
+        # beside a host-encoded feed — a differently-named scale would
+        # never be auto-fed
+        for n in op.inputs.get("Scale", []):
+            if not n.endswith(CODEC_SCALE_SUFFIX):
+                diags.append(Diagnostic(
+                    WARNING, "wire-scale-name",
+                    f"dequant scale {n!r} does not follow the "
+                    f"'<feed>{CODEC_SCALE_SUFFIX}' naming — the executor "
+                    "only auto-feeds the conventional name",
+                    block.idx, i, op.type, n))
+    return diags
+
+
+# ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
 

@@ -192,6 +192,47 @@ class Executor(TimedExecutorMixin):
                     out[seq_len_name] = jnp.full((arr0.shape[0],),
                                                  arr0.shape[1], np.int32)
 
+            # on-wire feed codec (data/codec.py apply_wire_codec): the
+            # var's recorded dtype IS the wire dtype and the dequant is
+            # traced into the step. A raw float feed is host-encoded HERE
+            # — before device_put — so the bytes that cross the pipe are
+            # the compact ones; an already-encoded feed (the pipeline's
+            # encode stage) falls through to the normal dtype check.
+            wire = getattr(var, "wire_codec", None) if var is not None \
+                else None
+            if wire:
+                from ..data import codec as _codec
+                from .types import CODEC_SCALE_SUFFIX
+                want_wire = np_dtype(device_dtype(var.dtype))
+                if not isinstance(val, jax.Array):
+                    # never a device value: guarded by the jax.Array check
+                    arr = np.asarray(val)  # host-sync: ok — host feed
+                    if arr.dtype != want_wire:
+                        # any not-yet-encoded host batch is encoded here:
+                        # f32/f64 directly, integer pixel batches (uint8
+                        # images that used to cast to the f32 var dtype)
+                        # via f32 — a bare astype to int8 would wrap
+                        # 128..255 into garbage
+                        if not np.issubdtype(arr.dtype, np.floating):
+                            arr = arr.astype(np.float32)
+                        payload, scale = _codec.encode_array(arr, wire)
+                        out[name] = jnp.asarray(payload)
+                        sname = name + CODEC_SCALE_SUFFIX
+                        if scale is not None and sname not in feed:
+                            out[sname] = jnp.asarray(scale)
+                        continue
+                elif (val.dtype != jnp.dtype(want_wire)
+                        and str(var.dtype) not in ("bfloat16", "float16")):
+                    # a raw batch already uploaded (f32, uint8 pixels…):
+                    # the wire saving is forfeit and an astype to int8
+                    # would be garbage — refuse loudly instead of
+                    # corrupting the feed (bf16 wire vars are exempt:
+                    # the widening astype is lossless there)
+                    raise ValueError(
+                        f"feed {name!r} declares wire codec {wire!r} but "
+                        f"arrived as an already-uploaded {val.dtype} "
+                        "array — encode on the host (data/codec.py, or "
+                        "feed numpy and the executor encodes for you)")
             if isinstance(val, jax.Array):
                 # already on device (double-buffer prefetch, reader/prefetch
                 # .py) — never round-trip through host numpy

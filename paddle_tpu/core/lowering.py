@@ -443,9 +443,15 @@ def build_step_fn(program: Program, feed_names: Sequence[str],
             # AMP entry casts: float32 feeds run in the compute dtype, so the
             # whole activation path is low-precision; params are cast inside
             # the differentiated forward (run_block_with_autodiff) so their
-            # f32 masters keep receiving f32 grads.
+            # f32 masters keep receiving f32 grads. Wire-codec scale
+            # companions (data/codec.py) are exempt: the feed_dequant op
+            # consumes them at f32 and lands the decoded batch directly at
+            # the compute dtype — truncating the scales would double-quantize.
+            from .types import CODEC_SCALE_SUFFIX
             adt = jnp.dtype(program.amp_dtype)
             for k in feed:
+                if k.endswith(CODEC_SCALE_SUFFIX):
+                    continue
                 if jnp.result_type(env[k]) == jnp.float32:
                     env[k] = env[k].astype(adt)
         env = run_block_with_autodiff(block, env, ctx)

@@ -166,7 +166,7 @@ class VarDesc:
         "name", "shape", "dtype", "kind", "persistable", "is_parameter",
         "stop_gradient", "lod_level", "initializer", "trainable", "regularizer",
         "need_clip", "is_data", "optimize_attr", "gradient_clip_attr",
-        "sharding", "seq_len_var",
+        "sharding", "seq_len_var", "wire_codec",
     )
 
     def __init__(self, name: str, shape: Sequence[int] = (), dtype: str = "float32",
@@ -200,6 +200,13 @@ class VarDesc:
         # ragged-sequence support (LoD parity, lod_tensor.h:58): padded
         # sequence vars carry the name of their [B] length companion var.
         self.seq_len_var = None
+        # on-wire feed codec policy (data/codec.py apply_wire_codec):
+        # set on a data var whose recorded dtype IS the wire dtype and
+        # whose f32 value is recovered by a traced feed_dequant op. The
+        # executor host-encodes raw float feeds for such vars. Serialized
+        # (the is_data lesson): a clone that forgot it would make the
+        # executor coerce raw f32 feeds to int8 by astype — garbage.
+        self.wire_codec = None
 
     def to_dict(self) -> dict:
         return {
@@ -210,6 +217,7 @@ class VarDesc:
             "is_data": self.is_data,
             "sharding": list(self.sharding) if self.sharding is not None else None,
             "seq_len_var": self.seq_len_var,
+            "wire_codec": self.wire_codec,
         }
 
     @staticmethod
@@ -222,6 +230,7 @@ class VarDesc:
         sh = d.get("sharding")
         v.sharding = tuple(sh) if sh is not None else None
         v.seq_len_var = d.get("seq_len_var")
+        v.wire_codec = d.get("wire_codec")
         return v
 
     def __repr__(self):

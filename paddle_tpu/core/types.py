@@ -65,6 +65,38 @@ def is_floating(dtype: str) -> bool:
     return dtype in ("float32", "float64", "bfloat16", "float16")
 
 
+# --- on-wire feed codec (data/codec.py) ------------------------------------
+# The host->device feed pipe is the measured bottleneck on thin-pipe rigs
+# (BENCH r05: ~15 MB/s tunnel caps real-data training at 245 img/s), so
+# batches may cross the wire ENCODED and dequantize on device. These two
+# facts live here — not in data/codec.py — because the core layers
+# (executor feed prep, lowering's AMP entry cast, the feed_dequant op)
+# must know them without importing the data package.
+
+#: codec policy -> the dtype that actually crosses the host->device wire.
+#: "none" = raw passthrough; "bf16" = truncate f32 to bfloat16 (2x fewer
+#: bytes); "int8" = per-channel symmetric int8 (4x, plus a tiny f32 scale
+#: companion per channel).
+WIRE_DTYPES = {"none": None, "bf16": "bfloat16", "int8": "int8"}
+
+#: name suffix of the per-channel scale companion feed that rides beside
+#: an int8-encoded feed. The lowering exempts these from the AMP entry
+#: cast (dequant scales must stay f32) and the executor materializes them
+#: when it host-encodes a raw feed.
+CODEC_SCALE_SUFFIX = "__codec_scale"
+
+
+def wire_dtype_of(policy: str) -> str:
+    """Wire dtype for a codec policy; raises on unknown policies so a
+    typo'd PT_FEED_CODEC fails loudly instead of silently passing raw."""
+    try:
+        return WIRE_DTYPES[policy]
+    except KeyError:
+        raise ValueError(
+            f"unknown feed-codec policy {policy!r} "
+            f"(know {sorted(WIRE_DTYPES)})") from None
+
+
 # Variable kinds — the subset of the reference's VarType::Type that survives
 # the move to a functional runtime. LOD_TENSOR/SELECTED_ROWS collapse into
 # DENSE (ragged sequences are dense values + explicit length/offset vars,

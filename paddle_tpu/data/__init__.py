@@ -7,6 +7,7 @@
             .shuffle(buf_size=1024, seed=7)
             .batch(128, drop_last=True)
             .map_batches(decode_fn, workers=4)   # parallel decode
+            .encode("int8")                # on-wire codec (thin pipes)
             .augment(data.Augment(crop=224, flip_lr=True))
             .device_prefetch(capacity=2)
             .named("train"))
@@ -21,8 +22,10 @@ operator-facing overview.
 
 from .pipeline import Dataset
 from .augment import Augment
+from .codec import FeedCodec, apply_wire_codec
 from .metrics import (PipelineMetrics, register, unregister,
                       registry_snapshots)
 
-__all__ = ["Dataset", "Augment", "PipelineMetrics", "register",
-           "unregister", "registry_snapshots"]
+__all__ = ["Dataset", "Augment", "FeedCodec", "apply_wire_codec",
+           "PipelineMetrics", "register", "unregister",
+           "registry_snapshots"]

@@ -68,16 +68,26 @@ class Augment:
             raise ValueError("pad without crop has no effect: pass "
                              "crop=<output size> (crop == input size + "
                              "pad > 0 gives random shifts)")
-        self._fn = None  # jitted lazily: jax import stays off module load
+        # one compiled program per codec policy (none/bf16/int8): the
+        # wire codec's dequant fuses INTO the augmentation trace, so an
+        # encoded batch is decoded and cropped/flipped/normalized by a
+        # single device dispatch — the f32 batch never exists on the host
+        # side of the pipe. Lazy: jax import stays off module load.
+        self._fns = {}
 
-    def _build(self):
+    def _build(self, codec):
         import jax
         import jax.numpy as jnp
+        from .codec import decode_array
 
         crop, pad, flip_lr = self.crop, self.pad, self.flip_lr
         normalize, seed = self.normalize, self.seed
+        policy = codec.policy if codec is not None else "none"
+        out_dtype = codec.out_dtype if codec is not None else "float32"
 
-        def apply(x, epoch_cursor):
+        def apply(x, scale, epoch_cursor):
+            if policy != "none":
+                x = decode_array(x, scale, policy, out_dtype)
             key = jax.random.fold_in(
                 jax.random.fold_in(jax.random.PRNGKey(seed),
                                    epoch_cursor[0]), epoch_cursor[1])
@@ -118,16 +128,49 @@ class Augment:
                 x = (x - mean) * inv
             return x
 
-        self._fn = jax.jit(apply)
+        self._fns[policy] = jax.jit(apply)
 
-    def __call__(self, batch: dict, cursor: int, epoch: int = 0) -> dict:
-        if self._fn is None:
-            self._build()
+    def __call__(self, batch: dict, cursor: int, epoch: int = 0,
+                 codec=None) -> dict:
+        """codec: the upstream encode stage's FeedCodec (wired by the
+        pipeline) — selects the fused dequant+augment program and
+        consumes the image's scale companion. Other encoded entries
+        (non-image keys) are decoded by the codec's own traced call."""
+        from .codec import SCALE_SUFFIX
         x = batch[self.image_key]
+        scale_key = self.image_key + SCALE_SUFFIX
+        scale = batch.get(scale_key)
+        # fuse the dequant ONLY when the image entry was actually encoded
+        # (int8 ships its scale companion; bf16 shows as the dtype) — a
+        # codec governing other keys (keys=["aux"]) must not dequantize a
+        # raw image
+        policy = codec.policy if codec is not None else "none"
+        if policy == "int8" and scale is None:
+            policy = "none"
+        elif policy == "bf16" and str(getattr(x, "dtype", "")) != "bfloat16":
+            policy = "none"
+        if policy not in self._fns:
+            self._build(codec if policy != "none" else None)
+        if scale is None:
+            # 0-size placeholder keeps the jit signature uniform for
+            # scale-less policies — never read inside the trace
+            scale = np.zeros((0,), np.float32)
         # the counter rides as a tiny uint32 array: values stay out of the
         # jit cache key, so every batch reuses one compiled program
         ec = np.asarray([epoch & 0xFFFFFFFF, cursor & 0xFFFFFFFF],
                         np.uint32)
-        out = dict(batch)
-        out[self.image_key] = self._fn(x, ec)
+        out = {k: v for k, v in batch.items() if k != scale_key}
+        out[self.image_key] = self._fns[policy](x, scale, ec)
+        if codec is not None and codec.policy != "none":
+            # non-image encoded entries (rare: a second float feed) still
+            # need their decode; the codec skips the already-decoded image
+            rest = {k: v for k, v in out.items() if k != self.image_key}
+            need = any(k.endswith(SCALE_SUFFIX) for k in rest) or (
+                codec.policy == "bf16"
+                and any(str(getattr(v, "dtype", "")) == "bfloat16"
+                        for v in rest.values()))
+            if need:
+                rest = codec.decode_batch(rest)
+                rest[self.image_key] = out[self.image_key]
+                out = rest
         return out

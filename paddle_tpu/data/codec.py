@@ -1,0 +1,328 @@
+"""On-wire feed codec: batches cross the host->device pipe encoded.
+
+PR 8 moved decode off the consumer thread and BENCH r05 moved the
+bottleneck with it: the host now decodes 9496 img/s but the ~15 MB/s
+host->device upload pipe delivers only 245 img/s of training — the WIRE,
+not the CPU, governs real-data throughput on thin-pipe rigs. EQuARX
+(PAPERS.md) showed that aggressive quantization of on-wire bytes with
+negligible quality loss is TPU-idiomatic for collectives; this module
+applies the same economics to the input plane: the pipeline's terminal
+batches are ENCODED on the host (int8 per-channel / bf16-truncated /
+raw), cross the wire compact, and dequantize on device inside the
+already-jitted augmentation call — the decoded f32 batch never rides
+the pipe.
+
+Policies (PT_FEED_CODEC, or per-stage ``Dataset.encode(policy=...)``):
+
+    none   raw passthrough (the identity codec; ratio 1x)
+    bf16   truncate float32 to bfloat16 on host, upcast on device
+           (2x fewer wire bytes; bf16 is the device compute dtype under
+           AMP anyway, so parity is exact for bf16 programs)
+    int8   per-channel symmetric int8: q = clip(round(x / s), -127, 127)
+           with s[c] = amax(|x[:, c]|) / 127 computed per batch, the
+           scale riding beside the payload as a tiny f32 companion feed
+           (``<name>__codec_scale``). ~4x fewer wire bytes; LOSSY by
+           design — input-quantization parity is a calibrated tolerance
+           band, not bit-exactness (values already ON the quantization
+           grid round-trip exactly, which is what the determinism tests
+           pin).
+
+Two decode sites, one codec:
+
+  * pipeline path — ``Dataset.encode(...)`` encodes post-decode batches;
+    the device-side decode fuses into the Augment jitted call (or a
+    dedicated decode transform in the device_prefetch upload thread), so
+    the executor sees ordinary f32/bf16 feeds and no program changes.
+  * program path — ``apply_wire_codec(program)`` rewrites the program
+    itself: data vars narrow to the wire dtype, a ``feed_dequant`` op is
+    traced in at the feed boundary, and the executor host-encodes any
+    raw float feed it receives (core/executor.py). The static layers see
+    the win before it is measured: cost.py prices feed bytes at the wire
+    dtype (the PT_FEED_WIRE_MBPS roofline leg) and memory.py's feed
+    breakdown shrinks with the recorded dtype.
+
+Determinism contract: encoding is a pure function of the batch, so an
+``encode`` stage composes with shard/shuffle/batch without touching the
+iter_from/set_epoch/state machinery — skips are claimed upstream in raw
+batch units, which ARE encoded units (encode is strictly 1:1), and a
+resumed stream re-encodes bit-identically.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from ..core.types import CODEC_SCALE_SUFFIX, WIRE_DTYPES, wire_dtype_of
+
+__all__ = ["POLICIES", "SCALE_SUFFIX", "FeedCodec", "policy_from_env",
+           "encode_array", "decode_array", "apply_wire_codec",
+           "raw_nbytes"]
+
+POLICIES = tuple(WIRE_DTYPES)
+SCALE_SUFFIX = CODEC_SCALE_SUFFIX
+
+#: int8 symmetric range: +-127 keeps the grid symmetric around 0 (the
+#: -128 slot is never produced, matching the EQuARX-style convention)
+_QMAX = 127.0
+
+
+def policy_from_env() -> str:
+    """PT_FEED_CODEC -> policy string (default 'none'); unknown values
+    raise so a typo cannot silently ship raw f32 over a thin pipe."""
+    raw = os.environ.get("PT_FEED_CODEC", "").strip().lower()
+    if raw in ("", "0", "off"):
+        return "none"
+    wire_dtype_of(raw)  # validates
+    return raw
+
+
+def _channel_axis(ndim: int) -> int:
+    """The per-channel scale axis: dim 1 of NCHW/NC* batches (the repo's
+    channel position), the whole tensor for rank-0/1."""
+    return 1 if ndim >= 2 else 0
+
+
+def _scale_shape(shape) -> Tuple[int, ...]:
+    return (int(shape[_channel_axis(len(shape))]),) if len(shape) else (1,)
+
+
+def encode_array(x: np.ndarray, policy: str
+                 ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Host-side encode of one float array -> (payload, scale|None).
+
+    int8: per-channel symmetric quantization (channel = axis 1 for
+    rank >= 2, whole-tensor otherwise). All-zero channels get scale 1.0
+    so the dequant never divides by zero. bf16: dtype truncation, no
+    scale. none: identity.
+    """
+    if policy == "none":
+        return x, None
+    x = np.asarray(x)
+    if policy == "bf16":
+        import ml_dtypes
+        return x.astype(ml_dtypes.bfloat16), None
+    if policy == "int8":
+        ax = _channel_axis(x.ndim)
+        reduce_axes = tuple(i for i in range(x.ndim) if i != ax)
+        amax = np.max(np.abs(x.astype(np.float32)), axis=reduce_axes) \
+            if x.ndim else np.abs(np.float32(x))
+        amax = np.atleast_1d(np.asarray(amax, np.float32))
+        scale = np.where(amax > 0, amax / _QMAX, np.float32(1.0))
+        bshape = [1] * x.ndim
+        if x.ndim:
+            bshape[ax] = scale.shape[0]
+        q = np.clip(np.rint(x.astype(np.float32)
+                            / scale.reshape(bshape)), -_QMAX, _QMAX)
+        return q.astype(np.int8), scale.astype(np.float32)
+    raise ValueError(f"unknown feed-codec policy {policy!r} "
+                     f"(know {sorted(WIRE_DTYPES)})")
+
+
+def decode_array(q, scale, policy: str, out_dtype: str = "float32"):
+    """Traced device-side decode (jax): the exact inverse of
+    encode_array up to quantization loss. Callable inside jit — this is
+    the body the augment call and the feed_dequant op share."""
+    import jax.numpy as jnp
+    dt = jnp.dtype(out_dtype)
+    if policy == "none":
+        return q if q.dtype == dt else q.astype(dt)
+    if policy == "bf16":
+        return q.astype(dt)
+    if policy == "int8":
+        bshape = [1] * q.ndim
+        if q.ndim:
+            bshape[_channel_axis(q.ndim)] = scale.shape[0]
+        return q.astype(dt) * scale.reshape(bshape).astype(dt)
+    raise ValueError(f"unknown feed-codec policy {policy!r}")
+
+
+def raw_nbytes(batch: Dict[str, np.ndarray]) -> int:
+    """Total payload bytes of a feed-dict batch — on an encoded batch
+    this IS the on-wire byte count (the encode stage's accounting)."""
+    return sum(int(getattr(v, "nbytes", 0)) for v in batch.values())
+
+
+class FeedCodec:
+    """One pipeline's codec: policy + which feed-dict keys it applies to.
+
+    keys=None (default) encodes every floating-dtype entry; integer
+    entries (labels, ids) always pass through. ``decode_batch`` is the
+    traced device-side inverse, jitted once per (shape, dtype) signature
+    — the compiled-program-per-policy contract the augment fusion keys
+    on.
+    """
+
+    def __init__(self, policy: Optional[str] = None,
+                 keys: Optional[Iterable[str]] = None,
+                 out_dtype: str = "float32"):
+        self.policy = policy if policy is not None else policy_from_env()
+        wire_dtype_of(self.policy)  # validate eagerly
+        self.keys = tuple(keys) if keys is not None else None
+        self.out_dtype = out_dtype
+        self._decode_jit = None
+
+    def _applies(self, key: str, val) -> bool:
+        if key.endswith(SCALE_SUFFIX):
+            return False
+        if self.keys is not None:
+            return key in self.keys
+        dt = getattr(val, "dtype", None)
+        return dt is not None and np.issubdtype(np.dtype(dt), np.floating)
+
+    # -- host side -----------------------------------------------------------
+    def encode_batch(self, batch: Dict[str, np.ndarray]
+                     ) -> Dict[str, np.ndarray]:
+        """Encode the governed entries of one feed-dict batch; scale
+        companions ride as ``<key>__codec_scale``. Non-dict batches and
+        the 'none' policy pass through untouched."""
+        if self.policy == "none" or not isinstance(batch, dict):
+            return batch
+        out = {}
+        for k, v in batch.items():
+            if not self._applies(k, v):
+                out[k] = v
+                continue
+            payload, scale = encode_array(np.asarray(v), self.policy)
+            out[k] = payload
+            if scale is not None:
+                out[k + SCALE_SUFFIX] = scale
+        return out
+
+    # -- device side ---------------------------------------------------------
+    def _build_decode(self):
+        import jax
+
+        policy, out_dtype = self.policy, self.out_dtype
+
+        keys = self.keys
+
+        def decode(batch):
+            out = {}
+            for k, v in batch.items():
+                if k.endswith(SCALE_SUFFIX):
+                    continue  # consumed by its payload entry below
+                if keys is not None and k not in keys:
+                    out[k] = v
+                    continue
+                if policy == "int8":
+                    s = batch.get(k + SCALE_SUFFIX)
+                    # no scale companion = the entry was never encoded
+                    # (integer labels under keys=None)
+                    out[k] = v if s is None else decode_array(
+                        v, s, "int8", out_dtype)
+                else:  # bf16: upcast exactly the truncated entries
+                    enc = str(getattr(v, "dtype", "")) == "bfloat16"
+                    out[k] = decode_array(v, None, "bf16", out_dtype) \
+                        if enc else v
+            return out
+
+        self._decode_jit = jax.jit(decode)
+
+    def decode_batch(self, batch: Dict[str, object]) -> Dict[str, object]:
+        """Device-side decode of one (already uploaded) encoded batch:
+        ONE jitted call covering every governed key, scale companions
+        consumed. The identity for policy 'none'."""
+        if self.policy == "none" or not isinstance(batch, dict):
+            return batch
+        if self._decode_jit is None:
+            self._build_decode()
+        return dict(self._decode_jit(batch))
+
+    def __repr__(self):
+        return f"FeedCodec({self.policy!r})"
+
+
+# ---------------------------------------------------------------------------
+# program-level wire codec: the dequant traced INTO the step
+# ---------------------------------------------------------------------------
+
+def apply_wire_codec(program, policy: Optional[str] = None,
+                     feeds: Optional[Iterable[str]] = None):
+    """Rewrite `program` in place so its float feeds cross the wire
+    encoded and dequantize inside the compiled step.
+
+    For every governed data var: the var's recorded dtype narrows to the
+    policy's wire dtype (``VarDesc.wire_codec`` marks the boundary), a
+    ``feed_dequant`` op is prepended producing ``<name>__decoded`` at the
+    original dtype, every consumer is rewritten onto the decoded name,
+    and (int8) a tiny f32 per-channel scale companion feed is declared.
+    The executor host-encodes raw float feeds it receives for such vars
+    (core/executor.py), so existing training loops work unchanged — the
+    bytes that cross host->device are the encoded ones.
+
+    The static layers see the narrowing immediately: the verifier's
+    dtype-prop pass checks the boundary through feed_dequant's infer fn,
+    cost.py prices feed traffic at the wire dtype (predict_step's
+    PT_FEED_WIRE_MBPS leg), and memory.py's feeds breakdown shrinks.
+
+    Returns the list of rewritten feed names. policy=None reads
+    PT_FEED_CODEC; 'none' is a no-op returning [].
+    """
+    policy = policy if policy is not None else policy_from_env()
+    wdt = wire_dtype_of(policy)
+    if wdt is None:
+        return []
+    block0 = program.global_block
+    want = set(feeds) if feeds is not None else None
+    targets = []
+    satisfied = set()
+    for v in list(block0.vars.values()):
+        if not getattr(v, "is_data", False):
+            continue
+        if want is not None and v.name not in want:
+            continue
+        existing = getattr(v, "wire_codec", None)
+        if existing:
+            # already rewritten (idempotent) — but an explicit ask for a
+            # DIFFERENT policy is a conflict, not a no-op
+            if want is not None and existing != policy:
+                raise ValueError(
+                    f"apply_wire_codec: feed {v.name!r} already carries "
+                    f"wire codec {existing!r}; cannot re-encode as "
+                    f"{policy!r}")
+            satisfied.add(v.name)
+            continue
+        if str(v.dtype) != "float32":
+            continue  # integer feeds / length companions pass through
+        if v.name.endswith(SCALE_SUFFIX):
+            continue
+        targets.append(v)
+    if want is not None:
+        missing = want - {v.name for v in targets} - satisfied
+        if missing:
+            raise ValueError(
+                f"apply_wire_codec: {sorted(missing)} are not float32 "
+                "data vars of this program")
+    for v in targets:
+        orig_dtype = str(v.dtype)
+        dec_name = v.name + "__decoded"
+        dec = block0.create_var(dec_name, shape=v.shape, dtype=orig_dtype)
+        dec.stop_gradient = True
+        # every consumer (any block: control-flow sub-blocks may read the
+        # feed) now reads the decoded value
+        for b in program.blocks:
+            for op in b.ops:
+                for slot, names in op.inputs.items():
+                    if v.name in names:
+                        op.inputs[slot] = [dec_name if n == v.name else n
+                                           for n in names]
+        v.dtype = wdt
+        v.wire_codec = policy
+        inputs = {"X": v.name}
+        if policy == "int8":
+            sv = block0.create_var(v.name + SCALE_SUFFIX,
+                                   shape=_scale_shape(v.shape),
+                                   dtype="float32")
+            sv.is_data = True
+            sv.stop_gradient = True
+            # explicit do-not-shard fact: a [C] scale must replicate, not
+            # ride the ParallelExecutor's default dim-0 dp feed split
+            sv.sharding = (None,)
+            inputs["Scale"] = sv.name
+        block0.prepend_op("feed_dequant", inputs, {"Out": dec_name},
+                          {"policy": policy, "out_dtype": orig_dtype})
+    program.invalidate_cache()
+    return [v.name for v in targets]

@@ -13,6 +13,11 @@ Stages and their meaning:
                 summed across workers. occupancy = busy / (window x
                 workers): 1.0 means every worker decoded flat-out — add
                 workers or move work on-device.
+    encode      seconds spent host-encoding batches for the wire
+                (data/codec.py int8/bf16 policies). The stage also feeds
+                the wire accounting below: raw vs on-wire bytes and
+                their ratio, exported as pt_data_wire_bytes /
+                pt_data_codec_ratio.
     queue_wait  seconds the pipeline's CONSUMER blocked waiting for the
                 next decoded batch. occupancy ~1.0 = input-bound (the
                 device idles on data); ~0.0 = the pipeline outruns its
@@ -40,7 +45,7 @@ __all__ = ["PipelineMetrics", "STAGES", "register", "unregister",
            "registry_snapshots"]
 
 #: the stage axis, in pipeline order
-STAGES = ("decode", "queue_wait", "upload", "augment")
+STAGES = ("decode", "encode", "queue_wait", "upload", "augment")
 
 
 class _Stage:
@@ -70,6 +75,8 @@ class PipelineMetrics:
             self.batches = 0
             self.samples = 0
             self.workers = 1
+            self.raw_bytes = 0
+            self.wire_bytes = 0
 
     def set_workers(self, n: int) -> None:
         """Decode fan-out width — the denominator of decode occupancy."""
@@ -92,6 +99,14 @@ class PipelineMetrics:
             self.batches += 1
             self.samples += int(samples)
 
+    def add_wire(self, raw_bytes: int, wire_bytes: int) -> None:
+        """One encoded batch: bytes it would have cost raw vs the bytes
+        that actually cross the host->device pipe (the encode stage's
+        wire accounting — codec_ratio = raw / wire)."""
+        with self._lock:
+            self.raw_bytes += int(raw_bytes)
+            self.wire_bytes += int(wire_bytes)
+
     # -- reading ------------------------------------------------------------
     def snapshot(self, reset: bool = False) -> dict:
         with self._lock:
@@ -112,6 +127,10 @@ class PipelineMetrics:
                 "workers": self.workers,
                 "batches_per_sec": round(self.batches / window, 2),
                 "samples_per_sec": round(self.samples / window, 1),
+                "raw_bytes": self.raw_bytes,
+                "wire_bytes": self.wire_bytes,
+                "codec_ratio": (round(self.raw_bytes / self.wire_bytes, 3)
+                                if self.wire_bytes else None),
                 "stages": stages,
             }
             if reset:
@@ -119,6 +138,8 @@ class PipelineMetrics:
                 self._stages = {s: _Stage() for s in STAGES}
                 self.batches = 0
                 self.samples = 0
+                self.raw_bytes = 0
+                self.wire_bytes = 0
         return out
 
 

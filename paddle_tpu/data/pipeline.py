@@ -191,12 +191,37 @@ class Dataset:
         limits)."""
         return _MapBatches(self, fn, workers, prefetch, backend)
 
+    def encode(self, policy: Optional[str] = None,
+               keys: Optional[Sequence[str]] = None,
+               out_dtype: str = "float32") -> "Dataset":
+        """On-wire feed codec (data/codec.py): host-encode the decoded
+        batches so the bytes that cross the host->device pipe are
+        int8/bf16, not f32 — the thin-pipe lever (BENCH r05: the
+        ~15 MB/s upload tunnel, not the CPU, caps real-data training).
+        `policy` defaults to PT_FEED_CODEC (none | bf16 | int8); `keys`
+        limits encoding to those feed-dict entries (default: every
+        floating entry); `out_dtype` is what the device-side decode
+        recovers (match your pipeline's pre-encode dtype).
+
+        Composes 1:1 with shard/shuffle/batch — skips stay claimed
+        upstream in raw batch units, which ARE encoded units, so the
+        iter_from/set_epoch/state resume contract is untouched. The
+        matching device-side decode fuses into a downstream `.augment()`
+        call or runs as its own traced transform in `.device_prefetch()`
+         's upload thread; without either, the consumer receives encoded
+        batches (the program-level `apply_wire_codec` path)."""
+        from .codec import FeedCodec
+        return _Encode(self, FeedCodec(policy, keys, out_dtype))
+
     def augment(self, aug) -> "Dataset":
         """Device-side augmentation (data/augment.py Augment): applied to
         the uploaded batch as one traced call. When the next stage is
         device_prefetch, the call is hoisted into its upload thread so
-        the consumer never touches it."""
-        return _AugmentStage(self, aug)
+        the consumer never touches it. Downstream of an `.encode()`
+        stage the dequant fuses INTO the augment program (one compiled
+        call, keyed on the codec policy) — the decoded f32 batch exists
+        only on device."""
+        return _AugmentStage(self, aug, codec=self._upstream_codec())
 
     def device_prefetch(self, capacity: int = 2) -> "Dataset":
         """Two-stage host->device prefetch (reader/prefetch.py
@@ -287,6 +312,17 @@ class Dataset:
         return self._metrics.snapshot(reset=reset)
 
     # -- node internals -----------------------------------------------------
+    def _upstream_codec(self):
+        """The nearest upstream `_Encode` stage's codec (None if the
+        stream is unencoded) — how augment/device_prefetch know to fuse
+        the device-side dequant."""
+        node: Optional[Dataset] = self
+        while node is not None:
+            if isinstance(node, _Encode):
+                return node._codec
+            node = node._up
+        return None
+
     def _iter(self, ctx: _Ctx):
         raise NotImplementedError
 
@@ -641,24 +677,62 @@ class _MapBatches(Dataset):
         return "map_batches"
 
 
+class _Encode(Dataset):
+    """Host-side wire encode (data/codec.py). Strictly 1:1 — output
+    batch k IS input batch k, encoded — so the pending skip passes
+    through to be claimed upstream in raw batch units (the PR-8
+    skip-units lesson: only non-1:1 stages may claim it). Encoding is a
+    pure function of the batch, so a resumed stream re-encodes
+    bit-identically."""
+
+    def __init__(self, up: Dataset, codec):
+        super().__init__(up)
+        self._codec = codec
+
+    def _iter(self, ctx: _Ctx):
+        src = self._up._iter(ctx)  # 1:1: upstream discards skipped batches
+        codec = self._codec
+        met = ctx.metrics
+
+        def gen():
+            from .codec import raw_nbytes
+            for item in src:
+                if met is None:
+                    yield codec.encode_batch(item)
+                    continue
+                raw = raw_nbytes(item) if isinstance(item, dict) else 0
+                with met.span("encode"):
+                    out = codec.encode_batch(item)
+                met.add_wire(raw, raw_nbytes(out)
+                             if isinstance(out, dict) else 0)
+                yield out
+
+        return gen()
+
+    def _sig(self) -> str:
+        return f"encode({self._codec.policy})"
+
+
 class _AugmentStage(Dataset):
-    def __init__(self, up: Dataset, aug):
+    def __init__(self, up: Dataset, aug, codec=None):
         super().__init__(up)
         self._aug = aug
+        self._codec = codec
 
     def _iter(self, ctx: _Ctx):
         src = self._up._iter(ctx)
         aug = self._aug
+        codec = self._codec
         epoch, cursor0 = ctx.epoch, ctx.cursor0
         met = ctx.metrics
 
         def gen():
             for i, item in enumerate(src):
                 if met is None:
-                    yield aug(item, cursor0 + i, epoch)
+                    yield aug(item, cursor0 + i, epoch, codec=codec)
                     continue
                 with met.span("augment"):
-                    out = aug(item, cursor0 + i, epoch)
+                    out = aug(item, cursor0 + i, epoch, codec=codec)
                 yield out
 
         return gen()
@@ -681,12 +755,19 @@ class _DevicePrefetch(Dataset):
         if isinstance(up, _AugmentStage):
             # hoist the augmentation into the upload thread: the traced
             # call dispatches right after device_put, off the consumer's
-            # critical path (its execution overlaps the training step)
-            aug = up._aug
+            # critical path (its execution overlaps the training step).
+            # An upstream encode stage's dequant fuses into the same call.
+            aug, codec = up._aug, up._codec
             epoch, cursor0 = ctx.epoch, ctx.cursor0
             transform = (lambda item, idx:
-                         aug(item, cursor0 + idx, epoch))
+                         aug(item, cursor0 + idx, epoch, codec=codec))
             up = up._up
+        elif isinstance(up, _Encode):
+            # encoded but un-augmented stream: the device-side dequant
+            # still runs as one traced call in the upload thread — the
+            # consumer (and the wire) never see a decoded f32 batch
+            codec = up._codec
+            transform = (lambda item, idx: codec.decode_batch(item))
         src_iter = up._iter(ctx)
         buffered = double_buffer(lambda: src_iter,
                                  capacity=self._capacity,

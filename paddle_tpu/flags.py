@@ -258,6 +258,35 @@ declare_env_knob("PT_DATA_PREFETCH",
                  "2 x workers). Bounds host RAM held in decoded "
                  "batches; too low re-serializes decode behind the "
                  "consumer")
+declare_env_knob("PT_FEED_CODEC",
+                 "on-wire feed codec default policy (data/codec.py): "
+                 "none (default) | bf16 | int8. Batches cross the "
+                 "host->device pipe encoded (int8 = per-channel "
+                 "symmetric, ~4x fewer wire bytes + a tiny f32 scale "
+                 "companion; bf16 = truncation, 2x) and dequantize on "
+                 "device inside the jitted augment call / the traced "
+                 "feed_dequant op. Per-stage Dataset.encode(policy=...) "
+                 "and apply_wire_codec(policy=...) override it. int8 is "
+                 "LOSSY by design: parity is a calibrated tolerance "
+                 "band (docs/data.md)")
+declare_env_knob("PT_FEED_WIRE_MBPS",
+                 "modeled host->device feed-pipe rate in MB/s for the "
+                 "roofline's host leg (analysis/cost.py predict_step): "
+                 "feed bytes at the WIRE dtype divided by this rate "
+                 "become a fourth leg, and when it sets the max the "
+                 "declared bound is 'host' — the thin-pipe reading "
+                 "BENCH r05 measured (~15 MB/s tunnel), now predicted. "
+                 "Unset/0 = pipe not modeled (co-located hosts)")
+declare_env_knob("PT_OPT_STATE_DTYPE",
+                 "optimizer-state precision policy (optimizer.py): "
+                 "bfloat16 stores the param-shaped moment accumulators "
+                 "(Adam m/v, Momentum velocity) at bf16 — half the "
+                 "optimizer-state HBM, visible to the memory estimator "
+                 "and the PT_MEM_BUDGET_GB gate before compile. Update "
+                 "math still runs f32 in the op kernels; params and "
+                 "scalar beta-power accumulators stay f32. Must be set "
+                 "BEFORE optimizer.minimize builds the accumulators. "
+                 "Unset/float32 = off")
 declare_env_knob("PT_COMPILE_CACHE",
                  "persistent XLA compile cache (core/compile_cache.py): "
                  "unset/0 = off, 1 = ~/.cache/paddle_tpu/xla_cache, "

@@ -289,3 +289,41 @@ def fake_dequantize_max_abs(ctx, ins, attrs):
     x, scale = ins["X"][0], ins["Scale"][0]
     max_range = float(attrs.get("max_range", 127.0))
     return {"Out": [x.astype(jnp.float32) * scale.reshape(()) / max_range]}
+
+
+def _feed_dequant_infer(op, block):
+    """The wire-codec boundary, statically checked: Out keeps X's shape;
+    the dtype derives from the declared out_dtype ONLY when X actually
+    arrives at the policy's wire dtype. A boundary violation (the feed
+    var re-widened, a mismatched policy) derives X's dtype instead, so
+    the verifier's dtype-prop pass flags the recorded/derived
+    disagreement at the op — the dtype narrowing is understood, never
+    waved through."""
+    from ..core.types import normalize_dtype, wire_dtype_of
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape = x.shape
+    want = wire_dtype_of(str(op.attrs.get("policy", "none")))
+    if want is None or str(x.dtype) == want:
+        out.dtype = normalize_dtype(op.attrs.get("out_dtype", "float32"))
+    else:
+        out.dtype = x.dtype
+
+
+@register_op("feed_dequant", infer_shape=_feed_dequant_infer)
+def feed_dequant(ctx, ins, attrs):
+    """data/codec.py wire codec, traced into the step: the feed crossed
+    the host->device pipe at the wire dtype (int8 payload + f32
+    per-channel scale, or truncated bf16) and is decoded here, on
+    device, as the program's first op. Under AMP the decoded value lands
+    directly at the compute dtype — mirroring the executor's entry cast,
+    so no f32 copy of the batch ever materializes."""
+    from ..data.codec import decode_array
+    x = ins["X"][0]
+    out_dtype = str(attrs.get("out_dtype", "float32"))
+    adt = getattr(ctx, "amp_dtype", None)
+    if adt is not None and out_dtype == "float32":
+        out_dtype = str(adt)
+    policy = str(attrs.get("policy", "none"))
+    scale = ins["Scale"][0] if ins.get("Scale") else None
+    return {"Out": [decode_array(x, scale, policy, out_dtype)]}

@@ -28,6 +28,18 @@ def _p(ins, slot):
     return ins[slot][0]
 
 
+def _f32(x):
+    """Accumulator math under PT_OPT_STATE_DTYPE (optimizer.py): moments
+    may be STORED bf16 but must UPDATE in f32 — a bf16 `b1*m + (1-b1)*g`
+    would quantize the running statistic itself, not just its storage.
+    New moment values are cast back to the stored dtype by the caller so
+    the carried state keeps one dtype across steps (a drifting state
+    dtype re-keys the jit cache and breaks run_loop's scan-carry
+    structure). For f32 moments every cast is an identity — the
+    pre-policy path stays bit-exact."""
+    return x.astype(jnp.float32) if x.dtype != jnp.float32 else x
+
+
 @register_op("sgd", supports_sparse=True)
 def sgd(ctx, ins, attrs):
     p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
@@ -45,20 +57,22 @@ def momentum(ctx, ins, attrs):
     mu = attrs["mu"]
     if isinstance(g, RowSparseGrad):
         rows, vals = g.rows, g.values.astype(p.dtype)
-        v_rows = v.at[rows].get(mode="clip")
+        v_rows = _f32(v.at[rows].get(mode="clip"))
         v_new = mu * v_rows + vals
         if attrs.get("use_nesterov", False):
             delta = (vals + mu * v_new) * lr
         else:
             delta = lr * v_new
-        return {"ParamOut": [p.at[rows].add(-delta, mode="drop")],
-                "VelocityOut": [v.at[rows].set(v_new, mode="drop")]}
-    v_new = mu * v + g
+        return {"ParamOut": [p.at[rows].add(-delta.astype(p.dtype),
+                                            mode="drop")],
+                "VelocityOut": [v.at[rows].set(v_new.astype(v.dtype),
+                                               mode="drop")]}
+    v_new = mu * _f32(v) + g
     if attrs.get("use_nesterov", False):
         p_new = p - (g + mu * v_new) * lr
     else:
         p_new = p - lr * v_new
-    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+    return {"ParamOut": [p_new], "VelocityOut": [v_new.astype(v.dtype)]}
 
 
 @register_op("adam", supports_sparse=True)
@@ -71,21 +85,25 @@ def adam(ctx, ins, attrs):
     b1, b2, eps = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999), attrs.get("epsilon", 1e-8)
     if isinstance(g, RowSparseGrad):
         rows, vals = g.rows, g.values.astype(p.dtype)
-        m_rows = m.at[rows].get(mode="clip")
-        v_rows = v.at[rows].get(mode="clip")
+        m_rows = _f32(m.at[rows].get(mode="clip"))
+        v_rows = _f32(v.at[rows].get(mode="clip"))
         m_new = b1 * m_rows + (1 - b1) * vals
         v_new = b2 * v_rows + (1 - b2) * jnp.square(vals)
         lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
         delta = lr_t * m_new / (jnp.sqrt(v_new) + eps)
-        return {"ParamOut": [p.at[rows].add(-delta, mode="drop")],
-                "Moment1Out": [m.at[rows].set(m_new, mode="drop")],
-                "Moment2Out": [v.at[rows].set(v_new, mode="drop")],
+        return {"ParamOut": [p.at[rows].add(-delta.astype(p.dtype),
+                                            mode="drop")],
+                "Moment1Out": [m.at[rows].set(m_new.astype(m.dtype),
+                                              mode="drop")],
+                "Moment2Out": [v.at[rows].set(v_new.astype(v.dtype),
+                                              mode="drop")],
                 "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
-    m_new = b1 * m + (1 - b1) * g
-    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    m_new = b1 * _f32(m) + (1 - b1) * g
+    v_new = b2 * _f32(v) + (1 - b2) * jnp.square(g)
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
-    return {"ParamOut": [p_new], "Moment1Out": [m_new], "Moment2Out": [v_new],
+    return {"ParamOut": [p_new], "Moment1Out": [m_new.astype(m.dtype)],
+            "Moment2Out": [v_new.astype(v.dtype)],
             "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
 
 

@@ -11,6 +11,7 @@ per-step XLA executable.
 
 from __future__ import annotations
 
+import os
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
@@ -21,6 +22,28 @@ from .layer_helper import LayerHelper
 from .initializer import ConstantInitializer
 from .regularizer import append_regularization_ops
 from .clip import append_gradient_clip_ops, error_clip_callback
+
+
+def _opt_state_dtype() -> Optional[str]:
+    """PT_OPT_STATE_DTYPE: precision policy for the param-shaped moment
+    accumulators (Adam m/v, Momentum velocity). 'bfloat16' halves the
+    optimizer-state HBM residency — for Adam the single largest state
+    term after the params themselves — at a precision cost the bf16
+    moment literature accepts (the moments are statistics, not masters;
+    the update math still runs f32 in the op kernels and the params stay
+    f32). The memory estimator (analysis/memory.py) prices accumulators
+    at their RECORDED dtype, so the saving is visible to the
+    PT_MEM_BUDGET_GB gate and the placement planner before anything
+    compiles. Unset/float32 = off. Scalar beta-power accumulators always
+    stay f32 (they steer the bias correction; narrowing them would decay
+    the correction itself)."""
+    raw = os.environ.get("PT_OPT_STATE_DTYPE", "").strip().lower()
+    if raw in ("", "0", "off", "float32", "f32", "fp32"):
+        return None
+    if raw in ("bfloat16", "bf16"):
+        return "bfloat16"
+    raise ValueError(f"malformed PT_OPT_STATE_DTYPE={raw!r}: expected "
+                     "bfloat16 (or unset/float32)")
 
 
 class Optimizer:
@@ -156,8 +179,11 @@ class MomentumOptimizer(Optimizer):
         self._use_nesterov = use_nesterov
 
     def _create_accumulators(self, block, parameters):
+        moment_dt = _opt_state_dtype()
         for p in parameters:
-            self._add_accumulator(self._velocity_acc_str, p)
+            self._add_accumulator(
+                self._velocity_acc_str, p,
+                dtype=moment_dt if str(p.dtype) == "float32" else None)
 
     def _append_optimize_op(self, block, param_and_grad):
         velocity = self._get_accumulator(self._velocity_acc_str, param_and_grad[0])
@@ -204,9 +230,14 @@ class AdamOptimizer(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def _create_accumulators(self, block, parameters):
+        # PT_OPT_STATE_DTYPE: the param-shaped moments take the policy
+        # dtype (bf16 halves Adam's optimizer-state HBM); the scalar
+        # beta-power accumulators stay f32 — see _opt_state_dtype
+        moment_dt = _opt_state_dtype()
         for p in parameters:
-            self._add_accumulator(self._moment1_acc_str, p)
-            self._add_accumulator(self._moment2_acc_str, p)
+            dt = moment_dt if str(p.dtype) == "float32" else None
+            self._add_accumulator(self._moment1_acc_str, p, dtype=dt)
+            self._add_accumulator(self._moment2_acc_str, p, dtype=dt)
             self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
                                   shape=[1])
             self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2,

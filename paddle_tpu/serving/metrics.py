@@ -358,9 +358,13 @@ _DECODE_GAUGES = ("tokens_per_sec", "slot_occupancy", "active", "waiting",
                   "kv_blocks_in_use", "kv_blocks_capacity",
                   "kv_high_water")
 #: data-plane (input pipeline) counters/gauges exported as pt_data_*
-#: (data/metrics.py PipelineMetrics.snapshot)
+#: (data/metrics.py PipelineMetrics.snapshot). wire_bytes/raw_bytes/
+#: codec_ratio are the on-wire feed codec's accounting (data/codec.py):
+#: what the host->device pipe actually carried vs what raw f32 would
+#: have cost.
 _DATA_COUNTERS = ("batches", "samples")
-_DATA_GAUGES = ("batches_per_sec", "samples_per_sec", "workers")
+_DATA_GAUGES = ("batches_per_sec", "samples_per_sec", "workers",
+                "wire_bytes", "raw_bytes", "codec_ratio")
 
 
 def render_prometheus(snapshot: dict) -> str:

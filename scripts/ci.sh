@@ -24,8 +24,12 @@
 #           determinism, sharding disjointness, parallel shard readers,
 #           cheap skip + checkpointable state, device-side augmentation,
 #           exactly-once under reader faults, mid-epoch resume
-#           bit-exactness, pt_data_* metrics) + the legacy reader /
-#           dataset-parser / double-buffer suite — all thread-backend
+#           bit-exactness, pt_data_* metrics) + the on-wire feed-codec
+#           suite (int8/bf16 encode-decode round-trips, fused
+#           dequant+augment, resume through an encode stage, the
+#           wire-dtype program path, feed-wire roofline leg, bf16
+#           optimizer moments) + the legacy reader / dataset-parser /
+#           double-buffer suite — all thread-backend
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -54,8 +58,8 @@ if [[ "${1:-}" == "chaos" ]]; then
 fi
 
 if [[ "${1:-}" == "data" ]]; then
-  echo "== data: production data plane + legacy reader chain =="
-  python -m pytest tests/test_data_pipeline.py \
+  echo "== data: production data plane + wire codec + legacy readers =="
+  python -m pytest tests/test_data_pipeline.py tests/test_data_codec.py \
     tests/test_data_plane.py -q -m 'not slow'
   echo "DATA OK"
   exit 0

@@ -1,0 +1,812 @@
+"""On-wire feed codec (data/codec.py): int8/bf16 encode-decode
+round-trips, the pipeline `encode` stage (wire metrics, fused
+dequant+augment, determinism/resume through encoding), the program-level
+wire path (apply_wire_codec + feed_dequant + executor host-encode), the
+static layers' view of the narrowing (cost/memory/predict_step feed-wire
+leg, verifier boundary checks), and the PT_OPT_STATE_DTYPE bf16
+optimizer-moment policy.
+
+Thread backend only, like test_data_pipeline.py (tier-1 sandbox
+multiprocess limits).
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import data as pt_data
+from paddle_tpu import layers
+from paddle_tpu.data import codec
+from paddle_tpu.data.codec import SCALE_SUFFIX, apply_wire_codec
+from paddle_tpu.data.pipeline import Dataset
+from paddle_tpu.resilience import FaultInjected, faults
+
+
+@pytest.fixture(autouse=True)
+def fresh_fault_plan(monkeypatch):
+    monkeypatch.delenv("PT_FAULT_INJECT", raising=False)
+    monkeypatch.delenv("PT_FEED_CODEC", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv("PT_FAULT_INJECT", spec)
+    faults.reset()
+
+
+def _img_samples(n=32, c=3, px=8, seed=1):
+    rs = np.random.RandomState(seed)
+    return [rs.randn(c, px, px).astype(np.float32) for i in range(n)]
+
+
+def _img_pipe(samples=None, seed=3, batch=4, workers=2):
+    samples = _img_samples() if samples is None else samples
+
+    def decode(rows):
+        return {"data": np.stack(rows),
+                "label": np.arange(len(rows), dtype=np.int64)}
+
+    return (Dataset.from_samples(samples)
+            .shuffle(buf_size=8, seed=seed)
+            .batch(batch, drop_last=True)
+            .map_batches(decode, workers=workers))
+
+
+# ---------------------------------------------------------------------------
+# codec math
+# ---------------------------------------------------------------------------
+
+class TestCodecMath:
+    def test_int8_round_trip_tolerance(self):
+        x = np.random.RandomState(0).randn(4, 3, 8, 8).astype(np.float32)
+        q, s = codec.encode_array(x, "int8")
+        assert q.dtype == np.int8 and s.shape == (3,) \
+            and s.dtype == np.float32
+        dec = np.asarray(codec.decode_array(q, s, "int8"))
+        # quantization error is bounded by half a grid step per channel
+        for ch in range(3):
+            assert np.max(np.abs(dec[:, ch] - x[:, ch])) <= s[ch] / 2 + 1e-7
+
+    def test_int8_exact_on_grid(self):
+        # values ON the quantization grid round-trip bit-exactly: per
+        # channel c, amax == 127 * step_c makes scale == step_c (both
+        # powers of two, so the division is exact), every value is an
+        # integer multiple of step_c, and rint/clip are identities
+        rs = np.random.RandomState(7)
+        steps = [0.125, 0.5]
+        chans = []
+        for step in steps:
+            ints = rs.randint(-127, 128, size=(2, 5, 51))
+            ints.flat[0] = 127  # pin the channel amax to 127 * step
+            chans.append(ints.astype(np.float32) * step)
+        x = np.stack(chans, axis=1)  # [B=2, C=2, 5, 51]
+        q, s = codec.encode_array(x, "int8")
+        np.testing.assert_array_equal(s, np.asarray(steps, np.float32))
+        dec = np.asarray(codec.decode_array(q, s, "int8"))
+        np.testing.assert_array_equal(dec, x)
+
+    def test_int8_all_zero_channel_safe(self):
+        x = np.zeros((2, 3, 4, 4), np.float32)
+        x[:, 1] = 1.0
+        q, s = codec.encode_array(x, "int8")
+        dec = np.asarray(codec.decode_array(q, s, "int8"))
+        np.testing.assert_array_equal(dec, x)
+
+    def test_bf16_truncation(self):
+        x = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        enc, s = codec.encode_array(x, "bf16")
+        assert s is None and enc.nbytes == x.nbytes // 2
+        dec = np.asarray(codec.decode_array(enc, None, "bf16"))
+        assert dec.dtype == np.float32
+        # truncation error bounded by bf16's 8-bit mantissa
+        assert np.max(np.abs(dec - x) / np.maximum(np.abs(x), 1e-6)) < 2 ** -8
+
+    def test_unknown_policy_raises(self):
+        with pytest.raises(ValueError, match="unknown feed-codec policy"):
+            codec.encode_array(np.zeros((2, 2), np.float32), "int4")
+        with pytest.raises(ValueError, match="unknown feed-codec policy"):
+            codec.FeedCodec("fp8")
+
+    def test_policy_from_env(self, monkeypatch):
+        monkeypatch.delenv("PT_FEED_CODEC", raising=False)
+        assert codec.policy_from_env() == "none"
+        monkeypatch.setenv("PT_FEED_CODEC", "int8")
+        assert codec.policy_from_env() == "int8"
+        monkeypatch.setenv("PT_FEED_CODEC", "gzip")
+        with pytest.raises(ValueError):
+            codec.policy_from_env()
+
+    def test_feed_codec_batch_selects_float_entries(self):
+        fc = codec.FeedCodec("int8")
+        b = {"data": np.random.randn(2, 3, 4, 4).astype(np.float32),
+             "label": np.arange(2, dtype=np.int64)}
+        enc = fc.encode_batch(b)
+        assert enc["data"].dtype == np.int8
+        assert enc["label"].dtype == np.int64  # ints never encoded
+        assert ("data" + SCALE_SUFFIX) in enc
+        dec = fc.decode_batch(enc)
+        assert str(dec["data"].dtype) == "float32"
+        assert ("data" + SCALE_SUFFIX) not in dec
+        np.testing.assert_array_equal(np.asarray(dec["label"]), b["label"])
+
+
+# ---------------------------------------------------------------------------
+# pipeline encode stage
+# ---------------------------------------------------------------------------
+
+class TestEncodeStage:
+    def test_wire_ratio_and_metrics(self):
+        p = _img_pipe().encode("int8").named("codec_t1")
+        batches = list(p())
+        assert all(b["data"].dtype == np.int8 for b in batches)
+        snap = p.metrics_snapshot()
+        assert snap["wire_bytes"] > 0
+        # f32 -> int8 payload + tiny scales + untouched int64 labels:
+        # the image bytes shrink 4x, the whole-batch ratio must clear
+        # the acceptance floor
+        assert snap["codec_ratio"] >= 3.5
+        assert snap["stages"]["encode"]["items"] == len(batches)
+        pt_data.unregister("codec_t1")
+
+    def test_prometheus_gauges(self):
+        from paddle_tpu.serving.metrics import render_prometheus
+        p = _img_pipe().encode("int8").named("codec_prom")
+        list(p())
+        text = render_prometheus({"data": {"codec_prom":
+                                           p.metrics_snapshot()}})
+        assert 'pt_data_wire_bytes{pipeline="codec_prom"}' in text
+        assert 'pt_data_codec_ratio{pipeline="codec_prom"}' in text
+        pt_data.unregister("codec_prom")
+
+    def test_encode_is_deterministic_and_1to1(self):
+        a = list(_img_pipe().encode("int8")())
+        b = list(_img_pipe().encode("int8")())
+        raw = list(_img_pipe()())
+        assert len(a) == len(raw)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x["data"], y["data"])
+            np.testing.assert_array_equal(x["data" + SCALE_SUFFIX],
+                                          y["data" + SCALE_SUFFIX])
+
+    def test_worker_count_never_reorders_encoded_stream(self):
+        one = list(_img_pipe(workers=1).encode("int8")())
+        four = list(_img_pipe(workers=4).encode("int8")())
+        for x, y in zip(one, four):
+            np.testing.assert_array_equal(x["data"], y["data"])
+
+    def test_iter_from_matches_tail_through_encode(self):
+        # skips stay claimed upstream in raw batch units == encoded units
+        p = _img_pipe().encode("int8")
+        full = list(p())
+        tail = list(p.iter_from(3))
+        assert len(tail) == len(full) - 3
+        for x, y in zip(tail, full[3:]):
+            np.testing.assert_array_equal(x["data"], y["data"])
+            np.testing.assert_array_equal(x["data" + SCALE_SUFFIX],
+                                          y["data" + SCALE_SUFFIX])
+
+    def test_state_restore_resumes_encoded_stream(self):
+        p = _img_pipe().encode("int8")
+        it = p()
+        seen = [next(it) for _ in range(2)]
+        del seen
+        state = p.state()
+        q = _img_pipe().encode("int8")
+        q.restore(state)
+        resumed = list(q())
+        full = list(_img_pipe().encode("int8")())
+        assert len(resumed) == len(full) - 2
+        for x, y in zip(resumed, full[2:]):
+            np.testing.assert_array_equal(x["data"], y["data"])
+
+    def test_restore_refuses_unencoded_signature(self):
+        p = _img_pipe().encode("int8")
+        q = _img_pipe()
+        with pytest.raises(ValueError, match="signature"):
+            q.restore(p.state())
+
+    def test_exactly_once_under_reader_faults(self, monkeypatch):
+        from paddle_tpu.resilience import RetryPolicy
+        from paddle_tpu.resilience.retry import resilient_reader
+        clean = list(_img_pipe().encode("int8")())
+        _arm(monkeypatch, "reader_raise@2,reader_raise@5")
+        pol = RetryPolicy(retries=3, base_delay=0.0, jitter=0.0,
+                          sleep=lambda s: None)
+        wrapped = resilient_reader(_img_pipe().encode("int8"), policy=pol)
+        got = list(wrapped())
+        assert len(got) == len(clean)
+        for x, y in zip(got, clean):
+            np.testing.assert_array_equal(x["data"], y["data"])
+
+
+# ---------------------------------------------------------------------------
+# device-side decode: fused augment + decode-only prefetch transform
+# ---------------------------------------------------------------------------
+
+class TestDeviceDecode:
+    def _chain(self, encoded: bool, **aug_kw):
+        aug = pt_data.Augment(crop=8, pad=1, flip_lr=True, seed=0,
+                              **aug_kw)
+        p = _img_pipe()
+        if encoded:
+            p = p.encode("int8")
+        return p.augment(aug).device_prefetch(2)
+
+    def test_fused_dequant_augment_parity(self):
+        import jax
+        enc = list(self._chain(True)())
+        raw = list(self._chain(False)())
+        assert isinstance(enc[0]["data"], jax.Array)
+        assert str(enc[0]["data"].dtype) == "float32"
+        for a, b in zip(enc, raw):
+            assert SCALE_SUFFIX not in "".join(a.keys())
+            # identical crops/flips (same counter rng); values differ only
+            # by the input quantization step
+            d = np.abs(np.asarray(a["data"]) - np.asarray(b["data"]))
+            assert d.max() < 0.05, d.max()
+
+    def test_augment_exact_on_grid_values(self):
+        # grid-valued inputs: fused dequant+augment == augment(raw), bit
+        # for bit (the int8 leg is exact, the augment rng identical).
+        # Every sample pins each channel's amax to 127 * 0.125, so the
+        # whole-batch per-channel scale is exactly the grid step.
+        rs = np.random.RandomState(0)
+        samples = []
+        for _ in range(16):
+            ints = rs.randint(-127, 128, size=(3, 8, 8))
+            ints[:, 0, 0] = 127
+            samples.append(ints.astype(np.float32) * 0.125)
+
+        def mk(encoded):
+            aug = pt_data.Augment(crop=8, pad=1, flip_lr=True, seed=0)
+            p = _img_pipe(samples=samples)
+            if encoded:
+                p = p.encode("int8")
+            return p.augment(aug).device_prefetch(2)
+
+        for a, b in zip(mk(True)(), mk(False)()):
+            np.testing.assert_array_equal(np.asarray(a["data"]),
+                                          np.asarray(b["data"]))
+
+    def test_decode_transform_without_augment(self):
+        import jax
+        p = _img_pipe().encode("int8").device_prefetch(2)
+        out = list(p())
+        assert isinstance(out[0]["data"], jax.Array)
+        assert str(out[0]["data"].dtype) == "float32"
+        assert ("data" + SCALE_SUFFIX) not in out[0]
+
+    def test_one_compiled_program_per_policy(self):
+        aug = pt_data.Augment(crop=8, seed=0)
+        fc = codec.FeedCodec("int8")
+        b = {"data": np.random.randn(4, 3, 8, 8).astype(np.float32)}
+        enc = fc.encode_batch(b)
+        aug(enc, 0, 0, codec=fc)
+        aug(b, 0, 0)
+        assert set(aug._fns) == {"int8", "none"}
+
+
+# ---------------------------------------------------------------------------
+# trainer resume through an encode stage (crash + SIGTERM)
+# ---------------------------------------------------------------------------
+
+N_STEPS = 12
+STEP_INTERVAL = 4
+
+
+def _train_pipeline(seed=11):
+    rs = np.random.RandomState(4321)
+    data = [(rs.randn(4).astype(np.float32),
+             rs.randn(1).astype(np.float32)) for _ in range(N_STEPS * 4)]
+
+    def decode(rows):
+        return {"x": np.stack([r[0] for r in rows]),
+                "y": np.stack([r[1] for r in rows])}
+
+    return (Dataset.from_samples(data)
+            .shuffle(buf_size=16, seed=seed)
+            .batch(4, drop_last=True)
+            .map_batches(decode, workers=2)
+            .encode("int8"))
+
+
+def _make_trainer(ckpt_dir):
+    pt.core.program.reset_unique_names()
+
+    def train_func():
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        return [layers.mean(layers.square_error_cost(pred, y))]
+
+    cfg = pt.CheckpointConfig(ckpt_dir, step_interval=STEP_INTERVAL)
+    t = pt.Trainer(train_func, lambda: pt.optimizer.SGDOptimizer(0.05),
+                   checkpoint_config=cfg)
+    # the trainer consumes ENCODED batches: the program carries the
+    # traced dequant (int8 x + f32 scale feeds, f32 y passes raw)
+    apply_wire_codec(t.train_program, "int8", feeds=["x", "y"])
+    return t
+
+
+def _final_params(trainer):
+    with pt.scope_guard(trainer.scope):
+        return {v.name: np.array(trainer.scope.find_var(v.name))
+                for v in
+                trainer.train_program.global_block.all_parameters()}
+
+
+class TestTrainerResumeThroughCodec:
+    def test_mid_epoch_crash_resume_is_bit_exact(self, tmp_path,
+                                                 monkeypatch):
+        a = _make_trainer(str(tmp_path / "a"))
+        a.train(num_epochs=2, event_handler=lambda e: None,
+                reader=_train_pipeline())
+        want = _final_params(a)
+
+        b = _make_trainer(str(tmp_path / "b"))
+        _arm(monkeypatch, "step_crash@7")
+        with pytest.raises(FaultInjected):
+            b.train(num_epochs=2, event_handler=lambda e: None,
+                    reader=_train_pipeline())
+        _arm(monkeypatch, "")
+
+        c = _make_trainer(str(tmp_path / "b"))
+        c.train(num_epochs=2, event_handler=lambda e: None,
+                reader=_train_pipeline())
+        got = _final_params(c)
+        assert set(got) == set(want)
+        for name in want:
+            np.testing.assert_array_equal(
+                got[name], want[name],
+                err_msg=f"{name}: resumed params diverge through the "
+                        "encode stage")
+
+    def test_preemption_resume_is_bit_exact(self, tmp_path):
+        a = _make_trainer(str(tmp_path / "a"))
+        a.train(num_epochs=2, event_handler=lambda e: None,
+                reader=_train_pipeline())
+        want = _final_params(a)
+
+        def handler(event):
+            if isinstance(event, pt.EndStepEvent) \
+                    and (event.epoch, event.step) == (0, 5):
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        b = _make_trainer(str(tmp_path / "b"))
+        b.train(num_epochs=2, event_handler=handler,
+                reader=_train_pipeline())
+        assert b.preempted
+
+        c = _make_trainer(str(tmp_path / "b"))
+        c.train(num_epochs=2, event_handler=lambda e: None,
+                reader=_train_pipeline())
+        got = _final_params(c)
+        for name in want:
+            np.testing.assert_array_equal(got[name], want[name])
+
+
+# ---------------------------------------------------------------------------
+# program-level wire path
+# ---------------------------------------------------------------------------
+
+def _wire_program(policy="int8"):
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [3, 8, 8])
+        y = layers.data("y", [1], dtype="int64")
+        pred = layers.fc(layers.flatten(x), size=10)
+        loss = layers.mean(layers.cross_entropy(layers.softmax(pred), y))
+        pt.optimizer.AdamOptimizer(0.01).minimize(loss)
+    if policy:
+        apply_wire_codec(main, policy)
+    return main, startup, loss
+
+
+class TestWireProgram:
+    def test_rewrite_structure(self):
+        main, _, _ = _wire_program("int8")
+        b = main.global_block
+        assert str(b.var("x").dtype) == "int8"
+        assert b.var("x").wire_codec == "int8"
+        assert str(b.var("x" + SCALE_SUFFIX).dtype) == "float32"
+        assert b.var("x" + SCALE_SUFFIX).is_data
+        assert str(b.var("y").dtype) == "int64"  # ints untouched
+        assert b.ops[0].type == "feed_dequant"
+        # every old consumer reads the decoded name
+        for op in b.ops[1:]:
+            assert "x" not in op.input_names()
+
+    def test_idempotent_and_missing_feed_raises(self):
+        main, _, _ = _wire_program("int8")
+        assert apply_wire_codec(main, "int8") == []  # already rewritten
+        with pytest.raises(ValueError, match="not float32 data vars"):
+            apply_wire_codec(main, "int8", feeds=["nope"])
+
+    def test_verifies_clean_and_survives_clone(self):
+        from paddle_tpu.analysis import verify_program
+        main, _, loss = _wire_program("int8")
+        res = verify_program(main, feeds=["x", "x" + SCALE_SUFFIX, "y"],
+                             fetches=[loss.name])
+        assert res.ok, res.report()
+        clone = pt.Program.from_dict(main.to_dict())
+        assert clone.global_block.var("x").wire_codec == "int8"
+        assert verify_program(clone,
+                              feeds=["x", "x" + SCALE_SUFFIX, "y"],
+                              fetches=[loss.name]).ok
+
+    def test_verifier_flags_rewidened_wire_var(self):
+        from paddle_tpu.analysis import verify_program
+        main, _, loss = _wire_program("int8")
+        # corrupt the boundary: someone re-widens the wire var — the
+        # executor would feed f32 to a step compiled for int8
+        main.global_block.var("x").dtype = "float32"
+        main.invalidate_cache()
+        res = verify_program(main, feeds=["x", "x" + SCALE_SUFFIX, "y"],
+                             fetches=[loss.name])
+        assert "wire-dtype-mismatch" in {d.code for d in res.errors}
+
+    def test_dtype_prop_understands_dequant_boundary(self):
+        from paddle_tpu.analysis import verify_program
+        main, _, loss = _wire_program("int8")
+        # the decoded var's recorded dtype disagrees with what the
+        # dequant op derives from its attrs — dtype-prop re-derives the
+        # boundary through feed_dequant's infer fn and flags it
+        main.global_block.var("x__decoded").dtype = "int8"
+        main.invalidate_cache()
+        res = verify_program(main, feeds=["x", "x" + SCALE_SUFFIX, "y"],
+                             fetches=[loss.name], passes=["dtype-prop"])
+        bad = [d for d in res.errors if d.code == "dtype-mismatch"
+               and d.var == "x__decoded"]
+        assert bad, res.report()
+
+    def test_verifier_flags_missing_scale(self):
+        from paddle_tpu.analysis import verify_program
+        main, _, loss = _wire_program("int8")
+        op = main.global_block.ops[0]
+        assert op.type == "feed_dequant"
+        op.inputs.pop("Scale")
+        main.invalidate_cache()
+        res = verify_program(main, feeds=["x", "y"], fetches=[loss.name])
+        assert "wire-scale-missing" in {d.code for d in res.errors}
+
+    def test_executor_host_encodes_raw_feeds(self):
+        main, startup, loss = _wire_program("int8")
+        raw_main, raw_startup, raw_loss = _wire_program(None)
+        rs = np.random.RandomState(0)
+        # grid-valued feed => the int8 leg is exact and the wire program
+        # must train bit-identically to the raw program
+        g = np.arange(-127, 128, dtype=np.float32) * 0.125
+        feeds = [{"x": rs.choice(g, size=(8, 3, 8, 8)).astype(np.float32),
+                  "y": rs.randint(0, 10, (8, 1)).astype(np.int64)}
+                 for _ in range(3)]
+
+        def run(mp, sp, fetch):
+            scope = pt.Scope()
+            with pt.scope_guard(scope):
+                exe = pt.Executor()
+                exe.run(sp)
+                return [float(exe.run(mp, feed=f, fetch_list=[fetch])[0])
+                        for f in feeds]
+
+        enc_losses = run(main, startup, loss)
+        raw_losses = run(raw_main, raw_startup, raw_loss)
+        assert enc_losses == raw_losses
+
+    def test_executor_refuses_device_float_for_wire_feed(self):
+        import jax
+        main, startup, loss = _wire_program("int8")
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            bad = {"x": jax.device_put(
+                np.zeros((4, 3, 8, 8), np.float32)),
+                "y": np.zeros((4, 1), np.int64)}
+            with pytest.raises(ValueError, match="wire codec"):
+                exe.run(main, feed=bad, fetch_list=[loss])
+
+    def test_pre_encoded_pipeline_feed_passes_through(self):
+        # feeding the encoded payload + scale directly (the pipeline's
+        # encode stage) must equal the executor's own host-encode of the
+        # raw batch — run each in a FRESH scope (the program trains: a
+        # shared scope would compare step 1 against step 2)
+        main, startup, loss = _wire_program("int8")
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 3, 8, 8).astype(np.float32)
+        q, s = codec.encode_array(x, "int8")
+
+        def run(feed):
+            scope = pt.Scope()
+            with pt.scope_guard(scope):
+                exe = pt.Executor()
+                exe.run(startup)
+                return float(np.asarray(
+                    exe.run(main, feed=feed, fetch_list=[loss])[0])[0])
+
+        manual = run({"x": q, "x" + SCALE_SUFFIX: s,
+                      "y": np.zeros((8, 1), np.int64)})
+        auto = run({"x": x, "y": np.zeros((8, 1), np.int64)})
+        assert manual == auto
+
+
+# ---------------------------------------------------------------------------
+# static layers: cost / memory / roofline feed-wire leg
+# ---------------------------------------------------------------------------
+
+class TestStaticLayers:
+    def test_feed_dequant_is_covered(self):
+        from paddle_tpu.analysis.cost import program_cost
+        main, _, _ = _wire_program("int8")
+        pc = program_cost(main, batch=16)
+        assert "feed_dequant" not in pc.uncovered_ops
+        assert not pc.uncovered_ops
+
+    def test_encoded_program_bytes_strictly_decrease(self):
+        from paddle_tpu.analysis.cost import (predict_step,
+                                              program_feed_bytes)
+        from paddle_tpu.analysis.memory import estimate_memory
+        raw, _, _ = _wire_program(None)
+        enc, _, _ = _wire_program("int8")
+        b = 64
+        assert program_feed_bytes(enc, b) < program_feed_bytes(raw, b)
+        # >= 3.5x on the image feed (labels + scales dilute slightly)
+        ratio = program_feed_bytes(raw, b) / program_feed_bytes(enc, b)
+        assert ratio >= 3.5
+        assert (estimate_memory(enc, b).breakdown["feeds"]
+                < estimate_memory(raw, b).breakdown["feeds"])
+        p_raw, p_enc = predict_step(raw, batch=b), predict_step(enc, batch=b)
+        assert p_enc.feed_wire_bytes < p_raw.feed_wire_bytes
+        assert p_enc.hbm_bytes < p_raw.hbm_bytes
+
+    def test_bf16_policy_halves_feed_bytes(self):
+        from paddle_tpu.analysis.cost import program_feed_bytes
+        raw, _, _ = _wire_program(None)
+        enc, _, _ = _wire_program("bf16")
+        b = 64
+        ratio = program_feed_bytes(raw, b) / program_feed_bytes(enc, b)
+        assert 1.8 <= ratio <= 2.0
+
+    def test_feed_wire_leg_and_host_bound(self, monkeypatch):
+        from paddle_tpu.analysis.cost import predict_step
+        raw, _, _ = _wire_program(None)
+        monkeypatch.delenv("PT_FEED_WIRE_MBPS", raising=False)
+        p0 = predict_step(raw, batch=64)
+        assert p0.t_feed_ms == 0.0  # knob unset: leg absent, bound as before
+        monkeypatch.setenv("PT_FEED_WIRE_MBPS", "0.001")  # absurdly thin
+        p1 = predict_step(raw, batch=64)
+        assert p1.bound == "host"
+        assert p1.t_feed_ms > 0
+        assert p1.predicted_step_ms == pytest.approx(p1.t_feed_ms)
+        assert p1.predicted_mfu <= p0.predicted_mfu
+        d = p1.to_dict()
+        assert d["bound"] == "host" and d["feed_wire_bytes"] > 0
+
+    def test_modeled_ratio_tracks_wire_direction(self, monkeypatch):
+        # the acceptance criterion's direction check in miniature: under
+        # a thin modeled pipe the encoded program predicts a strictly
+        # faster step than the raw one
+        from paddle_tpu.analysis.cost import predict_step
+        monkeypatch.setenv("PT_FEED_WIRE_MBPS", "1")
+        raw, _, _ = _wire_program(None)
+        enc, _, _ = _wire_program("int8")
+        p_raw, p_enc = (predict_step(raw, batch=256),
+                        predict_step(enc, batch=256))
+        assert p_raw.bound == "host"
+        assert p_enc.predicted_step_ms < p_raw.predicted_step_ms
+
+    def test_malformed_wire_knob_raises(self, monkeypatch):
+        from paddle_tpu.analysis.cost import feed_wire_mbps
+        monkeypatch.setenv("PT_FEED_WIRE_MBPS", "fast")
+        with pytest.raises(ValueError, match="PT_FEED_WIRE_MBPS"):
+            feed_wire_mbps()
+
+
+# ---------------------------------------------------------------------------
+# PT_OPT_STATE_DTYPE: bf16 optimizer moments
+# ---------------------------------------------------------------------------
+
+def _adam_program():
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [16])
+        y = layers.data("y", [1])
+        pred = layers.fc(layers.fc(x, size=32, act="relu"), size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.AdamOptimizer(0.01).minimize(loss)
+    return main, startup, loss
+
+
+class TestOptStateDtype:
+    def test_moments_take_policy_dtype(self, monkeypatch):
+        monkeypatch.setenv("PT_OPT_STATE_DTYPE", "bfloat16")
+        main, _, _ = _adam_program()
+        from paddle_tpu.core.program import iter_optimizer_state_inputs
+        accs = {a for _, a in
+                iter_optimizer_state_inputs(main.global_block)}
+        moments = [a for a in accs if "moment" in a]
+        pows = [a for a in accs if "pow_acc" in a]
+        assert moments and pows
+        for a in moments:
+            assert str(main.global_block.var(a).dtype) == "bfloat16", a
+        for a in pows:  # bias-correction scalars stay f32
+            assert str(main.global_block.var(a).dtype) == "float32", a
+
+    def test_estimator_delta_matches_policy(self, monkeypatch):
+        from paddle_tpu.analysis.memory import estimate_memory
+        monkeypatch.delenv("PT_OPT_STATE_DTYPE", raising=False)
+        m_f32, _, _ = _adam_program()
+        monkeypatch.setenv("PT_OPT_STATE_DTYPE", "bfloat16")
+        m_bf16, _, _ = _adam_program()
+        e32 = estimate_memory(m_f32, batch=8).breakdown["optimizer_state"]
+        e16 = estimate_memory(m_bf16, batch=8).breakdown["optimizer_state"]
+        param_elems = sum(
+            int(np.prod(v.shape))
+            for v in m_f32.global_block.all_parameters())
+        # exactly the two moment tables halve: delta = 2 moments x
+        # (4 - 2) bytes x param elems; beta-pow scalars unchanged
+        assert e32 - e16 == 2 * 2 * param_elems
+        assert e16 < e32
+
+    def test_training_state_dtype_stable_and_learns(self, monkeypatch):
+        monkeypatch.setenv("PT_OPT_STATE_DTYPE", "bfloat16")
+        pt.core.program.reset_unique_names()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [16])
+            y = layers.data("y", [1])
+            loss = layers.mean(layers.square_error_cost(
+                layers.fc(x, size=1), y))
+            pt.optimizer.AdamOptimizer(0.05).minimize(loss)
+        rs = np.random.RandomState(0)
+        w = rs.randn(16, 1).astype(np.float32)
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            losses = []
+            for _ in range(25):
+                xb = rs.randn(16, 16).astype(np.float32)
+                losses.append(float(np.asarray(exe.run(
+                    main, feed={"x": xb, "y": xb @ w},
+                    fetch_list=[loss])[0])[0]))
+            # two compiles total (startup + ONE train step), stable bf16
+            # carry: every later step would recompile if the moment
+            # dtype drifted f32 after step 1
+            assert len(exe._cache) == 2
+            import jax.numpy as jnp
+            from paddle_tpu.core.program import iter_optimizer_state_inputs
+            accs = {a for _, a in
+                    iter_optimizer_state_inputs(main.global_block)
+                    if "moment" in a}
+            assert accs
+            for a in accs:
+                assert str(jnp.result_type(
+                    scope.find_var(a))) == "bfloat16", a
+        assert min(losses[-5:]) < losses[0] * 0.5, losses
+
+    def test_malformed_policy_raises(self, monkeypatch):
+        monkeypatch.setenv("PT_OPT_STATE_DTYPE", "int8")
+        with pytest.raises(ValueError, match="PT_OPT_STATE_DTYPE"):
+            _adam_program()
+
+
+# ---------------------------------------------------------------------------
+# artifacts floors for the bench codec A/B
+# ---------------------------------------------------------------------------
+
+class TestCodecABFloors:
+    def _good(self):
+        return {
+            "arms": {
+                "raw": {"wire_bytes_ratio": 1.0,
+                        "delivered_images_per_sec": 100.0},
+                "int8": {"wire_bytes_ratio": 4.0,
+                         "delivered_images_per_sec": 300.0},
+            },
+            "parity": {"loss_delta_rel": 0.005, "tolerance": 0.1},
+        }
+
+    def test_good_doc_passes(self):
+        from paddle_tpu.analysis.artifacts import validate_codec_ab
+        assert validate_codec_ab(self._good()) == []
+
+    def test_sub_unity_ratio_rejected(self):
+        from paddle_tpu.analysis.artifacts import validate_codec_ab
+        doc = self._good()
+        doc["arms"]["int8"]["wire_bytes_ratio"] = 0.5
+        assert any("below 1x" in p for p in validate_codec_ab(doc))
+
+    def test_nan_ratio_and_rate_rejected(self):
+        from paddle_tpu.analysis.artifacts import validate_codec_ab
+        doc = self._good()
+        doc["arms"]["int8"]["wire_bytes_ratio"] = float("nan")
+        doc["arms"]["raw"]["delivered_images_per_sec"] = 0.0
+        problems = validate_codec_ab(doc)
+        assert any("wire_bytes_ratio" in p for p in problems)
+        assert any("delivered_images_per_sec" in p for p in problems)
+
+    def test_missing_parity_rejected(self):
+        from paddle_tpu.analysis.artifacts import validate_codec_ab
+        doc = self._good()
+        del doc["parity"]
+        assert any("parity" in p for p in validate_codec_ab(doc))
+        doc = self._good()
+        del doc["parity"]["loss_delta_rel"]
+        assert any("loss_delta_rel" in p for p in validate_codec_ab(doc))
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+# ---------------------------------------------------------------------------
+
+class TestReviewRegressions:
+    def test_augment_skips_dequant_for_ungoverned_image_key(self):
+        # codec governs only "aux": the image entry stays raw f32 and the
+        # augment must NOT try to dequantize it (the 0-size scale
+        # placeholder would shape-error inside the trace)
+        rs = np.random.RandomState(0)
+        samples = [rs.randn(3, 8, 8).astype(np.float32) for _ in range(8)]
+
+        def decode(rows):
+            return {"data": np.stack(rows),
+                    "aux": np.ones((len(rows), 2), np.float32)}
+
+        aug = pt_data.Augment(crop=8, pad=1, seed=0)
+        p = (Dataset.from_samples(samples).batch(4, drop_last=True)
+             .map_batches(decode, workers=1)
+             .encode("int8", keys=["aux"])
+             .augment(aug).device_prefetch(2))
+        out = list(p())
+        assert str(out[0]["data"].dtype) == "float32"
+        # the governed aux entry was decoded back
+        assert str(out[0]["aux"].dtype) == "float32"
+        np.testing.assert_allclose(np.asarray(out[0]["aux"]),
+                                   np.ones((4, 2), np.float32))
+
+    def test_augment_bf16_decodes_non_image_entries(self):
+        fc = codec.FeedCodec("bf16")
+        b = {"data": np.random.randn(4, 3, 8, 8).astype(np.float32),
+             "aux": np.ones((4, 2), np.float32)}
+        enc = fc.encode_batch(b)
+        aug = pt_data.Augment(crop=8, seed=0)
+        out = aug(enc, 0, 0, codec=fc)
+        # the stage contract: every governed entry recovers out_dtype
+        assert str(out["data"].dtype) == "float32"
+        assert str(out["aux"].dtype) == "float32"
+
+    def test_executor_encodes_uint8_pixel_feed(self):
+        # uint8 image batches previously cast to the f32 var dtype; for a
+        # wire var they must route through the codec (a bare astype to
+        # int8 would wrap 128..255 into negatives)
+        main, startup, loss = _wire_program("int8")
+        rs = np.random.RandomState(0)
+        pix = rs.randint(0, 256, (8, 3, 8, 8)).astype(np.uint8)
+
+        def run(feed):
+            scope = pt.Scope()
+            with pt.scope_guard(scope):
+                exe = pt.Executor()
+                exe.run(startup)
+                return float(np.asarray(exe.run(
+                    main, feed=feed, fetch_list=[loss])[0])[0])
+
+        as_uint8 = run({"x": pix, "y": np.zeros((8, 1), np.int64)})
+        as_f32 = run({"x": pix.astype(np.float32),
+                      "y": np.zeros((8, 1), np.int64)})
+        assert as_uint8 == as_f32
+
+    def test_apply_wire_codec_explicit_feeds_idempotent(self):
+        main, _, _ = _wire_program("int8")
+        # re-applying with the same explicit feed list is a no-op…
+        assert apply_wire_codec(main, "int8", feeds=["x"]) == []
+        # …but asking for a different policy on a rewritten feed is a
+        # conflict, named as such
+        with pytest.raises(ValueError, match="already carries"):
+            apply_wire_codec(main, "bf16", feeds=["x"])

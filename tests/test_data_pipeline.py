@@ -613,8 +613,8 @@ class TestMetrics:
         snap = p.metrics_snapshot()
         assert snap["batches"] == 6
         assert snap["samples"] == 24
-        assert set(snap["stages"]) == {"decode", "queue_wait", "upload",
-                                       "augment"}
+        assert set(snap["stages"]) == {"decode", "encode", "queue_wait",
+                                       "upload", "augment"}
         for st in snap["stages"].values():
             assert 0.0 <= st["occupancy"] <= 1.0
         assert snap["stages"]["decode"]["items"] == 6
