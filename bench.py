@@ -178,24 +178,67 @@ def _train_loop(main_prog, startup, fetch, feed, steps, unroll=2,
         # fetch on. min-of-windows on both sides; the emitted pct tracks
         # the "PT_GUARD=skip costs <= 1%" claim per config across
         # BENCH_*.json revisions.
+        def _overhead_pct(what, run_window):
+            """Min-of-windows A/B vs the plain loop's `best`: re-time
+            the instrumented variant and report the pct delta (one
+            window policy for every overhead metric). Returns None —
+            never fails the bench — when the variant can't run."""
+            try:
+                window_s = []
+                for _ in range(max(timed_windows, 1)):
+                    t0 = time.time()
+                    run_window()
+                    window_s.append(time.time() - t0)
+                return round((min(window_s) - best) / best * 100.0, 2)
+            except Exception as e:
+                import logging
+                logging.getLogger("paddle_tpu").warning(
+                    "%s overhead measurement skipped: %s", what, e)
+                return None
+
         guard_overhead_pct = None
         try:
             from paddle_tpu.resilience import guard as pt_guard
             guarded_prog = pt_guard.instrument(main_prog.clone())
             exe.run_loop(guarded_prog, feed=feed, fetch_list=[fetch],
                          n_steps=steps, unroll=unroll, guard=True)  # compile
-            g_window_s = []
-            for _ in range(max(timed_windows, 1)):
-                t0 = time.time()
-                exe.run_loop(guarded_prog, feed=feed, fetch_list=[fetch],
-                             n_steps=steps, unroll=unroll, guard=True)
-                g_window_s.append(time.time() - t0)
-            guard_overhead_pct = round(
-                (min(g_window_s) - best) / best * 100.0, 2)
+            guard_overhead_pct = _overhead_pct(
+                "guard",
+                lambda: exe.run_loop(guarded_prog, feed=feed,
+                                     fetch_list=[fetch], n_steps=steps,
+                                     unroll=unroll, guard=True))
         except Exception as e:  # a config without an autodiff boundary
             import logging
             logging.getLogger("paddle_tpu").warning(
                 "guard overhead measurement skipped: %s", e)
+        # tracing-overhead A/B (obs/trace.py): re-time the IDENTICAL
+        # compiled loop with PT_TRACE armed — same window policy as the
+        # guard A/B. The program and jit cache are untouched (tracing is
+        # pure host-side emission), so no recompile rides the
+        # comparison. The documented budget is on the DISABLED path
+        # (<= 1%, pinned in tests/test_obs.py); this emitted pct tracks
+        # the ENABLED cost per config across BENCH_*.json revisions.
+        # When the caller already armed PT_TRACE, the baseline windows
+        # above were traced too and an A/B would read ~0 by
+        # construction — report None instead of a vacuous number.
+        trace_overhead_pct = None
+        from paddle_tpu.obs import trace as pt_trace
+        if pt_trace.enabled():
+            import logging
+            logging.getLogger("paddle_tpu").warning(
+                "trace overhead A/B skipped: PT_TRACE was already armed, "
+                "so the baseline windows include the tracing cost")
+        else:
+            os.environ["PT_TRACE"] = "1"
+            try:
+                trace_overhead_pct = _overhead_pct(
+                    "trace",
+                    lambda: exe.run_loop(main_prog, feed=feed,
+                                         fetch_list=[fetch],
+                                         n_steps=steps, unroll=unroll))
+            finally:
+                os.environ.pop("PT_TRACE", None)
+                pt_trace.reset()   # drop the A/B's events: bench-local
     # static roofline prediction (analysis/cost.py) beside the measured
     # numbers: predicted_mfu_pct + the declared bound (compute|bandwidth|
     # comm|host) attribute the 45%-gap per config, and the full
@@ -227,6 +270,7 @@ def _train_loop(main_prog, startup, fetch, feed, steps, unroll=2,
            "phase_s": {p: tm[f"{p}_s"]
                        for p in ("host_prep", "dispatch", "device", "fetch")},
            "guard_overhead_pct": guard_overhead_pct,
+           "trace_overhead_pct": trace_overhead_pct,
            "compile_cache": compile_cache, **pred_fields}
     # flatten [steps, 1] fetches: float(arr[0]) on a size-1 ndarray is
     # deprecated (NumPy 1.25) and will raise once NumPy promotes it

@@ -34,14 +34,24 @@ from typing import Dict, Optional
 import jax
 import numpy as np
 
+from ..obs import trace as obs_trace
+
 __all__ = ["LazyFetch", "PhaseTimer", "materialize"]
 
 
 class PhaseTimer:
     """Per-phase wall-time accumulator (thread-safe: LazyFetch handles may
-    be read from any thread, e.g. a metrics logger)."""
+    be read from any thread, e.g. a metrics logger).
+
+    Also a span emitter: every `add()` (which both direct calls and the
+    span() context manager funnel through) lands the same interval on
+    the structured trace (obs/trace.py) when PT_TRACE is armed — ONE
+    timing source feeding two views, the cumulative phase accounting
+    and the per-event timeline. `trace_cat` names the plane (subclasses
+    override: the serving timer emits under "serve")."""
 
     PHASES = ("host_prep", "dispatch", "device", "fetch")
+    trace_cat = "exec"
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -55,6 +65,8 @@ class PhaseTimer:
     def add(self, phase: str, seconds: float):
         with self._lock:
             self._s[phase] += seconds
+        if obs_trace.enabled():
+            obs_trace.complete(phase, seconds, cat=self.trace_cat)
 
     def count_run(self):
         with self._lock:
@@ -132,14 +144,18 @@ class LazyFetch:
     (resilience/watchdog.py, PT_STEP_DEADLINE_S) includes it in the
     hang dump."""
 
-    __slots__ = ("_val", "_timer", "_np", "_prov")
+    __slots__ = ("_val", "_timer", "_np", "_prov", "_settle")
 
     def __init__(self, value, timer: Optional[PhaseTimer] = None,
-                 provenance: Optional[dict] = None):
+                 provenance: Optional[dict] = None, on_settle=None):
         self._val = value
         self._timer = timer
         self._np = None
         self._prov = dict(provenance) if provenance else {}
+        #: called once when the device value settles — the drift
+        #: monitor's measured-step hook (obs/drift.py step_recorder);
+        #: the recorder itself dedups across a run's several handles
+        self._settle = on_settle
 
     def annotate(self, **context) -> "LazyFetch":
         """Merge provenance context (e.g. epoch=, step=); returns self."""
@@ -189,11 +205,15 @@ class LazyFetch:
                         _watchdog.wait_until_ready(
                             self._val, provenance=self._prov,
                             timer=self._timer)
+                    if self._settle is not None:
+                        self._settle()
                     with self._timer.span("fetch"):
                         self._np = np.asarray(self._val)  # host-sync: ok — this IS the read
                 else:
                     _watchdog.wait_until_ready(self._val,
                                                provenance=self._prov)
+                    if self._settle is not None:
+                        self._settle()
                     self._np = np.asarray(self._val)  # host-sync: ok — this IS the read
             except _watchdog.StepHungError:
                 raise  # dump already carries the provenance

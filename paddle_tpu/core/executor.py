@@ -107,6 +107,9 @@ class TimedExecutorMixin:
         #: dispatches — kept OUT of the dispatch phase so a one-off 43 s
         #: compile cannot masquerade as per-step host overhead
         self.compile_s = 0.0
+        #: compile events since construction — the pt_train_* family's
+        #: compile counter (obs/metrics.py TrainMetrics) reads it
+        self.compile_count = 0
         # persistent XLA compile cache (PT_COMPILE_CACHE): applied
         # process-wide on first construction, before any jit call
         ensure_compile_cache()
@@ -116,6 +119,10 @@ class TimedExecutorMixin:
             self._timings.add("dispatch", seconds)
         else:
             self.compile_s += seconds
+            self.compile_count += 1
+            from ..obs import trace as obs_trace
+            if obs_trace.enabled():
+                obs_trace.complete("compile", seconds, cat="exec")
         self._timings.count_run()
 
     def step_timings(self, reset: bool = False) -> dict:
@@ -272,7 +279,7 @@ class Executor(TimedExecutorMixin):
     # -- main entry ---------------------------------------------------------
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
                   build, key_extra, per_step_feed_prep=False, lazy=False,
-                  guard=False, guard_steps=None):
+                  guard=False, guard_steps=None, n_steps=1):
         """Shared body of run/run_loop: prep feeds/state, hit the jit cache
         (≙ the reference's program cache, executor.py:165), execute, write
         new state back to the scope.
@@ -317,7 +324,8 @@ class Executor(TimedExecutorMixin):
                                 for k, v in feed_arrays.items()))
         state_sig = tuple(sorted((k, jnp.shape(v), str(jnp.result_type(v)))
                                  for k, v in state.items()))
-        key = (program.fingerprint(), key_extra, feed_sig,
+        fingerprint = program.fingerprint()
+        key = (fingerprint, key_extra, feed_sig,
                tuple(fetch_names), state_sig, numeric_mode)
         self._timings.add("host_prep", time.perf_counter() - t_prep)
         compiled = self._cache.get(key)
@@ -344,6 +352,16 @@ class Executor(TimedExecutorMixin):
             # zero device syncs to the hot path.
             from ..analysis.memory import enforce_budget
             enforce_budget(program, batch=bh)
+            # drift monitor (obs/drift.py): record the roofline
+            # predict_step for this program at the SAME amortization
+            # point as the verifier/budget gates — compile-miss only, a
+            # pure host IR walk; measured steps fold into its EWMA below
+            # so pt_model_drift_ratio tracks prediction honesty live.
+            # Fetch-less runs (startup programs) carry no step to drift.
+            if fetch_names:
+                from ..obs import drift as obs_drift
+                obs_drift.observe_prediction(program, batch=bh,
+                                             timer=self._timings)
             # grouped-conv autotune pre-pass (utils/gconv_autotune.py):
             # the formulation choice inside the trace is cache-lookup
             # only, so any un-tuned shape must be measured BEFORE tracing
@@ -376,6 +394,16 @@ class Executor(TimedExecutorMixin):
         self._run_counter += 1
         rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._run_counter)
 
+        # measured-step recorder (obs/drift.py): settle-to-settle gaps
+        # over the steps between fold into the program's EWMA — the
+        # steady-state per-step time, immune to how late a lazy handle
+        # materializes. Cached runs only; the compile miss above reset
+        # the baseline so compile seconds never fold in.
+        settle = None
+        if was_cached and fetch_names:
+            from ..obs import drift as obs_drift
+            settle = obs_drift.step_recorder(fingerprint, n_steps)
+
         # jit compiles on FIRST call: a cold dispatch is charged to
         # compile_s, never to the per-step dispatch phase
         t0 = time.perf_counter()
@@ -385,6 +413,8 @@ class Executor(TimedExecutorMixin):
             import logging
             with self._timings.span("device"):
                 jax.block_until_ready((fetches, new_state))
+            if settle is not None:
+                settle()
             logging.getLogger("paddle_tpu").warning(
                 "[benchmark] run %s: %.2f ms%s", program.fingerprint(),
                 (time.perf_counter() - t0) * 1e3,
@@ -397,12 +427,21 @@ class Executor(TimedExecutorMixin):
         if lazy:
             # fetch-name provenance rides every handle: a deferred device
             # error (or a watchdog dump) names WHAT was in flight; the
-            # Trainer annotates epoch/step on top
-            return [LazyFetch(f, self._timings, provenance={"fetch": n})
+            # Trainer annotates epoch/step on top. With tracing armed
+            # the active span's context (the trainer step span carries
+            # epoch=/step=) is captured here instead — the span IS the
+            # provenance plumbing then (resilience/watchdog.py dumps it).
+            from ..obs import trace as obs_trace
+            span_ctx = obs_trace.current_attrs()
+            return [LazyFetch(f, self._timings,
+                              provenance=dict(span_ctx, fetch=n),
+                              on_settle=settle)
                     for n, f in zip(compiled.fetch_names, fetches)]
         if return_numpy:
             with self._timings.span("device"):
                 jax.block_until_ready(fetches)
+            if settle is not None:
+                settle()
             with self._timings.span("fetch"):
                 # host-sync: ok — the sync return contract (return_numpy)
                 return [np.asarray(f) for f in fetches]
@@ -469,7 +508,8 @@ class Executor(TimedExecutorMixin):
             program, feed, fetch_list, scope, return_numpy, build,
             key_extra=("loop", n_steps, per_step_feeds, unroll),
             per_step_feed_prep=per_step_feeds, lazy=lazy, guard=guard,
-            guard_steps=n_steps if per_step_feeds else None)
+            guard_steps=n_steps if per_step_feeds else None,
+            n_steps=n_steps)
 
     def close(self):
         self._cache.clear()

@@ -38,8 +38,10 @@ from __future__ import annotations
 
 import threading
 import time
-import weakref
 from typing import Callable, Dict
+
+from ..obs import trace as obs_trace
+from ..obs.metrics import REGISTRY
 
 __all__ = ["PipelineMetrics", "STAGES", "register", "unregister",
            "registry_snapshots"]
@@ -83,15 +85,25 @@ class PipelineMetrics:
         with self._lock:
             self.workers = max(int(n), 1)
 
-    def add(self, stage: str, seconds: float, items: int = 1) -> None:
+    def add(self, stage: str, seconds: float, items: int = 1,
+            **attrs) -> None:
         with self._lock:
             st = self._stages[stage]
             st.busy_s += seconds
             st.items += items
+        # one timing source, two views: the same interval lands on the
+        # structured trace (obs/trace.py) when PT_TRACE is armed —
+        # pipeline stages join the executor/trainer/serving timeline.
+        # `attrs` (e.g. cursor=) ride the span only; the cumulative
+        # stage accounting stays unchanged.
+        if obs_trace.enabled():
+            obs_trace.complete(stage, seconds, cat="data",
+                               pipeline=self.name, items=items, **attrs)
 
-    def span(self, stage: str, items: int = 1):
-        """Context manager: time a block into `stage`."""
-        return _Span(self, stage, items)
+    def span(self, stage: str, items: int = 1, **attrs):
+        """Context manager: time a block into `stage`. Extra attrs (the
+        batch cursor) ride the emitted trace span."""
+        return _Span(self, stage, items, attrs)
 
     def on_delivered(self, samples: int = 0) -> None:
         """One batch handed to the consumer (the pipeline's output unit)."""
@@ -144,48 +156,46 @@ class PipelineMetrics:
 
 
 class _Span:
-    __slots__ = ("_m", "_stage", "_items", "_t0")
+    __slots__ = ("_m", "_stage", "_items", "_attrs", "_t0")
 
-    def __init__(self, metrics: PipelineMetrics, stage: str, items: int):
+    def __init__(self, metrics: PipelineMetrics, stage: str, items: int,
+                 attrs: dict = None):
         self._m = metrics
         self._stage = stage
         self._items = items
+        self._attrs = attrs or {}
 
     def __enter__(self):
         self._t0 = self._m._clock()
         return self
 
     def __exit__(self, *exc):
-        self._m.add(self._stage, self._m._clock() - self._t0, self._items)
+        self._m.add(self._stage, self._m._clock() - self._t0, self._items,
+                    **self._attrs)
         return False
 
 
 # ---------------------------------------------------------------------------
 # Process-wide registry: live pipelines register their metrics so ONE
 # scrape of the serving HTTP front end covers the data plane too.
-# Weak references — an abandoned pipeline must not be pinned in memory
-# (or keep reporting) just because it once registered.
+# Since the unified metrics plane (obs/metrics.py), these are thin
+# wrappers over the shared MetricsRegistry's "data" section — same
+# weakref semantics (an abandoned pipeline must not be pinned in memory,
+# or keep reporting, just because it once registered), one registry for
+# every plane.
 # ---------------------------------------------------------------------------
-
-_registry: "weakref.WeakValueDictionary[str, PipelineMetrics]" = \
-    weakref.WeakValueDictionary()
-_registry_lock = threading.Lock()
-
 
 def register(metrics: PipelineMetrics) -> None:
     """Expose a pipeline's metrics on the process-wide scrape. Re-using a
     name replaces the previous registrant (a rebuilt pipeline is the same
     timeline to an operator, like a reloaded serving model)."""
-    with _registry_lock:
-        _registry[metrics.name] = metrics
+    REGISTRY.register("data", metrics.name, metrics)
 
 
 def unregister(name: str) -> None:
-    with _registry_lock:
-        _registry.pop(name, None)
+    REGISTRY.unregister("data", name)
 
 
 def registry_snapshots() -> Dict[str, dict]:
-    with _registry_lock:
-        live = dict(_registry)
+    live = REGISTRY.providers("data")
     return {name: m.snapshot() for name, m in sorted(live.items())}

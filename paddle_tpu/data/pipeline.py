@@ -267,7 +267,8 @@ class Dataset:
                     item = next(it)
                 except StopIteration:
                     return
-                met.add("queue_wait", clock() - t0, 1)
+                met.add("queue_wait", clock() - t0, 1,
+                        cursor=self._delivered)
                 met.on_delivered(_batch_samples(item))
                 self._delivered += 1
                 yield item
@@ -615,10 +616,18 @@ class _MapBatches(Dataset):
         if met is not None:
             met.set_workers(workers)
 
-        def timed_fn(item):
+        cursor0 = ctx.cursor0
+
+        def timed_fn(item, idx=None):
             if met is None:
                 return fn(item)
-            with met.span("decode"):
+            # the batch cursor rides the decode span (map_batches is
+            # 1:1, so submission index + the skip base IS the delivered
+            # cursor) — "which batch was decoding" is answerable from
+            # the trace
+            with met.span("decode",
+                          **({} if idx is None
+                             else {"cursor": cursor0 + idx})):
                 return fn(item)
 
         def gen():
@@ -646,10 +655,16 @@ class _MapBatches(Dataset):
 
             def feed():
                 try:
-                    for item in src:
+                    for i, item in enumerate(src):
                         if stop.is_set():
                             return
-                        if not put(pool.submit(work, item)):
+                        # thread backend: pass the submission index so
+                        # the decode span carries the batch cursor (the
+                        # process pool runs the bare fn — child-process
+                        # time is not attributable here anyway)
+                        fut = (pool.submit(work, item) if work is fn
+                               else pool.submit(work, item, i))
+                        if not put(fut):
                             return
                 except BaseException as e:  # noqa: BLE001 — re-raised in order
                     put(_Err(e))
@@ -696,12 +711,12 @@ class _Encode(Dataset):
 
         def gen():
             from .codec import raw_nbytes
-            for item in src:
+            for i, item in enumerate(src):
                 if met is None:
                     yield codec.encode_batch(item)
                     continue
                 raw = raw_nbytes(item) if isinstance(item, dict) else 0
-                with met.span("encode"):
+                with met.span("encode", cursor=ctx.cursor0 + i):
                     out = codec.encode_batch(item)
                 met.add_wire(raw, raw_nbytes(out)
                              if isinstance(out, dict) else 0)
@@ -731,7 +746,7 @@ class _AugmentStage(Dataset):
                 if met is None:
                     yield aug(item, cursor0 + i, epoch, codec=codec)
                     continue
-                with met.span("augment"):
+                with met.span("augment", cursor=cursor0 + i):
                     out = aug(item, cursor0 + i, epoch, codec=codec)
                 yield out
 
@@ -772,7 +787,8 @@ class _DevicePrefetch(Dataset):
         buffered = double_buffer(lambda: src_iter,
                                  capacity=self._capacity,
                                  transform=transform,
-                                 instrument=ctx.metrics)
+                                 instrument=ctx.metrics,
+                                 cursor0=ctx.cursor0)
         return buffered()
 
     def _sig(self) -> str:

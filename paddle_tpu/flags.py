@@ -293,6 +293,29 @@ declare_env_knob("PT_COMPILE_CACHE",
                  "else = that directory. Compiles are then paid once per "
                  "machine, not per process (the transformer bench "
                  "config's 43.5 s cold compile warm-starts in seconds)")
+declare_env_knob("PT_TRACE",
+                 "structured tracing (obs/trace.py): 1 arms span "
+                 "emission across every plane — executor phases, "
+                 "trainer step/epoch/checkpoint/guard events, "
+                 "data-pipeline stages, the serving request lifecycle "
+                 "— into a bounded in-process ring buffer; "
+                 "tools/trace_dump.py writes the Chrome-trace JSON "
+                 "Perfetto loads. Read per call, so it can be toggled "
+                 "at runtime; the disabled path costs <= 1% "
+                 "(bench.py emits trace_overhead_pct per config). "
+                 "Unset/0 = off")
+declare_env_knob("PT_TRACE_BUF",
+                 "ring-buffer capacity of the structured trace, in "
+                 "events (default 16384). The buffer keeps the NEWEST "
+                 "window — a long run_loop never grows memory. Read "
+                 "when the ring is (re)created (obs.trace.reset)")
+declare_env_knob("PT_TRACE_DIR",
+                 "with PT_TRACE armed: directory for trace output — "
+                 "tools/trace_dump.py defaults its JSON there, and the "
+                 "Trainer opens a jax.profiler.trace session writing "
+                 "device-side op attribution (the per-op named_scopes) "
+                 "next to the host-side spans. Unset = host-side spans "
+                 "only")
 declare_env_knob("PT_PLAN_BEAM",
                  "placement planner (analysis/planner.py): how many "
                  "ranked plans the emitted PlacementPlan artifact keeps "

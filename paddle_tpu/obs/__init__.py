@@ -1,0 +1,34 @@
+"""paddle_tpu.obs — the unified observability plane.
+
+Three surfaces, one timeline:
+
+  trace     structured spans (`trace.span(name, **attrs)`) with
+            thread-local context propagation, a bounded ring buffer,
+            and Chrome-trace-event output (tools/trace_dump.py writes a
+            Perfetto-loadable file). Armed by PT_TRACE; near-zero cost
+            off. Every plane — executor phases, trainer events,
+            data-pipeline stages, the serving request lifecycle —
+            emits onto it.
+  metrics   the process-wide MetricsRegistry + the ONE Prometheus text
+            renderer for every family (pt_serve_* / pt_decode_* /
+            pt_data_* / pt_train_* / pt_model_*), plus TrainMetrics —
+            the train-plane family the Trainer records into.
+  drift     continuous predicted-vs-measured monitoring: the roofline
+            `predict_step` recorded at compile time, measured step time
+            folded into an EWMA per step, exported as
+            pt_model_predicted_step_ms / pt_model_measured_step_ms /
+            pt_model_drift_ratio on the same scrape.
+
+See docs/observability.md.
+"""
+
+from . import trace
+from .drift import MONITOR, DriftMonitor, observe_prediction, step_recorder
+from .metrics import (REGISTRY, MetricsRegistry, TrainMetrics,
+                      global_snapshot, render_prometheus,
+                      validate_exposition)
+
+__all__ = ["trace", "REGISTRY", "MetricsRegistry", "TrainMetrics",
+           "render_prometheus", "validate_exposition", "global_snapshot",
+           "MONITOR", "DriftMonitor", "observe_prediction",
+           "step_recorder"]

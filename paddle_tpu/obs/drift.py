@@ -1,0 +1,274 @@
+"""Predicted-vs-measured drift monitor: the cost model's honesty as a
+LIVE metric family.
+
+PR 7/9/11 built a static prediction stack — roofline `predict_step`,
+planner PlacementPlan predictions, the feed-wire leg — whose agreement
+with reality is checked only by offline bench runs and the CI
+rank-correlation gate. But predicted-vs-measured agreement IS the
+product of a cost-model-driven system ("Synthesizing Optimal
+Parallelism Placement and Reduction Strategies on Hierarchical Systems"
+validates its model the same way): a plan whose prediction rots in
+production — a new XLA version, a different co-tenant load, a thinner
+feed pipe — should be visible on the same scrape the autoscaler reads,
+not at the next release's bench run.
+
+Mechanics:
+
+  * at executor compile time (the same amortization point as the
+    verifier and the HBM-budget gate — a pure host IR walk, never per
+    step) the program's `predict_step` is recorded;
+  * measured step time is the SETTLE-TO-SETTLE gap divided by the
+    steps dispatched between two settles of the same program — the
+    steady-state throughput reading. Under lazy pipelining a single
+    run's dispatch->settle latency includes however long its handle
+    sat unmaterialized (a guard health handle drained log_every
+    windows later would read 10x), and queueing behind earlier
+    windows; consecutive-settle gaps cancel both. Compile-miss runs
+    reset the baseline instead of folding — a 43 s compile must not
+    poison the EWMA — and the first settle after a (re)compile only
+    seeds it;
+  * the `pt_model_*` family exports predicted / measured / ratio plus
+    the declared bound and the observed host share (the PhaseTimer's
+    host_overhead_pct — "the model said compute-bound, the host
+    disagrees" is exactly the drift an operator needs attributed).
+
+Entries are bounded (LRU over program fingerprints) and weakly
+registered on the unified metrics plane (obs/metrics.py REGISTRY,
+section "model")."""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from .metrics import REGISTRY
+
+__all__ = ["ProgramDrift", "DriftMonitor", "MONITOR",
+           "observe_prediction", "step_recorder", "DRIFT_ALPHA"]
+
+#: EWMA smoothing factor: new = alpha * sample + (1 - alpha) * old.
+#: 0.2 ~ a ~10-step memory — fast enough to see a regression within a
+#: scrape interval, slow enough that one co-tenant burst doesn't flap
+#: the ratio.
+DRIFT_ALPHA = 0.2
+
+#: LRU bound on tracked programs — a test suite compiling hundreds of
+#: tiny programs must not grow the monitor (or the exposition) forever
+MAX_PROGRAMS = 64
+
+
+class ProgramDrift:
+    """One program's predicted-vs-measured ledger."""
+
+    def __init__(self, fingerprint: str):
+        self.fingerprint = str(fingerprint)
+        self._lock = threading.Lock()
+        self.predicted_ms: Optional[float] = None
+        self.bound: Optional[str] = None
+        self.predicted_mfu: Optional[float] = None
+        self.ewma_ms: Optional[float] = None
+        self.steps = 0
+        self._timer_ref: Optional[Callable] = None   # weakref to PhaseTimer
+        #: cumulative steps DISPATCHED (cached runs only) — the settle
+        #: baseline's step axis
+        self._dispatched = 0
+        #: (perf_counter, cumulative-steps) of the newest settle, or
+        #: None right after a (re)compile — the next settle re-seeds
+        self._baseline: Optional[tuple] = None
+
+    def set_prediction(self, predicted_ms: float, bound: str,
+                       predicted_mfu: Optional[float] = None) -> None:
+        with self._lock:
+            self.predicted_ms = float(predicted_ms)
+            self.bound = str(bound)
+            if predicted_mfu is not None:
+                self.predicted_mfu = float(predicted_mfu)
+
+    def attach_timer(self, timer) -> None:
+        """Weakly remember the owning executor's PhaseTimer so the
+        snapshot can report the OBSERVED host share beside the DECLARED
+        bound."""
+        with self._lock:
+            self._timer_ref = weakref.ref(timer)
+
+    def observe_step(self, step_ms: float) -> None:
+        with self._lock:
+            self._observe_locked(step_ms)
+
+    def _observe_locked(self, step_ms: float) -> None:
+        self.steps += 1
+        if self.ewma_ms is None:
+            self.ewma_ms = float(step_ms)
+        else:
+            self.ewma_ms = (DRIFT_ALPHA * float(step_ms)
+                            + (1.0 - DRIFT_ALPHA) * self.ewma_ms)
+
+    # -- settle-to-settle measurement (step_recorder's machinery) -----------
+    def begin_run(self, n_steps: int) -> int:
+        """A cached run of `n_steps` was dispatched; returns this run's
+        cumulative-step position on the settle axis."""
+        with self._lock:
+            self._dispatched += max(int(n_steps), 1)
+            return self._dispatched
+
+    def reset_baseline(self) -> None:
+        """A (re)compile happened: its wall time sits between settles
+        and must not fold into the measured series — the next settle
+        seeds a fresh baseline instead."""
+        with self._lock:
+            self._baseline = None
+
+    def settle(self, cumulative: int) -> None:
+        """A run that ended at `cumulative` dispatched steps settled:
+        fold (gap since the previous settle) / (steps between) — the
+        steady-state per-step time, immune to how late a lazy handle
+        was materialized and to device queueing behind earlier runs."""
+        now = time.perf_counter()
+        with self._lock:
+            if self._baseline is not None:
+                t0, c0 = self._baseline
+                if cumulative > c0:
+                    self._observe_locked((now - t0) * 1e3
+                                         / (cumulative - c0))
+            if self._baseline is None or cumulative > self._baseline[1]:
+                self._baseline = (now, cumulative)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            host_share = None
+            timer = self._timer_ref() if self._timer_ref else None
+            predicted, measured = self.predicted_ms, self.ewma_ms
+            bound, mfu, steps = self.bound, self.predicted_mfu, self.steps
+        if timer is not None:
+            try:
+                host_share = timer.snapshot().get("host_overhead_pct")
+            except Exception:   # noqa: BLE001 — snapshot must not raise
+                host_share = None
+        ratio = (round(measured / predicted, 4)
+                 if predicted and measured else None)
+        return {
+            "fingerprint": self.fingerprint,
+            "predicted_step_ms": (round(predicted, 6)
+                                  if predicted is not None else None),
+            "measured_step_ms": (round(measured, 6)
+                                 if measured is not None else None),
+            "drift_ratio": ratio,
+            "bound": bound,
+            "predicted_mfu": (round(mfu, 4) if mfu is not None else None),
+            "host_share_pct": host_share,
+            "steps": steps,
+        }
+
+
+class DriftMonitor:
+    """Bounded fingerprint -> ProgramDrift map; entries self-register
+    on the metrics plane under their short fingerprint."""
+
+    def __init__(self, registry=REGISTRY, max_programs: int = MAX_PROGRAMS):
+        self._registry = registry
+        self._max = max_programs
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, ProgramDrift]" = OrderedDict()
+        self._last_fp: Optional[str] = None
+
+    @staticmethod
+    def _short(fp: str) -> str:
+        return str(fp)[:12]
+
+    def entry(self, fingerprint: str) -> ProgramDrift:
+        fp = str(fingerprint)
+        with self._lock:
+            e = self._entries.get(fp)
+            if e is None:
+                e = self._entries[fp] = ProgramDrift(fp)
+                while len(self._entries) > self._max:
+                    old_fp, old = self._entries.popitem(last=False)
+                    # dropping the strong ref is enough — the registry
+                    # holds it weakly — but unregister anyway so the
+                    # name can't briefly resurrect via a live snapshot
+                    self._registry.unregister("model", self._short(old_fp))
+                self._registry.register("model", self._short(fp), e)
+            else:
+                self._entries.move_to_end(fp)
+        return e
+
+    def note_dispatch(self, fingerprint: str) -> None:
+        """A run (or compile) of `fingerprint` is starting. When the
+        process switches programs — a periodic eval, a second model —
+        every OTHER entry's settle baseline is invalidated: their next
+        settle gap would otherwise fold the interleaved program's wall
+        time (or its first 43 s compile) into THEIR measured EWMA as a
+        false drift spike. Steady single-program loops (the dominant
+        case) pay one lock + compare. In a strictly-alternating regime
+        no wall-gap measurement is honest, so none is recorded."""
+        fp = str(fingerprint)
+        with self._lock:
+            if self._last_fp == fp:
+                return
+            self._last_fp = fp
+            others = [e for k, e in self._entries.items() if k != fp]
+        for e in others:
+            e.reset_baseline()
+
+    def reset(self) -> None:
+        with self._lock:
+            for fp in list(self._entries):
+                self._registry.unregister("model", self._short(fp))
+            self._entries.clear()
+            self._last_fp = None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            entries = list(self._entries.values())
+        return {self._short(e.fingerprint): e.snapshot() for e in entries}
+
+
+#: the process-wide monitor the executors record into
+MONITOR = DriftMonitor()
+
+
+def observe_prediction(program, batch: int = 1, timer=None) -> None:
+    """Record `predict_step` for this program (compile-time hook; a
+    prediction failure must never cost a compile — an un-modeled
+    program just shows measured-only). Called on compile MISSES, so it
+    also resets the settle baseline: the compile's wall time sits
+    between settles and must not fold into the measured series."""
+    try:
+        fp = program.fingerprint()
+    except Exception:   # noqa: BLE001 — observability never kills a run
+        return
+    MONITOR.note_dispatch(fp)
+    e = MONITOR.entry(fp)
+    e.reset_baseline()
+    if timer is not None:
+        e.attach_timer(timer)
+    try:
+        from ..analysis.cost import predict_step
+        pred = predict_step(program, batch=batch)
+        e.set_prediction(pred.predicted_step_ms, pred.bound,
+                         predicted_mfu=pred.predicted_mfu)
+    except Exception:   # noqa: BLE001 — measured-only entry is still useful
+        pass
+
+
+def step_recorder(fingerprint: str, n_steps: int = 1):
+    """One-shot per-run recorder: call the returned closure when the
+    dispatched run SETTLES (block_until_ready returned / the first
+    LazyFetch materialized). Folds the settle-to-settle gap over the
+    steps between (ProgramDrift.settle) into the program's EWMA;
+    repeated calls (several handles of one run) are deduped."""
+    MONITOR.note_dispatch(fingerprint)
+    e = MONITOR.entry(fingerprint)
+    cumulative = e.begin_run(n_steps)
+    fired = [False]
+
+    def settled() -> None:
+        if fired[0]:
+            return
+        fired[0] = True
+        e.settle(cumulative)
+
+    return settled

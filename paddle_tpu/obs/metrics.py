@@ -1,0 +1,421 @@
+"""The unified metrics plane: one registry, one Prometheus renderer.
+
+Before this module, three subsystems each hand-rolled their own metric
+registry and exposition glue — serving (`serving/metrics.py`
+ModelMetrics/DecodeMetrics + the text renderer), the data plane
+(`data/metrics.py` weakref pipeline registry), and the decode engine —
+and the training loop exported NOTHING. The ROADMAP's autoscaler/router
+consumes "the unified metrics plane": this module is that plane.
+
+  MetricsRegistry   process-wide, weakref-valued registry of metric
+                    providers grouped into SECTIONS (data / train /
+                    model). A provider is anything with `.snapshot() ->
+                    dict`. Weak references: an abandoned pipeline or
+                    trainer must not be pinned (or keep reporting)
+                    because it once registered — the data plane's
+                    registry semantics, generalized.
+  render_prometheus the ONE text-exposition renderer (version 0.0.4)
+                    for every family: pt_serve_* / pt_decode_* /
+                    pt_data_* / pt_train_* / pt_model_*. serving/
+                    metrics.py re-exports it, so the existing HTTP
+                    scrape (`GET /v1/metrics?format=prometheus`) now
+                    carries the training and drift families beside the
+                    serving ones.
+  TrainMetrics      the pt_train_* provider: step time p50/p95,
+                    examples/s, last loss, guard skip/rollback
+                    counters, checkpoint/epoch/compile events. The
+                    Trainer records into one per `train()` call.
+  validate_exposition
+                    conformance checker for the exposition format
+                    (# TYPE present, label escaping, no duplicate
+                    series) — the CI `obs` leg and the conformance
+                    test both call it, so a malformed line fails as a
+                    named finding, not as a scraper mystery.
+
+Snapshot-merge semantics are preserved from the pre-consolidation code:
+`ServingMetrics.snapshot()` still returns its own models/decode
+sections and merges the registry's sections on top — one scrape, every
+plane.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["MetricsRegistry", "REGISTRY", "TrainMetrics",
+           "render_prometheus", "validate_exposition", "percentiles",
+           "global_snapshot"]
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class MetricsRegistry:
+    """Named sections of weakly-held metric providers. `snapshot()`
+    merges every live provider into {section: {name: snapshot}} —
+    the shape `render_prometheus` consumes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sections: Dict[str, "weakref.WeakValueDictionary"] = {}
+
+    def register(self, section: str, name: str, provider) -> None:
+        """Re-using a (section, name) replaces the previous registrant —
+        a rebuilt pipeline/trainer is the same timeline to an operator,
+        like a reloaded serving model."""
+        with self._lock:
+            sec = self._sections.get(section)
+            if sec is None:
+                sec = self._sections[section] = \
+                    weakref.WeakValueDictionary()
+            sec[name] = provider
+
+    def unregister(self, section: str, name: str) -> None:
+        with self._lock:
+            sec = self._sections.get(section)
+            if sec is not None:
+                sec.pop(name, None)
+
+    def providers(self, section: str) -> Dict[str, object]:
+        with self._lock:
+            sec = self._sections.get(section)
+            return dict(sec) if sec is not None else {}
+
+    def snapshot(self) -> Dict[str, Dict[str, dict]]:
+        with self._lock:
+            live = {s: dict(sec) for s, sec in self._sections.items()}
+        out: Dict[str, Dict[str, dict]] = {}
+        for section, providers in live.items():
+            if not providers:
+                continue
+            out[section] = {name: p.snapshot()
+                            for name, p in sorted(providers.items())}
+        return out
+
+
+#: the process-wide registry every plane reports through
+REGISTRY = MetricsRegistry()
+
+
+def global_snapshot() -> dict:
+    """The registry's merged snapshot — what a scrape sees for the
+    non-serving planes (serving merges this into its own snapshot)."""
+    return REGISTRY.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# shared percentile helper (was serving/metrics._percentiles)
+# ---------------------------------------------------------------------------
+
+def percentiles(samples: List[float],
+                qs=(0.50, 0.95, 0.99)) -> Dict[str, Optional[float]]:
+    """p50/p95/p99 by nearest-rank over a sorted copy, in ms."""
+    if not samples:
+        return {f"p{int(q * 100)}_ms": None for q in qs}
+    s = sorted(samples)
+    n = len(s)
+
+    def rank(q: float) -> float:
+        i = min(n - 1, max(0, int(round(q * (n - 1)))))
+        return round(s[i] * 1000.0, 3)
+
+    return {f"p{int(q * 100)}_ms": rank(q) for q in qs}
+
+
+# ---------------------------------------------------------------------------
+# the train-plane provider (pt_train_*)
+# ---------------------------------------------------------------------------
+
+#: per-metric ring for step-time percentiles — same bound rationale as
+#: the serving reservoirs: recent is what an operator wants, memory
+#: must not grow with step count
+TRAIN_RESERVOIR = 2048
+
+
+class TrainMetrics:
+    """One training run's counters + step-time reservoir. Thread-safe:
+    the train loop records while HTTP scrapes read."""
+
+    def __init__(self, name: str = "trainer",
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._t0 = self._clock()
+            self.steps = 0
+            self.examples = 0
+            self.epochs = 0
+            self.anomalies = 0      # guard skip events (bad steps seen)
+            self.rollbacks = 0      # guard rollback restores
+            self.checkpoints = 0
+            self.compile_events = 0
+            self.loss: Optional[float] = None
+            self.grad_norm: Optional[float] = None
+            self._step_ms: deque = deque(maxlen=TRAIN_RESERVOIR)
+
+    # -- recording ----------------------------------------------------------
+    def observe_step(self, step_ms: Optional[float] = None, n: int = 1,
+                     examples: int = 0) -> None:
+        """A completed step window: step count and examples ALWAYS
+        count; the per-step wall sample joins the percentile reservoir
+        only when given (the Trainer passes None for windows whose
+        lazy fetches haven't materialized yet — under log_every > 1
+        only materialize boundaries carry an honest wall reading, the
+        same dispatch-vs-settle distinction obs/drift.py makes)."""
+        with self._lock:
+            self.steps += int(n)
+            self.examples += int(examples)
+            if step_ms is not None:
+                self._step_ms.append(step_ms / 1000.0)  # reservoir in s
+
+    def observe_loss(self, value: float) -> None:
+        with self._lock:
+            self.loss = float(value)
+
+    def observe_grad_norm(self, value: float) -> None:
+        """Optional: populated when the caller fetches a grad-norm
+        metric (the guard's in-graph flag is boolean — the norm itself
+        is not fetched by default)."""
+        with self._lock:
+            self.grad_norm = float(value)
+
+    def observe_compiles(self, total: int) -> None:
+        """Cumulative compile events of THIS training run (the Trainer
+        passes the executor-lifetime delta since train() started,
+        summed across guard-rollback re-entries) — recorded
+        monotonic."""
+        with self._lock:
+            self.compile_events = max(self.compile_events, int(total))
+
+    def on_anomaly(self) -> None:
+        with self._lock:
+            self.anomalies += 1
+
+    def on_rollback(self) -> None:
+        with self._lock:
+            self.rollbacks += 1
+
+    def on_checkpoint(self) -> None:
+        with self._lock:
+            self.checkpoints += 1
+
+    def on_epoch(self) -> None:
+        with self._lock:
+            self.epochs += 1
+
+    # -- reading ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            elapsed = max(self._clock() - self._t0, 1e-9)
+            return {
+                "name": self.name,
+                "steps": self.steps,
+                "examples": self.examples,
+                "epochs": self.epochs,
+                "anomalies": self.anomalies,
+                "rollbacks": self.rollbacks,
+                "checkpoints": self.checkpoints,
+                "compile_events": self.compile_events,
+                "loss": self.loss,
+                "grad_norm": self.grad_norm,
+                "examples_per_sec": round(self.examples / elapsed, 2),
+                "steps_per_sec": round(self.steps / elapsed, 3),
+                "window_s": round(elapsed, 3),
+                "step_time": percentiles(list(self._step_ms),
+                                         qs=(0.50, 0.95)),
+            }
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (version 0.0.4) — the ONE renderer
+# ---------------------------------------------------------------------------
+
+#: ModelMetrics counters exported as pt_serve_<key>; monotonic ones get
+#: the conventional _total suffix
+_SERVE_COUNTERS = ("received", "completed", "failed", "shed_overload",
+                   "shed_deadline", "batches", "reloads")
+_SERVE_GAUGES = ("queue_depth", "batch_fill_ratio", "qps")
+_DECODE_COUNTERS = ("received", "completed", "failed", "shed_overload",
+                    "shed_deadline", "evictions", "resumes", "prefills",
+                    "prefill_tokens", "decode_steps", "tokens_out")
+_DECODE_GAUGES = ("tokens_per_sec", "slot_occupancy", "active", "waiting",
+                  "kv_blocks_in_use", "kv_blocks_capacity",
+                  "kv_high_water")
+#: data-plane (input pipeline) counters/gauges exported as pt_data_*
+#: (data/metrics.py PipelineMetrics.snapshot). wire_bytes/raw_bytes/
+#: codec_ratio are the on-wire feed codec's accounting (data/codec.py)
+_DATA_COUNTERS = ("batches", "samples")
+_DATA_GAUGES = ("batches_per_sec", "samples_per_sec", "workers",
+                "wire_bytes", "raw_bytes", "codec_ratio")
+#: train-plane counters/gauges exported as pt_train_* (TrainMetrics)
+_TRAIN_COUNTERS = ("steps", "examples", "epochs", "anomalies",
+                   "rollbacks", "checkpoints", "compile_events")
+_TRAIN_GAUGES = ("examples_per_sec", "steps_per_sec", "loss",
+                 "grad_norm")
+#: drift-monitor gauges exported as pt_model_* (obs/drift.py)
+_MODEL_GAUGES = ("predicted_step_ms", "measured_step_ms", "drift_ratio",
+                 "host_share_pct")
+
+
+def render_prometheus(snapshot: dict) -> str:
+    """Render a merged metrics snapshot (ServingMetrics.snapshot() /
+    global_snapshot()) as Prometheus text exposition (version 0.0.4).
+    None values are omitted — absence is the Prometheus idiom for 'no
+    observation yet', not 0."""
+    lines: List[str] = []
+    typed: set = set()
+
+    def esc(v) -> str:
+        # the 0.0.4 format requires \ " and newline escaped in label
+        # values; names are caller-controlled strings
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    def emit(metric: str, labels: Dict[str, str], value,
+             kind: str = "gauge") -> None:
+        if value is None:
+            return
+        if metric not in typed:
+            typed.add(metric)
+            lines.append(f"# TYPE {metric} {kind}")
+        lab = ",".join(f'{k}="{esc(v)}"' for k, v in labels.items())
+        # full precision: %g's 6 significant digits would freeze large
+        # counters between scrapes, breaking rate() on the very
+        # throughput series this exposition exists for. repr = shortest
+        # round-trip form.
+        val = float(value)
+        text = str(int(val)) if val.is_integer() else repr(val)
+        lines.append(f"{metric}{{{lab}}} {text}")
+
+    for name, snap in sorted(snapshot.get("models", {}).items()):
+        for key in _SERVE_COUNTERS:
+            emit(f"pt_serve_{key}_total", {"model": name}, snap.get(key),
+                 "counter")
+        for key in _SERVE_GAUGES:
+            emit(f"pt_serve_{key}", {"model": name}, snap.get(key))
+        for phase, pcts in snap.get("latency", {}).items():
+            for q in ("p50", "p95", "p99"):
+                emit("pt_serve_latency_ms",
+                     {"model": name, "phase": phase, "quantile": q},
+                     pcts.get(f"{q}_ms"))
+        for key, val in snap.get("phases", {}).items():
+            if key.endswith("_s"):
+                emit("pt_serve_phase_seconds_total",
+                     {"model": name, "phase": key[:-2]}, val, "counter")
+    for name, snap in sorted(snapshot.get("decode", {}).items()):
+        for key in _DECODE_COUNTERS:
+            emit(f"pt_decode_{key}_total", {"model": name}, snap.get(key),
+                 "counter")
+        for key in _DECODE_GAUGES:
+            emit(f"pt_decode_{key}", {"model": name}, snap.get(key))
+        for key in ("prefill_s", "decode_s"):
+            emit("pt_decode_phase_seconds_total",
+                 {"model": name, "phase": key[:-2]}, snap.get(key),
+                 "counter")
+    for name, snap in sorted(snapshot.get("data", {}).items()):
+        for key in _DATA_COUNTERS:
+            emit(f"pt_data_{key}_total", {"pipeline": name},
+                 snap.get(key), "counter")
+        for key in _DATA_GAUGES:
+            emit(f"pt_data_{key}", {"pipeline": name}, snap.get(key))
+        for stage, st in snap.get("stages", {}).items():
+            emit("pt_data_stage_seconds_total",
+                 {"pipeline": name, "stage": stage}, st.get("busy_s"),
+                 "counter")
+            emit("pt_data_stage_occupancy",
+                 {"pipeline": name, "stage": stage}, st.get("occupancy"))
+    for name, snap in sorted(snapshot.get("train", {}).items()):
+        for key in _TRAIN_COUNTERS:
+            emit(f"pt_train_{key}_total", {"trainer": name},
+                 snap.get(key), "counter")
+        for key in _TRAIN_GAUGES:
+            emit(f"pt_train_{key}", {"trainer": name}, snap.get(key))
+        for q, val in (snap.get("step_time") or {}).items():
+            emit("pt_train_step_time_ms",
+                 {"trainer": name, "quantile": q[:-3]}, val)
+    for name, snap in sorted(snapshot.get("model", {}).items()):
+        for key in _MODEL_GAUGES:
+            emit(f"pt_model_{key}", {"program": name}, snap.get(key))
+        emit("pt_model_steps_total", {"program": name},
+             snap.get("steps"), "counter")
+        if snap.get("bound") is not None:
+            # declared roofline bound as an info-style series: the label
+            # carries the enum, the value is a constant 1
+            emit("pt_model_bound",
+                 {"program": name, "bound": snap["bound"]}, 1)
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# exposition conformance (the CI `obs` leg's check)
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\\\|\\"|\\n)*)"')
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Check Prometheus text-format (0.0.4) conformance: every sample
+    line parses (`name{labels} value`), every metric has a `# TYPE`
+    line BEFORE its first sample, label values are correctly escaped,
+    no duplicate series. Returns problems (empty = conformant)."""
+    problems: List[str] = []
+    typed: set = set()
+    seen_series: set = set()
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                if parts[3] not in ("counter", "gauge", "histogram",
+                                    "summary", "untyped"):
+                    problems.append(f"line {i}: unknown TYPE {parts[3]!r}")
+                if parts[2] in typed:
+                    problems.append(
+                        f"line {i}: duplicate TYPE for {parts[2]!r}")
+                typed.add(parts[2])
+            continue
+        m = _NAME_RE.match(line)
+        if m is None:
+            problems.append(f"line {i}: unparsable sample {line!r}")
+            continue
+        name = m.group(0)
+        rest = line[m.end():]
+        labels = ""
+        if rest.startswith("{"):
+            close = rest.find("}")
+            if close < 0:
+                problems.append(f"line {i}: unterminated label set")
+                continue
+            labels = rest[1:close]
+            rest = rest[close + 1:]
+            consumed = _LABEL_RE.sub("", labels).replace(",", "").strip()
+            if consumed:
+                problems.append(
+                    f"line {i}: malformed/unescaped labels {labels!r}")
+        value = rest.strip().split()[0] if rest.strip() else ""
+        try:
+            float(value)
+        except ValueError:
+            problems.append(f"line {i}: non-numeric value {value!r}")
+        if name not in typed:
+            problems.append(
+                f"line {i}: sample for {name!r} has no preceding # TYPE")
+        series = (name, tuple(sorted(_LABEL_RE.findall(labels))))
+        if series in seen_series:
+            problems.append(f"line {i}: duplicate series {name}"
+                            f"{{{labels}}}")
+        seen_series.add(series)
+    return problems

@@ -255,9 +255,17 @@ class ParallelExecutor(TimedExecutorMixin):
             # dp, an upper bound under tp/ZeRO — conservative-safe.
             from ..analysis.memory import enforce_budget
             from ..core.executor import _autotune_batch_hint
-            enforce_budget(program, batch=_autotune_batch_hint(
-                program, feed_arrays, 1 if per_step else 0),
-                mesh=self._mesh)
+            bh = _autotune_batch_hint(program, feed_arrays,
+                                      1 if per_step else 0)
+            enforce_budget(program, batch=bh, mesh=self._mesh)
+            # drift monitor (obs/drift.py): whole-program roofline
+            # prediction recorded at compile time, same contract as the
+            # single-chip Executor — measured sharded steps fold into
+            # the same pt_model_* entry
+            if fetch_names:
+                from ..obs import drift as obs_drift
+                obs_drift.observe_prediction(program, batch=bh,
+                                             timer=self._timings)
             if loop is None:
                 step, state_out = lowering.build_step_fn(
                     program, list(feed_arrays), fetch_names, sorted(state),
@@ -340,7 +348,7 @@ class ParallelExecutor(TimedExecutorMixin):
             fetch_list, feed, loop=(n_steps, per_step_feeds, unroll),
             guard=guard)
         return self._execute(compiled, state, feed_arrays, return_numpy,
-                             was_cached, lazy=lazy)
+                             was_cached, lazy=lazy, n_steps=n_steps)
 
     def run(self, fetch_list: Sequence, feed: Optional[dict] = None,
             feed_dict: Optional[dict] = None, return_numpy: bool = True,
@@ -355,11 +363,18 @@ class ParallelExecutor(TimedExecutorMixin):
                              was_cached, lazy=lazy)
 
     def _execute(self, compiled, state, feed_arrays, return_numpy,
-                 was_cached=True, lazy=False):
+                 was_cached=True, lazy=False, n_steps=1):
         program = self._program
         seed = program.random_seed if program.random_seed is not None else 0
         self._run_counter += 1
         rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._run_counter)
+        # measured-step recorder (obs/drift.py): settle-to-settle gaps,
+        # cached runs only — see Executor._run_impl for the rationale
+        settle = None
+        if was_cached and compiled.fetch_names:
+            from ..obs import drift as obs_drift
+            settle = obs_drift.step_recorder(program.fingerprint(),
+                                             n_steps)
         t0 = time.perf_counter()
         with self._mesh:
             fetches, new_state = compiled.fn(state, feed_arrays, rng)
@@ -367,11 +382,17 @@ class ParallelExecutor(TimedExecutorMixin):
         for name, val in new_state.items():
             self._scope.set_var(name, val)
         if lazy:
-            return [LazyFetch(f, self._timings, provenance={"fetch": n})
+            from ..obs import trace as obs_trace
+            span_ctx = obs_trace.current_attrs()
+            return [LazyFetch(f, self._timings,
+                              provenance=dict(span_ctx, fetch=n),
+                              on_settle=settle)
                     for n, f in zip(compiled.fetch_names, fetches)]
         if return_numpy:
             with self._timings.span("device"):
                 jax.block_until_ready(fetches)
+            if settle is not None:
+                settle()
             with self._timings.span("fetch"):
                 # host-sync: ok — the sync return contract (return_numpy)
                 return [np.asarray(f) for f in fetches]

@@ -58,7 +58,8 @@ _null_span = _NullSpan()
 
 
 def double_buffer(reader: Callable, place=None, capacity: int = 2,
-                  retry_policy=None, transform=None, instrument=None):
+                  retry_policy=None, transform=None, instrument=None,
+                  cursor0: int = 0):
     """Wrap a feed-dict reader so device uploads overlap compute.
 
     reader() yields dicts of numpy arrays (or anything jax.device_put
@@ -83,6 +84,9 @@ def double_buffer(reader: Callable, place=None, capacity: int = 2,
 
     instrument: a data.metrics.PipelineMetrics (duck-typed: span()) —
     the upload/augment stages report their busy time through it.
+    cursor0 offsets the cursor= attribute their emitted trace spans
+    carry, so after a pipeline resume (iter_from(n)) the upload span of
+    batch n agrees with its decode/encode spans upstream.
     """
     import jax
     if retry_policy is not None:
@@ -147,8 +151,9 @@ def double_buffer(reader: Callable, place=None, capacity: int = 2,
                     item = get(q_host)
                     if item is _STOP:
                         return
-                    span = (instrument.span("upload") if instrument
-                            else _null_span)
+                    span = (instrument.span("upload",
+                                            cursor=cursor0 + idx)
+                            if instrument else _null_span)
                     with span:
                         if isinstance(item, dict):
                             item = {k: jax.device_put(v)
@@ -156,8 +161,9 @@ def double_buffer(reader: Callable, place=None, capacity: int = 2,
                         else:
                             item = jax.device_put(item)
                     if transform is not None:
-                        span = (instrument.span("augment") if instrument
-                                else _null_span)
+                        span = (instrument.span("augment",
+                                                cursor=cursor0 + idx)
+                                if instrument else _null_span)
                         with span:
                             item = transform(item, idx)
                     idx += 1

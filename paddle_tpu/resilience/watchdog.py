@@ -57,7 +57,7 @@ def deadline() -> Optional[float]:
     return d if d > 0 else None
 
 
-def _dump(provenance, timer, deadline_s: float) -> str:
+def _dump(provenance, timer, deadline_s: float, spans=None) -> str:
     lines = [
         f"device step did not settle within {deadline_s:g}s "
         f"({DEADLINE_ENV}) — stuck in phase 'device' (dispatch returned, "
@@ -66,6 +66,15 @@ def _dump(provenance, timer, deadline_s: float) -> str:
     if provenance:
         ctx = ", ".join(f"{k}={v!r}" for k, v in sorted(provenance.items()))
         lines.append(f"in-flight fetch: {ctx}")
+    if spans:
+        # the active span stack (obs/trace.py): which phase/stage/
+        # request the caller was inside when the step hung — the
+        # structured-trace reading of "where were we"
+        chain = " > ".join(
+            f"{s['cat']}:{s['name']}"
+            + (f"{s['attrs']}" if s.get("attrs") else "")
+            for s in spans)
+        lines.append(f"active spans (outermost first): {chain}")
     if timer is not None:
         lines.append(f"accounted phases since last reset: {timer.snapshot()}")
     lines.append("the hung wait was abandoned on its monitor thread (XLA "
@@ -149,7 +158,9 @@ def wait_until_ready(value, deadline_s: Optional[float] = None,
     mon, settled, err = _submit(value)
     if not settled.wait(d):
         _abandon(mon)
-        raise StepHungError(_dump(provenance, timer, d))
+        from ..obs import trace as obs_trace
+        raise StepHungError(_dump(provenance, timer, d,
+                                  spans=obs_trace.active_stack()))
     if err:
         raise err[0]
     return value

@@ -33,6 +33,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Dict, List, Optional
 
+from ..obs import trace as obs_trace
 from ..resilience import faults
 from .admission import (AdmissionController, DeadlineExceeded,
                         ModelUnavailable, RequestFailed)
@@ -64,9 +65,16 @@ def env_int(name: str, default: int) -> int:
 
 class Request:
     """One queued example: feeds + deadline + the Future its caller
-    holds. Timing fields feed the queue-phase latency metric."""
+    holds. Timing fields feed the queue-phase latency metric.
 
-    __slots__ = ("feeds", "bucket", "future", "deadline_t", "t_enqueue")
+    With tracing armed (PT_TRACE), each request is minted an id at
+    submission and captures the submitter's span context (the HTTP
+    ingress span, for front-end traffic) — the dispatcher thread
+    parents the request's queue/batch spans under it, so one request's
+    queue -> pad -> device -> scatter lifeline reads as one trace."""
+
+    __slots__ = ("feeds", "bucket", "future", "deadline_t", "t_enqueue",
+                 "rid", "ctx")
 
     def __init__(self, feeds, bucket, deadline_t: Optional[float]):
         self.feeds = feeds
@@ -74,6 +82,12 @@ class Request:
         self.future: Future = Future()
         self.deadline_t = deadline_t
         self.t_enqueue = time.monotonic()
+        if obs_trace.enabled():
+            self.rid = obs_trace.new_id()
+            self.ctx = obs_trace.current_context()
+        else:
+            self.rid = None
+            self.ctx = None
 
 
 class MicroBatcher:
@@ -237,13 +251,32 @@ class MicroBatcher:
         if not live:
             return
         queue_s = [now - r.t_enqueue for r in live]
+        if obs_trace.enabled():
+            # per-request queue spans, parented under each submitter's
+            # context (the HTTP ingress span) — the measured wait ended
+            # now, so the span is emitted with its known duration
+            for r, qs in zip(live, queue_s):
+                obs_trace.complete("queue", qs, cat="serve",
+                                   parent=r.ctx, model=self.name,
+                                   rid=r.rid)
         self.metrics.on_batch(len(live), self.max_batch_size)
+        # the batch span parents the pad/device/scatter phase spans the
+        # model's timer emits; a single-request batch adopts THAT
+        # request's trace (the common online case — one request, one
+        # causal timeline end to end), a coalesced batch records every
+        # rid it serves
+        batch_span = obs_trace.span(
+            "batch", cat="serve",
+            parent=(live[0].ctx if len(live) == 1 else None),
+            model=self.name, n=len(live),
+            rids=[r.rid for r in live])
         t0 = time.monotonic()
         try:
-            faults.crash_point("serve_dispatch")
-            results, phase_s = self.model.execute_batch(
-                bucket, [r.feeds for r in live],
-                timer=self.metrics.timer)
+            with batch_span:
+                faults.crash_point("serve_dispatch")
+                results, phase_s = self.model.execute_batch(
+                    bucket, [r.feeds for r in live],
+                    timer=self.metrics.timer)
         except BaseException as e:  # noqa: BLE001 — typed + re-delivered
             batch_s = time.monotonic() - t0
             self.admission.observe_batch(batch_s)

@@ -41,6 +41,7 @@ from typing import Dict, List, Optional, Sequence as Seq
 
 import numpy as np
 
+from ...obs import trace as obs_trace
 from ..admission import (AdmissionController, DeadlineExceeded,
                          ModelUnavailable, Overloaded)
 from ..metrics import DecodeMetrics
@@ -114,7 +115,7 @@ class Sequence:
 
     __slots__ = ("sid", "prompt", "max_new", "deadline_t", "priority",
                  "eos_id", "handle", "t_submit", "generated", "blocks",
-                 "slot", "cached_len", "evictions")
+                 "slot", "cached_len", "evictions", "ctx")
 
     def __init__(self, sid: int, prompt: List[int], max_new: int,
                  deadline_t: Optional[float], priority: int,
@@ -134,6 +135,11 @@ class Sequence:
         #: token is never cached (it is the next step's input)
         self.cached_len = 0
         self.evictions = 0
+        #: submitter's trace context (the HTTP ingress span) — the
+        #: scheduler thread parents this sequence's prefill/evict/resume
+        #: events under it (obs/trace.py)
+        self.ctx = obs_trace.current_context() if obs_trace.enabled() \
+            else None
 
     @property
     def tokens_so_far(self) -> List[int]:
@@ -346,6 +352,9 @@ class DecodeScheduler:
         victim.cached_len = 0
         victim.evictions += 1
         self.metrics.on_evicted()
+        obs_trace.instant("evict", cat="decode", parent=victim.ctx,
+                          model=self.name, sid=victim.sid,
+                          generated=len(victim.generated))
         if len(victim.tokens_so_far) > self.model.max_prompt_len:
             self.metrics.on_shed("overload")
             self._terminate(victim, error=Overloaded(
@@ -398,6 +407,8 @@ class DecodeScheduler:
             self._waiting.remove(seq)
             if seq.evictions:
                 self.metrics.on_resumed()
+                obs_trace.instant("resume", cat="decode", parent=seq.ctx,
+                                  model=self.name, sid=seq.sid)
             seq.blocks = self.pool.alloc(need)
             t0 = time.monotonic()
             try:
@@ -410,6 +421,9 @@ class DecodeScheduler:
                 continue
             dt = time.monotonic() - t0
             self.metrics.on_prefill(len(tokens), dt)
+            obs_trace.complete("prefill", dt, cat="decode",
+                               parent=seq.ctx, model=self.name,
+                               sid=seq.sid, tokens=len(tokens))
             seq.cached_len = len(tokens)
             tok = int(np.argmax(last_logits))
             seq.generated.append(tok)
@@ -466,6 +480,15 @@ class DecodeScheduler:
         dt = time.monotonic() - t0
         self.admission.observe_batch(dt)
         self.metrics.on_step(len(active), slots, dt, len(active))
+        if obs_trace.enabled():
+            # one fixed-shape dispatch serving every running sequence:
+            # the span records which sids shared it (a single-sequence
+            # step adopts that sequence's trace)
+            obs_trace.complete(
+                "decode_step", dt, cat="decode",
+                parent=(active[0].ctx if len(active) == 1 else None),
+                model=self.name, n=len(active),
+                sids=[s.sid for s in active])
         for seq in active:
             tok = int(np.argmax(logits[seq.slot]))
             seq.cached_len += 1

@@ -49,6 +49,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..obs import trace as obs_trace
 from .admission import InvalidRequest, ServingError
 from .metrics import render_prometheus
 
@@ -149,32 +150,40 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_typed(e)
 
     def _predict(self, engine, name: str) -> None:
-        body = self._read_json()
-        feeds_in = body.get("feeds")
-        if not isinstance(feeds_in, dict) or not feeds_in:
-            raise InvalidRequest("predict needs {'feeds': {name: value}}")
-        # one routing read, public surface only (ModelUnavailable -> 404)
-        model = engine.registry.get(name).model
-        # dtype-faithful conversion: the model's feed dtypes win over
-        # whatever JSON number type the client happened to send
-        dtypes = model.feed_dtypes()
-        feeds = {}
-        for k, v in feeds_in.items():
-            try:
-                feeds[k] = (np.asarray(v, dtype=dtypes[k])
-                            if k in dtypes else np.asarray(v))
-            except (TypeError, ValueError) as e:
+        # the ingress span is the request's trace ROOT: engine.submit
+        # runs on this handler thread, so the batcher's Request captures
+        # this context and the dispatcher parents the queue/batch spans
+        # under it — "why was this request slow" reads as one trace
+        with obs_trace.span("http_request", cat="serve",
+                            route="predict", model=name):
+            body = self._read_json()
+            feeds_in = body.get("feeds")
+            if not isinstance(feeds_in, dict) or not feeds_in:
                 raise InvalidRequest(
-                    f"feed {k!r} is not coercible: {e}") from e
-        fut = engine.submit(name, feeds,
-                            deadline_ms=body.get("deadline_ms"))
-        result = fut.result()   # engine deadline machinery bounds this
-        fetches = {
-            k: {"data": v.tolist(), "shape": list(v.shape),
-                "dtype": v.dtype.name}
-            for k, v in result.items()}
-        self._send(200, {"fetches": fetches,
-                         "model_version": model.version})
+                    "predict needs {'feeds': {name: value}}")
+            # one routing read, public surface only
+            # (ModelUnavailable -> 404)
+            model = engine.registry.get(name).model
+            # dtype-faithful conversion: the model's feed dtypes win
+            # over whatever JSON number type the client happened to send
+            dtypes = model.feed_dtypes()
+            feeds = {}
+            for k, v in feeds_in.items():
+                try:
+                    feeds[k] = (np.asarray(v, dtype=dtypes[k])
+                                if k in dtypes else np.asarray(v))
+                except (TypeError, ValueError) as e:
+                    raise InvalidRequest(
+                        f"feed {k!r} is not coercible: {e}") from e
+            fut = engine.submit(name, feeds,
+                                deadline_ms=body.get("deadline_ms"))
+            result = fut.result()   # engine deadline machinery bounds this
+            fetches = {
+                k: {"data": v.tolist(), "shape": list(v.shape),
+                    "dtype": v.dtype.name}
+                for k, v in result.items()}
+            self._send(200, {"fetches": fetches,
+                             "model_version": model.version})
 
     def _generate(self, engine, name: str) -> None:
         body = self._read_json()
@@ -188,11 +197,19 @@ class _Handler(BaseHTTPRequestHandler):
             if body.get(key) is not None:
                 kw[key] = body[key]
         # typed admission errors raise BEFORE any response bytes -> they
-        # map to their status like every other route
-        handle = engine.generate(name, prompt, **kw)
-        if not body.get("stream", True):
-            result = handle.result()
-            return self._send(200, result)
+        # map to their status like every other route. The ingress span
+        # roots the request's trace: the decode scheduler parents its
+        # prefill/decode/evict/resume events under this context. For
+        # non-streaming requests it also covers the result() wait (the
+        # full wall time, like _predict); a streaming response's span
+        # necessarily closes at submit — its duration lives in the
+        # scheduler's per-sequence events instead.
+        with obs_trace.span("http_request", cat="serve",
+                            route="generate", model=name):
+            handle = engine.generate(name, prompt, **kw)
+            if not body.get("stream", True):
+                result = handle.result()
+                return self._send(200, result)
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")

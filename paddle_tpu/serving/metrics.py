@@ -26,9 +26,13 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Optional
 
 from ..core.async_fetch import PhaseTimer
+from ..obs.metrics import REGISTRY, percentiles
+from ..obs.metrics import render_prometheus  # noqa: F401 — re-export:
+# the ONE exposition renderer now lives on the unified metrics plane
+# (obs/metrics.py); existing importers keep working unchanged.
 
 __all__ = ["ServingPhaseTimer", "ModelMetrics", "DecodeMetrics",
            "ServingMetrics", "PHASES", "render_prometheus"]
@@ -43,9 +47,11 @@ class ServingPhaseTimer(PhaseTimer):
     """PhaseTimer (same span()/add() surface as the executor's) over the
     serving request phases. snapshot() is re-derived here: the training
     timer's host_overhead_pct reads training-phase keys that do not
-    exist on this axis."""
+    exist on this axis. Emitted trace spans land under the "serve"
+    category (one timing source, two views — see PhaseTimer.add)."""
 
     PHASES = PHASES
+    trace_cat = "serve"
 
     def snapshot(self, reset: bool = False) -> dict:
         with self._lock:
@@ -57,19 +63,9 @@ class ServingPhaseTimer(PhaseTimer):
         return out
 
 
-def _percentiles(samples: List[float]) -> Dict[str, float]:
-    """p50/p95/p99 by nearest-rank over a sorted copy, in milliseconds."""
-    if not samples:
-        return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
-    s = sorted(samples)
-    n = len(s)
-
-    def rank(q: float) -> float:
-        i = min(n - 1, max(0, int(round(q * (n - 1)))))
-        return round(s[i] * 1000.0, 3)
-
-    return {"p50_ms": rank(0.50), "p95_ms": rank(0.95),
-            "p99_ms": rank(0.99)}
+#: p50/p95/p99 by nearest-rank, in ms — shared with the train-plane
+#: family (obs/metrics.py owns the one implementation now)
+_percentiles = percentiles
 
 
 class ModelMetrics:
@@ -327,109 +323,19 @@ class ServingMetrics:
         out = {"models": {m.name: m.snapshot() for m in models}}
         if decode:
             out["decode"] = {m.name: m.snapshot() for m in decode}
-        # the data plane reports through the same snapshot (and so the
-        # same Prometheus scrape): any live, named input pipeline in
-        # this process (data/metrics.py registry) rides along as the
-        # pt_data_* family — trainer and serving report through one pane
-        from ..data.metrics import registry_snapshots
-        pipelines = registry_snapshots()
-        if pipelines:
-            out["data"] = pipelines
+        # every other plane reports through the same snapshot (and so
+        # the same Prometheus scrape) via the unified MetricsRegistry
+        # (obs/metrics.py): live input pipelines (pt_data_*), the
+        # training loop (pt_train_*), and the predicted-vs-measured
+        # drift monitor (pt_model_*) all ride along — one scrape, one
+        # observability plane.
+        for section, snaps in REGISTRY.snapshot().items():
+            if snaps:
+                out.setdefault(section, snaps)
         return out
 
 
-# ---------------------------------------------------------------------------
-# Prometheus text exposition (the first brick of the ROADMAP's unified
-# observability plane): flatten a snapshot() dict into the standard
-# `name{labels} value` lines so any Prometheus-compatible scraper can
-# consume the serving metrics straight off the existing HTTP front end
-# (GET /v1/metrics?format=prometheus).
-# ---------------------------------------------------------------------------
-
-#: ModelMetrics counters exported as pt_serve_<key>; monotonic ones get
-#: the conventional _total suffix
-_SERVE_COUNTERS = ("received", "completed", "failed", "shed_overload",
-                   "shed_deadline", "batches", "reloads")
-_SERVE_GAUGES = ("queue_depth", "batch_fill_ratio", "qps")
-_DECODE_COUNTERS = ("received", "completed", "failed", "shed_overload",
-                    "shed_deadline", "evictions", "resumes", "prefills",
-                    "prefill_tokens", "decode_steps", "tokens_out")
-_DECODE_GAUGES = ("tokens_per_sec", "slot_occupancy", "active", "waiting",
-                  "kv_blocks_in_use", "kv_blocks_capacity",
-                  "kv_high_water")
-#: data-plane (input pipeline) counters/gauges exported as pt_data_*
-#: (data/metrics.py PipelineMetrics.snapshot). wire_bytes/raw_bytes/
-#: codec_ratio are the on-wire feed codec's accounting (data/codec.py):
-#: what the host->device pipe actually carried vs what raw f32 would
-#: have cost.
-_DATA_COUNTERS = ("batches", "samples")
-_DATA_GAUGES = ("batches_per_sec", "samples_per_sec", "workers",
-                "wire_bytes", "raw_bytes", "codec_ratio")
-
-
-def render_prometheus(snapshot: dict) -> str:
-    """Render a ServingMetrics.snapshot() as Prometheus text exposition
-    (version 0.0.4). None values are omitted — absence is the Prometheus
-    idiom for 'no observation yet', not 0."""
-    lines: List[str] = []
-
-    def esc(v) -> str:
-        # the 0.0.4 format requires \ " and newline escaped in label
-        # values; model names are caller-controlled strings
-        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
-                .replace("\n", "\\n"))
-
-    def emit(metric: str, labels: Dict[str, str], value,
-             kind: str = "gauge") -> None:
-        if value is None:
-            return
-        if not any(ln.startswith(f"# TYPE {metric} ") for ln in lines):
-            lines.append(f"# TYPE {metric} {kind}")
-        lab = ",".join(f'{k}="{esc(v)}"' for k, v in labels.items())
-        # full precision: %g's 6 significant digits would freeze large
-        # counters between scrapes, breaking rate() on the very
-        # throughput series this exposition exists for
-        val = float(value)
-        # repr = shortest round-trip form: exact (unlike %g's 6 digits)
-        # without the .17g noise ("0.33329999999999999" for 0.3333)
-        text = str(int(val)) if val.is_integer() else repr(val)
-        lines.append(f"{metric}{{{lab}}} {text}")
-
-    for name, snap in sorted(snapshot.get("models", {}).items()):
-        for key in _SERVE_COUNTERS:
-            emit(f"pt_serve_{key}_total", {"model": name}, snap.get(key),
-                 "counter")
-        for key in _SERVE_GAUGES:
-            emit(f"pt_serve_{key}", {"model": name}, snap.get(key))
-        for phase, pcts in snap.get("latency", {}).items():
-            for q in ("p50", "p95", "p99"):
-                emit("pt_serve_latency_ms",
-                     {"model": name, "phase": phase, "quantile": q},
-                     pcts.get(f"{q}_ms"))
-        for key, val in snap.get("phases", {}).items():
-            if key.endswith("_s"):
-                emit("pt_serve_phase_seconds_total",
-                     {"model": name, "phase": key[:-2]}, val, "counter")
-    for name, snap in sorted(snapshot.get("decode", {}).items()):
-        for key in _DECODE_COUNTERS:
-            emit(f"pt_decode_{key}_total", {"model": name}, snap.get(key),
-                 "counter")
-        for key in _DECODE_GAUGES:
-            emit(f"pt_decode_{key}", {"model": name}, snap.get(key))
-        for key in ("prefill_s", "decode_s"):
-            emit("pt_decode_phase_seconds_total",
-                 {"model": name, "phase": key[:-2]}, snap.get(key),
-                 "counter")
-    for name, snap in sorted(snapshot.get("data", {}).items()):
-        for key in _DATA_COUNTERS:
-            emit(f"pt_data_{key}_total", {"pipeline": name},
-                 snap.get(key), "counter")
-        for key in _DATA_GAUGES:
-            emit(f"pt_data_{key}", {"pipeline": name}, snap.get(key))
-        for stage, st in snap.get("stages", {}).items():
-            emit("pt_data_stage_seconds_total",
-                 {"pipeline": name, "stage": stage}, st.get("busy_s"),
-                 "counter")
-            emit("pt_data_stage_occupancy",
-                 {"pipeline": name, "stage": stage}, st.get("occupancy"))
-    return "\n".join(lines) + "\n"
+# The Prometheus text renderer lived here until the obs consolidation
+# (obs/metrics.py render_prometheus is the ONE renderer for every
+# family — pt_serve_*/pt_decode_*/pt_data_*/pt_train_*/pt_model_*);
+# it is re-exported above so importers keep working.

@@ -16,6 +16,7 @@ import itertools
 import os
 import signal
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
@@ -49,6 +50,35 @@ def _shape_chunks(batches, n: int):
         cur = s
     if window:
         yield window
+
+
+def _window_examples(window, n_in_window: int) -> int:
+    """Best-effort example count of one dispatched window (pt_train_*
+    examples accounting): the leading batch dim of any feed array —
+    dim 1 under a stacked [n, B, ...] window, dim 0 per-step."""
+    try:
+        feed = window if isinstance(window, dict) else window[0]
+        shp = np.shape(next(iter(feed.values())))
+        if isinstance(window, dict):
+            return int(shp[1]) * n_in_window if len(shp) > 1 \
+                else n_in_window
+        return int(shp[0]) * n_in_window if shp else n_in_window
+    except Exception:   # noqa: BLE001 — metrics must not kill the loop
+        return 0
+
+
+def _observe_loss(tm, metrics) -> None:
+    """Record the freshest materialized loss scalar (metrics[0]) on the
+    train-plane family. Called only at log boundaries, where metrics
+    are already numpy — no extra sync."""
+    if tm is None or not metrics:
+        return
+    try:
+        m0 = np.asarray(metrics[0])  # host-sync: ok — already materialized
+        tm.observe_loss(float(m0.reshape(-1)[-1]))
+    except Exception:   # noqa: BLE001 — metrics must not kill the loop
+        pass
+
 
 __all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
            "EndStepEvent", "CheckpointConfig", "Trainer", "Inferencer"]
@@ -271,11 +301,24 @@ class Trainer:
         the executor run counter, and fast-forwards the reader, so a
         resumed run matches the uninterrupted one bit-exactly for
         deterministic readers."""
+        from .obs import trace as obs_trace
+        from .obs.metrics import REGISTRY, TrainMetrics
         from .reader.prefetch import DeviceFeeder
         from .resilience import faults
         from .resilience import guard as guard_mod
         from .resilience import watchdog as watchdog_mod
         from .resilience.retry import RetryPolicy, resilient_reader
+        # the train-plane metric family (pt_train_*): one provider per
+        # train() call, registered on the unified metrics plane so the
+        # serving scrape (and obs.global_snapshot) sees the training
+        # loop beside pt_serve_*/pt_decode_*/pt_data_*
+        self.train_metrics = TrainMetrics()
+        REGISTRY.register("train", self.train_metrics.name,
+                          self.train_metrics)
+        #: compile events from FINISHED _train_impl segments — a guard
+        #: rollback re-enters with a fresh executor baseline (and the
+        #: parallel path builds a fresh executor), so segments must SUM
+        self._compile_events_prior = 0
         # -- training guardrails (PT_GUARD; resilience/guard.py) ----------
         # validate the watchdog knob up front: a malformed deadline must
         # fail HERE as a config error, not minutes later inside a lazy
@@ -342,19 +385,23 @@ class Trainer:
                 except (ValueError, OSError):  # pragma: no cover
                     pass
         try:
-            while True:
-                try:
-                    self._train_impl(num_epochs, event_handler, reader,
-                                     feed_order, double_buffer,
-                                     steps_per_loop, DeviceFeeder, faults,
-                                     max(int(log_every), 1))
-                    break
-                except guard_mod.RollbackSignal as rb:
-                    # PT_GUARD=rollback: restore the newest verified
-                    # serial and re-enter — resume fast-forwards the
-                    # reader and replays rng, exactly the crash-resume
-                    # machinery, so recovery is bit-exact-testable
-                    self._guard_rollback(rb)
+            # PT_TRACE_DIR (+PT_TRACE): a jax.profiler.trace session
+            # around the whole loop writes device-side op attribution
+            # (the per-op named_scopes) beside the host-side spans
+            with obs_trace.device_profile():
+                while True:
+                    try:
+                        self._train_impl(num_epochs, event_handler, reader,
+                                         feed_order, double_buffer,
+                                         steps_per_loop, DeviceFeeder,
+                                         faults, max(int(log_every), 1))
+                        break
+                    except guard_mod.RollbackSignal as rb:
+                        # PT_GUARD=rollback: restore the newest verified
+                        # serial and re-enter — resume fast-forwards the
+                        # reader and replays rng, exactly the crash-resume
+                        # machinery, so recovery is bit-exact-testable
+                        self._guard_rollback(rb)
         finally:
             for sig, old in restore_handlers.items():
                 signal.signal(sig, old)
@@ -437,6 +484,14 @@ class Trainer:
                     "update skipped in-graph (consecutive: %d/%d, "
                     "policy=%s)", epoch_id, step0 + i, self._bad_streak,
                     patience, self._guard_policy)
+                from .obs import trace as obs_trace
+                obs_trace.instant("guard_anomaly", cat="train",
+                                  epoch=epoch_id, step=step0 + i,
+                                  streak=self._bad_streak,
+                                  policy=self._guard_policy)
+                tm = getattr(self, "train_metrics", None)
+                if tm is not None:
+                    tm.on_anomaly()
                 if self._bad_streak < patience:
                     continue
                 if self._guard_policy == "raise":
@@ -502,6 +557,12 @@ class Trainer:
         self._bad_streak = 0
         self._guard_rollbacks += 1
         self._last_rollback_at = (rb.epoch, rb.step)
+        from .obs import trace as obs_trace
+        obs_trace.instant("guard_rollback", cat="train", epoch=rb.epoch,
+                          step=rb.step, serial=serial)
+        _tm = getattr(self, "train_metrics", None)
+        if _tm is not None:
+            _tm.on_rollback()
         logging.getLogger("paddle_tpu").warning(
             "[guard] %d consecutive anomalous steps (epoch %d step %d): "
             "rolled back to verified checkpoint serial %d — resuming at "
@@ -512,6 +573,8 @@ class Trainer:
                     double_buffer, steps_per_loop, DeviceFeeder, faults,
                     log_every=1):
         from .core.async_fetch import materialize, LazyFetch
+        from .obs import trace as obs_trace
+        tm = getattr(self, "train_metrics", None)
         guard_on = bool(self._guard_policy)
         # data-pipeline epoch pinning (data/pipeline.py): captured BEFORE
         # any host-table rewrap — the underlying pipeline object is shared
@@ -526,6 +589,17 @@ class Trainer:
                                          main_program=self.train_program,
                                          scope=self.scope)
                         if self.parallel else self.exe)
+            # pt_train_compile_events_total counts compiles THIS run
+            # caused: the executor's lifetime counter already includes
+            # the startup program (and any pre-train use), so record
+            # the delta from here, on top of prior segments' total
+            compile0 = getattr(executor, "compile_count", 0)
+            compile_prior = getattr(self, "_compile_events_prior", 0)
+
+            def _note_compiles():
+                delta = getattr(executor, "compile_count", 0) - compile0
+                self._compile_events_prior = compile_prior + delta
+                tm.observe_compiles(self._compile_events_prior)
             start_epoch = (self.checkpoint_cfg.epoch_id
                            if self.checkpoint_cfg else 0)
             use_loop = steps_per_loop > 1
@@ -590,11 +664,16 @@ class Trainer:
                 if guard_on:
                     health, outs = outs[-1], list(outs[:-1])
                     self._pending_health.append((epoch_id, step0, n, health))
-                for m in outs:
-                    if isinstance(m, LazyFetch):
-                        m.annotate(epoch=epoch_id, step=step0)
-                if isinstance(health, LazyFetch):
-                    health.annotate(epoch=epoch_id, step=step0)
+                if not obs_trace.enabled():
+                    # span-context reuse: with tracing armed the executor
+                    # captured the step span's attrs (epoch/step) into
+                    # every handle's provenance at creation — annotating
+                    # again here would be duplicate plumbing
+                    for m in outs:
+                        if isinstance(m, LazyFetch):
+                            m.annotate(epoch=epoch_id, step=step0)
+                    if isinstance(health, LazyFetch):
+                        health.annotate(epoch=epoch_id, step=step0)
                 return outs, health
 
             def _run_window(feed, fetch, n, epoch_id, step0):
@@ -604,31 +683,40 @@ class Trainer:
                 # Fetches come back LAZY: window N+1's host-side stacking
                 # and upload overlap window N's device loop, and the
                 # handles materialize only at log_every boundaries.
+                # The step span parents the executor's phase spans (one
+                # causal timeline) and its epoch/step attrs ride every
+                # lazy handle's provenance.
                 full = list(fetch) + ht_fetch
-                if self.parallel:
-                    outs = executor.run_loop(fetch_list=full, feed=feed,
-                                             n_steps=n, per_step_feeds=True,
-                                             lazy=True, guard=guard_on)
-                else:
-                    outs = executor.run_loop(self.train_program, feed=feed,
-                                             fetch_list=full, n_steps=n,
-                                             per_step_feeds=True, lazy=True,
-                                             guard=guard_on)
-                outs, health = _strip_health(outs, epoch_id, step0, n)
-                return _apply_host_grads(outs, stacked_steps=n,
-                                         health=health)
+                with obs_trace.span("step", cat="train", epoch=epoch_id,
+                                    step=step0, n=n):
+                    if self.parallel:
+                        outs = executor.run_loop(fetch_list=full, feed=feed,
+                                                 n_steps=n,
+                                                 per_step_feeds=True,
+                                                 lazy=True, guard=guard_on)
+                    else:
+                        outs = executor.run_loop(self.train_program,
+                                                 feed=feed,
+                                                 fetch_list=full, n_steps=n,
+                                                 per_step_feeds=True,
+                                                 lazy=True, guard=guard_on)
+                    outs, health = _strip_health(outs, epoch_id, step0, n)
+                    return _apply_host_grads(outs, stacked_steps=n,
+                                             health=health)
 
             def _run_one(feed, fetch, epoch_id, step_id):
                 full = list(fetch) + ht_fetch
-                if self.parallel:
-                    outs = executor.run(fetch_list=full, feed=feed,
-                                        lazy=True, guard=guard_on)
-                else:
-                    outs = executor.run(self.train_program, feed=feed,
-                                        fetch_list=full, lazy=True,
-                                        guard=guard_on)
-                outs, health = _strip_health(outs, epoch_id, step_id, 1)
-                return _apply_host_grads(outs, health=health)
+                with obs_trace.span("step", cat="train", epoch=epoch_id,
+                                    step=step_id, n=1):
+                    if self.parallel:
+                        outs = executor.run(fetch_list=full, feed=feed,
+                                            lazy=True, guard=guard_on)
+                    else:
+                        outs = executor.run(self.train_program, feed=feed,
+                                            fetch_list=full, lazy=True,
+                                            guard=guard_on)
+                    outs, health = _strip_health(outs, epoch_id, step_id, 1)
+                    return _apply_host_grads(outs, health=health)
             for epoch_id in range(start_epoch, num_epochs):
                 # mid-epoch resume: the checkpoint recorded the NEXT step
                 # to run; skip that many batches (undelivered — no events
@@ -648,6 +736,18 @@ class Trainer:
                     (r.iter_from(n) if hasattr(r, "iter_from")
                      else itertools.islice(r(), n, None)))
                 event_handler(BeginEpochEvent(epoch_id))
+                obs_trace.instant("epoch_begin", cat="train",
+                                  epoch=epoch_id)
+                # pt_train_* step-time sampling: wall time is measured
+                # between MATERIALIZE boundaries (under log_every > 1
+                # the lazy windows in between cost only host dispatch —
+                # a gap there would read dispatch-only and the boundary
+                # gap would absorb the catch-up), divided by the steps
+                # in between. The first boundary after a (re)entry only
+                # seeds (it absorbs the compile). Step/example COUNTS
+                # record every window regardless.
+                tm_boundary = None
+                tm_pending_steps = 0
                 batches = (DeviceFeeder(feeder, epoch_reader)
                            if double_buffer and not self.parallel
                            and not use_loop
@@ -703,10 +803,25 @@ class Trainer:
                             # window contains a log step: hand the event
                             # handler real numpy, not lazy handles
                             metrics = materialize(metrics)
+                            _observe_loss(tm, metrics)
                         event_handler(EndStepEvent(epoch_id, step_id,
                                                    metrics))
                         if log_boundary:
                             self._drain_health()
+                        if tm is not None:
+                            now = time.perf_counter()
+                            tm_pending_steps += n_in_window
+                            ms = None
+                            if log_boundary:
+                                if tm_boundary is not None:
+                                    ms = ((now - tm_boundary) * 1e3
+                                          / tm_pending_steps)
+                                tm_boundary, tm_pending_steps = now, 0
+                            tm.observe_step(
+                                ms, n=n_in_window,
+                                examples=_window_examples(window,
+                                                          n_in_window))
+                            _note_compiles()
                         prev_step, step_id = step_id, step_id + n_in_window
                         iv = (self.checkpoint_cfg.step_interval
                               if self.checkpoint_cfg else 0)
@@ -722,6 +837,10 @@ class Trainer:
                                               agree=saved):
                             return
                     event_handler(EndEpochEvent(epoch_id))
+                    obs_trace.instant("epoch_end", cat="train",
+                                      epoch=epoch_id)
+                    if tm is not None:
+                        tm.on_epoch()
                     self._drain_health()
                     saved = self._epoch_checkpoint(epoch_id)
                     if self._preempt_exit(epoch_id + 1, 0, saved):
@@ -735,9 +854,23 @@ class Trainer:
                     metrics = _run_one(feed, fetch, epoch_id, step_id)
                     if step_id % log_every == 0:
                         metrics = materialize(metrics)
+                        _observe_loss(tm, metrics)
                     event_handler(EndStepEvent(epoch_id, step_id, metrics))
                     if step_id % log_every == 0:
                         self._drain_health()
+                    if tm is not None:
+                        now = time.perf_counter()
+                        tm_pending_steps += 1
+                        ms = None
+                        if step_id % log_every == 0:
+                            if tm_boundary is not None:
+                                ms = ((now - tm_boundary) * 1e3
+                                      / tm_pending_steps)
+                            tm_boundary, tm_pending_steps = now, 0
+                        tm.observe_step(
+                            ms, n=1,
+                            examples=_window_examples([feed], 1))
+                        _note_compiles()
                     # crossing semantics, matching the windowed path: fire
                     # every `step_interval` COMPLETED steps. The args
                     # record step_id+1 — the NEXT step to run — and resume
@@ -754,6 +887,10 @@ class Trainer:
                                           agree=saved):
                         return
                 event_handler(EndEpochEvent(epoch_id))
+                obs_trace.instant("epoch_end", cat="train",
+                                  epoch=epoch_id)
+                if tm is not None:
+                    tm.on_epoch()
                 self._drain_health()
                 saved = self._epoch_checkpoint(epoch_id)
                 if self._preempt_exit(epoch_id + 1, 0, saved):
@@ -841,15 +978,21 @@ class Trainer:
         next run should execute first — plus the executor run counter
         (rng-stream replay; see __init__'s restore)."""
         import jax
-        io_mod.save_checkpoint(
-            self.exe, self.checkpoint_cfg.checkpoint_dir,
-            trainer_id=jax.process_index(),
-            trainer_args={"args_version": 2, "epoch_id": epoch_id,
-                          "step_id": step_id,
-                          "run_counter": self.exe._run_counter},
-            main_program=self.train_program,
-            max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
-            scope=self.scope)
+        from .obs import trace as obs_trace
+        with obs_trace.span("checkpoint", cat="train", epoch=epoch_id,
+                            step=step_id):
+            io_mod.save_checkpoint(
+                self.exe, self.checkpoint_cfg.checkpoint_dir,
+                trainer_id=jax.process_index(),
+                trainer_args={"args_version": 2, "epoch_id": epoch_id,
+                              "step_id": step_id,
+                              "run_counter": self.exe._run_counter},
+                main_program=self.train_program,
+                max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
+                scope=self.scope)
+        tm = getattr(self, "train_metrics", None)
+        if tm is not None:
+            tm.on_checkpoint()
 
 
 class Inferencer:

@@ -2,7 +2,7 @@
 # CI entry (≙ paddle/scripts/paddle_build.sh: build + test in one place).
 # Runs the lint gate, the full suite on the 8-device virtual CPU mesh,
 # the multi-chip dryrun, and a bench sanity pass.
-# Usage: scripts/ci.sh [quick|lint|chaos|perf|serve|analyze|data]
+# Usage: scripts/ci.sh [quick|lint|chaos|perf|serve|analyze|data|obs]
 #   lint  = just the lint gate
 #   chaos = lint gate + the resilience suite under two fixed fault seeds
 #   perf  = lint gate + the async-hot-path suite (lazy fetches, per-phase
@@ -20,6 +20,11 @@
 #           placement planner (tools/plan.py): schema-checked plans for
 #           all three builders, plus the predicted-vs-measured
 #           rank-correlation gate over the hand-picked dryrun meshes
+#   obs   = lint gate + the unified-observability suite (span core,
+#           cross-thread trace correctness, ring-buffer bounds,
+#           drift-monitor EWMA, Chrome-trace JSON schema, pt_train_*/
+#           pt_model_* families, disabled-path overhead budget) + an
+#           exposition-format conformance check over a live scrape
 #   data  = lint gate + the production data-plane suite (pipeline
 #           determinism, sharding disjointness, parallel shard readers,
 #           cheap skip + checkpointable state, device-side augmentation,
@@ -54,6 +59,36 @@ if [[ "${1:-}" == "chaos" ]]; then
       tests/test_guardrails.py -q
   done
   echo "CHAOS OK"
+  exit 0
+fi
+
+if [[ "${1:-}" == "obs" ]]; then
+  echo "== obs: structured tracing + unified metrics + drift monitor =="
+  python -m pytest tests/test_obs.py -q
+  echo "== obs: Prometheus exposition conformance (live snapshot) =="
+  python - <<'PY'
+from paddle_tpu.obs.metrics import (REGISTRY, TrainMetrics,
+                                    render_prometheus,
+                                    validate_exposition)
+from paddle_tpu.serving.metrics import ServingMetrics
+
+sm = ServingMetrics()
+sm.model("conformance-model").on_received(1)
+sm.decode("conformance-decode").on_received()
+tm = TrainMetrics("conformance")
+tm.observe_step(10.0, n=1, examples=8)
+REGISTRY.register("train", tm.name, tm)
+text = render_prometheus(sm.snapshot())
+problems = validate_exposition(text)
+assert not problems, problems
+families = {ln.split("{")[0] for ln in text.splitlines()
+            if ln and not ln.startswith("#")}
+for fam in ("pt_serve_", "pt_decode_", "pt_train_"):
+    assert any(f.startswith(fam) for f in families), (fam, families)
+print(f"exposition conformant: {len(text.splitlines())} lines, "
+      f"{len(families)} series names")
+PY
+  echo "OBS OK"
   exit 0
 fi
 
