@@ -239,6 +239,21 @@ def _train_loop(main_prog, startup, fetch, feed, steps, unroll=2,
             finally:
                 os.environ.pop("PT_TRACE", None)
                 pt_trace.reset()   # drop the A/B's events: bench-local
+        # per-op attribution (obs/opprof.py): the measured laggard
+        # ledger joined to the cost model — top-5 ops by measured share
+        # + the attribution-coverage gauge, per config, so "which ops
+        # eat the step" ships beside the whole-step MFU it explains.
+        # repeats=2: the per-segment min-of-N at bench cost discipline.
+        try:
+            from paddle_tpu.obs import opprof
+            op_attribution = opprof.profile_program(
+                main_prog, feed=feed, scope=scope, repeats=2,
+                fused_step=False).summary(top=5)
+        except Exception as e:  # attribution must never cost a bench
+            import logging
+            logging.getLogger("paddle_tpu").warning(
+                "op attribution skipped: %s", e)
+            op_attribution = {"error": f"{type(e).__name__}: {e}"}
     # static roofline prediction (analysis/cost.py) beside the measured
     # numbers: predicted_mfu_pct + the declared bound (compute|bandwidth|
     # comm|host) attribute the 45%-gap per config, and the full
@@ -271,6 +286,7 @@ def _train_loop(main_prog, startup, fetch, feed, steps, unroll=2,
                        for p in ("host_prep", "dispatch", "device", "fetch")},
            "guard_overhead_pct": guard_overhead_pct,
            "trace_overhead_pct": trace_overhead_pct,
+           "op_attribution": op_attribution,
            "compile_cache": compile_cache, **pred_fields}
     # flatten [steps, 1] fetches: float(arr[0]) on a size-1 ndarray is
     # deprecated (NumPy 1.25) and will raise once NumPy promotes it

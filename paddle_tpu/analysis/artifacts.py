@@ -84,8 +84,11 @@ _RATIO_KEY_MARKERS = ("mfu", "hfu")
 #: keys marking MODEL OUTPUTS of the static cost model (analysis/cost.py)
 #: rather than instrument readings: the measurement band does not apply
 #: (a tiny CPU-shape config legitimately predicts microsecond steps) but
-#: negative/zero work or >100% predicted utilization is still impossible
-_PREDICTION_MARKERS = ("predict", "prediction")
+#: negative/zero work or >100% predicted utilization is still impossible.
+#: "attribution" covers the per-op ledger (obs/opprof.py): its rows are
+#: cost-share SLICES of a step, legitimately far below the whole-step
+#: floor — validate_op_report applies the band to the ledger's total.
+_PREDICTION_MARKERS = ("predict", "prediction", "attribution")
 #: prediction fields that must be strictly positive: a step whose model
 #: says zero flops / zero HBM traffic / zero time was mis-analyzed, the
 #: cost-model analogue of the 0.0 ms autotune poisonings. (predicted_mfu
@@ -293,6 +296,84 @@ def validate_cost_report(doc) -> List[str]:
         if not isinstance(v, (int, float)) or _bad_pred_num(v):
             problems.append(f"$.comm.{mesh_key}.total_wire_bytes: {v!r} "
                             "must be a finite non-negative number")
+    return problems
+
+
+_OP_REPORT_REQUIRED = ("program", "batch", "chip", "attribution")
+_OP_ROW_REQUIRED = ("type", "name", "phase", "predicted_ms", "covered")
+
+
+def validate_op_report(doc) -> List[str]:
+    """Schema + floor checks for a tools/op_report.py document
+    ([] = valid) — the per-op attribution ledger (obs/opprof.py).
+
+    Floors (the gconv discipline at ledger scale): the attributed total
+    is finite, positive and under the physical ceiling; the coverage
+    gauge sits in [0, 100]; every row's predicted/measured values are
+    finite and non-negative (per-op SLICES of a step legitimately sit
+    under the whole-step MS_FLOOR, so the measurement band applies to
+    the total, not the rows); per-op MFU never exceeds 100%; measured
+    rows' shares sum to ~100% — a ledger that attributes more (or much
+    less) time than it measured mis-joined somewhere.
+    """
+    if not isinstance(doc, dict):
+        return [f"op report root is {type(doc).__name__}, not an object"]
+    problems = [f"$.{k}: required field missing"
+                for k in _OP_REPORT_REQUIRED if k not in doc]
+    attr = doc.get("attribution")
+    if not isinstance(attr, dict):
+        if "attribution" in doc:
+            problems.append("$.attribution: not an object")
+        return problems
+    total = attr.get("total_measured_ms")
+    if not isinstance(total, (int, float)) or isinstance(total, bool) \
+            or not math.isfinite(float(total)) or total <= 0 \
+            or total >= MS_CEILING:
+        problems.append(
+            f"$.attribution.total_measured_ms: {total!r} must be a "
+            f"positive finite reading under {MS_CEILING} ms — a ledger "
+            "with no measured time attributed nothing")
+    cov = attr.get("coverage_pct")
+    if not isinstance(cov, (int, float)) or isinstance(cov, bool) \
+            or not math.isfinite(float(cov)) or cov < 0 or cov > 100.0:
+        problems.append(f"$.attribution.coverage_pct: {cov!r} must sit "
+                        "in [0, 100]")
+    rows = attr.get("rows")
+    if not isinstance(rows, list) or not rows:
+        problems.append("$.attribution.rows: empty/missing — a ledger "
+                        "that names no ops is not an attribution")
+        rows = []
+    share_sum = 0.0
+    any_measured = False
+    for i, row in enumerate(rows):
+        here = f"$.attribution.rows[{i}]"
+        if not isinstance(row, dict):
+            problems.append(f"{here}: not an object")
+            continue
+        problems.extend(f"{here}.{k}: required field missing"
+                        for k in _OP_ROW_REQUIRED if k not in row)
+        for k in ("predicted_ms", "measured_ms", "share_pct"):
+            v = row.get(k)
+            if v is not None and _bad_pred_num(v):
+                problems.append(f"{here}.{k}: {v!r} is not a finite "
+                                "non-negative number")
+        mfu = row.get("mfu_pct")
+        if mfu is not None and (_bad_pred_num(mfu) or float(mfu) > 101.0):
+            problems.append(f"{here}.mfu_pct: {mfu!r} — per-op MFU over "
+                            "100% is impossible")
+        if isinstance(row.get("share_pct"), (int, float)) \
+                and not isinstance(row.get("share_pct"), bool) \
+                and math.isfinite(float(row["share_pct"])):
+            share_sum += float(row["share_pct"])
+        if row.get("measured_ms") is not None:
+            any_measured = True
+    if rows and not any_measured:
+        problems.append("$.attribution.rows: no row carries a measured "
+                        "reading — nothing was actually profiled")
+    if any_measured and not (99.0 <= share_sum <= 101.0):
+        problems.append(
+            f"$.attribution.rows: measured shares sum to {share_sum:.2f}%"
+            " — attribution must account for ~100% of the measured step")
     return problems
 
 

@@ -50,7 +50,8 @@ AUTODIFF_OP = "autodiff"
 __all__ = ["OpCost", "ProgramCost", "ChipSpec", "Prediction", "cost_entry",
            "op_cost", "program_cost", "chip_spec_for", "resolve_chip",
            "predict_step", "roofline_step", "PEAK_TABLE",
-           "program_feed_bytes", "feed_wire_mbps"]
+           "program_feed_bytes", "feed_wire_mbps", "op_roofline_ms",
+           "predict_grouped_conv_ms"]
 
 
 # ---------------------------------------------------------------------------
@@ -623,6 +624,45 @@ def roofline_step(hw_mxu_flops: float, hbm_bytes: float,
         bound = "bandwidth"
     mfu = min((model_mxu_flops / n_dev) / (t * chip.peak_flops), 1.0)
     return t_compute, t_hbm, t, bound, mfu
+
+
+def op_roofline_ms(c: OpCost, chip: ChipSpec) -> Tuple[float, str]:
+    """ONE op's roofline time on `chip`: max of the MXU-compute and
+    HBM-traffic legs (the same two device legs roofline_step overlaps
+    for the whole program), in ms, plus the leg that set it. The per-op
+    profiler (obs/opprof.py) uses this both as each op's predicted_ms
+    and as the weight that distributes a measured segment's time across
+    its member ops — so the ledger's predicted column and its
+    attribution shares come from one formula."""
+    t_compute = c.mxu_flops / chip.peak_flops
+    t_hbm = c.bytes_total / (chip.hbm_gbps * 1e9)
+    bound = "compute" if t_compute >= t_hbm else "bandwidth"
+    return max(t_compute, t_hbm) * 1e3, bound
+
+
+def predict_grouped_conv_ms(n, cin, h, w, cout, groups, stride, k=3,
+                            dtype: str = "float32",
+                            chip: Optional[ChipSpec] = None,
+                            train: bool = True) -> float:
+    """Roofline prediction for one grouped conv2d shape — the static
+    side of the gconv autotune harness (utils/gconv_autotune.py), which
+    records each candidate formulation's measured ms NEXT TO this
+    prediction so every cache entry carries its own predicted-vs-
+    measured delta. train=True models the harness's fwd+dW chain step
+    (~2 forward-equivalents — the chained loss differentiates w.r.t.
+    the filter only)."""
+    chip = chip or resolve_chip()
+    sh, sw = (stride if isinstance(stride, (tuple, list))
+              else (stride, stride))
+    ho, wo = max(int(h) // int(sh), 1), max(int(w) // int(sw), 1)
+    flops = 2 * n * ho * wo * cout * (cin // max(groups, 1)) * k * k
+    nb = dtype_nbytes(dtype)
+    traffic = (n * cin * h * w + cout * (cin // max(groups, 1)) * k * k
+               + n * cout * ho * wo) * nb
+    mult = 2 if train else 1
+    t = max(mult * flops / chip.peak_flops,
+            mult * traffic / (chip.hbm_gbps * 1e9))
+    return t * 1e3
 
 
 @dataclass

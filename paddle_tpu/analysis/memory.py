@@ -212,20 +212,18 @@ def estimate_memory(program: Optional[Program] = None, batch: int = 1,
             feed_bytes += safe_nbytes(v.name)
 
     # -- residual watermark over the forward -------------------------------
-    # segment id per op: maximal runs of one remat_scope tag (the same
-    # grouping run_op_range checkpoints); None = not rematerialized
+    # segment id per op: the lowering's own run boundaries
+    # (core/lowering.iter_op_runs — the grouping run_op_range
+    # checkpoints); None = not rematerialized
+    from ..core.lowering import iter_op_runs
     seg_of: List[Optional[int]] = []
     seg_id = -1
-    prev_tag = None
-    for i in range(fwd_stop):
-        tag = ops[i].attrs.get("remat_scope")
+    for i, j, tag in iter_op_runs(ops, 0, fwd_stop):
         if tag is None:
-            seg_of.append(None)
+            seg_of.extend([None] * (j - i))
         else:
-            if tag != prev_tag:
-                seg_id += 1
-            seg_of.append(seg_id)
-        prev_tag = tag
+            seg_id += 1
+            seg_of.extend([seg_id] * (j - i))
 
     # names read at or after op i (later forward ops + the optimizer
     # suffix). Only the sets at remat segment ends are ever consumed, so

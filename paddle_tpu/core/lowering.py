@@ -189,25 +189,39 @@ def _run_remat_segment(ops, start: int, stop: int, range_stop: int, env,
     env.update(zip(written, outs))
 
 
+def iter_op_runs(ops: Sequence[OpDesc], start: int, stop: int):
+    """Yield the maximal runs ``(i, j, tag)`` of ops[start:stop] sharing
+    one ``remat_scope`` tag — untagged ops are unit runs, tagged ops
+    coalesce into one run per contiguous tag span. This is THE run
+    segmentation of the lowering: run_op_range executes exactly these
+    runs (tagged ones under jax.checkpoint), the static memory estimator
+    (analysis/memory.py) prices residuals at these boundaries, and the
+    per-op profiler (obs/opprof.py) compiles and times these same
+    segments — one definition, so measured attribution, memory liveness,
+    and the traced program can never segment differently."""
+    i = start
+    while i < stop:
+        tag = ops[i].attrs.get("remat_scope")
+        j = i + 1
+        if tag is not None:
+            while j < stop and ops[j].attrs.get("remat_scope") == tag:
+                j += 1
+        yield i, j, tag
+        i = j
+
+
 def run_op_range(ops: Sequence[OpDesc], start: int, stop: int,
                  env: Dict[str, object], ctx: ExecContext, block: Block,
                  live_out=None):
     """live_out: names the CALLER reads from env after this range — used
     to bound what escapes a remat segment. None = everything may escape
     (safe default for sub-block interpreters)."""
-    i = start
-    while i < stop:
-        tag = ops[i].attrs.get("remat_scope")
+    for i, j, tag in iter_op_runs(ops, start, stop):
         if tag is None:
             ctx.op_index = i
             run_op(ops[i], env, ctx, block)
-            i += 1
-            continue
-        j = i
-        while j < stop and ops[j].attrs.get("remat_scope") == tag:
-            j += 1
-        _run_remat_segment(ops, i, j, stop, env, ctx, block, live_out)
-        i = j
+        else:
+            _run_remat_segment(ops, i, j, stop, env, ctx, block, live_out)
     return env
 
 

@@ -57,21 +57,13 @@ import random
 import threading
 from typing import Callable, Iterable, List, Optional, Sequence
 
+from ..flags import env_knob_int as _knob_int
 from ..reader.prefetch import bounded_put
 from .metrics import PipelineMetrics, register as _register_metrics
 
 __all__ = ["Dataset"]
 
 _END = object()
-
-
-def _knob_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, "")
-    try:
-        val = int(raw) if raw else 0
-    except ValueError as e:
-        raise ValueError(f"malformed {name}={raw!r}: {e}") from e
-    return val if val > 0 else default
 
 
 class _Ctx:

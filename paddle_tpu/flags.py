@@ -18,7 +18,20 @@ import os
 from typing import Any, Dict
 
 __all__ = ["FLAGS", "DEFINE_flag", "reset_flags_from_env",
-           "ENV_KNOBS", "declare_env_knob"]
+           "ENV_KNOBS", "declare_env_knob", "env_knob_int"]
+
+
+def env_knob_int(name: str, default: int) -> int:
+    """Positive-int PT_* knob parse: malformed raises (a config error
+    must fail loudly, not silently default), unset/non-positive falls
+    back to `default`. ONE parser for every int-valued knob — the
+    data pipeline and the per-op profiler both read through it."""
+    raw = os.environ.get(name, "").strip()
+    try:
+        val = int(raw) if raw else 0
+    except ValueError as e:
+        raise ValueError(f"malformed {name}={raw!r}: {e}") from e
+    return val if val > 0 else default
 
 
 class _Flags:
@@ -316,6 +329,22 @@ declare_env_knob("PT_TRACE_DIR",
                  "device-side op attribution (the per-op named_scopes) "
                  "next to the host-side spans. Unset = host-side spans "
                  "only")
+declare_env_knob("PT_OPPROF_REPEATS",
+                 "per-op profiler (obs/opprof.py): each program segment "
+                 "is timed as the MIN of this many settled runs after a "
+                 "warm/compile pass (default 3) — the least-contended "
+                 "estimate, the bench window policy at segment scale")
+declare_env_knob("PT_OPPROF_SEG_OPS",
+                 "per-op profiler: coalesce adjacent unit op-runs into "
+                 "segments of up to this many ops (default 16) before "
+                 "compiling — bounds the compile count; remat-tagged "
+                 "runs stay atomic regardless. 1 = every untagged op "
+                 "times individually (slow, exact)")
+declare_env_knob("PT_OPPROF_TOPK",
+                 "per-op profiler: how many laggard rows the pt_op_* "
+                 "exposition and the bench op_attribution block carry "
+                 "(default 5); tools/op_report.py --top overrides per "
+                 "run")
 declare_env_knob("PT_PLAN_BEAM",
                  "placement planner (analysis/planner.py): how many "
                  "ranked plans the emitted PlacementPlan artifact keeps "

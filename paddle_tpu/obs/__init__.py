@@ -18,17 +18,24 @@ Three surfaces, one timeline:
             folded into an EWMA per step, exported as
             pt_model_predicted_step_ms / pt_model_measured_step_ms /
             pt_model_drift_ratio on the same scrape.
+  opprof    the per-op performance observatory: measured device time
+            per program segment (the lowering's own run boundaries),
+            distributed across ops by predicted cost share and JOINED
+            to analysis/cost — the ranked laggard ledger behind
+            tools/op_report.py, the pt_op_* family, and bench.py's
+            op_attribution block. Opt-in profiling, never a hot-path
+            hook.
 
 See docs/observability.md.
 """
 
-from . import trace
+from . import opprof, trace
 from .drift import MONITOR, DriftMonitor, observe_prediction, step_recorder
 from .metrics import (REGISTRY, MetricsRegistry, TrainMetrics,
-                      global_snapshot, render_prometheus,
-                      validate_exposition)
+                      build_info_labels, global_snapshot,
+                      render_prometheus, validate_exposition)
 
-__all__ = ["trace", "REGISTRY", "MetricsRegistry", "TrainMetrics",
-           "render_prometheus", "validate_exposition", "global_snapshot",
-           "MONITOR", "DriftMonitor", "observe_prediction",
-           "step_recorder"]
+__all__ = ["trace", "opprof", "REGISTRY", "MetricsRegistry",
+           "TrainMetrics", "render_prometheus", "validate_exposition",
+           "global_snapshot", "build_info_labels", "MONITOR",
+           "DriftMonitor", "observe_prediction", "step_recorder"]

@@ -40,6 +40,7 @@ plane.
 
 from __future__ import annotations
 
+import os
 import re
 import threading
 import time
@@ -49,7 +50,7 @@ from typing import Callable, Dict, List, Optional
 
 __all__ = ["MetricsRegistry", "REGISTRY", "TrainMetrics",
            "render_prometheus", "validate_exposition", "percentiles",
-           "global_snapshot"]
+           "global_snapshot", "build_info_labels"]
 
 
 # ---------------------------------------------------------------------------
@@ -265,6 +266,50 @@ _TRAIN_GAUGES = ("examples_per_sec", "steps_per_sec", "loss",
 #: drift-monitor gauges exported as pt_model_* (obs/drift.py)
 _MODEL_GAUGES = ("predicted_step_ms", "measured_step_ms", "drift_ratio",
                  "host_share_pct")
+#: per-op attribution fields exported as pt_op_* (obs/opprof.py):
+#: the coverage/total gauges per profiled program, plus the top-K
+#: laggard rows by measured share
+_OP_GAUGES = ("coverage_pct", "total_measured_ms", "fused_step_ms")
+_OP_ROW_GAUGES = ("measured_ms", "predicted_ms", "share_pct", "mfu_pct")
+
+
+#: (jax_version, detected_chip) memo — jax.devices() forces backend
+#: init, far too heavy to pay per scrape; both are process constants.
+#: The PT_COST_CHIP override and the armed-knob label stay live (knobs
+#: toggle at runtime), so only the expensive detection is cached.
+_BUILD_INFO_MEMO: Optional[tuple] = None
+
+
+def build_info_labels() -> Dict[str, str]:
+    """Labels of the pt_build_info info-series: what produced the
+    numbers a scrape carries — jax version, the chip the cost model
+    prices for (PT_COST_CHIP override or the detected device kind), and
+    every ARMED PT_* knob from the flags registry. The value is a
+    constant 1; identity lives in the labels (the Prometheus
+    build_info convention)."""
+    global _BUILD_INFO_MEMO
+    if _BUILD_INFO_MEMO is None:
+        try:
+            import jax
+            jax_version = jax.__version__
+        except Exception:   # noqa: BLE001 — a scrape must never fail
+            jax_version = "unknown"
+        try:
+            import jax
+            detected = getattr(jax.devices()[0], "device_kind", "") \
+                or jax.default_backend()
+        except Exception:   # noqa: BLE001
+            detected = "unknown"
+        _BUILD_INFO_MEMO = (jax_version, detected)
+    jax_version, detected = _BUILD_INFO_MEMO
+    chip = os.environ.get("PT_COST_CHIP", "").strip() or detected
+    try:
+        from ..flags import ENV_KNOBS
+        armed = ",".join(f"{k}={os.environ[k]}" for k in sorted(ENV_KNOBS)
+                         if os.environ.get(k, "") != "")
+    except Exception:   # noqa: BLE001
+        armed = ""
+    return {"jax": jax_version, "chip": chip, "knobs": armed}
 
 
 def render_prometheus(snapshot: dict) -> str:
@@ -297,6 +342,9 @@ def render_prometheus(snapshot: dict) -> str:
         text = str(int(val)) if val.is_integer() else repr(val)
         lines.append(f"{metric}{{{lab}}} {text}")
 
+    # identity first: one constant-1 info series whose labels say what
+    # produced every number below — jax version, priced chip, armed knobs
+    emit("pt_build_info", build_info_labels(), 1)
     for name, snap in sorted(snapshot.get("models", {}).items()):
         for key in _SERVE_COUNTERS:
             emit(f"pt_serve_{key}_total", {"model": name}, snap.get(key),
@@ -353,6 +401,17 @@ def render_prometheus(snapshot: dict) -> str:
             # carries the enum, the value is a constant 1
             emit("pt_model_bound",
                  {"program": name, "bound": snap["bound"]}, 1)
+    for name, snap in sorted(snapshot.get("op", {}).items()):
+        # per-op attribution (obs/opprof.py): the coverage gauge says
+        # how much of the profiled step is attributed to cost-model-
+        # covered ops; the top-K laggards ride as labeled rows
+        for key in _OP_GAUGES:
+            emit(f"pt_op_{key}", {"program": name}, snap.get(key))
+        for row in snap.get("top_ops") or []:
+            labels = {"program": name, "op": str(row.get("name")),
+                      "type": str(row.get("type"))}
+            for key in _OP_ROW_GAUGES:
+                emit(f"pt_op_{key}", labels, row.get(key))
     return "\n".join(lines) + "\n"
 
 

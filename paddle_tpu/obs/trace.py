@@ -50,8 +50,8 @@ from typing import Dict, List, Optional
 
 __all__ = ["span", "instant", "complete", "enabled", "current_context",
            "use_context", "active_stack", "events", "drain", "reset",
-           "new_id", "device_profile", "ENABLE_ENV", "BUF_ENV",
-           "DIR_ENV", "DEFAULT_BUF"]
+           "new_id", "device_profile", "postmortem_dump", "ENABLE_ENV",
+           "BUF_ENV", "DIR_ENV", "DEFAULT_BUF"]
 
 ENABLE_ENV = "PT_TRACE"
 BUF_ENV = "PT_TRACE_BUF"
@@ -312,6 +312,38 @@ def reset(buf: Optional[int] = None) -> None:
     global _ring
     with _ring_lock:
         _ring = deque(maxlen=int(buf)) if buf else None
+
+
+def postmortem_dump(tag: str, error: Optional[str] = None) -> Optional[str]:
+    """Crash-forensics mini-bundle: when PT_TRACE_DIR is set, write the
+    trace ring (non-destructive snapshot), this thread's active span
+    stack, and the merged metrics snapshot as ONE JSON file beside the
+    jax.profiler dir — the Trainer calls this when it escalates
+    StepAnomalyError / StepHungError, so the evidence of the dying run
+    (which step, which spans were open, what every gauge last read)
+    survives the process. Returns the path, or None when unarmed; never
+    raises — forensics must not mask the original error."""
+    out_dir = os.environ.get(DIR_ENV, "").strip()
+    if not out_dir:
+        return None
+    try:
+        import json
+        from .metrics import global_snapshot
+        doc = {"reason": str(tag), "error": error, "pid": os.getpid(),
+               "unix_time": time.time(),
+               "active_spans": active_stack(),
+               "trace_events": events(),
+               "metrics": global_snapshot()}
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(
+            out_dir, f"pt_postmortem_{os.getpid()}_{tag}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+        os.replace(tmp, path)
+        return path
+    except Exception:   # noqa: BLE001 — never mask the escalating error
+        return None
 
 
 @contextmanager

@@ -402,6 +402,14 @@ class Trainer:
                         # reader and replays rng, exactly the crash-resume
                         # machinery, so recovery is bit-exact-testable
                         self._guard_rollback(rb)
+        except (guard_mod.StepAnomalyError,
+                watchdog_mod.StepHungError) as e:
+            # postmortem mini-bundle (obs/trace.py): under PT_TRACE_DIR
+            # the trace ring + metrics snapshot land beside the profiler
+            # dir, so the dying run's evidence survives the process —
+            # crash forensics ride the existing span-stack dump
+            obs_trace.postmortem_dump(type(e).__name__, error=str(e))
+            raise
         finally:
             for sig, old in restore_handlers.items():
                 signal.signal(sig, old)

@@ -156,9 +156,26 @@ def measure(n, cin, h, w, cout, groups, stride, dtype, k=3,
     from .chain_timer import time_step
     t_native = time_step(make_step(False), (x, wg), iters)
     t_dense = time_step(make_step(True), (x, wg), iters)
-    return {"native_ms": round(t_native * 1e3, 4),
-            "dense_ms": round(t_dense * 1e3, 4),
-            "prefers_dense": bool(t_dense < t_native)}
+    ent = {"native_ms": round(t_native * 1e3, 4),
+           "dense_ms": round(t_dense * 1e3, 4),
+           "prefers_dense": bool(t_dense < t_native)}
+    # predicted-vs-measured join (obs/opprof.py discipline applied to
+    # the autotune harness): every cache entry carries the cost model's
+    # roofline for this conv shape plus each candidate FORMULATION's
+    # measured/predicted ratio — a delta far above the fleet norm names
+    # the shape the conv-family MFU push should attack first. Advisory
+    # only: the formulation choice stays purely measured.
+    try:
+        from ..analysis.cost import predict_grouped_conv_ms
+        pred = predict_grouped_conv_ms(n, cin, h, w, cout, groups, stride,
+                                       k=int(k), dtype=str(dtype))
+        if pred > 0:
+            ent["predicted_ms"] = round(pred, 6)
+            ent["native_delta"] = round(t_native * 1e3 / pred, 3)
+            ent["dense_delta"] = round(t_dense * 1e3 / pred, 3)
+    except Exception:   # noqa: BLE001 — prediction must never break tuning
+        pass
+    return ent
 
 
 def ensure_tuned(n, cin, h, w, cout, groups, stride, dtype, k=3,

@@ -23,8 +23,12 @@
 #   obs   = lint gate + the unified-observability suite (span core,
 #           cross-thread trace correctness, ring-buffer bounds,
 #           drift-monitor EWMA, Chrome-trace JSON schema, pt_train_*/
-#           pt_model_* families, disabled-path overhead budget) + an
-#           exposition-format conformance check over a live scrape
+#           pt_model_* families, disabled-path overhead budget) + the
+#           per-op attribution suite (ledger math, coverage gaps,
+#           pt_op_*/pt_build_info exposition, postmortem bundle) + an
+#           exposition-format conformance check over a live scrape +
+#           schema-checked tools/op_report.py attribution runs on the
+#           resnet and transformer bench programs
 #   data  = lint gate + the production data-plane suite (pipeline
 #           determinism, sharding disjointness, parallel shard readers,
 #           cheap skip + checkpointable state, device-side augmentation,
@@ -64,7 +68,13 @@ fi
 
 if [[ "${1:-}" == "obs" ]]; then
   echo "== obs: structured tracing + unified metrics + drift monitor =="
-  python -m pytest tests/test_obs.py -q
+  python -m pytest tests/test_obs.py tests/test_opprof.py -q
+  echo "== obs: per-op attribution reports (schema-checked) =="
+  # the measured laggard ledger joined to the cost model: the ranked
+  # table must attribute the step (coverage floor lives in --check)
+  for prog in resnet transformer; do
+    python tools/op_report.py "$prog" --check > /dev/null
+  done
   echo "== obs: Prometheus exposition conformance (live snapshot) =="
   python - <<'PY'
 from paddle_tpu.obs.metrics import (REGISTRY, TrainMetrics,
@@ -176,8 +186,20 @@ missing = [n for n, c in doc["configs"].items()
            if isinstance(c, dict) and "ms_per_batch" in c
            and not ("predicted_mfu_pct" in c and "bound" in c)]
 assert not missing, f"configs without roofline prediction: {missing}"
-print(f"bench sanity: predicted_mfu + bound present on all "
-      f"{sum(1 for c in doc['configs'].values() if isinstance(c, dict) and 'ms_per_batch' in c)} measured configs")
+# every measured training config carries the per-op attribution block,
+# and the headline configs must have actually attributed (top_ops) —
+# a laggard hunt that silently skipped resnet is not observability
+no_attr = [n for n, c in doc["configs"].items()
+           if isinstance(c, dict) and "ms_per_batch" in c
+           and not isinstance(c.get("op_attribution"), dict)]
+assert not no_attr, f"configs without op_attribution: {no_attr}"
+for name in ("resnet50", "transformer"):
+    attr = doc["configs"].get(name, {}).get("op_attribution", {})
+    assert attr.get("top_ops"), f"{name}: op_attribution has no top_ops"
+    assert attr.get("coverage_pct", 0) >= 90.0, \
+        f"{name}: attribution coverage {attr.get('coverage_pct')} < 90%"
+print(f"bench sanity: predicted_mfu + bound + op_attribution present on "
+      f"all {sum(1 for c in doc['configs'].values() if isinstance(c, dict) and 'ms_per_batch' in c)} measured configs")
 PY
 fi
 

@@ -191,3 +191,29 @@ def test_autotune_batch_hint_skips_host_table_rows(monkeypatch):
             bdim=0) == 16
     finally:
         table.unregister()
+
+
+def test_measure_records_predicted_vs_measured_delta(monkeypatch):
+    """Every autotune entry carries the cost model's roofline for the
+    conv shape plus each candidate formulation's measured/predicted
+    ratio (the per-op observatory's join discipline applied to the
+    harness) — advisory fields, the choice stays purely measured."""
+    monkeypatch.setenv("PT_COST_CHIP", "tpu v5e")
+    # time_step is chip-bound: stub the instrument, keep measure()'s
+    # own accounting (the local import reads the module attr per call)
+    monkeypatch.setattr("paddle_tpu.utils.chain_timer.time_step",
+                        lambda step, carry, iters: 0.004)
+    ent = gt.measure(8, 16, 16, 16, 32, groups=4, stride=(1, 1),
+                     dtype="float32")
+    assert ent["native_ms"] == ent["dense_ms"] == 4.0
+    from paddle_tpu.analysis.cost import predict_grouped_conv_ms
+    pred = predict_grouped_conv_ms(8, 16, 16, 16, 32, 4, (1, 1),
+                                   dtype="float32")
+    assert pred > 0 and np.isfinite(pred)
+    assert ent["predicted_ms"] == pytest.approx(pred, rel=1e-3)
+    assert ent["native_delta"] == pytest.approx(4.0 / ent["predicted_ms"],
+                                                rel=1e-2)
+    assert ent["dense_delta"] == ent["native_delta"]
+    # the schema layer still accepts the enriched entry
+    from paddle_tpu.analysis.artifacts import check_autotune_entry
+    assert check_autotune_entry("k", ent) == []

@@ -1,0 +1,499 @@
+"""Per-op performance observatory (paddle_tpu/obs/opprof.py): the
+measured-vs-predicted attribution ledger.
+
+Test planes:
+  * segmentation — iter_op_runs boundaries are the lowering's own
+    (unit runs for untagged ops, atomic maximal runs per remat tag),
+    coalescing never crosses a run or phase boundary;
+  * ledger math — per-op measured shares within a segment sum EXACTLY
+    to the segment's measured time, totals equal segment sums, shares
+    sum to 100%, and the join distributes by predicted cost share;
+  * coverage — a segment of ops the cost model does not cover is a
+    GAP: its time appears in the ledger (never silently zero) and the
+    attribution-coverage gauge drops exactly by its share;
+  * floors — tools/op_report.py --check rejects corrupted documents
+    (validate_op_report negatives);
+  * exposition — pt_op_* family + pt_build_info render conformantly on
+    the one Prometheus renderer;
+  * postmortem — a Trainer escalation under PT_TRACE_DIR dumps the
+    trace-ring + metrics mini-bundle.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.analysis.artifacts import validate_op_report
+from paddle_tpu.core.lowering import iter_op_runs
+from paddle_tpu.core.program import OpDesc
+from paddle_tpu.obs import opprof
+from paddle_tpu.obs import trace
+from paddle_tpu.obs.metrics import (REGISTRY, build_info_labels,
+                                    render_prometheus,
+                                    validate_exposition)
+
+
+@pytest.fixture(autouse=True)
+def clean_obs(monkeypatch):
+    monkeypatch.delenv("PT_TRACE", raising=False)
+    monkeypatch.delenv("PT_TRACE_DIR", raising=False)
+    for k in ("PT_OPPROF_REPEATS", "PT_OPPROF_SEG_OPS", "PT_OPPROF_TOPK"):
+        monkeypatch.delenv(k, raising=False)
+    trace.reset()
+    yield
+    trace.reset()
+
+
+def _mlp_program(train=True):
+    """Tiny 2-layer regression MLP: matmul-heavy enough that the mul
+    ops must out-rank the elementwise tail."""
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [16])
+        y = layers.data("y", [1])
+        h = layers.fc(x, size=64, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        if train:
+            pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+    return main, startup
+
+
+def _profile(main, startup, batch=8, **kw):
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        feed = {"x": rs.rand(batch, 16).astype("float32"),
+                "y": rs.rand(batch, 1).astype("float32")}
+        kw.setdefault("repeats", 1)
+        kw.setdefault("fused_step", False)
+        kw.setdefault("publish_metrics", False)
+        return opprof.profile_program(main, feed=feed, scope=scope,
+                                      **kw)
+
+
+# ---------------------------------------------------------------------------
+# segmentation: the lowering's own boundaries
+# ---------------------------------------------------------------------------
+
+def _fake_ops(tags):
+    return [OpDesc("noop", {}, {}, {"remat_scope": t} if t else {})
+            for t in tags]
+
+
+def test_iter_op_runs_unit_and_maximal_runs():
+    ops = _fake_ops([None, None, "a", "a", "b", None, "a"])
+    runs = list(iter_op_runs(ops, 0, len(ops)))
+    assert runs == [(0, 1, None), (1, 2, None), (2, 4, "a"),
+                    (4, 5, "b"), (5, 6, None), (6, 7, "a")]
+
+
+def test_segments_keep_remat_runs_atomic_and_bound_unit_runs():
+    ops = _fake_ops([None] * 5 + ["a"] * 4 + [None] * 3)
+    segs = opprof._segments_for(ops, len(ops), len(ops), seg_ops=2)
+    # unit runs coalesce up to 2 ops; the tagged run stays one segment
+    assert (5, 9, "forward", "a") in segs
+    for start, stop, _phase, tag in segs:
+        if tag is None:
+            assert stop - start <= 2
+    # segments tile the range exactly, in order
+    covered = sorted((s, e) for s, e, _p, _t in segs)
+    cur = 0
+    for s, e in covered:
+        assert s == cur
+        cur = e
+    assert cur == len(ops)
+
+
+# ---------------------------------------------------------------------------
+# ledger math
+# ---------------------------------------------------------------------------
+
+def test_join_totals_equal_segment_sums():
+    main, startup = _mlp_program()
+    ledger = _profile(main, startup)
+    seg_total = sum(s.measured_ms or 0.0 for s in ledger.segments)
+    assert ledger.total_measured_ms == pytest.approx(seg_total, rel=1e-9)
+    # per-segment: member rows' measured sums to the segment's reading
+    for seg in ledger.segments:
+        if seg.measured_ms is None:
+            continue
+        members = [r for r in ledger.rows if r.segment == seg.seg_id]
+        assert sum(r.measured_ms for r in members) == pytest.approx(
+            seg.measured_ms, rel=1e-9)
+    # shares account for ~100% of the profiled step
+    assert sum(r.share_pct for r in ledger.rows
+               if r.share_pct is not None) == pytest.approx(100.0,
+                                                            abs=1e-6)
+
+
+def test_distribution_follows_predicted_cost_share():
+    main, startup = _mlp_program(train=False)
+    # one big segment: every forward op lands in a single compiled unit,
+    # so the measured split is purely the predicted-share distribution
+    ledger = _profile(main, startup, seg_ops=1000)
+    fwd = [s for s in ledger.segments if s.phase == "forward"]
+    assert len(fwd) == 1
+    members = [r for r in ledger.rows if r.segment == fwd[0].seg_id
+               and r.predicted_ms > 0]
+    assert len(members) >= 2
+    total_pred = sum(r.predicted_ms for r in members)
+    for r in members:
+        expect = fwd[0].measured_ms * r.predicted_ms / total_pred
+        assert r.measured_ms == pytest.approx(expect, rel=1e-9)
+
+
+def test_training_program_measures_backward_and_optimizer():
+    main, startup = _mlp_program(train=True)
+    ledger = _profile(main, startup)
+    assert ledger.train
+    fwd_segs = [s for s in ledger.segments if s.phase == "forward"]
+    opt_segs = [s for s in ledger.segments if s.phase == "optimizer"]
+    assert fwd_segs and opt_segs
+    for s in fwd_segs:
+        assert s.measured_bwd_ms is not None
+    opt_rows = [r for r in ledger.rows if r.phase == "optimizer"]
+    assert {r.op_type for r in opt_rows} == {"momentum"}
+    # laggard ranking: a matmul must out-rank the scalar tail ops
+    ranked_types = [r.op_type for r in ledger.top(4)]
+    assert "mul" in ranked_types
+
+
+def test_amp_program_profiles_at_compute_dtype():
+    # the AMP entry: f32 feeds/params run bf16 inside the forward, the
+    # f32 masters come back for the optimizer suffix — profiling must
+    # mirror the lowering or it times the wrong dtype regime
+    main, startup = _mlp_program()
+    main.amp_dtype = "bfloat16"
+    ledger = _profile(main, startup)
+    assert ledger.total_measured_ms > 0
+    assert not any(s.error for s in ledger.segments)
+    assert any(r.phase == "optimizer" and r.measured_ms is not None
+               for r in ledger.rows)
+
+
+def test_matmul_rows_carry_mfu_and_bound():
+    main, startup = _mlp_program()
+    ledger = _profile(main, startup)
+    muls = [r for r in ledger.rows if r.op_type == "mul"]
+    assert muls
+    for r in muls:
+        assert r.mxu_flops > 0
+        assert r.mfu_pct is not None and 0 <= r.mfu_pct <= 100.0
+        assert r.predicted_mfu_pct is not None
+        assert r.bound in ("compute", "bandwidth")
+    relus = [r for r in ledger.rows if r.op_type == "relu"]
+    assert all(r.mxu_flops == 0 for r in relus)
+
+
+# ---------------------------------------------------------------------------
+# coverage: gaps are visible, never silently zero
+# ---------------------------------------------------------------------------
+
+def _register_exotic_once():
+    from paddle_tpu.core import registry
+    if registry.get_op("opprof_exotic_op") is None:
+        @registry.register_op("opprof_exotic_op")
+        def _exotic(ctx, ins, attrs):
+            return {"Out": [ins["X"][0] * 2.0 + 1.0]}
+
+
+def _exotic_program():
+    """fc -> exotic (unmodeled) -> mean: the exotic op RUNS but has no
+    cost entry and sits outside the curated elementwise tables."""
+    _register_exotic_once()
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [16])
+        h = layers.fc(x, size=8)
+        blk = main.global_block
+        out = blk.create_var("exotic_out", shape=list(h.shape),
+                             dtype="float32")
+        blk.ops.append(OpDesc("opprof_exotic_op", {"X": [h.name]},
+                              {"Out": [out.name]}, {}))
+        layers.mean(out)
+    return main, startup
+
+
+def test_uncovered_segment_is_a_gap_not_a_zero():
+    main, startup = _exotic_program()
+    # seg_ops=1: every op is its own segment, so the exotic op forms an
+    # ALL-uncovered segment
+    ledger = _profile(main, startup, seg_ops=1)
+    gap_segs = [s for s in ledger.segments if s.gap]
+    assert len(gap_segs) == 1
+    assert gap_segs[0].op_types == ["opprof_exotic_op"]
+    # the gap's time is IN the ledger — measured, not zeroed
+    assert gap_segs[0].measured_ms is not None
+    assert gap_segs[0].measured_ms > 0
+    row = next(r for r in ledger.rows
+               if r.op_type == "opprof_exotic_op")
+    assert not row.covered
+    assert row.measured_ms == pytest.approx(gap_segs[0].measured_ms)
+    assert "opprof_exotic_op" in ledger.uncovered_ops
+    assert ledger.coverage_pct < 100.0
+
+
+def test_coverage_gauge_is_exact():
+    main, startup = _exotic_program()
+    ledger = _profile(main, startup, seg_ops=1)
+    total = sum(s.measured_ms or 0.0 for s in ledger.segments)
+    gap = sum(s.measured_ms or 0.0 for s in ledger.segments if s.gap)
+    assert ledger.coverage_pct == pytest.approx(
+        100.0 * (total - gap) / total, rel=1e-9)
+
+
+def test_all_segments_failing_is_not_100_percent_coverage(monkeypatch):
+    # if EVERY segment fails to compile/run, nothing was measured —
+    # reporting 100% coverage would sail a zero-reading profile through
+    # the CI coverage gates (the silently-zero failure mode)
+    def boom(fn, args, repeats):
+        raise RuntimeError("no backend")
+    monkeypatch.setattr(opprof, "_time_call", boom)
+    main, startup = _mlp_program()
+    ledger = _profile(main, startup)
+    assert all(s.error for s in ledger.segments)
+    assert ledger.total_measured_ms == 0.0
+    assert ledger.coverage_pct == 0.0
+    assert ledger.summary()["segments_errored"] == len(ledger.segments)
+    # and the floor layer refuses the document outright
+    doc = {"program": "x", "batch": 8, "chip": ledger.chip,
+           "attribution": ledger.to_dict()}
+    assert validate_op_report(doc)
+
+
+def test_publish_is_lru_bounded():
+    from paddle_tpu.obs.opprof import (MAX_PUBLISHED, OpLedger, _PUBLISHED,
+                                       publish)
+    before = dict(_PUBLISHED)
+    try:
+        _PUBLISHED.clear()
+        for i in range(MAX_PUBLISHED + 8):
+            publish(OpLedger(program=f"lru-{i}", batch=1, chip="cpu",
+                             train=False), name=f"lru-{i}")
+        assert len(_PUBLISHED) == MAX_PUBLISHED
+        assert "lru-0" not in _PUBLISHED          # evicted FIFO
+        assert f"lru-{MAX_PUBLISHED + 7}" in _PUBLISHED
+        assert not REGISTRY.providers("op").get("lru-0")
+    finally:
+        for key in list(_PUBLISHED):
+            REGISTRY.unregister("op", key)
+        _PUBLISHED.clear()
+        _PUBLISHED.update(before)
+
+
+def test_mixed_segment_is_not_a_gap():
+    # the exotic op coalesced WITH covered neighbors: the segment
+    # attributes by default-modeled share and stays covered
+    main, startup = _exotic_program()
+    ledger = _profile(main, startup, seg_ops=1000)
+    assert not any(s.gap for s in ledger.segments)
+    assert ledger.coverage_pct == 100.0
+    # the uncovered op is still flagged per-row
+    row = next(r for r in ledger.rows
+               if r.op_type == "opprof_exotic_op")
+    assert not row.covered
+
+
+# ---------------------------------------------------------------------------
+# floors: op_report --check negatives
+# ---------------------------------------------------------------------------
+
+def _valid_doc():
+    main, startup = _mlp_program()
+    ledger = _profile(main, startup)
+    return {"program": "mlp", "batch": 8, "chip": ledger.chip,
+            "attribution": ledger.to_dict()}
+
+
+def test_validate_op_report_accepts_a_real_ledger():
+    assert validate_op_report(_valid_doc()) == []
+
+
+def test_validate_op_report_floor_violations():
+    doc = _valid_doc()
+    doc["attribution"]["coverage_pct"] = 250.0
+    assert any("coverage_pct" in p for p in validate_op_report(doc))
+
+    doc = _valid_doc()
+    doc["attribution"]["total_measured_ms"] = 0.0
+    assert any("total_measured_ms" in p for p in validate_op_report(doc))
+
+    doc = _valid_doc()
+    doc["attribution"]["rows"] = []
+    assert any("rows" in p for p in validate_op_report(doc))
+
+    doc = _valid_doc()
+    doc["attribution"]["rows"][0]["measured_ms"] = float("nan")
+    assert any("measured_ms" in p for p in validate_op_report(doc))
+
+    doc = _valid_doc()
+    doc["attribution"]["rows"][0]["mfu_pct"] = 180.0
+    assert any("mfu_pct" in p for p in validate_op_report(doc))
+
+    doc = _valid_doc()
+    for row in doc["attribution"]["rows"]:
+        if row["share_pct"] is not None:
+            row["share_pct"] = row["share_pct"] * 0.5
+    assert any("sum" in p for p in validate_op_report(doc))
+
+    doc = _valid_doc()
+    del doc["attribution"]
+    assert any("attribution" in p for p in validate_op_report(doc))
+
+
+# ---------------------------------------------------------------------------
+# exposition: pt_op_* + pt_build_info
+# ---------------------------------------------------------------------------
+
+def test_pt_op_family_renders_conformantly():
+    main, startup = _mlp_program()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        feed = {"x": rs.rand(8, 16).astype("float32"),
+                "y": rs.rand(8, 1).astype("float32")}
+        ledger = opprof.profile_program(main, feed=feed, scope=scope,
+                                        repeats=1, fused_step=False,
+                                        name="expo-test")
+    try:
+        snap = {"op": {"expo-test": ledger.summary(top=3)}}
+        text = render_prometheus(snap)
+        assert validate_exposition(text) == [], validate_exposition(text)
+        assert "pt_op_coverage_pct" in text
+        assert 'pt_op_measured_ms{program="expo-test"' in text
+        # publish() put it on the live registry too: a global scrape
+        # carries the family without hand-built snapshots
+        from paddle_tpu.obs.metrics import global_snapshot
+        live = render_prometheus(global_snapshot())
+        assert 'pt_op_coverage_pct{program="expo-test"}' in live
+        assert validate_exposition(live) == []
+    finally:
+        REGISTRY.unregister("op", "expo-test")
+        opprof._PUBLISHED.pop("expo-test", None)
+
+
+def test_pt_build_info_labels_and_exposition(monkeypatch):
+    monkeypatch.setenv("PT_COST_CHIP", "tpu v5e")
+    monkeypatch.setenv("PT_TRACE", "1")
+    labels = build_info_labels()
+    assert labels["chip"] == "tpu v5e"
+    assert labels["jax"] not in ("", None)
+    assert "PT_TRACE=1" in labels["knobs"]
+    assert "PT_COST_CHIP=tpu v5e" in labels["knobs"]
+    text = render_prometheus({})
+    assert validate_exposition(text) == [], validate_exposition(text)
+    assert text.startswith("# TYPE pt_build_info gauge")
+    assert 'chip="tpu v5e"' in text
+
+
+def test_top_k_knob_bounds_the_published_rows(monkeypatch):
+    monkeypatch.setenv("PT_OPPROF_TOPK", "2")
+    main, startup = _mlp_program()
+    ledger = _profile(main, startup)
+    assert len(ledger.summary()["top_ops"]) == 2
+    assert len(ledger.summary(top=7)["top_ops"]) == 7
+
+
+# ---------------------------------------------------------------------------
+# trace merge + postmortem bundle
+# ---------------------------------------------------------------------------
+
+def test_measured_intervals_merge_into_the_trace(monkeypatch):
+    monkeypatch.setenv("PT_TRACE", "1")
+    main, startup = _mlp_program()
+    _profile(main, startup)
+    evs = trace.events()
+    opprof_evs = [e for e in evs if e["cat"] == "opprof"]
+    assert any(e["name"].startswith("opprof:seg") for e in opprof_evs)
+    assert any(e["name"].startswith("op:") for e in opprof_evs)
+    # pre-measured complete() intervals: X events with a duration
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in opprof_evs)
+
+
+def test_postmortem_bundle_on_step_anomaly(monkeypatch, tmp_path):
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.resilience.guard import StepAnomalyError
+    monkeypatch.setenv("PT_TRACE", "1")
+    monkeypatch.setenv("PT_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("PT_GUARD", "raise")
+    monkeypatch.setenv("PT_GUARD_PATIENCE", "1")
+    monkeypatch.setenv("PT_FAULT_INJECT", "nan_loss@2")
+    faults.reset()
+    pt.core.program.reset_unique_names()
+
+    def train_func():
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        return [layers.mean(layers.square_error_cost(pred, y))]
+
+    def reader():
+        rs = np.random.RandomState(0)
+        for _ in range(6):
+            xv = rs.rand(4, 4).astype(np.float32)
+            yield [(xv, xv.sum(1, keepdims=True) * 0.3)]
+
+    trainer = pt.Trainer(train_func,
+                         lambda: pt.optimizer.SGDOptimizer(0.05))
+    try:
+        with pytest.raises(StepAnomalyError):
+            trainer.train(num_epochs=1, event_handler=lambda e: None,
+                          reader=reader)
+    finally:
+        monkeypatch.delenv("PT_FAULT_INJECT", raising=False)
+        faults.reset()
+    bundles = list(tmp_path.glob("pt_postmortem_*_StepAnomalyError.json"))
+    assert len(bundles) == 1
+    doc = json.loads(bundles[0].read_text())
+    assert doc["reason"] == "StepAnomalyError"
+    assert "consecutive anomalous" in doc["error"]
+    assert isinstance(doc["trace_events"], list) and doc["trace_events"]
+    assert "metrics" in doc and "train" in doc["metrics"]
+
+
+def test_postmortem_dump_is_a_noop_without_trace_dir(tmp_path):
+    assert trace.postmortem_dump("Nothing") is None
+    assert list(tmp_path.iterdir()) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI roundtrip (tiny transformer so the suite stays fast)
+# ---------------------------------------------------------------------------
+
+def test_op_report_cli_roundtrip(monkeypatch, tmp_path, capsys):
+    for k, v in (("BENCH_TFM_VOCAB", "64"), ("BENCH_TFM_SEQ", "8"),
+                 ("BENCH_TFM_LAYERS", "1"), ("BENCH_TFM_DMODEL", "16"),
+                 ("BENCH_TFM_HEADS", "2"), ("BENCH_TFM_DFF", "32")):
+        monkeypatch.setenv(k, v)
+    import importlib
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        op_report = importlib.import_module("op_report")
+        out = tmp_path / "report.json"
+        rc = op_report.main(["transformer", "--batch", "2", "--top", "5",
+                             "--repeats", "1", "--check", "--out",
+                             str(out)])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert validate_op_report(doc) == []
+        assert doc["attribution"]["coverage_pct"] >= 90.0
+        text = capsys.readouterr().out
+        assert "per-op attribution" in text
+        # the ranked table prints per-op predicted-vs-measured columns
+        assert "meas ms" in text and "pred ms" in text
+    finally:
+        REGISTRY.unregister("op", "transformer")
+        opprof._PUBLISHED.pop("transformer", None)

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Per-op performance observatory CLI (obs/opprof.py).
+
+Builds one of the bench programs, initializes real parameters (the
+startup program through an Executor), profiles every op segment at the
+lowering's own run boundaries, and prints the RANKED LAGGARD TABLE:
+measured device time per op joined to the static cost model's
+prediction — per-op MFU, declared bound, and share of step — so the
+conv-family MFU push starts from a named, quantified list instead of
+guesses.
+
+Usage:
+    python tools/op_report.py resnet --batch 4 --top 10
+    python tools/op_report.py transformer --check      # schema-validated
+    python tools/op_report.py decode --repeats 5 --out report.json
+
+--check validates the emitted document with
+analysis/artifacts.validate_op_report (the scripts/ci.sh obs leg) and
+exits non-zero on schema/floor problems. PT_OPPROF_REPEATS /
+PT_OPPROF_SEG_OPS tune the measurement; BENCH_TFM_* env knobs resize
+the transformer exactly like tools/cost_report.py. With PT_TRACE (and
+PT_TRACE_DIR) armed, the measured per-op intervals additionally land in
+the Chrome-trace ring and a Perfetto-loadable dump is written next to
+the device profile.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from cost_report import BUILDERS  # noqa: E402
+from paddle_tpu.obs import opprof  # noqa: E402
+from paddle_tpu.obs import trace as obs_trace  # noqa: E402
+
+
+def synth_feeds(program, batch: int) -> dict:
+    """Deterministic feeds for every data var: random floats, zero ints
+    (zero ids hit the reserved null block / class 0 — always legal)."""
+    rs = np.random.RandomState(0)
+    feeds = {}
+    block = program.global_block
+    for v in block.vars.values():
+        if not getattr(v, "is_data", False):
+            continue
+        shape = tuple(batch if int(d) == -1 else int(d)
+                      for d in (v.shape or ()))
+        dt = str(v.dtype)
+        if dt in ("int64", "int32"):
+            feeds[v.name] = np.zeros(shape, dt)
+        elif dt in ("float64", "float32", "bfloat16", "float16"):
+            feeds[v.name] = rs.rand(*shape).astype("float32")
+        else:
+            feeds[v.name] = np.zeros(shape, "float32")
+    return feeds
+
+
+def print_table(ledger, top: int) -> None:
+    print(f"per-op attribution: program={ledger.program} "
+          f"batch={ledger.batch} chip={ledger.chip} "
+          f"train={ledger.train}")
+    print(f"  profiled step {ledger.total_measured_ms:.4f} ms over "
+          f"{len(ledger.segments)} segments "
+          f"(fused one-dispatch step: "
+          f"{ledger.fused_step_ms if ledger.fused_step_ms is not None else 'n/a'} ms)")
+    print(f"  attribution coverage {ledger.coverage_pct:.2f}% "
+          f"(uncovered op types: {ledger.uncovered_ops or 'none'})")
+    hdr = (f"  {'#':>3} {'op type':22} {'name':28} {'meas ms':>10} "
+           f"{'pred ms':>10} {'share%':>7} {'mfu%':>6} {'pmfu%':>6} "
+           f"{'bound':9} cov")
+    print(hdr)
+    for rank, r in enumerate(ledger.top(top), 1):
+        meas = f"{r.measured_ms:.5f}" if r.measured_ms is not None else "-"
+        share = f"{r.share_pct:.2f}" if r.share_pct is not None else "-"
+        mfu = f"{r.mfu_pct:.1f}" if r.mfu_pct is not None else "-"
+        pmfu = (f"{r.predicted_mfu_pct:.1f}"
+                if r.predicted_mfu_pct is not None else "-")
+        print(f"  {rank:>3} {r.op_type:22.22} {r.name:28.28} {meas:>10} "
+              f"{r.predicted_ms:>10.5f} {share:>7} {mfu:>6} {pmfu:>6} "
+              f"{r.bound:9} {'y' if r.covered else 'GAP'}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("program", choices=sorted(BUILDERS))
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows of the laggard table (default 10)")
+    ap.add_argument("--repeats", type=int, default=None,
+                    help="min-of-N settled runs per segment "
+                         "(default PT_OPPROF_REPEATS or 3)")
+    ap.add_argument("--seg-ops", type=int, default=None,
+                    help="max ops per coalesced segment "
+                         "(default PT_OPPROF_SEG_OPS or 16)")
+    ap.add_argument("--infer", action="store_true",
+                    help="build the inference variant (no backward)")
+    ap.add_argument("--check", action="store_true",
+                    help="schema-validate the report; exit 1 on problems")
+    ap.add_argument("--out", help="also write the JSON document here")
+    args = ap.parse_args(argv)
+
+    main_prog, startup = BUILDERS[args.program](not args.infer)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        feeds = synth_feeds(main_prog, args.batch)
+        ledger = opprof.profile_program(
+            main_prog, feed=feeds, scope=scope, batch=args.batch,
+            repeats=args.repeats, seg_ops=args.seg_ops,
+            name=args.program)
+
+    print_table(ledger, args.top)
+    doc = {"program": args.program, "batch": args.batch,
+           "chip": ledger.chip, "attribution": ledger.to_dict()}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    if obs_trace.enabled() and os.environ.get(obs_trace.DIR_ENV,
+                                              "").strip():
+        from trace_dump import dump
+        print(f"trace: wrote {dump()}", file=sys.stderr)
+    if args.check:
+        from paddle_tpu.analysis.artifacts import validate_op_report
+        problems = validate_op_report(doc)
+        if problems:
+            print("OP REPORT INVALID:\n  " + "\n  ".join(problems),
+                  file=sys.stderr)
+            return 1
+        print(f"op report ok: {args.program} train={ledger.train} "
+              f"coverage={ledger.coverage_pct:.1f}% "
+              f"rows={len(ledger.rows)}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
