@@ -1521,6 +1521,252 @@ def bench_serving(on_tpu, peak):
     return out
 
 
+def bench_fleet(on_tpu, peak):
+    """Fleet serving tier (paddle_tpu/serving/fleet/): staged A/B of 1
+    replica vs N replicas under mixed-priority synthetic load.
+
+    Replicas execute a SYNTHETIC model whose 'device time' is a sleep —
+    it releases the GIL exactly like a real dispatch blocking on the
+    accelerator, so N replica dispatcher threads genuinely overlap.
+    That deliberately isolates the fleet tier's economics (routing,
+    queueing, scale, shed policy) from this box's compute: the question
+    this bench answers is whether the ROUTER can keep N engines full,
+    not how fast one engine runs (bench_serving measures that).
+
+    Legs: (1) throughput A/B 1 vs N replicas, min-of-windows, with
+    per-class p95 latency; (2) overload: arrivals far above service,
+    3:1 free:paid mix — per-class shed rates, free tier must absorb
+    >= 90% of sheds; (3) chaos + scale-down under concurrent fire:
+    deterministic `router_dispatch` replica crashes (failover) plus a
+    mid-fire scale 3 -> 2 (drain) with ZERO dropped in-flight
+    requests; (4) autoscale: a 1-replica fleet under sustained load
+    grows on the live queue-depth signal. Floored by
+    artifacts.validate_fleet_ab (the gconv pattern)."""
+    import threading
+    from paddle_tpu.resilience import faults as pfaults
+    from paddle_tpu.serving import fleet as pfleet
+    from paddle_tpu.serving.admission import Overloaded
+
+    service_ms = float(os.environ.get("BENCH_FLEET_SERVICE_MS", 4.0))
+    batch = int(os.environ.get("BENCH_FLEET_BATCH", 4))
+    n_reqs = int(os.environ.get("BENCH_FLEET_REQS", 512))
+    windows = int(os.environ.get("BENCH_FLEET_WINDOWS", 3))
+    big_n = int(os.environ.get("BENCH_FLEET_REPLICAS", 4))
+
+    class SyntheticReplicaModel:
+        batch_size = batch
+        version = None
+
+        def bucket_of(self, feeds):
+            return None
+
+        def execute_batch(self, bucket, examples, timer=None):
+            time.sleep(service_ms / 1e3)   # 'device' time, GIL released
+            return ([{"y": np.asarray(e["x"]) * 2.0} for e in examples],
+                    {"pad": 0.0, "device": 0.0, "scatter": 0.0})
+
+    def loader(engine, rid):
+        engine.load_model_object("m", SyntheticReplicaModel())
+
+    def p95_ms(samples):
+        if not samples:
+            return None
+        s = sorted(samples)
+        return round(s[int(0.95 * (len(s) - 1))] * 1e3, 2)
+
+    def run_arm(n):
+        router = pfleet.FleetRouter(
+            pfleet.ReplicaPool(loader, replicas=n,
+                               max_replicas=max(n, 8)),
+            queue_depth=4 * n_reqs)
+        try:
+            warm = [router.submit("m", {"x": np.float32(0)})
+                    for _ in range(2 * n * batch)]
+            for f in warm:
+                f.result(timeout=30)
+            best, lat_best = float("inf"), None
+            for _w in range(windows):
+                lats = {0: [], 1: []}
+                futs = []
+                t0 = time.time()
+                for i in range(n_reqs):
+                    cls = 1 if i % 4 == 3 else 0
+                    ts = time.monotonic()
+                    f = router.submit("m", {"x": np.float32(i)},
+                                      priority=cls)
+                    # bind THIS window's book as a default arg: a
+                    # straggler callback firing after `lats` rebinds
+                    # must land in its own window, never the next one's
+                    f.add_done_callback(
+                        lambda fut, c=cls, t=ts, book=lats:
+                        book[c].append(time.monotonic() - t))
+                    futs.append(f)
+                for f in futs:
+                    f.result(timeout=120)
+                wall = time.time() - t0
+                # set_result wakes the waiter before callbacks run:
+                # give the tail callbacks a beat so the percentile
+                # window is complete
+                time.sleep(0.01)
+                if wall < best:
+                    best, lat_best = wall, lats
+            return {"replicas": n, "requests": n_reqs,
+                    "rps": round(n_reqs / best, 1),
+                    "p95_ms": {"free": p95_ms(lat_best[0]),
+                               "paid": p95_ms(lat_best[1])}}
+        finally:
+            router.close()
+
+    arm1 = run_arm(1)
+    armN = run_arm(big_n)
+    out = {
+        "synthetic_service_ms": service_ms,
+        "batch": batch,
+        "policy": "least_loaded",
+        "arms": {"1": arm1, str(big_n): armN},
+        "throughput_scaling_x": round(armN["rps"] / arm1["rps"], 2),
+    }
+
+    # -- overload: per-class shed rates, lowest-class-first ------------------
+    router = pfleet.FleetRouter(
+        pfleet.ReplicaPool(loader, replicas=1, max_replicas=8,
+                           engine_opts={"queue_depth": batch,
+                                        "max_wait_ms": 0.5}),
+        queue_depth=2 * batch)
+    try:
+        submitted = {0: 0, 1: 0}
+        shed = []
+        futs = []
+        for i in range(3 * n_reqs // 4):
+            cls = 1 if i % 4 == 3 else 0
+            submitted[cls] += 1
+            try:
+                futs.append((cls, router.submit(
+                    "m", {"x": np.float32(i)}, priority=cls)))
+            except Overloaded as e:
+                shed.append(e.shed_class)
+            time.sleep(0.0001)
+        for cls, f in futs:
+            try:
+                f.result(timeout=120)
+            except Overloaded as e:
+                shed.append(e.shed_class)
+        free_share = (shed.count(0) / len(shed)) if shed else None
+        out["overload"] = {
+            "submitted_by_class": {str(c): n for c, n in
+                                   submitted.items()},
+            "sheds_by_class": {"0": shed.count(0), "1": shed.count(1)},
+            "free_shed_share": (round(free_share, 4)
+                                if free_share is not None else None),
+            "shed_rate_by_class": {
+                str(c): round(shed.count(c) / max(submitted[c], 1), 4)
+                for c in (0, 1)},
+        }
+        if free_share is not None and free_share < 0.9:
+            out["warning_shed"] = (
+                f"SHED-ORDER: free tier absorbed only "
+                f"{free_share:.0%} of sheds (acceptance: >= 90%)")
+            print(f"bench_fleet WARNING: {out['warning_shed']}",
+                  file=sys.stderr)
+    finally:
+        router.close()
+
+    # -- chaos + scale-down under fire: zero dropped in-flight ---------------
+    prior_plan = os.environ.get("PT_FAULT_INJECT")
+    os.environ["PT_FAULT_INJECT"] = \
+        "router_dispatch@25,router_dispatch@90"
+    pfaults.reset()
+    router = pfleet.FleetRouter(
+        pfleet.ReplicaPool(loader, replicas=3, max_replicas=8),
+        queue_depth=4 * n_reqs)
+    dropped, done = [], [0, 0, 0, 0]
+    try:
+        def client(seed):
+            for i in range(40):
+                x = seed * 1000 + i
+                try:
+                    got = router.predict("m", {"x": np.float32(x)},
+                                         priority=i % 2, timeout=60)
+                    assert float(got["y"]) == 2.0 * x
+                    done[seed] += 1
+                except Exception as e:  # noqa: BLE001 — the drop count
+                    dropped.append(f"{type(e).__name__}: {e}")
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        router.pool.scale_to(2, reason="bench_scale_down")
+        for t in threads:
+            t.join(120)
+        snap = router.metrics.snapshot()
+        out["chaos"] = {
+            "requests": 160,
+            "completed": sum(done),
+            "dropped_in_flight": len(dropped),
+            "crashes_injected": 2,
+            "failovers": snap["failovers"],
+            "rebuilds": snap["rebuilds"],
+            "replicas_after_scale_down": router.pool.size(),
+        }
+        if dropped:
+            out["warning_chaos"] = ("ZERO-DROP violated: "
+                                    + "; ".join(dropped[:3]))
+            print(f"bench_fleet WARNING: {out['warning_chaos']}",
+                  file=sys.stderr)
+    finally:
+        if prior_plan is None:
+            os.environ.pop("PT_FAULT_INJECT", None)
+        else:
+            os.environ["PT_FAULT_INJECT"] = prior_plan
+        pfaults.reset()
+        router.close()
+
+    # -- autoscale: sustained load grows a 1-replica fleet -------------------
+    router = pfleet.FleetRouter(
+        pfleet.ReplicaPool(loader, replicas=1, min_replicas=1,
+                           max_replicas=big_n),
+        queue_depth=4 * n_reqs)
+    asc = pfleet.Autoscaler(router.pool, metrics=router.metrics,
+                            interval_s=0.02, up_depth=2.0, up_after=2,
+                            down_after=10_000)
+    router.autoscaler = asc
+    try:
+        asc.start()
+        futs = [router.submit("m", {"x": np.float32(i)},
+                              priority=i % 2)
+                for i in range(2 * n_reqs)]
+        for f in futs:
+            f.result(timeout=120)
+        asc.stop()
+        snap = router.metrics.snapshot()
+        out["autoscale"] = {
+            "replicas_start": 1,
+            "replicas_end": router.pool.size(),
+            "scale_up_events": snap["scale_events"]["up"],
+            "autoscaler": asc.describe(),
+        }
+    finally:
+        router.close()
+
+    if out["throughput_scaling_x"] < 2.5:
+        out["warning_scaling"] = (
+            f"FLEET-SCALING: {out['throughput_scaling_x']}x at "
+            f"{big_n} replicas (acceptance: >= 2.5x)")
+        print(f"bench_fleet WARNING: {out['warning_scaling']}",
+              file=sys.stderr)
+
+    # floor checks (artifacts.py, the gconv pattern): an impossible
+    # fleet reading ships flagged, loudly
+    from paddle_tpu.analysis.artifacts import validate_fleet_ab
+    problems = validate_fleet_ab(out)
+    if problems:
+        out["floor_violations"] = problems
+        print(f"bench_fleet FLOOR VIOLATIONS: {problems}",
+              file=sys.stderr)
+    return out
+
+
 def bench_planner(on_tpu, peak):
     """Static placement planner (analysis/planner.py): search the bench
     transformer's placement space for an 8-chip topology of the current
@@ -1701,6 +1947,7 @@ def main():
              ("data_codec",
               lambda: bench_data_codec(on_tpu, configs.get("resnet50"))),
              ("serving", lambda: bench_serving(on_tpu, peak)),
+             ("fleet", lambda: bench_fleet(on_tpu, peak)),
              ("planner", lambda: bench_planner(on_tpu, peak)),
              ("decode", lambda: bench_decode(on_tpu, peak)),
              ("transformer", lambda: bench_transformer(on_tpu, peak)),

@@ -385,6 +385,96 @@ def validate_op_report(doc) -> List[str]:
 _CODEC_ARM_REQUIRED = ("wire_bytes_ratio", "delivered_images_per_sec")
 
 
+#: per-arm fields the fleet A/B must record
+_FLEET_ARM_REQUIRED = ("replicas", "requests", "rps", "p95_ms")
+
+
+def validate_fleet_ab(doc) -> List[str]:
+    """Floor checks for bench.py's `fleet` staged A/B ([] = valid) —
+    the gconv pattern applied to the replica tier: an impossible
+    reading must never be committed as a measurement.
+
+      * every measured arm records a finite positive rps, a positive
+        replica count, and per-class p95 latencies (finite, positive);
+      * the throughput-scaling ratio is finite and positive (whether it
+        MEETS the 2.5x acceptance is a warning on the row, not a floor
+        — a genuine 1.8x is a measurement, a NaN is not);
+      * the overload leg records per-class shed counts (non-negative
+        ints, total > 0 — an overload leg that shed nothing measured
+        nothing) and a free_shed_share in [0, 1];
+      * the chaos leg records dropped_in_flight (the zero-drop count
+        must be PRESENT — absence would read as 'no drops' when the
+        leg never ran) and a positive completed count.
+    """
+    if not isinstance(doc, dict):
+        return [f"fleet A/B root is {type(doc).__name__}, not an object"]
+    problems: List[str] = []
+    arms = doc.get("arms")
+    if not isinstance(arms, dict) or len(arms) < 2:
+        problems.append("$.arms: the A/B needs >= 2 measured arms")
+        arms = {}
+    for key, arm in arms.items():
+        here = f"$.arms.{key}"
+        if not isinstance(arm, dict):
+            problems.append(f"{here}: not an object")
+            continue
+        for k in _FLEET_ARM_REQUIRED:
+            if k not in arm:
+                problems.append(f"{here}.{k}: required field missing")
+        rps = arm.get("rps")
+        if rps is not None and (_bad_pred_num(rps) or float(rps) <= 0):
+            problems.append(f"{here}.rps: {rps!r} must be finite and "
+                            "positive")
+        nrep = arm.get("replicas")
+        if nrep is not None and (not isinstance(nrep, int) or nrep < 1):
+            problems.append(f"{here}.replicas: {nrep!r} must be a "
+                            "positive int")
+        for cls, v in (arm.get("p95_ms") or {}).items():
+            if v is None or _bad_pred_num(v) or float(v) <= 0:
+                problems.append(f"{here}.p95_ms.{cls}: {v!r} must be "
+                                "finite and positive")
+    scaling = doc.get("throughput_scaling_x")
+    if scaling is None or _bad_pred_num(scaling) or float(scaling) <= 0:
+        problems.append(f"$.throughput_scaling_x: {scaling!r} must be "
+                        "recorded, finite, positive")
+    over = doc.get("overload")
+    if not isinstance(over, dict):
+        problems.append("$.overload: shed leg not recorded")
+    else:
+        sheds = over.get("sheds_by_class")
+        if not isinstance(sheds, dict) or not sheds:
+            problems.append("$.overload.sheds_by_class: missing")
+        else:
+            bad = [f"{c}={n!r}" for c, n in sheds.items()
+                   if not isinstance(n, int) or n < 0]
+            if bad:
+                problems.append("$.overload.sheds_by_class: "
+                                f"non-counts {bad}")
+            elif sum(sheds.values()) <= 0:
+                problems.append(
+                    "$.overload.sheds_by_class: zero total sheds — the "
+                    "overload leg measured no overload")
+        share = over.get("free_shed_share")
+        if share is None or _bad_pred_num(share) \
+                or not 0.0 <= float(share) <= 1.0:
+            problems.append(f"$.overload.free_shed_share: {share!r} "
+                            "must be recorded in [0, 1]")
+    chaos = doc.get("chaos")
+    if not isinstance(chaos, dict):
+        problems.append("$.chaos: crash/scale-down leg not recorded")
+    else:
+        drops = chaos.get("dropped_in_flight")
+        if not isinstance(drops, int) or drops < 0:
+            problems.append(f"$.chaos.dropped_in_flight: {drops!r} — "
+                            "the zero-drop count must be recorded as a "
+                            "non-negative int")
+        comp = chaos.get("completed")
+        if not isinstance(comp, int) or comp <= 0:
+            problems.append(f"$.chaos.completed: {comp!r} must be a "
+                            "positive int")
+    return problems
+
+
 def validate_codec_ab(doc) -> List[str]:
     """Floor checks for bench.py's `data_codec` staged A/B ([] = valid),
     the gconv pattern applied to the codec bench: an impossible reading

@@ -359,3 +359,24 @@ declare_env_knob("PT_PLAN_TOPOLOGY",
                  "Topology.parse). Lets an off-TPU host plan for the "
                  "deployment pod, like PT_COST_CHIP does for the "
                  "roofline")
+declare_env_knob("PT_FLEET_REPLICAS",
+                 "fleet tier (serving/fleet/): initial replica count "
+                 "of a ReplicaPool (default 1); constructor args win")
+declare_env_knob("PT_FLEET_MIN",
+                 "fleet tier: scale floor — the pool (and the "
+                 "autoscaler) never go below this many replicas "
+                 "(default 1)")
+declare_env_knob("PT_FLEET_MAX",
+                 "fleet tier: scale ceiling (default 8)")
+declare_env_knob("PT_FLEET_POLICY",
+                 "fleet router dispatch policy for sessionless "
+                 "traffic: least_loaded (default; queue-depth x "
+                 "EWMA-service-time score) | round_robin. Requests "
+                 "carrying a session key always route session-affine "
+                 "(rendezvous hash)")
+declare_env_knob("PT_FLEET_AUTOSCALE",
+                 "1 = fleet.make_fleet attaches + starts the "
+                 "metrics-driven Autoscaler (queue-depth + EWMA "
+                 "signals, hysteresis; scale-up fast on sustained "
+                 "depth, scale-down slow after an idle window, "
+                 "bounded by PT_FLEET_MIN/PT_FLEET_MAX)")

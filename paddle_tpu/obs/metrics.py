@@ -77,6 +77,26 @@ class MetricsRegistry:
                     weakref.WeakValueDictionary()
             sec[name] = provider
 
+    def register_unique(self, section: str, base_name: str,
+                        provider) -> str:
+        """Atomic register-if-absent: returns the name actually used —
+        `base_name`, or the first free numeric-suffix variant when
+        another LIVE provider already holds it. Unlike register(),
+        concurrent callers can never silently shadow each other (the
+        probe and the insert share one lock hold)."""
+        with self._lock:
+            sec = self._sections.get(section)
+            if sec is None:
+                sec = self._sections[section] = \
+                    weakref.WeakValueDictionary()
+            name, n = base_name, 1
+            while sec.get(name) is not None \
+                    and sec.get(name) is not provider:
+                n += 1
+                name = f"{base_name}-{n}"
+            sec[name] = provider
+            return name
+
     def unregister(self, section: str, name: str) -> None:
         with self._lock:
             sec = self._sections.get(section)
@@ -342,34 +362,79 @@ def render_prometheus(snapshot: dict) -> str:
         text = str(int(val)) if val.is_integer() else repr(val)
         lines.append(f"{metric}{{{lab}}} {text}")
 
+    def serve_labels(name: str, snap: dict) -> Dict[str, str]:
+        # the model label comes from the snapshot itself (the merge key
+        # may be namespaced, e.g. the fleet's "r0/ranker"), and a
+        # replica id — stamped by ServingMetrics(replica=...) in
+        # multi-engine processes — becomes a label so two replicas
+        # serving one model name are distinct series, not duplicates
+        labels = {"model": str(snap.get("model", name))}
+        if snap.get("replica"):
+            labels["replica"] = str(snap["replica"])
+        return labels
+
     # identity first: one constant-1 info series whose labels say what
     # produced every number below — jax version, priced chip, armed knobs
     emit("pt_build_info", build_info_labels(), 1)
     for name, snap in sorted(snapshot.get("models", {}).items()):
+        base = serve_labels(name, snap)
         for key in _SERVE_COUNTERS:
-            emit(f"pt_serve_{key}_total", {"model": name}, snap.get(key),
+            emit(f"pt_serve_{key}_total", base, snap.get(key),
                  "counter")
         for key in _SERVE_GAUGES:
-            emit(f"pt_serve_{key}", {"model": name}, snap.get(key))
+            emit(f"pt_serve_{key}", base, snap.get(key))
         for phase, pcts in snap.get("latency", {}).items():
             for q in ("p50", "p95", "p99"):
                 emit("pt_serve_latency_ms",
-                     {"model": name, "phase": phase, "quantile": q},
+                     dict(base, phase=phase, quantile=q),
                      pcts.get(f"{q}_ms"))
         for key, val in snap.get("phases", {}).items():
             if key.endswith("_s"):
                 emit("pt_serve_phase_seconds_total",
-                     {"model": name, "phase": key[:-2]}, val, "counter")
+                     dict(base, phase=key[:-2]), val, "counter")
     for name, snap in sorted(snapshot.get("decode", {}).items()):
+        base = serve_labels(name, snap)
         for key in _DECODE_COUNTERS:
-            emit(f"pt_decode_{key}_total", {"model": name}, snap.get(key),
+            emit(f"pt_decode_{key}_total", base, snap.get(key),
                  "counter")
         for key in _DECODE_GAUGES:
-            emit(f"pt_decode_{key}", {"model": name}, snap.get(key))
+            emit(f"pt_decode_{key}", base, snap.get(key))
         for key in ("prefill_s", "decode_s"):
             emit("pt_decode_phase_seconds_total",
-                 {"model": name, "phase": key[:-2]}, snap.get(key),
+                 dict(base, phase=key[:-2]), snap.get(key),
                  "counter")
+    for name, snap in sorted(snapshot.get("fleet", {}).items()):
+        # the replica-tier family (serving/fleet/): pool size +
+        # per-replica health gauges, dispatch/shed/scale counters
+        fl = {"fleet": str(snap.get("name", name))}
+        emit("pt_fleet_replicas", fl, snap.get("replicas"))
+        for key in ("completed", "failed", "failovers", "rebuilds"):
+            emit(f"pt_fleet_{key}_total", fl, snap.get(key), "counter")
+        for policy, n in sorted((snap.get("dispatched") or {}).items()):
+            emit("pt_fleet_dispatch_total", dict(fl, policy=policy), n,
+                 "counter")
+        for cls, n in sorted((snap.get("sheds") or {}).items()):
+            emit("pt_fleet_sheds_total",
+                 dict(fl, **{"class": str(cls), "kind": "overload"}), n,
+                 "counter")
+        for cls, n in sorted((snap.get("sheds_deadline") or {}).items()):
+            emit("pt_fleet_sheds_total",
+                 dict(fl, **{"class": str(cls), "kind": "deadline"}), n,
+                 "counter")
+        for direction, n in sorted(
+                (snap.get("scale_events") or {}).items()):
+            emit("pt_fleet_scale_events_total",
+                 dict(fl, direction=direction), n, "counter")
+        for cls, n in sorted((snap.get("queue_depths") or {}).items()):
+            emit("pt_fleet_queue_depth",
+                 dict(fl, **{"class": str(cls)}), n)
+        for rid, h in sorted((snap.get("replica_health") or {}).items()):
+            rl = dict(fl, replica=str(rid))
+            emit("pt_fleet_replica_queue_depth", rl,
+                 h.get("queue_depth"))
+            emit("pt_fleet_replica_ewma_ms", rl, h.get("ewma_ms"))
+            emit("pt_fleet_replica_healthy", rl,
+                 1 if h.get("healthy") else 0)
     for name, snap in sorted(snapshot.get("data", {}).items()):
         for key in _DATA_COUNTERS:
             emit(f"pt_data_{key}_total", {"pipeline": name},

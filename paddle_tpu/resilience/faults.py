@@ -75,6 +75,10 @@ SITES: Dict[str, str] = {
     "serve_dispatch": "crash inside the serving micro-batcher's "
                       "dispatcher loop, per flushed batch "
                       "(serving/batcher.py)",
+    "router_dispatch": "replica crash at fleet-router dispatch, per "
+                       "routed request (serving/fleet/router.py): the "
+                       "router fails over to the next-best replica and "
+                       "rebuilds the crashed one",
 }
 
 ENV_VAR = "PT_FAULT_INJECT"

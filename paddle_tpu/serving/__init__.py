@@ -108,6 +108,20 @@ class ServingEngine:
             self.metrics.model(name).on_reload()
         return ver
 
+    def load_model_object(self, name: str, model,
+                          version: Optional[int] = None) -> int:
+        """Serve an in-memory model object (batch_size / bucket_of /
+        execute_batch surface) behind the full batcher + admission +
+        metrics stack — the synthetic-replica hook the fleet tier's
+        bench and tests load replicas with. Same swap semantics as
+        load_model."""
+        if self._closed:
+            raise ModelUnavailable("engine is shut down")
+        ver = self.registry.load_object(name, model, version)
+        if ver > 1:
+            self.metrics.model(name).on_reload()
+        return ver
+
     def unload_model(self, name: str) -> None:
         self.registry.unload(name)
 

@@ -48,9 +48,19 @@ class ServingError(RuntimeError):
 
 
 class Overloaded(ServingError):
-    """Queue at capacity — rejected fast, worth retrying after backoff."""
+    """Queue at capacity — rejected fast, worth retrying after backoff.
+
+    When the fleet tier sheds under overload (serving/fleet/), the
+    error carries WHICH priority class paid: `shed_class` is the class
+    of the request that was shed (strictly the lowest class present —
+    free tier absorbs overload before paid tier). None on single-engine
+    queue-bound rejections, which predate classes."""
     retryable = True
     http_status = 429
+
+    def __init__(self, message: str, shed_class: Optional[int] = None):
+        super().__init__(message)
+        self.shed_class = shed_class
 
 
 class DeadlineExceeded(ServingError):

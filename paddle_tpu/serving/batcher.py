@@ -132,6 +132,13 @@ class MicroBatcher:
             return len(self._queue) + sum(len(v) for v in
                                           self._pending.values())
 
+    def service_estimate_s(self) -> Optional[float]:
+        """The admission EWMA of batch service seconds (None until the
+        first real batch) — the fleet tier's per-replica health signal
+        (fleet/pool.py), read from the one estimate deadline shedding
+        already maintains rather than a second bookkeeping path."""
+        return self.admission.estimated_batch_s()
+
     def submit(self, feeds, deadline_ms: Optional[float] = None) -> Future:
         """Admit + enqueue one example; returns its Future. Raises the
         typed admission errors (Overloaded / DeadlineExceeded /

@@ -292,10 +292,21 @@ class ServingMetrics:
     deliberately survive hot reloads — a reload is an event on the
     model's timeline, not a new timeline). Decode engines report through
     the same registry under their own axis (`decode(name)`), so ONE
-    snapshot — and one Prometheus scrape — covers both serving planes."""
+    snapshot — and one Prometheus scrape — covers both serving planes.
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic):
+    Multi-engine processes (the fleet tier, serving/fleet/): `replica`
+    namespaces this engine's series — every model/decode snapshot
+    carries a `replica` key the Prometheus renderer turns into a
+    `replica="<id>"` label, so two replicas serving the SAME model name
+    scrape as distinct series instead of duplicates (validate_exposition
+    rejects the duplicate). The pre-fleet single-engine assumption —
+    one engine per process, model name alone identifies a series — is
+    exactly what this parameter retires."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 replica: Optional[str] = None):
         self._clock = clock
+        self.replica = replica
         self._lock = threading.Lock()
         self._models: Dict[str, ModelMetrics] = {}
         self._decode: Dict[str, DecodeMetrics] = {}
@@ -316,22 +327,30 @@ class ServingMetrics:
                                                        clock=self._clock)
             return m
 
-    def snapshot(self) -> dict:
+    def snapshot(self, merge_registry: bool = True) -> dict:
         with self._lock:
             models = list(self._models.values())
             decode = list(self._decode.values())
         out = {"models": {m.name: m.snapshot() for m in models}}
         if decode:
             out["decode"] = {m.name: m.snapshot() for m in decode}
+        if self.replica is not None:
+            for sec in ("models", "decode"):
+                for snap in out.get(sec, {}).values():
+                    snap["replica"] = self.replica
         # every other plane reports through the same snapshot (and so
         # the same Prometheus scrape) via the unified MetricsRegistry
         # (obs/metrics.py): live input pipelines (pt_data_*), the
         # training loop (pt_train_*), and the predicted-vs-measured
         # drift monitor (pt_model_*) all ride along — one scrape, one
-        # observability plane.
-        for section, snaps in REGISTRY.snapshot().items():
-            if snaps:
-                out.setdefault(section, snaps)
+        # observability plane. A fleet router merging N replica
+        # snapshots passes merge_registry=False per replica and merges
+        # the registry sections ONCE — the one-engine-per-process
+        # assumption the fleet satellite fix retires.
+        if merge_registry:
+            for section, snaps in REGISTRY.snapshot().items():
+                if snaps:
+                    out.setdefault(section, snaps)
         return out
 
 

@@ -248,27 +248,57 @@ class ModelRegistry:
         self._entries: Dict[str, _Entry] = {}
         self._versions: Dict[str, int] = {}
 
-    def load(self, name: str, model_dir: str,
-             version: Optional[int] = None, *,
-             warmup: bool = True) -> int:
-        """Load (or hot-reload) `name` from `model_dir`. Returns the
-        version id. The new version is fully warmed BEFORE the swap; the
-        old version drains all queued requests before release."""
+    def _reserve_version(self, name: str,
+                         version: Optional[int]) -> int:
+        """Reserve a version id NOW, not after the (slow, unlocked)
+        model build — two concurrent reloads must get distinct ids."""
         with self._lock:
             if version is None:
                 version = self._versions.get(name, 0) + 1
-            # reserve NOW, not after the (slow, unlocked) model load —
-            # two concurrent reloads must get distinct version ids
             self._versions[name] = max(self._versions.get(name, 0),
                                        version)
-        model = ModelVersion.load(model_dir, version=version,
-                                  warmup=warmup)
+        return version
+
+    def _publish(self, name: str, model) -> None:
+        """The swap tail every load path shares: build the new
+        batcher, atomically swap the routing entry, then drain the old
+        version's batcher (zero dropped in-flight requests)."""
         batcher = self._make_batcher(name, model)
         with self._lock:
             old = self._entries.get(name)
             self._entries[name] = _Entry(name, model, batcher)
         if old is not None:
             old.batcher.close(drain=True)
+
+    def load(self, name: str, model_dir: str,
+             version: Optional[int] = None, *,
+             warmup: bool = True) -> int:
+        """Load (or hot-reload) `name` from `model_dir`. Returns the
+        version id. The new version is fully warmed BEFORE the swap; the
+        old version drains all queued requests before release."""
+        version = self._reserve_version(name, version)
+        model = ModelVersion.load(model_dir, version=version,
+                                  warmup=warmup)
+        self._publish(name, model)
+        return version
+
+    def load_object(self, name: str, model,
+                    version: Optional[int] = None) -> int:
+        """Register an in-memory model object through the same
+        batcher/entry path as an artifact load: anything with
+        `batch_size`, `bucket_of(feeds)`, and `execute_batch(bucket,
+        examples, timer=)` serves behind the engine's full queueing /
+        admission / metrics stack. This is how the fleet bench and the
+        unit plane host synthetic replicas — the routing tier above is
+        identical either way. Swap semantics match load(): new batcher
+        in, old batcher drained."""
+        version = self._reserve_version(name, version)
+        if getattr(model, "version", None) is None:
+            try:
+                model.version = version
+            except (AttributeError, TypeError):
+                pass   # slotted/frozen stubs keep their own identity
+        self._publish(name, model)
         return version
 
     def get(self, name: str) -> _Entry:
@@ -294,13 +324,16 @@ class ModelRegistry:
         out = {}
         for e in entries:
             m = e.model
+            # getattr-tolerant: load_object() models (fleet synthetic
+            # replicas, unit stubs) describe what they declare
             out[e.name] = {
-                "version": m.version,
-                "model_dir": m.model_dir,
+                "version": getattr(m, "version", None),
+                "model_dir": getattr(m, "model_dir", None),
                 "batch_size": m.batch_size,
-                "buckets": m.bounds if m.bounds else [None],
-                "feeds": m.feed_names,
-                "fetches": m.fetch_names,
+                "buckets": (m.bounds or [None]) if hasattr(m, "bounds")
+                else [None],
+                "feeds": getattr(m, "feed_names", []),
+                "fetches": getattr(m, "fetch_names", []),
             }
         return out
 

@@ -12,7 +12,11 @@
 #           buckets, hot reload, admission/shedding, metrics, HTTP front
 #           end) + the C-API serving drivers + the autoregressive decode
 #           suite (paged KV cache, continuous batching, eviction/resume
-#           token identity, streaming route, prometheus exposition)
+#           token identity, streaming route, prometheus exposition) +
+#           the fleet-tier suite (replica pool, least-loaded/session-
+#           affine routing, priority WFQ admission + lowest-class-first
+#           shedding, crash failover, autoscaler hysteresis, pt_fleet_*
+#           exposition)
 #   analyze = lint gate + the static cost-model suites + schema-checked
 #           tools/cost_report.py runs over the resnet / transformer /
 #           decode bench programs, incl. the collective audit on the
@@ -58,9 +62,12 @@ if [[ "${1:-}" == "chaos" ]]; then
   # the recovery invariants are exercised on two distinct failure
   # schedules, both reproducible.
   for seed in 0 7; do
-    echo "== chaos: resilience + guardrail suites (PT_CHAOS_SEED=$seed) =="
+    echo "== chaos: resilience + guardrail + fleet suites (PT_CHAOS_SEED=$seed) =="
+    # the fleet suite rides along: its router_dispatch chaos site
+    # (deterministic replica-crash injection at dispatch) exercises the
+    # failover/rebuild path under the same seeded harness
     PT_CHAOS_SEED=$seed python -m pytest tests/test_resilience.py \
-      tests/test_guardrails.py -q
+      tests/test_guardrails.py tests/test_fleet.py -q
   done
   echo "CHAOS OK"
   exit 0
@@ -111,9 +118,9 @@ if [[ "${1:-}" == "data" ]]; then
 fi
 
 if [[ "${1:-}" == "serve" ]]; then
-  echo "== serve: online serving engine + C-API drivers + decode =="
+  echo "== serve: online serving engine + C-API drivers + decode + fleet =="
   python -m pytest tests/test_serving.py tests/test_capi_serving.py \
-    tests/test_decode.py -q
+    tests/test_decode.py tests/test_fleet.py -q
   echo "SERVE OK"
   exit 0
 fi

@@ -1,0 +1,1048 @@
+"""Fleet tier (paddle_tpu/serving/fleet/): replica pool + router +
+priority admission + autoscaler.
+
+The suite runs entirely on synthetic replica models (ServingEngine
+.load_model_object) — the routing/scale/shed/failover contracts are
+host-side and must hold regardless of what executes a batch. Pinned
+here:
+
+  * least-loaded beats round-robin under skewed replica speed (the
+    queue-depth x EWMA score actually self-balances);
+  * session affinity is rendezvous-stable across scale events (only
+    sessions whose replica changed remap);
+  * shedding under overload is STRICTLY lowest-class-first, typed,
+    with the shed class on the error;
+  * WFQ service shares follow class weights (paid served faster, free
+    never starved);
+  * zero in-flight futures dropped across scale-down AND an injected
+    replica crash (the `router_dispatch` chaos site -> failover);
+  * autoscaler hysteresis math: fast up on sustained depth, slow down
+    after an idle window, no flapping on an oscillating load, never
+    below min;
+  * the multi-replica scrape is conformant: per-replica namespacing
+    (replica= label) keeps two replicas of one model from colliding
+    into duplicate series (the single-engine-assumption regression).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.obs.metrics import render_prometheus, validate_exposition
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import ServingEngine, fleet
+from paddle_tpu.serving.admission import (DeadlineExceeded,
+                                          ModelUnavailable, Overloaded)
+from paddle_tpu.serving.fleet import (Autoscaler, FleetRouter,
+                                      PendingRequest, ReplicaPool,
+                                      WeightedFairQueue, make_fleet)
+from paddle_tpu.serving.metrics import ServingMetrics
+
+
+@pytest.fixture(autouse=True)
+def fresh_fault_plan(monkeypatch):
+    monkeypatch.delenv("PT_FAULT_INJECT", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class SyntheticModel:
+    """One replica's 'model': doubles x, optionally sleeps per batch
+    (a slow replica), optionally crashes (a dead dispatcher), records
+    how many examples it served and tags results with its replica."""
+
+    batch_size = 4
+    version = None
+
+    def __init__(self, rid: str = "?", delay_s: float = 0.0):
+        self.rid = rid
+        self.delay_s = delay_s
+        self.crash = False
+        self.served = 0
+        self._lock = threading.Lock()
+        self.gate = None   # a threading.Event blocks execution when set
+
+    def bucket_of(self, feeds):
+        return None
+
+    def execute_batch(self, bucket, examples, timer=None):
+        if self.gate is not None:
+            assert self.gate.wait(20.0), "test gate never released"
+        if self.crash:
+            raise RuntimeError(f"replica {self.rid} dispatcher died")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        with self._lock:
+            self.served += len(examples)
+        out = [{"y": np.asarray(e["x"], np.float64) * 2.0,
+                "rid": np.asarray(int(self.rid[1:]))}
+               for e in examples]
+        return out, {"pad": 0.0, "device": 0.0, "scatter": 0.0}
+
+
+def _make(n=2, policy="least_loaded", queue_depth=1024, delay=None,
+          gate=None, default_delay=0.0, engine_opts=None, **router_kw):
+    """A fleet over synthetic replicas; returns (router, {rid: model}).
+    delay: {rid: seconds} per-replica slowness."""
+    models = {}
+
+    def loader(engine, rid):
+        m = models.get(rid)
+        if m is None:
+            m = models[rid] = SyntheticModel(
+                rid, delay_s=(delay or {}).get(rid, default_delay))
+            m.gate = gate
+        engine.load_model_object("m", m)
+
+    pool = ReplicaPool(loader, replicas=n, max_replicas=max(n, 8),
+                       engine_opts=engine_opts)
+    router = FleetRouter(pool, policy=policy, queue_depth=queue_depth,
+                         **router_kw)
+    return router, models
+
+
+def _fire(router, n, priority=0, session=None, x0=0):
+    return [router.submit("m", {"x": np.float32(x0 + i)},
+                          priority=priority, session=session)
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# dispatch policies
+# ---------------------------------------------------------------------------
+
+class TestDispatch:
+    def test_basic_dispatch_and_results(self):
+        router, _ = _make(2)
+        try:
+            futs = _fire(router, 16)
+            for i, f in enumerate(futs):
+                assert float(f.result(timeout=10)["y"]) == 2.0 * i
+            snap = router.metrics.snapshot()
+            assert snap["completed"] == 16
+            assert sum(snap["dispatched"].values()) == 16
+        finally:
+            router.close()
+
+    def test_round_robin_splits_evenly(self):
+        router, models = _make(2, policy="round_robin")
+        try:
+            for f in _fire(router, 24):
+                f.result(timeout=10)
+            served = sorted(m.served for m in models.values())
+            # deterministic rotation: near-even regardless of speed
+            assert served[0] >= 8, served
+        finally:
+            router.close()
+
+    def test_least_loaded_prefers_fast_replica_under_skew(self):
+        # r0 sleeps 30 ms per batch, r1 is instant: the slow replica's
+        # depth + EWMA grow, so its score does — most traffic lands on
+        # the fast one. Round-robin (above) splits blindly.
+        router, models = _make(2, delay={"r0": 0.03})
+        try:
+            futs = []
+            for i in range(60):
+                futs.extend(_fire(router, 1, x0=i))
+                time.sleep(0.001)   # arrival stream, not one burst
+            for f in futs:
+                f.result(timeout=30)
+            assert models["r1"].served > models["r0"].served, (
+                models["r0"].served, models["r1"].served)
+        finally:
+            router.close()
+
+    def test_unknown_policy_refused(self):
+        with pytest.raises(ValueError):
+            pool = ReplicaPool(
+                lambda e, r: e.load_model_object("m", SyntheticModel()),
+                replicas=1)
+            try:
+                FleetRouter(pool, policy="wishful")
+            finally:
+                pool.close()
+
+
+# ---------------------------------------------------------------------------
+# session affinity across scale events
+# ---------------------------------------------------------------------------
+
+class TestSessionAffinity:
+    def _served_by(self, router, session):
+        fut = router.submit("m", {"x": np.float32(1)}, session=session)
+        return int(fut.result(timeout=10)["rid"])
+
+    def test_same_session_same_replica(self):
+        router, _ = _make(4)
+        try:
+            sessions = [f"user-{i}" for i in range(24)]
+            first = {s: self._served_by(router, s) for s in sessions}
+            again = {s: self._served_by(router, s) for s in sessions}
+            assert first == again
+            # the hash actually spreads sessions over the fleet
+            assert len(set(first.values())) > 1
+        finally:
+            router.close()
+
+    def test_affinity_stable_across_scale_events(self):
+        router, _ = _make(3, queue_depth=4096)
+        try:
+            sessions = [f"user-{i}" for i in range(40)]
+            at3 = {s: self._served_by(router, s) for s in sessions}
+            # scale UP: only sessions remapped onto the NEW replica move
+            router.pool.scale_to(4)
+            at4 = {s: self._served_by(router, s) for s in sessions}
+            moved = [s for s in sessions if at4[s] != at3[s]]
+            assert all(at4[s] == 3 for s in moved), (
+                "a session moved to an old replica on scale-up")
+            assert len(moved) < len(sessions) // 2   # ~1/4 expected
+            # scale DOWN (retires r3): only r3's sessions move; every
+            # session that was NOT on r3 keeps its replica
+            router.pool.scale_to(3)
+            at3b = {s: self._served_by(router, s) for s in sessions}
+            for s in sessions:
+                if at4[s] != 3:
+                    assert at3b[s] == at4[s]
+        finally:
+            router.close()
+
+    def test_affinity_survives_rebuild(self):
+        # a rebuilt replica keeps its id, so its sessions come back to
+        # it rather than remapping
+        router, models = _make(3)
+        try:
+            rid = self._served_by(router, "sticky")
+            models[f"r{rid}"].crash = True
+            # the crash fails over (served elsewhere), marks the
+            # replica unhealthy, and rebuilds it off to the side
+            fut = router.submit("m", {"x": np.float32(1)},
+                                session="sticky")
+            assert int(fut.result(timeout=10)["rid"]) != rid
+            models[f"r{rid}"].crash = False
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                rep = router.pool.get(f"r{rid}")
+                if rep is not None and rep.healthy:
+                    break
+                time.sleep(0.01)
+            assert self._served_by(router, "sticky") == rid
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# priority admission: WFQ service + strict shed ordering
+# ---------------------------------------------------------------------------
+
+class TestPriorityAdmission:
+    def test_wfq_service_shares_follow_weights(self):
+        # saturated queue, weights 1:2: pops serve class 1 about twice
+        # as often as class 0 — weighted-fair, NOT strict priority
+        # (class 0 is served while class 1 is still backlogged)
+        wfq = WeightedFairQueue(10_000)
+        for i in range(300):
+            wfq.offer(PendingRequest("m", None, cls=i % 2))
+        order = [wfq.pop().cls for _ in range(150)]
+        c1 = sum(order)
+        c0 = len(order) - c1
+        assert 1.5 <= c1 / max(c0, 1) <= 2.5, (c0, c1)
+        assert 0 in order[:10]    # free tier not starved
+
+    def test_wfq_shed_strictly_lowest_class_first(self):
+        # the deterministic core of the shed contract, on the queue
+        # itself: the victim is ALWAYS from the lowest occupied class,
+        # an arrival of the lowest class sheds itself, and the typed
+        # error names the class that paid
+        wfq = WeightedFairQueue(4)
+        for cls in (0, 1, 0, 1):
+            assert wfq.offer(PendingRequest("m", None, cls=cls)) is None
+        v = wfq.offer(PendingRequest("m", None, cls=2))
+        assert v.cls == 0
+        v = wfq.offer(PendingRequest("m", None, cls=1))
+        assert v.cls == 0
+        # no class-0 left: an arriving class-1 is the lowest present
+        with pytest.raises(Overloaded) as ei:
+            wfq.offer(PendingRequest("m", None, cls=1))
+        assert ei.value.shed_class == 1
+        with pytest.raises(Overloaded) as ei:
+            wfq.offer(PendingRequest("m", None, cls=0))
+        assert ei.value.shed_class == 0
+        v = wfq.offer(PendingRequest("m", None, cls=3))
+        assert v.cls == 1   # strictly the lowest occupied, always
+
+    def test_overload_free_tier_absorbs_sheds(self):
+        # end to end: one slow replica, arrivals far above service
+        # rate, 3:1 free:paid mix — the free tier absorbs >= 90% of
+        # sheds (paid arrivals displace queued free requests; a paid
+        # request sheds only when the queue holds no free request),
+        # every shed is typed with its class, nothing is dropped
+        # silently
+        router, _ = _make(1, queue_depth=8, default_delay=0.01,
+                          engine_opts={"queue_depth": 4,
+                                       "max_wait_ms": 0.5})
+        outcomes = {"served": 0, "shed": []}
+        futs = []
+        try:
+            for i in range(200):
+                cls = 1 if i % 4 == 3 else 0
+                try:
+                    futs.append((cls, router.submit(
+                        "m", {"x": np.float32(i)}, priority=cls)))
+                except Overloaded as e:
+                    assert e.shed_class == cls
+                    outcomes["shed"].append(cls)
+                time.sleep(0.0001)
+            for cls, f in futs:
+                try:
+                    f.result(timeout=30)
+                    outcomes["served"] += 1
+                except Overloaded as e:
+                    assert e.shed_class == cls
+                    outcomes["shed"].append(cls)
+            shed = outcomes["shed"]
+            assert outcomes["served"] + len(shed) == 200
+            assert len(shed) >= 30          # the overload was real
+            free_share = shed.count(0) / len(shed)
+            assert free_share >= 0.9, free_share
+            # paid shed RATE strictly below free shed rate
+            paid_rate = shed.count(1) / 50
+            free_rate = shed.count(0) / 150
+            assert paid_rate < free_rate
+            snap = router.metrics.snapshot()
+            assert snap["sheds"].get("0", 0) == shed.count(0)
+            assert snap["sheds"].get("1", 0) == shed.count(1)
+        finally:
+            router.close()
+
+    def test_hostile_priority_cannot_kill_the_dispatcher(self):
+        # a client-supplied priority=2000 used to overflow the doubling
+        # weight (2.0**2000) inside pop() and kill the router thread —
+        # classes clamp to MAX_CLASS and the fleet keeps serving
+        from paddle_tpu.serving.fleet.admission import MAX_CLASS
+        router, _ = _make(1)
+        try:
+            hostile = router.submit("m", {"x": np.float32(7)},
+                                    priority=2000)
+            assert float(hostile.result(timeout=10)["y"]) == 14.0
+            for i, f in enumerate(_fire(router, 8)):
+                assert float(f.result(timeout=10)["y"]) == 2.0 * i
+        finally:
+            router.close()
+        wfq = WeightedFairQueue(10)
+        wfq.offer(PendingRequest("m", None, cls=10**9))
+        got = wfq.pop()
+        assert got.cls == MAX_CLASS and wfq.pop() is None
+
+    def test_deadline_passthrough_to_replica(self):
+        gate = threading.Event()
+        router, _ = _make(1, gate=gate)
+        try:
+            head = _fire(router, 1)
+            time.sleep(0.05)
+            late = router.submit("m", {"x": np.float32(1)},
+                                 deadline_ms=30)
+            time.sleep(0.1)
+            gate.set()
+            head[0].result(timeout=10)
+            with pytest.raises(DeadlineExceeded):
+                late.result(timeout=10)
+        finally:
+            gate.set()
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# failover + chaos + zero-drop scale
+# ---------------------------------------------------------------------------
+
+class TestFailoverAndScale:
+    def test_request_failed_fails_over_and_rebuilds(self):
+        router, models = _make(2)
+        try:
+            for f in _fire(router, 4):
+                f.result(timeout=10)
+            models["r0"].crash = True
+            models["r1"].crash = False
+            futs = _fire(router, 12)
+            for f in futs:
+                assert int(f.result(timeout=20)["rid"]) == 1
+            snap = router.metrics.snapshot()
+            assert snap["failovers"] >= 1
+            assert snap["rebuilds"] >= 1
+            models["r0"].crash = False
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                rep = router.pool.get("r0")
+                if rep is not None and rep.healthy:
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("crashed replica never rebuilt")
+        finally:
+            router.close()
+
+    def test_router_dispatch_chaos_site_failover(self, monkeypatch):
+        monkeypatch.setenv("PT_FAULT_INJECT", "router_dispatch@3")
+        faults.reset()
+        router, _ = _make(2)
+        try:
+            futs = _fire(router, 10)
+            for i, f in enumerate(futs):
+                assert float(f.result(timeout=20)["y"]) == 2.0 * i
+            snap = router.metrics.snapshot()
+            assert snap["failovers"] == 1    # the injected crash
+            assert snap["completed"] == 10   # ...dropped nothing
+        finally:
+            router.close()
+
+    def test_zero_dropped_futures_scale_down_plus_crash(self,
+                                                        monkeypatch):
+        # concurrent fire; mid-fire the pool scales 3 -> 2 (drain) AND
+        # a deterministic replica crash is injected at dispatch: every
+        # submitted future must still resolve with the right answer
+        monkeypatch.setenv("PT_FAULT_INJECT", "router_dispatch@40")
+        faults.reset()
+        router, _ = _make(3, queue_depth=4096)
+        results, errors = [], []
+        lock = threading.Lock()
+
+        def client(seed):
+            for i in range(40):
+                x = seed * 1000 + i
+                try:
+                    got = router.predict("m", {"x": np.float32(x)},
+                                         priority=i % 2, timeout=30)
+                    with lock:
+                        results.append((x, float(got["y"])))
+                except Exception as e:  # noqa: BLE001 — the drop count
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+        try:
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)
+            router.pool.scale_to(2, reason="test")
+            for t in threads:
+                t.join(60)
+            assert not errors, errors[:3]
+            assert len(results) == 160
+            assert all(y == 2.0 * x for x, y in results)
+            assert router.pool.size() == 2
+        finally:
+            router.close()
+
+    def test_single_replica_crash_surfaces_original_error(self):
+        # the PT_FLEET_REPLICAS=1 default: a dispatcher crash with no
+        # second replica to fail over to must surface the retryable
+        # RequestFailed, never a "no replica left" 404 wrapper
+        from paddle_tpu.serving.admission import RequestFailed
+        router, models = _make(1)
+        try:
+            for f in _fire(router, 2):
+                f.result(timeout=10)
+            models["r0"].crash = True
+            with pytest.raises(RequestFailed):
+                router.predict("m", {"x": np.float32(1)}, timeout=20)
+        finally:
+            router.close()
+
+    def test_unknown_model_rejects_fast(self):
+        # reject-fast parity with the single engine: a name no replica
+        # serves never consumes a fleet queue slot (or sheds a real
+        # queued request on its way to a 404)
+        router, _ = _make(2)
+        try:
+            with pytest.raises(ModelUnavailable):
+                router.submit("nope", {"x": np.float32(1)}, priority=9)
+            assert router.metrics.snapshot()["sheds"] == {}
+        finally:
+            router.close()
+
+    def test_failed_rebuild_surrenders_slot_and_drains_dead_engine(self):
+        # loader refuses every rebuild: the slot is given up (size()
+        # tells the truth, no unhealthy zombie counted as capacity) and
+        # the dead engine is still drained — futures queued on it
+        # resolve, never hang
+        state = {"built": 0}
+
+        def loader(engine, rid):
+            state["built"] += 1
+            if state.get("fail"):
+                raise RuntimeError("model store unreachable")
+            engine.load_model_object("m", SyntheticModel(rid))
+
+        pool = ReplicaPool(loader, replicas=1)
+        router = FleetRouter(pool, queue_depth=64)
+        try:
+            for f in _fire(router, 2):
+                f.result(timeout=10)
+            state["fail"] = True
+            pool.mark_unhealthy("r0", replica=pool.get("r0"))
+            deadline = time.monotonic() + 15
+            while pool.size() > 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert pool.size() == 0          # slot surrendered
+            assert state["built"] == 1 + 3   # bounded retries
+            # the loader recovers: the next request HEALS the pool
+            # back to its floor instead of failing forever
+            state["fail"] = False
+            got = router.predict("m", {"x": np.float32(3)}, timeout=20)
+            assert float(got["y"]) == 6.0
+            assert pool.size() == 1
+        finally:
+            router.close()
+
+    def test_generate_exhaustion_surfaces_original_error(self, monkeypatch):
+        from paddle_tpu.serving.admission import RequestFailed
+        monkeypatch.setenv("PT_FAULT_INJECT", "router_dispatch@1")
+        faults.reset()
+        router, _ = _make(1)
+        try:
+            for rep in router.pool.all_replicas():
+                rep.engine._decode["g"] = FakeDecodeEngine(rep.rid)
+            # the only replica crashes at dispatch: the ORIGINAL typed
+            # crash error surfaces, not a model-not-found wrapper
+            with pytest.raises(RequestFailed):
+                router.generate("g", [1, 2])
+        finally:
+            router.close()
+
+    def test_pool_init_midbuild_failure_leaks_no_replicas(self):
+        # the 3rd replica's loader refuses: the two already-published
+        # engines must be torn down, not leaked for the process life
+        def loader(engine, rid):
+            if rid == "r2":
+                raise RuntimeError("bad artifact dir")
+            engine.load_model_object("poolleak", SyntheticModel(rid))
+
+        with pytest.raises(RuntimeError):
+            ReplicaPool(loader, replicas=3)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            leaked = [t.name for t in threading.enumerate()
+                      if "pt-serve[poolleak]" in t.name]
+            if not leaked:
+                break
+            time.sleep(0.02)
+        assert not leaked, leaked
+
+    def test_make_fleet_bad_policy_leaks_no_replicas(self):
+        before = {t.name for t in threading.enumerate()}
+        with pytest.raises(ValueError):
+            make_fleet(
+                lambda e, r: e.load_model_object(
+                    "leakm", SyntheticModel(r)),
+                replicas=2, policy="least-loaded")   # typo'd knob value
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            leaked = [t.name for t in threading.enumerate()
+                      if "pt-serve[leakm]" in t.name
+                      and t.name not in before]
+            if not leaked:
+                break
+            time.sleep(0.02)
+        assert not leaked, leaked
+
+    def test_stale_failover_cannot_condemn_rebuilt_replica(self):
+        # a straggler failure from an already-replaced engine must not
+        # tear down the fresh one: mark_unhealthy compares object
+        # identity, not just the slot id
+        router, models = _make(2)
+        try:
+            for f in _fire(router, 4):
+                f.result(timeout=10)
+            old = router.pool.get("r0")
+            assert router.pool.mark_unhealthy("r0", replica=old)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                rep = router.pool.get("r0")
+                if rep is not None and rep.healthy and rep is not old:
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("replica never rebuilt")
+            # the stale object's late failure is a no-op
+            assert not router.pool.mark_unhealthy("r0", replica=old)
+            assert router.pool.get("r0").healthy
+        finally:
+            router.close()
+
+    def test_second_fleet_gets_its_own_metrics_name(self):
+        ra, _ = _make(1)
+        rb, _ = _make(1)
+        try:
+            assert ra.name != rb.name
+            snap = ra.metrics_snapshot()["fleet"]
+            assert ra.name in snap and rb.name in snap
+            ra.close()
+            # closing A must not take B off the scrape
+            assert rb.name in rb.metrics_snapshot()["fleet"]
+        finally:
+            rb.close()
+
+    def test_requeue_after_shutdown_fails_typed_not_hangs(self):
+        # a failover requeue that races the dispatcher's exit must
+        # fail the future typed (retryable Overloaded), never strand
+        # it in a queue no thread will pop again
+        router, _ = _make(1)
+        for f in _fire(router, 2):
+            f.result(timeout=10)
+        router.close()
+        item = PendingRequest("m", {"x": np.float32(1)}, cls=1)
+        router._requeue(item)
+        with pytest.raises(Overloaded) as ei:
+            item.future.result(timeout=5)
+        assert ei.value.shed_class == 1
+
+    def test_all_replicas_dead_surfaces_original_error(self):
+        from paddle_tpu.serving.admission import RequestFailed
+        router, models = _make(2)
+        try:
+            for f in _fire(router, 4):
+                f.result(timeout=10)
+            for m in models.values():
+                m.crash = True
+            # the failover budget (retries=1) is spent on the second
+            # replica; when IT also dies, the ORIGINAL typed error
+            # surfaces — a retry layer must not replace the root cause
+            with pytest.raises(RequestFailed):
+                router.predict("m", {"x": np.float32(1)}, timeout=20)
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler hysteresis (pure math, synthetic health, no threads)
+# ---------------------------------------------------------------------------
+
+class FakePool:
+    def __init__(self, size=1, lo=1, hi=4):
+        self._n = size
+        self.min_replicas = lo
+        self.max_replicas = hi
+        self.scale_calls = []
+
+    def size(self):
+        return self._n
+
+    def scale_to(self, n, reason=""):
+        self.scale_calls.append((n, reason))
+        self._n = min(max(n, self.min_replicas), self.max_replicas)
+        return self._n
+
+    def health(self):
+        return {}
+
+    def ensure_min(self):
+        return False
+
+
+def _asc(pool, feed, **kw):
+    kw.setdefault("up_depth", 4.0)
+    kw.setdefault("down_depth", 0.5)
+    kw.setdefault("up_after", 2)
+    kw.setdefault("down_after", 4)
+    return Autoscaler(pool, health=feed, **kw)
+
+
+def _feed_of(depths):
+    it = iter(depths)
+
+    def feed():
+        d = next(it)
+        return {"r0": {"queue_depth": d, "ewma_ms": 10.0,
+                       "healthy": True}}
+    return feed
+
+
+class TestAutoscalerHysteresis:
+    def test_up_fast_on_sustained_depth(self):
+        pool = FakePool(1)
+        asc = _asc(pool, _feed_of([10, 10, 10]))
+        assert asc.tick() is None          # one hot tick is a burst
+        assert asc.tick() == "up"          # two is sustained
+        assert pool._n == 2
+
+    def test_down_slow_after_idle_window(self):
+        pool = FakePool(3)
+        asc = _asc(pool, _feed_of([0, 0, 0, 0, 0]))
+        assert [asc.tick() for _ in range(3)] == [None, None, None]
+        assert asc.tick() == "down"        # only after the full window
+        assert pool._n == 2
+
+    def test_no_flapping_on_oscillating_load(self):
+        pool = FakePool(2)
+        asc = _asc(pool, _feed_of([10, 0] * 10))
+        decisions = [asc.tick() for _ in range(20)]
+        assert decisions == [None] * 20    # streaks reset every flip
+        assert pool.scale_calls == []
+
+    def test_band_holds_streaks_at_zero(self):
+        # load hovering between the thresholds: no decision, ever
+        pool = FakePool(2)
+        asc = _asc(pool, _feed_of([2, 2, 2, 2, 2, 2, 2, 2]))
+        assert all(asc.tick() is None for _ in range(8))
+
+    def test_never_below_min_never_above_max(self):
+        pool = FakePool(1, lo=1, hi=2)
+        asc = _asc(pool, _feed_of([0] * 12 + [10] * 12))
+        for _ in range(12):
+            asc.tick()
+        assert pool._n == 1                # idle at min: stays
+        for _ in range(12):
+            asc.tick()
+        assert pool._n == 2                # hot at max: capped
+        assert all(1 <= n <= 2 for n, _ in pool.scale_calls)
+
+    def test_backlog_seconds_signal_scales_up(self):
+        # modest depth but slow service: depth x EWMA crosses the
+        # backlog threshold even though depth alone would not
+        def feed():
+            return {"r0": {"queue_depth": 2, "ewma_ms": 600.0,
+                           "healthy": True}}
+        pool = FakePool(1)
+        asc = _asc(pool, feed, up_backlog_s=1.0)
+        asc.tick()
+        assert asc.tick() == "up"
+
+    def test_hysteresis_band_required(self):
+        with pytest.raises(ValueError):
+            Autoscaler(FakePool(1), up_depth=1.0, down_depth=1.0)
+        with pytest.raises(ValueError):
+            Autoscaler(FakePool(1), up_backlog_s=1.0,
+                       down_backlog_s=1.0)
+
+    def test_no_flapping_on_steady_backlog_hover(self):
+        # slow-model fleet whose backlog hovers between the up and
+        # down backlog lines after a scale-up: the band holds the new
+        # size — a shared threshold would scale down and re-trigger
+        state = {"n": 2}
+
+        def feed():
+            # backlog/replica: 1.0 s at 2 replicas, ~0.67 s at 3 —
+            # above down_backlog_s (0.25) either way, depth tiny
+            per = 2.0 / state["n"]
+            return {f"r{i}": {"queue_depth": 0.4 * per / 1.0,
+                              "ewma_ms": 2500.0, "healthy": True}
+                    for i in range(state["n"])}
+
+        pool = FakePool(2, lo=1, hi=4)
+        pool.health = feed
+
+        def scale_to(n, reason=""):
+            pool._n = state["n"] = min(max(n, 1), 4)
+            pool.scale_calls.append((n, reason))
+            return pool._n
+        pool.scale_to = scale_to
+        asc = _asc(pool, feed, up_backlog_s=1.0)
+        decisions = [asc.tick() for _ in range(20)]
+        assert decisions.count("up") == 1        # one honest scale-up
+        assert "down" not in decisions           # ...and it STICKS
+        assert state["n"] == 3
+
+    def test_heal_to_min_before_signal(self):
+        # an empty pool reads pressure 0 (no health) — the floor is a
+        # contract, so tick() heals to min before reading the signal
+        pool = FakePool(0, lo=2, hi=4)
+        healed = []
+        pool.ensure_min = lambda: (healed.append(True),
+                                   pool.scale_to(2))[1] == 2
+        asc = _asc(pool, lambda: {})
+        asc.tick()
+        assert healed and pool._n == 2
+
+    def test_live_fleet_autoscales_up_under_load(self):
+        # end-to-end: a real router under sustained load, ticked
+        # manually — the pool grows off the live health signal
+        gate = threading.Event()
+        router, _ = _make(1, queue_depth=4096, gate=gate)
+        asc = Autoscaler(router.pool, metrics=router.metrics,
+                         up_depth=4.0, up_after=2, down_after=50)
+        try:
+            futs = _fire(router, 64)
+            time.sleep(0.1)     # queue depth lands on the metrics plane
+            asc.tick()
+            decision = asc.tick()
+            assert decision == "up"
+            assert router.pool.size() == 2
+            snap = router.metrics.snapshot()
+            assert snap["scale_events"]["up"] == 1
+            gate.set()
+            for f in futs:
+                f.result(timeout=30)
+        finally:
+            gate.set()
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# metrics: namespacing + exposition conformance
+# ---------------------------------------------------------------------------
+
+class TestFleetMetrics:
+    def test_replica_namespace_regression(self):
+        # the single-engine assumption: two engines serving the SAME
+        # model name merge into duplicate Prometheus series unless the
+        # replica label namespaces them. Without labels: duplicate
+        # (the bug); with: conformant.
+        s0, s1 = ServingMetrics(), ServingMetrics()
+        for s in (s0, s1):
+            s.model("m").on_received(1)
+        merged = {"models": {}}
+        for i, s in enumerate((s0, s1)):
+            merged["models"].update(
+                {f"r{i}/{k}": v for k, v in
+                 s.snapshot(merge_registry=False)["models"].items()})
+        problems = validate_exposition(render_prometheus(merged))
+        assert any("duplicate series" in p for p in problems)
+
+        s0.replica, s1.replica = "r0", "r1"
+        merged = {"models": {}}
+        for i, s in enumerate((s0, s1)):
+            merged["models"].update(
+                {f"r{i}/{k}": v for k, v in
+                 s.snapshot(merge_registry=False)["models"].items()})
+        text = render_prometheus(merged)
+        assert validate_exposition(text) == []
+        assert 'model="m",replica="r0"' in text.replace(" ", "") or \
+            'replica="r0"' in text
+
+    def test_fleet_scrape_conformant_and_complete(self):
+        router, _ = _make(2)
+        try:
+            for f in _fire(router, 8, priority=1, session="s"):
+                f.result(timeout=10)
+            text = render_prometheus(router.metrics_snapshot())
+            assert validate_exposition(text) == [], \
+                validate_exposition(text)[:5]
+            assert "pt_fleet_replicas" in text
+            assert "pt_fleet_dispatch_total" in text
+            assert 'replica="r0"' in text and 'replica="r1"' in text
+            # both replicas' pt_serve series for the one model name
+            assert text.count('pt_serve_received_total{model="m"') == 2
+        finally:
+            router.close()
+
+    def test_registry_sections_merge_once(self):
+        # each replica snapshot skips the registry merge; the router
+        # merges process-wide sections exactly once — no fleet-section
+        # duplication even though N replicas snapshot
+        router, _ = _make(3)
+        try:
+            snap = router.metrics_snapshot()
+            assert "fleet" in snap
+            assert list(snap["fleet"]) == ["fleet"]
+            fl = snap["fleet"]["fleet"]
+            assert fl["replicas"] == 3
+            assert set(fl["replica_health"]) == {"r0", "r1", "r2"}
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end over a fleet
+# ---------------------------------------------------------------------------
+
+def _post(url, payload, headers=None):
+    import json
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+class TestFleetHTTP:
+    def test_fleet_routes(self):
+        import json
+        from paddle_tpu.serving.http import start_http_server
+        router, _ = _make(2)
+        server, _t = start_http_server(router)
+        port = server.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        try:
+            out = _post(f"{base}/v1/models/m:predict",
+                        {"feeds": {"x": 3.0}, "priority": 1},
+                        headers={"X-PT-Session": "u1"})
+            assert out["fetches"]["y"]["data"] == 6.0
+            with urllib.request.urlopen(f"{base}/v1/fleet") as r:
+                st = json.loads(r.read())
+            assert set(st["replicas"]) == {"r0", "r1"}
+            assert st["policy"] == "least_loaded"
+            with urllib.request.urlopen(
+                    f"{base}/v1/metrics?format=prometheus") as r:
+                text = r.read().decode()
+            assert "pt_fleet_replicas" in text
+            assert validate_exposition(text) == []
+            # session affinity is honored end to end: the same header
+            # keeps landing on one replica
+            rids = {int(_post(f"{base}/v1/models/m:predict",
+                              {"feeds": {"x": 1.0}},
+                              headers={"X-PT-Session": "u1"}
+                              )["fetches"]["rid"]["data"])
+                    for _ in range(6)}
+            assert len(rids) == 1
+            # malformed priority is a client error: typed 400, not 500
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"{base}/v1/models/m:predict",
+                      {"feeds": {"x": 1.0}, "priority": "gold"})
+            assert ei.value.code == 400
+            # unknown model rejects fast at the fleet front door
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"{base}/v1/models/typo:predict",
+                      {"feeds": {"x": 1.0}})
+            assert ei.value.code == 404
+        finally:
+            server.shutdown()
+            router.close()
+
+    def test_single_engine_has_no_fleet_route(self):
+        from paddle_tpu.serving.http import start_http_server
+        engine = ServingEngine()
+        server, _t = start_http_server(engine)
+        port = server.server_address[1]
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/fleet")
+            assert ei.value.code == 404
+        finally:
+            server.shutdown()
+            engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# generation plane routing (session-affine decode dispatch)
+# ---------------------------------------------------------------------------
+
+class FakeDecodeEngine:
+    def __init__(self, rid):
+        self.rid = rid
+        self.calls = []
+
+    def generate(self, prompt_ids, **kw):
+        self.calls.append((list(prompt_ids), kw))
+        return {"replica": self.rid, "tokens": [1, 2, 3]}
+
+    def shutdown(self, drain=True):
+        pass
+
+
+class TestGenerateRouting:
+    def test_generate_routes_session_affine(self):
+        router, _ = _make(3)
+        try:
+            for rep in router.pool.all_replicas():
+                rep.engine._decode["g"] = FakeDecodeEngine(rep.rid)
+            first = router.generate("g", [1, 2], session="chat-7",
+                                    priority=2)
+            for _ in range(4):
+                again = router.generate("g", [3], session="chat-7")
+                assert again["replica"] == first["replica"]
+            # priority forwarded to the decode engine's own admission
+            eng = next(rep.engine._decode["g"]
+                       for rep in router.pool.all_replicas()
+                       if rep.rid == first["replica"])
+            assert eng.calls[0][1].get("priority") == 2
+            snap = router.metrics.snapshot()
+            assert snap["dispatched"].get("session_affine", 0) >= 5
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# bench artifact floors (the gconv pattern) + CLI roundtrip
+# ---------------------------------------------------------------------------
+
+def _valid_fleet_doc():
+    return {
+        "arms": {"1": {"replicas": 1, "requests": 64, "rps": 900.0,
+                       "p95_ms": {"free": 40.0, "paid": 12.0}},
+                 "4": {"replicas": 4, "requests": 64, "rps": 3100.0,
+                       "p95_ms": {"free": 11.0, "paid": 4.0}}},
+        "throughput_scaling_x": 3.4,
+        "overload": {"sheds_by_class": {"0": 120, "1": 2},
+                     "free_shed_share": 0.9836},
+        "chaos": {"dropped_in_flight": 0, "completed": 160},
+    }
+
+
+class TestFleetABFloors:
+    def test_valid_doc_passes(self):
+        from paddle_tpu.analysis.artifacts import validate_fleet_ab
+        assert validate_fleet_ab(_valid_fleet_doc()) == []
+
+    @pytest.mark.parametrize("corrupt", [
+        lambda d: d.pop("arms"),
+        lambda d: d["arms"].pop("4"),
+        lambda d: d["arms"]["1"].update(rps=float("nan")),
+        lambda d: d["arms"]["1"].update(rps=0.0),
+        lambda d: d["arms"]["4"].update(replicas=0),
+        lambda d: d["arms"]["4"]["p95_ms"].update(free=None),
+        lambda d: d.pop("throughput_scaling_x"),
+        lambda d: d.update(throughput_scaling_x=float("inf")),
+        lambda d: d.pop("overload"),
+        lambda d: d["overload"].update(sheds_by_class={"0": 0, "1": 0}),
+        lambda d: d["overload"].update(sheds_by_class={"0": -1}),
+        lambda d: d["overload"].update(free_shed_share=1.5),
+        lambda d: d["overload"].pop("free_shed_share"),
+        lambda d: d.pop("chaos"),
+        lambda d: d["chaos"].pop("dropped_in_flight"),
+        lambda d: d["chaos"].update(completed=0),
+    ])
+    def test_floor_violation_matrix(self, corrupt):
+        from paddle_tpu.analysis.artifacts import validate_fleet_ab
+        doc = _valid_fleet_doc()
+        corrupt(doc)
+        assert validate_fleet_ab(doc) != []
+
+
+def test_fleet_cli_demo_roundtrip(capsys):
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        fleet_cli = importlib.import_module("fleet")
+        assert fleet_cli.demo(replicas=2) == 0
+    finally:
+        sys.path.pop(0)
+    out = capsys.readouterr().out
+    assert "policy=least_loaded" in out
+    assert "pt_fleet_replicas" in out
+    # the demo injects one router_dispatch crash: failover is visible
+    assert "pt_fleet_failovers_total" in out
+
+
+# ---------------------------------------------------------------------------
+# knobs + make_fleet
+# ---------------------------------------------------------------------------
+
+class TestKnobs:
+    def test_make_fleet_reads_knobs(self, monkeypatch):
+        monkeypatch.setenv("PT_FLEET_REPLICAS", "2")
+        monkeypatch.setenv("PT_FLEET_POLICY", "round_robin")
+        monkeypatch.setenv("PT_FLEET_AUTOSCALE", "1")
+        monkeypatch.setenv("PT_FLEET_MAX", "3")
+        router = make_fleet(
+            lambda e, r: e.load_model_object("m", SyntheticModel(r)),
+            autoscaler_opts={"interval_s": 30.0})
+        try:
+            assert router.pool.size() == 2
+            assert router.policy == "round_robin"
+            assert router.pool.max_replicas == 3
+            assert router.autoscaler is not None
+            assert router.status()["autoscaler"]["running"]
+        finally:
+            router.close()
+            assert router.autoscaler.describe()["running"] is False

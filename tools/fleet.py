@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Fleet-tier status CLI (paddle_tpu/serving/fleet/).
+
+Two modes:
+
+    python tools/fleet.py --url http://host:port
+        Fetch a live server's /v1/fleet status (replica health, queue
+        depths per priority class, autoscaler state) and print it as a
+        readable table, plus the pt_fleet_* lines of its Prometheus
+        scrape. Works against any serving/http.py server fronting a
+        FleetRouter.
+
+    python tools/fleet.py --demo [--replicas N]
+        Spin a synthetic in-process fleet (sleep-backed replicas behind
+        the real router), fire a burst of mixed-priority traffic —
+        including one injected `router_dispatch` replica crash, so the
+        failover/rebuild counters are nonzero — then print the same
+        status view and the pt_fleet_* scrape. A self-contained way to
+        see the tier's observability surface without artifacts or
+        hardware.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                "..")))
+
+
+def _print_status(status: dict, out=sys.stdout) -> None:
+    w = out.write
+    w(f"fleet {status.get('name', '?')!r}  policy="
+      f"{status.get('policy')}  replicas "
+      f"[{status.get('min_replicas')}, {status.get('max_replicas')}]\n")
+    w(f"{'replica':<10}{'healthy':<9}{'queue':<8}{'ewma_ms':<10}\n")
+    for rid, h in sorted((status.get("replicas") or {}).items()):
+        w(f"{rid:<10}{str(bool(h.get('healthy'))):<9}"
+          f"{h.get('queue_depth', 0):<8}"
+          f"{h.get('ewma_ms') if h.get('ewma_ms') is not None else '-':<10}\n")
+    queue = status.get("queue") or {}
+    w("queued by class: "
+      + (", ".join(f"{c}: {n}" for c, n in sorted(queue.items()))
+         or "(empty)") + "\n")
+    asc = status.get("autoscaler")
+    if asc:
+        w(f"autoscaler: running={asc.get('running')} "
+          f"ticks={asc.get('ticks')} decisions={asc.get('decisions')} "
+          f"last_pressure={asc.get('last_pressure')}\n")
+
+
+def _print_fleet_scrape(text: str, out=sys.stdout) -> None:
+    out.write("\npt_fleet_* scrape:\n")
+    for line in text.splitlines():
+        if "pt_fleet_" in line:
+            out.write(line + "\n")
+
+
+def from_url(url: str) -> int:
+    import urllib.request
+    base = url.rstrip("/")
+    with urllib.request.urlopen(f"{base}/v1/fleet") as r:
+        status = json.loads(r.read())
+    _print_status(status)
+    try:
+        with urllib.request.urlopen(
+                f"{base}/v1/metrics?format=prometheus") as r:
+            _print_fleet_scrape(r.read().decode())
+    except Exception as e:   # noqa: BLE001 — status already printed
+        print(f"(metrics scrape failed: {type(e).__name__}: {e})",
+              file=sys.stderr)
+    return 0
+
+
+def demo(replicas: int = 3) -> int:
+    import numpy as np
+    from paddle_tpu.obs.metrics import render_prometheus
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import fleet
+
+    class Synthetic:
+        batch_size = 4
+        version = None
+
+        def bucket_of(self, feeds):
+            return None
+
+        def execute_batch(self, bucket, examples, timer=None):
+            time.sleep(0.002)
+            return ([{"y": np.asarray(e["x"]) * 2.0}
+                     for e in examples],
+                    {"pad": 0.0, "device": 0.0, "scatter": 0.0})
+
+    prior = os.environ.get("PT_FAULT_INJECT")
+    os.environ["PT_FAULT_INJECT"] = "router_dispatch@17"
+    faults.reset()
+    router = fleet.make_fleet(
+        lambda eng, rid: eng.load_model_object("demo", Synthetic()),
+        replicas=replicas, autoscale=False)
+    try:
+        futs = [router.submit("demo", {"x": np.float32(i)},
+                              priority=i % 3,
+                              session=f"user-{i % 7}")
+                for i in range(64)]
+        for f in futs:
+            f.result(timeout=30)
+        _print_status(router.status())
+        _print_fleet_scrape(
+            render_prometheus(router.metrics_snapshot()))
+        return 0
+    finally:
+        router.close()
+        if prior is None:
+            os.environ.pop("PT_FAULT_INJECT", None)
+        else:
+            os.environ["PT_FAULT_INJECT"] = prior
+        faults.reset()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", help="base URL of a live serving/http.py "
+                    "server fronting a FleetRouter")
+    ap.add_argument("--demo", action="store_true",
+                    help="spin a synthetic in-process fleet and print "
+                    "its status + pt_fleet_* scrape")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="demo fleet size (default 3)")
+    args = ap.parse_args(argv)
+    if args.url:
+        return from_url(args.url)
+    if args.demo:
+        return demo(args.replicas)
+    ap.error("need --url or --demo")
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
