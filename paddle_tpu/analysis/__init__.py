@@ -15,12 +15,19 @@ The compile-time checking layer the interpreted reference never had
   peak-HBM estimation + the PT_MEM_BUDGET_GB pre-compile gate
   (memory.py), and the sharding-aware collective audit (comm.py).
   CLI: tools/cost_report.py.
+* `schedule` — pipeline-parallel plan synthesis: the liveness-cut stage
+  search the pipeline transpiler consults for its cuts, GPipe/1F1B
+  schedule costing (bubble fraction, microbatch stash bound, inter-stage
+  p2p), and the typed `pipeline-stage` verifier pass.
 * `planner` — the static auto-parallelism placement planner: cost-model
-  driven mesh/placement search over {dp, ep, sp, tp} x ZeRO for a device
-  topology (parallel/mesh.py Topology), emitting ranked, floor-checked
-  PlacementPlan artifacts that ParallelExecutor(plan=...) and
-  transpile(plan=...) execute. CLI: tools/plan.py. Loaded lazily — the
-  search layer sits on top of cost/memory/comm and the parallel package.
+  driven mesh/placement search over {dp, ep, sp, tp} x ZeRO — plus the
+  pp axis for pipeline-transpiled programs, with per-collective
+  reduction-algorithm choice (ring/tree/hierarchical, comm.py) — for a
+  device topology (parallel/mesh.py Topology), emitting ranked,
+  floor-checked PlacementPlan artifacts that ParallelExecutor(plan=...)
+  and transpile(plan=...) execute. CLI: tools/plan.py. Loaded lazily —
+  the search layer sits on top of cost/memory/comm and the parallel
+  package.
 * `source_lint` — custom repo lint rules behind tools/lint.py (kept
   stdlib-only so the lint gate never imports jax).
 
@@ -37,7 +44,10 @@ from .cost import (OpCost, Prediction, ProgramCost, op_cost,  # noqa: F401
 from .memory import (MemoryBudgetError, MemoryEstimate,  # noqa: F401
                      enforce_budget, estimate_memory)
 from .comm import (Collective, CommReport, audit_collectives,  # noqa: F401
-                   mesh_axis_sizes)
+                   choose_algorithms, mesh_axis_sizes)
+from . import schedule  # noqa: F401  (registers the pipeline-stage pass)
+from .schedule import (StageCutError, StageCutPlan,  # noqa: F401
+                       stage_cut_search)
 
 __all__ = [
     "Diagnostic", "ProgramVerificationError", "VerifyResult",
@@ -48,6 +58,8 @@ __all__ = [
     "MemoryBudgetError", "MemoryEstimate", "enforce_budget",
     "estimate_memory",
     "Collective", "CommReport", "audit_collectives", "mesh_axis_sizes",
+    "choose_algorithms",
+    "schedule", "StageCutError", "StageCutPlan", "stage_cut_search",
     "planner", "plan_placement", "apply_plan", "PlanArtifact",
     "NoFeasiblePlacementError",
 ]

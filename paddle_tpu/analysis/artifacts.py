@@ -176,6 +176,67 @@ def validate_bench_json(doc, path: str = "$", pred: bool = False) -> List[str]:
 
 _PLAN_REQUIRED = ("schema_version", "kind", "batch", "topology", "ranked")
 _PLAN_ENTRY_REQUIRED = ("mesh", "specs", "prediction", "peak_hbm_bytes")
+#: the reduction algorithms the comm cost formulas implement. ONE
+#: alphabet — comm.ALGORITHMS re-exports this tuple, so the validator
+#: can never drift from the implementation (artifacts.py is the import
+#: leaf: stdlib-only, everything above imports down to it)
+PLAN_ALGORITHMS = ("ring", "tree", "hierarchical")
+#: the microbatch schedules parallel/pipeline.py executes.
+#: analysis/schedule.SCHEDULES re-exports this tuple; 1f1b first — the
+#: planner's preference order among time-equal candidates (lower stash)
+PLAN_SCHEDULES = ("1f1b", "gpipe")
+
+
+def _check_plan_pipeline(plan: dict, here: str) -> List[str]:
+    """pp-plan floors: a plan whose mesh names a pp axis > 1 must carry
+    a coherent pipeline schedule record (finite bubble fraction in
+    [0, 1), a stage count dividing the pp axis, positive microbatches, a
+    schedule the runtime implements) and a NON-EMPTY per-collective
+    algorithm table with known algorithms — a pp plan that recorded no
+    schedule or no reduction choice is the placement analogue of a
+    0.0 ms autotune reading."""
+    problems: List[str] = []
+    mesh = plan.get("mesh") or {}
+    pp = mesh.get("pp") if isinstance(mesh, dict) else None
+    is_pp = isinstance(pp, int) and not isinstance(pp, bool) and pp > 1
+    pipe = plan.get("pipeline")
+    if not is_pp:
+        if pipe is not None and not isinstance(pipe, dict):
+            problems.append(f"{here}.pipeline: not an object")
+        return problems
+    if not isinstance(pipe, dict):
+        problems.append(
+            f"{here}.pipeline: missing/malformed — a plan over a pp axis "
+            "must record its stages/microbatches/schedule")
+        pipe = {}
+    bf = pipe.get("bubble_fraction")
+    if not isinstance(bf, (int, float)) or isinstance(bf, bool) \
+            or not math.isfinite(float(bf)) or not 0.0 <= float(bf) < 1.0:
+        problems.append(
+            f"{here}.pipeline.bubble_fraction: {bf!r} must be a finite "
+            "fraction in [0, 1) — a full-bubble (or NaN) pipeline does "
+            "no work")
+    stages = pipe.get("stages")
+    if not isinstance(stages, int) or isinstance(stages, bool) \
+            or stages != pp:
+        problems.append(
+            f"{here}.pipeline.stages: {stages!r} must equal the pp axis "
+            f"({pp}) — the schedule runs exactly one stage per pp device "
+            "(ops/pipeline_ops.py rejects anything else at lowering)")
+    mb = pipe.get("microbatches")
+    if not isinstance(mb, int) or isinstance(mb, bool) or mb < 1:
+        problems.append(f"{here}.pipeline.microbatches: {mb!r} must be "
+                        "a positive int")
+    if pipe.get("schedule") not in PLAN_SCHEDULES:
+        problems.append(
+            f"{here}.pipeline.schedule: {pipe.get('schedule')!r} is not "
+            f"one of {list(PLAN_SCHEDULES)}")
+    colls = plan.get("collectives")
+    if not isinstance(colls, list) or not colls:
+        problems.append(
+            f"{here}.collectives: missing/empty — a pp plan must record "
+            "its per-collective reduction-algorithm table")
+    return problems
 
 
 def validate_plan(doc) -> List[str]:
@@ -250,6 +311,14 @@ def validate_plan(doc) -> List[str]:
                 f"exceeds the declared chip HBM "
                 f"{hbm_budget / 1e9:.2f} GB — an over-budget plan must "
                 "never rank")
+        problems.extend(_check_plan_pipeline(plan, here))
+        colls = plan.get("collectives")
+        for j, c in enumerate(colls if isinstance(colls, list) else ()):
+            algo = c.get("algorithm") if isinstance(c, dict) else None
+            if algo not in PLAN_ALGORITHMS:
+                problems.append(
+                    f"{here}.collectives[{j}].algorithm: {algo!r} is not "
+                    f"one of {list(PLAN_ALGORITHMS)}")
     return problems
 
 

@@ -39,20 +39,44 @@ Byte conventions (ring algorithms, the TPU ICI default):
   ppermute (ring) wire = (n-1)   x per-step shard (the full rotation)
 `wire_bytes` is PER DEVICE — the number the roofline's comm leg divides
 by ICI bandwidth (cost.predict_step).
+
+Reduction-algorithm synthesis (PAPERS: "Synthesizing Optimal Parallelism
+Placement and Reduction Strategies on Hierarchical Systems"): the ring
+convention above is only ONE implementation. `collective_time_s` prices
+each collective under three algorithms and `choose_algorithms` picks the
+cheapest per collective — the planner's searched dimension:
+
+  ring          bandwidth-optimal: wire/bw + steps x hop latency
+                (steps = 2(n-1) for all_reduce, n-1 otherwise). Wins
+                large payloads; pays n-1 latencies.
+  tree          latency-optimal: ~2 full-payload traversals of a
+                ceil(log2 n)-deep binomial tree for all_reduce (one for
+                gather/scatter). Wins small, latency-bound collectives.
+  hierarchical  for groups SPANNING hosts: ICI reduce-scatter inside
+                each host, a DCI ring over the 1/intra shard across
+                hosts, ICI all-gather back — only payload/intra ever
+                crosses the slow tier, beating a flat ring (which pays
+                the DCI rate on every hop) whenever DCI < ICI.
 """
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..core.program import Program, default_main_program
+#: the searched per-collective algorithm alphabet — ONE definition,
+#: owned by artifacts.py (the stdlib import leaf) so the plan validator
+#: and these cost formulas can never drift
+from .artifacts import PLAN_ALGORITHMS as ALGORITHMS
 from .cost import (AUTODIFF_OP, RESHAPE_ALIAS_OPS, _prod, _shape,
                    device_nbytes, dtype_nbytes)
 from .verifier import WARNING, Diagnostic, verifier_pass
 
 __all__ = ["Collective", "CommReport", "audit_collectives",
-           "mesh_axis_sizes"]
+           "mesh_axis_sizes", "ALGORITHMS", "collective_time_s",
+           "choose_algorithm", "choose_algorithms", "group_host_split"]
 
 
 def mesh_axis_sizes(mesh) -> Dict[str, int]:
@@ -544,6 +568,145 @@ def audit_collectives(program: Optional[Program] = None, mesh=None,
     program = program or default_main_program()
     sizes = mesh_axis_sizes(mesh) if mesh is not None else {}
     return _Audit(program, sizes, batch).run(zero)
+
+
+# ---------------------------------------------------------------------------
+# reduction-algorithm synthesis: ring vs tree vs hierarchical
+# ---------------------------------------------------------------------------
+
+#: per-hop launch latency, the term that makes small collectives
+#: latency-bound (where tree beats ring). ICI is the on-board fabric;
+#: DCI hops cross the data-center network.
+ICI_HOP_LATENCY_S = 1e-6
+DCI_HOP_LATENCY_S = 25e-6
+
+#: collective kinds a tree schedule implements (a ring rotation or an
+#: all-to-all shuffle has no tree form)
+_TREE_KINDS = frozenset({"all_reduce", "all_gather", "reduce_scatter"})
+
+
+def group_host_split(sizes: Dict[str, int], axes: Sequence[str],
+                     chips_per_host: int) -> Tuple[int, int]:
+    """(intra, inter): how a collective group over `axes` splits across
+    hosts — `intra` members share a host, `inter` hosts participate
+    (intra x inter = group size). Computed by enumerating the member ids
+    of the group containing device 0 under the row-major mesh layout
+    (the same id arithmetic as distributed.axis_spans_hosts, made exact
+    for multi-axis groups). A ragged split — members per host uneven —
+    conservatively reports (1, n): everything priced at the slow tier.
+    """
+    names = list(sizes)
+    sz = [int(sizes[a]) for a in names]
+    ids = [0]
+    for a in axes:
+        if a not in names or int(sizes[a]) <= 1:
+            continue
+        i = names.index(a)
+        stride = 1
+        for s in sz[i + 1:]:
+            stride *= s
+        ids = [b + j * stride for b in ids for j in range(sz[i])]
+    n = max(1, len(ids))
+    cph = max(1, int(chips_per_host))
+    by_host: Dict[int, int] = {}
+    for d in ids:
+        by_host[d // cph] = by_host.get(d // cph, 0) + 1
+    intra = by_host.get(0, 1)
+    if len(set(by_host.values())) != 1 or n % intra:
+        return 1, n
+    return intra, n // intra
+
+
+def _ring_steps(kind: str, n: int) -> int:
+    return 2 * (n - 1) if kind == "all_reduce" else (n - 1)
+
+
+def collective_time_s(c: Collective, algo: str, sizes: Dict[str, int],
+                      topology) -> Optional[float]:
+    """Predicted seconds for `c` under `algo` on `topology` (duck-typed:
+    needs ici_bandwidth_gbps() / dci_gbps / chips_per_host — a
+    parallel/mesh.py Topology). Returns None when the algorithm has no
+    implementation for this collective (tree rotation, hierarchical on a
+    single-host group) — the chooser skips it. Pure host-side math."""
+    intra, inter = group_host_split(sizes, c.axes, topology.chips_per_host)
+    crosses = inter > 1
+    ici = float(topology.ici_bandwidth_gbps()) * 1e9
+    dci = float(topology.dci_gbps) * 1e9
+    n = max(1, c.group)
+    payload = float(c.payload_bytes)
+    # a flat schedule on a spanning group is throttled by its slowest
+    # link: every hop pays the DCI tier
+    bw, lat = (dci, DCI_HOP_LATENCY_S) if crosses \
+        else (ici, ICI_HOP_LATENCY_S)
+    if algo == "ring":
+        return c.wire_bytes / bw + _ring_steps(c.kind, n) * lat
+    if algo == "tree":
+        if c.kind not in _TREE_KINDS:
+            return None
+        depth = max(1, math.ceil(math.log2(n)))
+        trips = 2 if c.kind == "all_reduce" else 1
+        return trips * (payload / bw + depth * lat)
+    if algo == "hierarchical":
+        # ICI reduce-scatter -> DCI ring over the 1/intra shard -> ICI
+        # all-gather; only meaningful for spanning reduction groups with
+        # an intra-host part to scatter over
+        if not crosses or intra <= 1 or c.kind not in _TREE_KINDS:
+            return None
+        shard = payload / intra
+        t_ici = (intra - 1) * ((payload / intra) / ici + ICI_HOP_LATENCY_S)
+        t_dci = _ring_steps(c.kind, inter) * (
+            shard / inter / dci + DCI_HOP_LATENCY_S)
+        if c.kind == "all_reduce":
+            t_ici *= 2  # reduce-scatter in, all-gather out
+        return t_ici + t_dci
+    raise ValueError(f"unknown collective algorithm {algo!r} "
+                     f"(know {list(ALGORITHMS)})")
+
+
+def choose_algorithm(c: Collective, sizes: Dict[str, int], topology,
+                     force: Optional[str] = None) -> Tuple[str, float, bool]:
+    """(algorithm, predicted seconds, crosses_hosts) for one collective:
+    the cheapest applicable algorithm, or `force` where applicable
+    (falling back to ring — ring implements everything). Ties break
+    toward ring, the fabric's default convention."""
+    _, inter = group_host_split(sizes, c.axes, topology.chips_per_host)
+    crosses = inter > 1
+    if force is not None:
+        t = collective_time_s(c, force, sizes, topology)
+        if t is None:
+            force = "ring"
+            t = collective_time_s(c, "ring", sizes, topology)
+        return force, float(t), crosses
+    best = ("ring", collective_time_s(c, "ring", sizes, topology))
+    for algo in ("tree", "hierarchical"):
+        t = collective_time_s(c, algo, sizes, topology)
+        if t is not None and t < best[1]:
+            best = (algo, t)
+    return best[0], float(best[1]), crosses
+
+
+def choose_algorithms(collectives: Sequence[Collective],
+                      sizes: Dict[str, int], topology,
+                      force: Optional[str] = None
+                      ) -> Tuple[float, List[dict]]:
+    """Per-collective algorithm choice over a whole audit: returns
+    (total predicted comm seconds, the algorithm table) — the planner's
+    comm leg and the plan artifact's `collectives` record. Deterministic
+    (rescore_plan must reproduce the search's choice exactly)."""
+    total = 0.0
+    table: List[dict] = []
+    for c in collectives:
+        algo, t, crosses = choose_algorithm(c, sizes, topology, force)
+        total += t
+        table.append({
+            "kind": c.kind, "op_type": c.op_type, "var": c.var,
+            "axes": list(c.axes), "group": int(c.group),
+            "payload_bytes": int(c.payload_bytes),
+            "wire_bytes": int(c.wire_bytes),
+            "algorithm": algo, "t_ms": t * 1e3,
+            "crosses_hosts": bool(crosses),
+        })
+    return total, table
 
 
 # ---------------------------------------------------------------------------

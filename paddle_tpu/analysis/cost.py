@@ -436,6 +436,34 @@ def _feed_dequant_cost(op, ctx):
                   bytes_read=r, bytes_written=w)
 
 
+@cost_entry("pipeline")
+def _pipeline_cost(op, ctx):
+    # the auto-pp rewrite (transpiler/pipeline_transpiler.py): one layer
+    # body in a sub-block, executed num_stages x layers_per_stage times
+    # over the full batch (microbatching splits WHEN work runs, not how
+    # much) — so the op prices as the sub-block's per-layer cost times
+    # the stacked layer count, keeping pipelined and inline programs
+    # comparable. Inner vars carry occurrence-0 shapes (batch dim -1
+    # substitutes ctx.batch); names the sub-block lacks resolve through
+    # the parent chain (shared masks/scales).
+    attrs = op.attrs or {}
+    sub = ctx.block.program.blocks[int(attrs["sub_block"])]
+    inner = _Ctx(sub, ctx.batch, ctx.amp)
+    layer = OpCost()
+    for o in sub.ops:
+        try:
+            layer = layer + _op_cost_ctx(o, inner)
+        except KeyError:
+            continue
+    n = int(attrs.get("num_stages", 1)) * int(attrs.get(
+        "layers_per_stage", 1))
+    return OpCost(mxu_flops=layer.mxu_flops * n,
+                  vector_flops=layer.vector_flops * n,
+                  bytes_read=layer.bytes_read * n,
+                  bytes_written=layer.bytes_written * n,
+                  covered=layer.covered)
+
+
 @cost_entry("lookup_table")
 def _lookup_cost(op, ctx):
     ids = ctx.elems(op.inputs["Ids"][0])

@@ -303,7 +303,55 @@ def estimate_memory(program: Optional[Program] = None, batch: int = 1,
                             if n in after and is_activation(n)}
                 seg_boundary[sid] = boundary
 
-    resid_bytes = sum(safe_nbytes(n) for n in residuals) + lse_extra
+    # pipeline sub-block residuals: the auto-pp rewrite (transpiler/
+    # pipeline_transpiler.py) hides its layer bodies in a sub-block the
+    # block-0 walk cannot see, so each of the L stacked layers saves its
+    # own residual set (GPipe semantics: every microbatch's forward runs
+    # before any backward). Inner param-slice placeholders are excluded
+    # (param_vars attr — weights, not activations). The planner's
+    # per-stage model (analysis/schedule.pipeline_memory) divides this
+    # term by stages x the schedule's microbatch stash bound.
+    pipe_resid = 0
+    if has_bwd:
+        for i in range(fwd_stop):
+            op = ops[i]
+            if op.type != "pipeline":
+                continue
+            attrs = op.attrs or {}
+            try:
+                sub = program.blocks[int(attrs["sub_block"])]
+            except (KeyError, IndexError, TypeError, ValueError):
+                continue
+            skip = set(attrs.get("param_vars", ()))
+            per_layer = 0
+            seen: Set[str] = set()
+            for o in sub.ops:
+                for n in _residual_reads(o):
+                    if n in skip or n in seen:
+                        continue
+                    seen.add(n)
+                    try:
+                        v = sub.var(n)
+                    except KeyError:
+                        continue
+                    if v.is_parameter or v.persistable \
+                            or getattr(v, "is_data", False):
+                        continue
+                    per_layer += _prod(_shape(sub, n, batch)) \
+                        * device_nbytes(v, amp)
+                if o.type == "scaled_dot_product_attention":
+                    # the flash kernel's saved logsumexp, per layer
+                    try:
+                        q = _shape(sub, o.inputs["Q"][0], batch)
+                        per_layer += q[0] * q[2] * q[1] * _F32
+                    except (KeyError, IndexError):
+                        pass
+            layers = int(attrs.get("num_stages", 1)) \
+                * int(attrs.get("layers_per_stage", 1))
+            pipe_resid += per_layer * layers
+
+    resid_bytes = (sum(safe_nbytes(n) for n in residuals) + lse_extra
+                   + pipe_resid)
     boundary_bytes = sum(safe_nbytes(n) for s in seg_boundary.values()
                          for n in s)
     seg_work = 0
@@ -335,20 +383,58 @@ def estimate_memory(program: Optional[Program] = None, batch: int = 1,
             if str(v.dtype) == "float32":
                 cast_bytes += _prod(_shape(block, p, batch)) * dtype_nbytes(
                     amp)
+    def fwd_ops_incl_pipeline():
+        """(op, blk, skip) over the forward INCLUDING pipeline sub-block
+        bodies: backward transients (the largest cotangent, the
+        attention score-map scratch) materialize inside the stage body
+        too, and layers differentiate one at a time, so the MAX below is
+        the right aggregation — one sub-block layer stands for all L.
+        skip = the stage's param-slice placeholders (weights, never
+        cotangent-bearing activations)."""
+        for i in range(fwd_stop):
+            op = ops[i]
+            yield op, block, frozenset()
+            if op.type == "pipeline":
+                attrs = op.attrs or {}
+                try:
+                    sub = program.blocks[int(attrs["sub_block"])]
+                except (KeyError, IndexError, TypeError, ValueError):
+                    continue
+                skip = frozenset(attrs.get("param_vars", ()))
+                for o in sub.ops:
+                    yield o, sub, skip
+
+    def sub_act_bytes(blk, name, skip) -> int:
+        """Bytes of an activation-class value in `blk` (0 when it is a
+        param/persistable/feed/placeholder or unresolvable)."""
+        if name in skip:
+            return 0
+        if blk is block:
+            if not is_activation(name):
+                return 0
+        else:
+            try:
+                v = blk.var(name)
+            except KeyError:
+                return 0
+            if v.is_parameter or v.persistable \
+                    or getattr(v, "is_data", False):
+                return 0
+        try:
+            return _prod(_shape(blk, name, batch)) * device_nbytes(
+                blk.var(name), amp)
+        except KeyError:
+            return 0
+
     # the largest single cotangent the backward materializes (the
     # [tokens, vocab] dlogits for LM programs), priced at the DEVICE
     # dtype: the memory-lean custom VJPs (ops/nn_ops.py softmax-xent)
     # emit dlogits in the logits dtype, never an f32 scatter temp
     cot_bytes = 0
     if has_bwd:
-        for i in range(fwd_stop):
-            op = ops[i]
+        for op, blk, skip in fwd_ops_incl_pipeline():
             for n in op.output_names():
-                if is_activation(n):
-                    try:
-                        cot_bytes = max(cot_bytes, nbytes(n))
-                    except KeyError:
-                        continue
+                cot_bytes = max(cot_bytes, sub_act_bytes(blk, n, skip))
     # attention backward scratch: differentiating one attention layer
     # stages up to the full [B, H, Sq, Sk] score map at device dtype
     # (the XLA fallback materializes it exactly; the Pallas kernel tiles
@@ -358,13 +444,12 @@ def estimate_memory(program: Optional[Program] = None, batch: int = 1,
     # residual (8k: 2.1 GB vs 0.6 GB of saved residuals).
     attn_scratch = 0
     if has_bwd:
-        for i in range(fwd_stop):
-            op = ops[i]
+        for op, blk, _skip in fwd_ops_incl_pipeline():
             if op.type == "scaled_dot_product_attention":
                 try:
-                    q = _shape(block, op.inputs["Q"][0], batch)
-                    k = _shape(block, op.inputs["K"][0], batch)
-                    nb = device_nbytes(block.var(op.inputs["Q"][0]), amp)
+                    q = _shape(blk, op.inputs["Q"][0], batch)
+                    k = _shape(blk, op.inputs["K"][0], batch)
+                    nb = device_nbytes(blk.var(op.inputs["Q"][0]), amp)
                     attn_scratch = max(attn_scratch,
                                        q[0] * q[2] * q[1] * k[1] * nb)
                 except (KeyError, IndexError):
@@ -400,6 +485,7 @@ def estimate_memory(program: Optional[Program] = None, batch: int = 1,
                    "feeds": feed_bytes},
         temp_bytes=temp, state_bytes=state + feed_bytes, peak_bytes=peak,
         details={"residual_bytes": resid_bytes,
+                 "pipeline_residual_bytes": pipe_resid,
                  "remat_boundary_bytes": boundary_bytes,
                  "remat_working_bytes": seg_work,
                  "amp_cast_bytes": cast_bytes,

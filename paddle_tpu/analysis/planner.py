@@ -10,9 +10,12 @@ per-chip HBM from cost.PEAK_TABLE), it
 
   1. enumerates legal mesh factorizations over {dp, ep, sp, tp} x
      {ZeRO on/off} (outermost-first axis order, so the cheap-to-sync dp
-     axis is the one that lands on the cross-host DCN hop; pp is a
-     program REWRITE, not an annotation, so pipeline placements are
-     taken as given rather than searched),
+     axis is the one that lands on the cross-host DCN hop) — PLUS, for
+     pipeline-transpiled programs, pp x dp candidates: pp is a program
+     REWRITE, so the search re-stages the program's own pipeline op
+     (analysis/schedule.retune_pipeline) per candidate and prices the
+     GPipe/1F1B schedule (bubble fraction, microbatch stash bound,
+     inter-stage p2p at the ICI-or-DCI tier),
   2. derives each candidate's per-var placement by running the sharding
      transpiler on a clone plus explicit defaults (dp feed split, ZeRO
      accumulator shards) so the emitted plan is the COMPLETE placement
@@ -22,14 +25,18 @@ per-chip HBM from cost.PEAK_TABLE), it
      verifier pass) -> per-device peak-HBM vs the topology's chip HBM
      (memory.py) -> accidental-resharding audit (comm.py flagged
      collectives),
-  4. scores survivors with the roofline (compute / HBM / comm legs), the
-     comm leg priced HIERARCHICALLY: a collective whose axes stay inside
-     one host pays ICI bandwidth, one that spans hosts pays the
-     topology's DCI tier (parallel/distributed.py axis_spans_hosts),
+  4. scores survivors with the roofline (compute / HBM / comm legs),
+     the comm leg SYNTHESIZED per collective: ring vs tree vs
+     hierarchical (ICI reduce-scatter -> DCI all-reduce -> ICI
+     all-gather) cost formulas in comm.py, the cheapest algorithm
+     chosen per collective (PT_PLAN_COLL pins one) — stage placement
+     AND reduction strategy are searched dimensions, not conventions,
   5. emits a ranked PlacementPlan artifact (JSON: mesh shape + axis
      names, per-var PartitionSpecs, predicted step ms / MFU / peak-HBM /
-     wire bytes, and the rejection log for every pruned candidate),
-     floor-checked by artifacts.validate_plan at save AND load.
+     wire bytes, the per-collective algorithm table, pp plans'
+     stages/microbatches/schedule record, and the rejection log for
+     every pruned candidate), floor-checked by artifacts.validate_plan
+     at save AND load.
 
 Nothing compiles and no device is touched — the whole search is host-
 side IR walks (tested: build_step_fn must not run during planning). The
@@ -40,7 +47,9 @@ plan reproduces the recorded prediction exactly (no search/score drift
 
 Knobs: PT_PLAN_BEAM (ranked plans kept in the artifact),
 PT_PLAN_TOPOLOGY (default topology, 'chip:chips_per_host[xhosts]'
-format — see Topology.parse). CLI: tools/plan.py.
+format — see Topology.parse), PT_PLAN_PP (pp sizes to search; 0 = off),
+PT_PLAN_MICROBATCH (pipeline microbatches, default 4), PT_PLAN_COLL
+(pin the per-collective reduction algorithm). CLI: tools/plan.py.
 """
 
 from __future__ import annotations
@@ -53,12 +62,14 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..core.program import (Program, default_main_program,
                             iter_optimizer_state_inputs)
-from ..parallel.distributed import axis_spans_hosts
-from ..parallel.mesh import DP, EP, SP, TP, Topology
-from .comm import _normalize, _spec_factor, audit_collectives
+from ..flags import env_knob_int
+from ..parallel.mesh import DP, EP, PP, SP, TP, Topology
+from .comm import (ALGORITHMS, _normalize, _spec_factor, audit_collectives,
+                   choose_algorithms)
 from .cost import _prod, program_cost, roofline_step
 from .memory import (_classify, batch_shard_factor, estimate_memory,
                      safe_nbytes_raw)
+from . import schedule as sched_mod
 
 __all__ = ["PlacementRejected", "NoFeasiblePlacementError", "PlanArtifact",
            "Topology", "plan_placement", "score_mesh", "apply_plan",
@@ -67,7 +78,10 @@ __all__ = ["PlacementRejected", "NoFeasiblePlacementError", "PlanArtifact",
 
 #: searched mesh axes, OUTERMOST first — the order make_mesh lays devices
 #: out, so under a multi-host topology the leading axes are the ones
-#: whose collectives cross the DCN hop
+#: whose collectives cross the DCN hop. pp rides separately (it is a
+#: program rewrite, searched only for pipeline-transpiled programs) and
+#: lands INNERMOST, so the per-microbatch stage p2p stays on ICI while
+#: the once-a-step dp grad sync takes the DCN hop.
 SEARCH_AXES: Tuple[str, ...] = (DP, EP, SP, TP)
 
 PLAN_SCHEMA_VERSION = 1
@@ -123,6 +137,51 @@ def _beam_width(beam: Optional[int]) -> int:
         return max(1, int(beam))
     raw = os.environ.get("PT_PLAN_BEAM", "").strip()
     return max(1, int(raw)) if raw else 8
+
+
+def _coll_force(coll_algo: Optional[str]) -> Optional[str]:
+    """Resolve the per-collective algorithm override: an explicit arg
+    wins, else PT_PLAN_COLL; 'auto'/unset = the planner chooses per
+    collective (comm.choose_algorithms)."""
+    raw = coll_algo if coll_algo is not None \
+        else os.environ.get("PT_PLAN_COLL", "").strip()
+    if not raw or raw == "auto":
+        return None
+    if raw not in ALGORITHMS:
+        raise ValueError(f"PT_PLAN_COLL={raw!r} is not one of "
+                         f"auto|{'|'.join(ALGORITHMS)}")
+    return raw
+
+
+def _default_microbatches(microbatches: Optional[int], batch: int) -> int:
+    """PT_PLAN_MICROBATCH (default 4), clamped to the batch."""
+    m = int(microbatches) if microbatches is not None \
+        else env_knob_int("PT_PLAN_MICROBATCH", 4)
+    return max(1, min(m, int(batch)))
+
+
+def _pp_options(program: Program, n_devices: int,
+                pp_options: Optional[Sequence[int]]) -> List[int]:
+    """pp sizes to search: an explicit arg wins, else PT_PLAN_PP
+    ('0' = off, csv of sizes), else every stacked-layer divisor of an
+    already-pipeline-transpiled program that also divides the chip
+    count. A program with no pipeline op searches none — the rewrite
+    happens at build time (pipeline_transpile BEFORE minimize), the
+    planner re-stages the emitted op."""
+    if pp_options is None:
+        raw = os.environ.get("PT_PLAN_PP", "").strip()
+        if raw:
+            pp_options = [int(x) for x in raw.split(",") if x.strip()]
+    if pp_options is not None:
+        # explicit asks pass through verbatim: an illegal size must land
+        # in the rejection log with a reason, never vanish silently
+        return [int(p) for p in pp_options if int(p) > 1]
+    facts = sched_mod.pipeline_facts(program)
+    if facts is None:
+        return []
+    total = facts["total_layers"]
+    return [p for p in range(2, total + 1)
+            if total % p == 0 and p <= n_devices and n_devices % p == 0]
 
 
 # ---------------------------------------------------------------------------
@@ -256,13 +315,46 @@ def _collect_specs(program: Program,
 
 
 def _prepare(program: Program, axes: Dict[str, int], batch: int,
-             zero: bool, sp_mode: Optional[str],
-             traits: _Traits) -> Tuple[Program, Dict[str, list]]:
+             zero: bool, sp_mode: Optional[str], traits: _Traits,
+             microbatches: Optional[int] = None,
+             pp_schedule: Optional[str] = None
+             ) -> Tuple[Program, Dict[str, list]]:
     """Clone + transpile + explicit defaults for one candidate; raises
-    PlacementRejected at the first failed legality stage."""
+    PlacementRejected at the first failed legality stage. A pp candidate
+    additionally retunes the clone's pipeline op to this candidate's
+    stages/microbatches/schedule (sched_mod.retune_pipeline), so scoring
+    and plan application share one program truth."""
     sizes = {a: int(s) for a, s in axes.items()}
     dp = sizes.get(DP, 1)
+    pp = sizes.get(PP, 1)
     # -- structural -------------------------------------------------------
+    if pp > 1:
+        facts = sched_mod.pipeline_facts(program)
+        if facts is None:
+            raise PlacementRejected(
+                "structural", f"pp={pp} needs a pipeline-transpiled "
+                "program (transpiler.pipeline_transpile BEFORE "
+                "optimizer.minimize) — block 0 has no pipeline op")
+        if facts["total_layers"] % pp:
+            raise PlacementRejected(
+                "structural", f"{facts['total_layers']} stacked layers "
+                f"do not divide into pp={pp} stages")
+        others = {a for a, s in sizes.items()
+                  if s > 1 and a not in (DP, PP)}
+        if others:
+            raise PlacementRejected(
+                "structural", "pp composes with dp only (the stage "
+                f"sub-block ops are not rewritten for {sorted(others)})")
+        m = int(microbatches or 1)
+        if batch % m:
+            raise PlacementRejected(
+                "structural", f"batch {batch} is not divisible by "
+                f"microbatches={m}")
+        if (batch // m) % dp:
+            raise PlacementRejected(
+                "structural", f"microbatch {batch // m} is not "
+                f"divisible by dp={dp} (the schedule dp-shards each "
+                "microbatch)")
     if dp > 1:
         if not traits.feed_dims:
             raise PlacementRejected("structural", "no feed vars to "
@@ -290,6 +382,13 @@ def _prepare(program: Program, axes: Dict[str, int], batch: int,
         # anything else is a genuine transpiler defect and must surface,
         # not drown in the rejection log
         raise PlacementRejected("shard-check", str(e).splitlines()[0][:200])
+    if pp > 1:
+        try:
+            sched_mod.retune_pipeline(clone, stages=pp,
+                                      microbatches=int(microbatches or 1),
+                                      schedule=pp_schedule or "1f1b")
+        except sched_mod.StageCutError as e:
+            raise PlacementRejected("pipeline-stage", str(e)[:200])
     _annotate_defaults(clone, sizes, zero, batch)
     # -- axis usability: an axis no var is sharded over buys nothing ------
     used = set()
@@ -304,9 +403,12 @@ def _prepare(program: Program, axes: Dict[str, int], batch: int,
     # -- shard legality (the PR-1 verifier pass, PT_VERIFY-independent).
     # uneven-shard is only a WARNING to the runtime (it degrades to
     # replication), but a candidate whose requested distribution silently
-    # degrades is NOT the placement the scorer would price — reject.
+    # degrades is NOT the placement the scorer would price — reject. pp
+    # candidates also run the typed pipeline-stage pass (stage counts,
+    # microbatch divisibility, per-stage param confinement).
     from . import verify_program
-    result = verify_program(clone, mesh=sizes, passes=["shard-check"])
+    passes = ["shard-check"] + (["pipeline-stage"] if pp > 1 else [])
+    result = verify_program(clone, mesh=sizes, passes=passes)
     if not result.ok:
         raise PlacementRejected("shard-check",
                                 str(result.errors[0])[:200])
@@ -321,12 +423,15 @@ def _prepare(program: Program, axes: Dict[str, int], batch: int,
 # ---------------------------------------------------------------------------
 
 def _plan_memory(program_t: Program, sizes: Dict[str, int],
-                 batch: int) -> Tuple[int, Dict[str, int]]:
+                 batch: int) -> Tuple[int, Dict[str, int], int]:
     """Per-device peak-HBM for a prepared candidate: activations/feeds
     priced at the per-device batch (the feed vars' dim-0 shard factor),
     params/optimizer state divided by each var's OWN spec factor (tp
     slices, ZeRO dp shards — the explicit specs carry both). Grads and
-    transients stay whole-program: conservative-safe upper bound."""
+    transients stay whole-program: conservative-safe upper bound. The
+    third return is the estimator's recorded pipeline-residual share of
+    the activation bucket — the only part a pp schedule's stash bound
+    may discount (schedule.pipeline_memory)."""
     shard = batch_shard_factor(program_t, sizes)
     per_dev_batch = batch
     if shard > 1 and batch % shard == 0:
@@ -353,18 +458,40 @@ def _plan_memory(program_t: Program, sizes: Dict[str, int],
             - est.breakdown.get("optimizer_state", 0) + params_sh + opt_sh)
     breakdown = dict(est.breakdown, params=params_sh,
                      optimizer_state=opt_sh)
-    return int(peak), {k: int(v) for k, v in breakdown.items()}
+    return (int(peak), {k: int(v) for k, v in breakdown.items()},
+            int(est.details.get("pipeline_residual_bytes", 0)))
 
 
 def _score(program_t: Program, axes: Dict[str, int], topology: Topology,
-           batch: int, zero: bool) -> Tuple[dict, int, Dict[str, int]]:
-    """Memory gate -> collective audit -> hierarchical roofline. Returns
-    (prediction, peak_hbm_bytes, memory_breakdown); raises
-    PlacementRejected on a failed gate. Pure host-side dict math — this
-    is the function an applied plan re-scores through (rescore_plan), so
-    it must stay deterministic."""
+           batch: int, zero: bool, coll_force: Optional[str] = None
+           ) -> Tuple[dict, int, Dict[str, int], List[dict],
+                      Optional[dict]]:
+    """Memory gate -> collective audit -> per-collective algorithm
+    choice -> hierarchical roofline (bubble-inflated for pp candidates).
+    Returns (prediction, peak_hbm_bytes, memory_breakdown,
+    collective_table, pipeline_info); raises PlacementRejected on a
+    failed gate. Pure host-side dict math — this is the function an
+    applied plan re-scores through (rescore_plan), so it must stay
+    deterministic. pp facts (stages/microbatches/schedule) come from the
+    prepared program's own pipeline op, so search-time scoring and plan
+    re-scoring read one truth."""
     sizes = {a: int(s) for a, s in axes.items()}
-    peak, breakdown = _plan_memory(program_t, sizes, batch)
+    pp = sizes.get(PP, 1)
+    pipe_facts = sched_mod.pipeline_facts(program_t) if pp > 1 else None
+    peak, breakdown, pipe_resid = _plan_memory(program_t, sizes, batch)
+    pipe_info: Optional[dict] = None
+    if pipe_facts is not None:
+        s_stages = pipe_facts["stages"]
+        m = pipe_facts["microbatches"]
+        pp_sched = pipe_facts["schedule"]
+        # the schedule's activation stash bound (1F1B: <= S microbatches
+        # resident, not M) prices BEFORE the memory gate — the whole
+        # point of 1F1B is fitting pipelines GPipe cannot. Only the
+        # estimator's recorded pipeline-residual share discounts; outer
+        # activations stay full-batch resident on their stage.
+        peak, breakdown = sched_mod.pipeline_memory(
+            peak, breakdown, pp_sched, s_stages, m,
+            pipeline_residual_bytes=pipe_resid)
     budget = topology.hbm_bytes()
     if peak > budget:
         raise PlacementRejected(
@@ -384,21 +511,61 @@ def _score(program_t: Program, axes: Dict[str, int], topology: Topology,
     mxu = pc.train.mxu_flops + pc.remat_recompute_mxu_flops
     flops = pc.train.mxu_flops + pc.train.vector_flops
     hbm = pc.train_bytes
-    wire_ici = 0
-    wire_dci = 0
-    for c in report.collectives:
-        crosses = any(axis_spans_hosts(sizes, a, topology.chips_per_host)
-                      for a in c.axes)
-        if crosses:
-            wire_dci += c.wire_bytes
-        else:
-            wire_ici += c.wire_bytes
-    # the ONLY departure from predict_step: the comm leg is priced per
-    # tier (intra-host ICI vs cross-host DCI) instead of one bandwidth
-    t_comm = (wire_ici / (topology.ici_bandwidth_gbps() * 1e9)
-              + wire_dci / (topology.dci_gbps * 1e9))
+    # per-collective reduction-algorithm choice (ring vs tree vs
+    # hierarchical ICI->DCI->ICI): the comm leg is the SUM of each
+    # collective's best algorithm's predicted time, not one bandwidth
+    # division — the searched dimension PAPERS' reduction-synthesis
+    # work names. coll_force pins one algorithm (PT_PLAN_COLL / the
+    # forced-ring regression baseline).
+    t_comm, coll_table = choose_algorithms(report.collectives, sizes,
+                                           topology, force=coll_force)
+    infl = 1.0
+    if pipe_facts is not None:
+        s_stages = pipe_facts["stages"]
+        m = pipe_facts["microbatches"]
+        pp_sched = pipe_facts["schedule"]
+        # the device legs stretch by THE RUNTIME'S schedule makespan:
+        # only M of its pipe ticks do useful work per stage. For gpipe
+        # (and 1f1b at M <= S) this is the semantic (S-1)/(S+M-1); the
+        # 1f1b wave schedule at M > S pays its per-wave refills, so the
+        # ranking prices what ParallelExecutor actually runs.
+        bubble = sched_mod.runtime_bubble_fraction(pp_sched, s_stages, m)
+        ticks = sched_mod.runtime_ticks(pp_sched, s_stages, m)
+        infl = 1.0 / (1.0 - bubble)
+        carry = sched_mod.carry_bytes(program_t, batch)
+        p2p = sched_mod.p2p_bytes_per_device(
+            carry, dp=sizes.get(DP, 1), train=pc.has_backward)
+        hops = (2 if pc.has_backward else 1) * ticks
+        t_p2p, pp_crosses = sched_mod.p2p_time_s(p2p, hops, sizes,
+                                                 topology)
+        t_comm += t_p2p
+        # the inter-stage p2p IS a collective of the plan — a neighbor
+        # ppermute over pp — so it rides the algorithm table like every
+        # audited collective (and keeps a pp-only plan's table non-empty,
+        # the validate_plan floor)
+        coll_table.append({
+            "kind": "ppermute", "op_type": "pipeline",
+            "var": pipe_facts["carry"], "axes": [PP],
+            "group": int(s_stages), "payload_bytes": int(p2p),
+            "wire_bytes": int(p2p), "algorithm": "ring",
+            "t_ms": t_p2p * 1e3, "crosses_hosts": bool(pp_crosses),
+        })
+        pipe_info = {
+            "stages": int(s_stages), "microbatches": int(m),
+            "schedule": pp_sched,
+            "layers_per_stage": int(pipe_facts["layers_per_stage"]),
+            "bubble_fraction": bubble,
+            "stash_microbatches": sched_mod.stash_microbatches(
+                pp_sched, s_stages, m),
+            "carry_bytes": int(carry), "p2p_bytes": int(p2p),
+            "t_p2p_ms": t_p2p * 1e3, "p2p_crosses_hosts": bool(pp_crosses),
+        }
+    wire_ici = sum(c["wire_bytes"] for c in coll_table
+                   if not c["crosses_hosts"])
+    wire_dci = sum(c["wire_bytes"] for c in coll_table
+                   if c["crosses_hosts"])
     t_compute, t_hbm, t, bound, mfu = roofline_step(
-        mxu, hbm, pc.train.mxu_flops, n_dev, chip, t_comm)
+        mxu * infl, hbm * infl, pc.train.mxu_flops, n_dev, chip, t_comm)
     prediction = {
         "flops": int(flops), "hbm_bytes": int(hbm),
         "comm_bytes": int(wire_ici + wire_dci),
@@ -407,20 +574,36 @@ def _score(program_t: Program, axes: Dict[str, int], topology: Topology,
         "t_comm_ms": t_comm * 1e3, "predicted_step_ms": t * 1e3,
         "predicted_mfu": mfu, "bound": bound, "chip": chip.name,
     }
-    return prediction, peak, breakdown
+    if pipe_info is not None:
+        prediction["bubble_fraction"] = pipe_info["bubble_fraction"]
+        prediction["t_p2p_ms"] = pipe_info["t_p2p_ms"]
+    return prediction, peak, breakdown, coll_table, pipe_info
 
 
 def score_mesh(program: Program, axes: Dict[str, int], topology: Topology,
                batch: int = 1, zero: bool = False,
-               sp_mode: Optional[str] = None) -> dict:
+               sp_mode: Optional[str] = None,
+               microbatches: Optional[int] = None,
+               pp_schedule: Optional[str] = None,
+               coll_algo: Optional[str] = None) -> dict:
     """Prepare + score ONE candidate placement (the search's inner loop,
     exposed for the rank-correlation gate and tests). Raises
-    PlacementRejected when the candidate fails a pruning stage."""
+    PlacementRejected when the candidate fails a pruning stage. pp
+    candidates (axes naming a pp size > 1) need a pipeline-transpiled
+    program; microbatches/pp_schedule select the schedule the clone is
+    retuned to (defaults: PT_PLAN_MICROBATCH, '1f1b'). coll_algo pins
+    the per-collective reduction algorithm ('ring'|'tree'|
+    'hierarchical'; default PT_PLAN_COLL or per-collective choice)."""
     traits = _traits(program, batch)
-    program_t, specs = _prepare(program, axes, batch, zero, sp_mode, traits)
-    prediction, peak, breakdown = _score(program_t, axes, topology, batch,
-                                         zero)
-    return {
+    pp = int(axes.get(PP, 1))
+    m = _default_microbatches(microbatches, batch) if pp > 1 else None
+    force = _coll_force(coll_algo)
+    program_t, specs = _prepare(program, axes, batch, zero, sp_mode,
+                                traits, microbatches=m,
+                                pp_schedule=pp_schedule)
+    prediction, peak, breakdown, coll_table, pipe_info = _score(
+        program_t, axes, topology, batch, zero, coll_force=force)
+    cand = {
         "mesh": {a: int(s) for a, s in axes.items()},
         "zero": bool(zero), "sp_mode": sp_mode,
         "devices_used": int(_prod([int(s) for s in axes.values()])),
@@ -431,8 +614,13 @@ def score_mesh(program: Program, axes: Dict[str, int], topology: Topology,
         "memory_breakdown": breakdown,
         "wire_bytes": int(prediction["comm_bytes"]),
         "wire_bytes_dci": int(prediction["comm_bytes_dci"]),
+        "collectives": coll_table,
+        "coll_algo": force or "auto",
         "program_fingerprint": program.fingerprint(),
     }
+    if pipe_info is not None:
+        cand["pipeline"] = pipe_info
+    return cand
 
 
 # ---------------------------------------------------------------------------
@@ -495,6 +683,10 @@ def plan_placement(program: Optional[Program] = None,
                    topology: Optional[Topology] = None, batch: int = 1,
                    *, zero_options: Sequence[bool] = (False, True),
                    sp_modes: Sequence[str] = ("ring",),
+                   pp_options: Optional[Sequence[int]] = None,
+                   microbatches: Optional[int] = None,
+                   pp_schedules: Sequence[str] = sched_mod.SCHEDULES,
+                   coll_algo: Optional[str] = None,
                    beam: Optional[int] = None,
                    program_name: str = "") -> PlanArtifact:
     """Search placements for `program` on `topology` at global `batch`.
@@ -502,14 +694,56 @@ def plan_placement(program: Optional[Program] = None,
     Pure host-side static analysis: candidates are transpiled CLONES,
     nothing compiles, no device is touched. Returns the ranked
     PlanArtifact; raises NoFeasiblePlacementError when every candidate
-    prunes (the artifact-level analogue of MemoryBudgetError)."""
+    prunes (the artifact-level analogue of MemoryBudgetError).
+
+    pp candidates ride beside the {dp, ep, sp, tp} x ZeRO factorizations
+    when the program is pipeline-transpiled (pp_options default: every
+    stacked-layer divisor that divides the chip count; PT_PLAN_PP
+    overrides, '0' disables), each scored per schedule in pp_schedules
+    at `microbatches` (PT_PLAN_MICROBATCH, default 4). Every candidate's
+    comm leg synthesizes the reduction algorithm per collective
+    (ring/tree/hierarchical; coll_algo / PT_PLAN_COLL pins one)."""
     program = program or default_main_program()
     topology = topology or default_topology()
     width = _beam_width(beam)
+    force = _coll_force(coll_algo)
     plans: List[dict] = []
     scored: List[dict] = []
     rejections: List[dict] = []
     n_candidates = 0
+
+    def try_candidate(axes: Dict[str, int], zero: bool,
+                      sp_mode: Optional[str],
+                      mb: Optional[int] = None,
+                      pp_sched: Optional[str] = None) -> None:
+        nonlocal n_candidates
+        n_candidates += 1
+        desc = {"mesh": dict(axes), "zero": zero, "sp_mode": sp_mode}
+        if pp_sched is not None:
+            desc["pipeline"] = {"microbatches": mb, "schedule": pp_sched}
+        try:
+            cand = score_mesh(program, axes, topology, batch, zero=zero,
+                              sp_mode=sp_mode, microbatches=mb,
+                              pp_schedule=pp_sched, coll_algo=force)
+        except PlacementRejected as e:
+            rejections.append(dict(desc, stage=e.stage, reason=e.reason))
+            return
+        plans.append(cand)
+        p = cand["prediction"]
+        row = dict(
+            desc, devices_used=cand["devices_used"],
+            predicted_step_ms=p["predicted_step_ms"],
+            predicted_mfu=p["predicted_mfu"], bound=p["bound"],
+            peak_hbm_bytes=cand["peak_hbm_bytes"],
+            wire_bytes=cand["wire_bytes"],
+            wire_bytes_dci=cand["wire_bytes_dci"])
+        if cand.get("pipeline"):
+            row["pipeline"] = {
+                k: cand["pipeline"][k]
+                for k in ("stages", "microbatches", "schedule",
+                          "bubble_fraction")}
+        scored.append(row)
+
     for axes in _mesh_candidates(topology.n_devices):
         dp = int(axes.get(DP, 1))
         zeros = [z for z in dict.fromkeys(bool(z) for z in zero_options)
@@ -518,25 +752,26 @@ def plan_placement(program: Optional[Program] = None,
             tuple(sp_modes) if int(axes.get(SP, 1)) > 1 else (None,))
         for zero in zeros:
             for sp_mode in modes:
-                n_candidates += 1
-                desc = {"mesh": dict(axes), "zero": zero,
-                        "sp_mode": sp_mode}
-                try:
-                    cand = score_mesh(program, axes, topology, batch,
-                                      zero=zero, sp_mode=sp_mode)
-                except PlacementRejected as e:
-                    rejections.append(dict(desc, stage=e.stage,
-                                           reason=e.reason))
-                    continue
-                plans.append(cand)
-                p = cand["prediction"]
-                scored.append(dict(
-                    desc, devices_used=cand["devices_used"],
-                    predicted_step_ms=p["predicted_step_ms"],
-                    predicted_mfu=p["predicted_mfu"], bound=p["bound"],
-                    peak_hbm_bytes=cand["peak_hbm_bytes"],
-                    wire_bytes=cand["wire_bytes"],
-                    wire_bytes_dci=cand["wire_bytes_dci"]))
+                try_candidate(axes, zero, sp_mode)
+    # -- pp x dp candidates (pipeline-transpiled programs only) ----------
+    mb = _default_microbatches(microbatches, batch)
+    for pp in _pp_options(program, topology.n_devices, pp_options):
+        if topology.n_devices % pp:
+            rejections.append({
+                "mesh": {DP: 1, PP: pp}, "zero": False, "sp_mode": None,
+                "stage": "structural",
+                "reason": f"pp={pp} does not divide the topology's "
+                          f"{topology.n_devices} devices"})
+            continue
+        for total in sorted(_divisors(topology.n_devices), reverse=True):
+            if total % pp:
+                continue
+            dp = total // pp
+            # dp outermost, pp innermost: the once-a-step grad sync
+            # takes any DCN hop, the per-microbatch stage p2p stays ICI
+            axes = ({DP: dp} if dp > 1 else {}) | {PP: pp}
+            for pp_sched in dict.fromkeys(pp_schedules):
+                try_candidate(axes, False, None, mb=mb, pp_sched=pp_sched)
     if not plans:
         raise NoFeasiblePlacementError(rejections)
     order = sorted(
@@ -620,6 +855,17 @@ def apply_plan(program: Program, plan) -> Dict[str, int]:
         for op in block.ops:
             if op.type == _ATTENTION_OP:
                 op.attrs["sp_mode"] = plan["sp_mode"]
+    pipe = plan.get("pipeline")
+    if pipe:
+        # a pp plan re-stages the program's OWN pipeline op (attr
+        # update: the stacked [L, ...] params represent every contiguous
+        # split). A program that was never pipeline-transpiled cannot
+        # execute a pp plan — the rewrite must happen before
+        # optimizer.minimize, so refuse with the recipe rather than
+        # apply a placement the runtime cannot honor.
+        sched_mod.retune_pipeline(program, stages=int(pipe["stages"]),
+                                  microbatches=int(pipe["microbatches"]),
+                                  schedule=str(pipe["schedule"]))
     program.invalidate_cache()
     return {str(a): int(s) for a, s in plan["mesh"].items()}
 
@@ -634,10 +880,14 @@ def rescore_plan(program: Program, plan, topology: Optional[Topology] = None,
     clone = program.clone()
     axes = apply_plan(clone, plan)
     b = int(plan.get("batch", 1)) if batch is None else batch
-    prediction, peak, breakdown = _score(clone, axes, topology, b,
-                                         bool(plan.get("zero")))
+    force = plan.get("coll_algo")
+    force = None if force in (None, "auto") else str(force)
+    prediction, peak, breakdown, coll_table, pipe_info = _score(
+        clone, axes, topology, b, bool(plan.get("zero")),
+        coll_force=force)
     return {"prediction": prediction, "peak_hbm_bytes": peak,
-            "memory_breakdown": breakdown}
+            "memory_breakdown": breakdown, "collectives": coll_table,
+            "pipeline": pipe_info}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,578 @@
+"""Pipeline-parallel plan synthesis: liveness-cut stage search + 1F1B/
+GPipe schedule costing + the `pipeline-stage` verifier pass.
+
+The pp axis is the one dimension the placement planner could not search
+— a pipeline placement is a program REWRITE (transpiler/
+pipeline_transpiler.py), not a sharding annotation. This module is the
+static analysis that closes the gap, joining three layers that already
+exist as islands:
+
+  * stage-cut search (`stage_cut_search`) — enumerate the stage
+    partitions of block 0 at the lowering's OWN run boundaries
+    (core/lowering.iter_op_runs, the one segmentation the traced step,
+    the memory estimator, and the per-op profiler already share), score
+    every boundary by the bytes live across it, and cut where the live
+    set is minimal: at layer-occurrence boundaries exactly ONE value —
+    the residual stream — crosses, while a mid-layer boundary carries
+    attention/FFN intermediates too. Legality is checked statically:
+    the carry crosses each cut exactly once, per-layer parameters are
+    confined to one stage (shared/tied weights stay replicated — legal,
+    just not stage-resident), and n_layers % n_stages == 0. The
+    pipeline transpiler consults this search for its cuts, so the
+    analysis IS the rewrite's decision procedure, not a parallel
+    opinion.
+
+  * schedule costing — closed forms for the two microbatch schedules
+    parallel/pipeline.py executes. Both GPipe and 1F1B share the
+    makespan (M + S - 1)(tf + tb), hence the bubble fraction
+    (S-1)/(S+M-1); the difference is MEMORY: GPipe holds all M
+    microbatch activations before any backward runs, 1F1B's
+    warmup/steady/cooldown interleaving bounds the stash at min(S, M)
+    (`stash_microbatches` — the memory estimator prices it via
+    `pipeline_memory`). Inter-stage p2p traffic is priced at the ICI or
+    DCI tier depending on whether the pp axis spans hosts
+    (`p2p_time_s`).
+
+  * the `pipeline-stage` verifier pass — stage-cut legality surfaced as
+    typed ProgramVerificationError diagnostics (stacked-layer counts vs
+    num_stages, pp-axis/stage mismatch, microbatch divisibility,
+    per-stage param confinement, unknown schedules) instead of
+    transpiler/lowering asserts; runs standalone via
+    tools/verify_program.py --plan on pp plans.
+
+The planner (analysis/planner.py) composes all three: pp x dp
+candidates enter the prune -> score -> rank flow, the roofline's
+compute/HBM legs inflate by 1/(1 - bubble), the p2p leg rides the comm
+term, and the winning plan records stages/microbatches/schedule plus
+the per-collective reduction-algorithm table (comm.choose_algorithms).
+
+Knobs: PT_PLAN_PP / PT_PLAN_MICROBATCH / PT_PLAN_COLL (read by the
+planner; declared in flags.py). Everything here is host-side IR math —
+no jax import, no device touched.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core.lowering import iter_op_runs
+from ..core.program import Program, default_main_program
+#: the microbatch schedules parallel/pipeline.py executes — ONE
+#: definition, owned by artifacts.py (the import leaf) beside the plan
+#: floors. 1F1B first: equal predicted time, strictly-not-worse
+#: activation stash, so the planner's peak-HBM tie-break prefers it.
+from .artifacts import PLAN_SCHEDULES as SCHEDULES
+from .cost import (OpCost, _Ctx, _op_cost_ctx, _prod, _shape,
+                   device_nbytes)
+from .verifier import ERROR, Diagnostic, verifier_pass
+
+__all__ = ["StageCutError", "CutPoint", "StageCutPlan", "stage_cut_search",
+           "boundary_liveness", "pipeline_facts", "retune_pipeline",
+           "bubble_fraction", "stash_microbatches", "makespan",
+           "runtime_ticks", "runtime_bubble_fraction",
+           "pipeline_memory", "carry_bytes", "p2p_bytes_per_device",
+           "p2p_time_s", "SCHEDULES"]
+
+class StageCutError(ValueError):
+    """A requested stage partition is statically illegal (no repeated
+    layer region, indivisible layer count, a cut the carry crosses more
+    than once, a parameter escaping its stage)."""
+
+
+# ---------------------------------------------------------------------------
+# boundary liveness: what a cut would have to carry
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CutPoint:
+    """One candidate cut — a run boundary inside the repeated region.
+
+    crossing: values produced IN the region before the boundary and
+    read at/after it (activations only — params and the shared outer
+    environment reach every stage through the interpreter env and never
+    travel stage-to-stage). legal = the residual stream is the ONLY
+    crossing value."""
+
+    op_idx: int
+    live_bytes: int
+    crossing: Tuple[str, ...]
+    legal: bool
+    at_occurrence: Optional[int] = None  # layer index when on a boundary
+
+
+@dataclass
+class StageCutPlan:
+    """The chosen partition: S stages of layers_per_stage layers each,
+    cut at liveness-minimal occurrence boundaries."""
+
+    n_stages: int
+    layers_per_stage: int
+    n_layers: int
+    carry: str
+    carry_bytes: int          # full-batch bytes of the residual stream
+    cut_op_idx: List[int]     # S-1 block-0 op indices
+    cut_points: List[CutPoint]  # every region boundary, for inspection
+    stage_flops: List[int]    # per-stage forward flops (balanced)
+    region: dict              # find_repeated_region's summary (verbatim)
+
+    @property
+    def minimal(self) -> bool:
+        """Do the chosen cuts sit at globally liveness-minimal
+        boundaries? (True for every residual-stream architecture; a
+        False here means a cheaper cut exists that the layer structure
+        cannot express.)"""
+        chosen = {p.op_idx for p in self.cut_points
+                  if p.op_idx in set(self.cut_op_idx)}
+        if not chosen:
+            return True
+        worst = max(p.live_bytes for p in self.cut_points
+                    if p.op_idx in chosen)
+        return all(p.live_bytes >= worst for p in self.cut_points
+                   if p.op_idx not in chosen)
+
+
+def _is_activation(block, name: str) -> bool:
+    try:
+        v = block.var(name)
+    except KeyError:
+        return False
+    if v.is_parameter or v.persistable or getattr(v, "is_data", False):
+        return False
+    return True
+
+
+def boundary_liveness(program: Program, region: dict,
+                      batch: int = 1) -> List[CutPoint]:
+    """CutPoints for every iter_op_runs boundary strictly inside the
+    repeated region: the live-across set and its bytes. One forward
+    sweep (produced-so-far) against one reverse sweep (read-at-or-after)
+    — O(region ops), the memory.py discipline."""
+    block = program.global_block
+    ops = block.ops
+    amp = program.amp_dtype
+    start, w, r = region["start"], region["w"], region["r"]
+    end = start + r * w
+    boundaries = [i for i, _j, _t in iter_op_runs(ops, start, end)
+                  if i > start]
+    # read-at-or-after, snapshotted at each boundary (reverse sweep to
+    # the end of the block: a carry read by the suffix stays live)
+    bset = set(boundaries)
+    read_after: Dict[int, Set[str]] = {}
+    running: Set[str] = set()
+    for i in range(len(ops) - 1, start - 1, -1):
+        running.update(ops[i].input_names())
+        if i in bset:
+            read_after[i] = set(running)
+    produced: Set[str] = set()
+    out: List[CutPoint] = []
+    occ_of = {start + k * w: k for k in range(1, r)}
+    bi = 0
+    for i in range(start, end):
+        if bi < len(boundaries) and boundaries[bi] == i:
+            crossing = sorted(n for n in produced & read_after[i]
+                              if _is_activation(block, n))
+            nbytes = 0
+            for n in crossing:
+                try:
+                    nbytes += _prod(_shape(block, n, batch)) \
+                        * device_nbytes(block.var(n), amp)
+                except KeyError:
+                    continue
+            out.append(CutPoint(i, nbytes, tuple(crossing),
+                                len(crossing) == 1, occ_of.get(i)))
+            bi += 1
+        produced.update(ops[i].output_names())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the stage-cut search
+# ---------------------------------------------------------------------------
+
+def stage_cut_search(program: Optional[Program] = None, n_stages: int = 2,
+                     batch: int = 1) -> StageCutPlan:
+    """Partition block 0's repeated layer region into `n_stages` stages
+    at liveness-minimal cut points. Raises StageCutError when the
+    partition is statically illegal; the pipeline transpiler calls this
+    to decide (and validate) its cuts, so search and rewrite share one
+    decision procedure."""
+    program = program or default_main_program()
+    block = program.global_block
+    from ..transpiler.pipeline_transpiler import find_repeated_region
+    region = find_repeated_region(block)
+    if region is None:
+        raise StageCutError(
+            "stage-cut: no repeated layer region found in block 0 "
+            "(needs >= 2 structurally identical consecutive layer blocks)")
+    r, w, start = region["r"], region["w"], region["start"]
+    if n_stages < 1:
+        raise StageCutError(f"stage-cut: need >= 1 stage, got {n_stages}")
+    if r % n_stages:
+        raise StageCutError(f"stage-cut: {r} layers do not divide into "
+                            f"{n_stages} stages")
+    ls = r // n_stages
+    points = boundary_liveness(program, region, batch)
+    by_idx = {p.op_idx: p for p in points}
+    cut_idx = [start + k * ls * w for k in range(1, n_stages)]
+
+    # -- carry legality: the residual stream crosses each cut ONCE -------
+    renames = region["renames"]
+    for k in range(1, n_stages):
+        idx = start + k * ls * w
+        p = by_idx.get(idx)
+        if p is None:
+            raise StageCutError(
+                f"stage-cut: occurrence boundary at op {idx} is not a "
+                "run boundary (a remat segment straddles the cut)")
+        expected = renames[k * ls - 1][region["carry_in"]]
+        if not p.legal or p.crossing != (expected,):
+            raise StageCutError(
+                f"stage-cut: cut at op {idx} carries {list(p.crossing)} "
+                f"— the residual stream ({expected!r}) must cross each "
+                "cut exactly once")
+
+    # -- param confinement: a per-layer param never escapes its stage ----
+    ops = block.ops
+    for chain in region["param_roles"]:
+        for layer, name in enumerate(chain):
+            lo, hi = start + layer * w, start + (layer + 1) * w
+            for i in range(start, start + r * w):
+                if lo <= i < hi:
+                    continue
+                if name in ops[i].input_names():
+                    raise StageCutError(
+                        f"stage-cut: parameter {name!r} of layer {layer} "
+                        f"is also read by op {i} in another stage — "
+                        "per-stage params must be stage-confined")
+
+    # -- balanced per-stage flops (homogeneous layers => exact split) ----
+    ctx = _Ctx(block, batch, program.amp_dtype)
+    layer_cost = OpCost()
+    for i in range(start, start + w):
+        try:
+            layer_cost = layer_cost + _op_cost_ctx(ops[i], ctx)
+        except KeyError:
+            continue
+    carry = region["carry_in"]
+    try:
+        cbytes = _prod(_shape(block, carry, batch)) \
+            * device_nbytes(block.var(carry), program.amp_dtype)
+    except KeyError:
+        cbytes = 0
+    return StageCutPlan(
+        n_stages=n_stages, layers_per_stage=ls, n_layers=r, carry=carry,
+        carry_bytes=int(cbytes), cut_op_idx=cut_idx, cut_points=points,
+        stage_flops=[int(layer_cost.flops) * ls] * n_stages,
+        region=region)
+
+
+# ---------------------------------------------------------------------------
+# pipeline-op introspection + retuning (the plan application surface)
+# ---------------------------------------------------------------------------
+
+def pipeline_facts(program: Optional[Program] = None) -> Optional[dict]:
+    """Summary of block 0's `pipeline` op, or None: stage/microbatch/
+    schedule attrs plus the total layer count — what the planner needs
+    to enumerate pp candidates and what apply_plan retunes."""
+    program = program or default_main_program()
+    for i, op in enumerate(program.global_block.ops):
+        if op.type != "pipeline":
+            continue
+        attrs = op.attrs or {}
+        s = int(attrs.get("num_stages", 1))
+        ls = int(attrs.get("layers_per_stage", 1))
+        return {"op_idx": i, "stages": s, "layers_per_stage": ls,
+                "total_layers": s * ls,
+                "microbatches": int(attrs.get("n_microbatches", 1)),
+                "schedule": str(attrs.get("schedule", "gpipe")),
+                "carry": op.inputs["X"][0],
+                "sub_block": attrs.get("sub_block"),
+                "params": list(op.inputs.get("Params", ()))}
+    return None
+
+
+def retune_pipeline(program: Program, stages: int, microbatches: int,
+                    schedule: str = "1f1b") -> dict:
+    """Re-stage an already-pipeline-transpiled program IN PLACE: the
+    stacked [L, ...] params and the one-layer sub-block represent every
+    contiguous partition of the L layers, so changing the split is an
+    attr update (num_stages x layers_per_stage), not a second rewrite.
+    This is how a pp plan applies. Raises StageCutError on an
+    indivisible or unknown request."""
+    facts = pipeline_facts(program)
+    if facts is None:
+        raise StageCutError(
+            "retune_pipeline: the program has no pipeline op — run "
+            "transpiler.pipeline_transpile(num_stages=..., "
+            "num_microbatches=...) BEFORE optimizer.minimize, then apply "
+            "the plan")
+    total = facts["total_layers"]
+    if stages < 1 or total % stages:
+        raise StageCutError(f"retune_pipeline: {total} layers do not "
+                            f"divide into {stages} stages")
+    if schedule not in SCHEDULES:
+        raise StageCutError(f"retune_pipeline: unknown schedule "
+                            f"{schedule!r} (know {list(SCHEDULES)})")
+    if microbatches < 1:
+        raise StageCutError("retune_pipeline: need >= 1 microbatch, got "
+                            f"{microbatches}")
+    op = program.global_block.ops[facts["op_idx"]]
+    op.attrs["num_stages"] = int(stages)
+    op.attrs["layers_per_stage"] = total // int(stages)
+    op.attrs["n_microbatches"] = int(microbatches)
+    op.attrs["schedule"] = str(schedule)
+    program.invalidate_cache()
+    return pipeline_facts(program)
+
+
+# ---------------------------------------------------------------------------
+# schedule costing: closed forms
+# ---------------------------------------------------------------------------
+
+def bubble_fraction(schedule: str, n_stages: int,
+                    microbatches: int) -> float:
+    """Idle fraction of the pipeline makespan. Both schedules share it:
+    GPipe fills/drains an (M + S - 1)-tick forward pipe then an equal
+    backward pipe; 1F1B's warmup((S-1) tf) + steady(M (tf+tb)) +
+    cooldown((S-1) tb) sums to the same (M + S - 1)(tf + tb) makespan.
+    The schedules differ in MEMORY (stash_microbatches), not time."""
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown schedule {schedule!r} "
+                         f"(know {list(SCHEDULES)})")
+    s, m = int(n_stages), int(microbatches)
+    if s < 1 or m < 1:
+        raise ValueError(f"need >= 1 stage and microbatch, got "
+                         f"S={n_stages} M={microbatches}")
+    return (s - 1) / (s + m - 1)
+
+
+def stash_microbatches(schedule: str, n_stages: int,
+                       microbatches: int) -> int:
+    """Microbatch activation sets a stage holds at its peak: GPipe runs
+    every forward before any backward (all M resident); 1F1B starts
+    microbatch k's backward as soon as stage S-1 finishes its forward,
+    bounding the stash at the pipeline depth min(S, M). This is the
+    SCHEDULE's semantic bound — what a deployment target's 1F1B runtime
+    realizes; the in-graph wave schedule (parallel/pipeline.one_f1b)
+    bounds in-flight microbatches but jax's whole-program autodiff still
+    saves all residuals until the backward, so realizing the bound on
+    this runtime is the staged-backward ROADMAP item."""
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown schedule {schedule!r} "
+                         f"(know {list(SCHEDULES)})")
+    s, m = int(n_stages), int(microbatches)
+    return m if schedule == "gpipe" else min(s, m)
+
+
+def runtime_ticks(schedule: str, n_stages: int, microbatches: int) -> int:
+    """Pipe ticks one step costs per direction ON THIS RUNTIME — the
+    number the planner prices and the rank gate measures against. GPipe
+    fills and drains once: M + S - 1. The in-graph 1F1B wave schedule
+    (parallel/pipeline.one_f1b) refills the pipe per wave of <= S
+    microbatches: M + ceil(M/S)(S-1), equal to GPipe's when M <= S (a
+    single wave, where one_f1b IS gpipe). A deployment runtime with a
+    staged backward realizes the semantic (M + S - 1) makespan instead
+    (`makespan`/`bubble_fraction` — the closed forms)."""
+    s, m = int(n_stages), int(microbatches)
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown schedule {schedule!r} "
+                         f"(know {list(SCHEDULES)})")
+    if s < 1 or m < 1:
+        raise ValueError(f"need >= 1 stage and microbatch, got "
+                         f"S={n_stages} M={microbatches}")
+    if schedule == "gpipe" or m <= s:
+        return m + s - 1
+    waves = -(-m // s)
+    return m + waves * (s - 1)
+
+
+def runtime_bubble_fraction(schedule: str, n_stages: int,
+                            microbatches: int) -> float:
+    """Idle fraction of THIS runtime's schedule (runtime_ticks): what
+    the planner's compute/HBM legs inflate by. For gpipe — and 1f1b at
+    M <= S — this equals the semantic closed form (S-1)/(S+M-1); the
+    1f1b wave schedule at M > S pays its per-wave refills honestly, so
+    a gpipe plan outranks it on time whenever the waves cost extra."""
+    ticks = runtime_ticks(schedule, n_stages, microbatches)
+    return (ticks - int(microbatches)) / ticks
+
+
+def makespan(schedule: str, n_stages: int, microbatches: int,
+             t_fwd: float, t_bwd: float) -> dict:
+    """Phase decomposition of one pipeline step (per-microbatch per-stage
+    forward/backward times tf, tb). Returns the named phases + total;
+    both schedules total (M + S - 1)(tf + tb) — the closed form the
+    bubble fraction divides."""
+    s, m = int(n_stages), int(microbatches)
+    if schedule == "gpipe":
+        phases = {"fwd_pipe": (m + s - 1) * t_fwd,
+                  "bwd_pipe": (m + s - 1) * t_bwd}
+    elif schedule == "1f1b":
+        phases = {"warmup": (s - 1) * t_fwd,
+                  "steady": m * (t_fwd + t_bwd),
+                  "cooldown": (s - 1) * t_bwd}
+    else:
+        raise ValueError(f"unknown schedule {schedule!r} "
+                         f"(know {list(SCHEDULES)})")
+    phases["total"] = sum(phases.values())
+    return phases
+
+
+def pipeline_memory(peak_bytes: int, breakdown: Dict[str, int],
+                    schedule: str, n_stages: int, microbatches: int,
+                    pipeline_residual_bytes: Optional[int] = None
+                    ) -> Tuple[int, Dict[str, int]]:
+    """Per-stage activation residency under a microbatch schedule: the
+    PIPELINE residual share of the activation watermark (the stacked
+    layers' saved values — memory.py records it as
+    details['pipeline_residual_bytes']) covers all L layers at the full
+    batch; one stage holds 1/S of those layers, each resident microbatch
+    is 1/M of the batch, and the schedule bounds how many microbatches
+    stash (M for GPipe, min(S, M) for 1F1B). Activations OUTSIDE the
+    pipeline op — embedding/loss residuals, the big cotangent, attention
+    backward scratch — stay full-batch resident on whichever stage hosts
+    them and are NOT discounted. pipeline_residual_bytes=None treats the
+    whole bucket as pipeline residuals (only right when the caller knows
+    that is true); the planner passes the estimator's recorded share.
+    Params/optimizer state are already divided by their own pp spec
+    factor (_plan_memory); grads stay whole-program — conservative-safe
+    upper bound."""
+    s, m = int(n_stages), int(microbatches)
+    stash = stash_microbatches(schedule, s, m)
+    act = int(breakdown.get("activations", 0))
+    pipe = act if pipeline_residual_bytes is None \
+        else max(0, min(act, int(pipeline_residual_bytes)))
+    pipe_stage = pipe * stash // max(1, s * m)
+    act_stage = act - pipe + pipe_stage
+    return (int(peak_bytes) - act + act_stage,
+            dict(breakdown, activations=act_stage))
+
+
+def carry_bytes(program: Program, batch: int = 1) -> int:
+    """Full-batch bytes of the pipeline op's residual-stream carry (the
+    inter-stage p2p payload before microbatching)."""
+    facts = pipeline_facts(program)
+    if facts is None:
+        return 0
+    block = program.global_block
+    try:
+        v = block.var(facts["carry"])
+    except KeyError:
+        return 0
+    return _prod(_shape(block, facts["carry"], batch)) \
+        * device_nbytes(v, program.amp_dtype)
+
+
+def p2p_bytes_per_device(carry_full_bytes: int, dp: int = 1,
+                         train: bool = True) -> int:
+    """Per-device inter-stage traffic for one step: each stage forwards
+    its output once per microbatch — summed over M microbatches that is
+    the full carry, dp-sharded — and training returns the carry
+    cotangent along the reverse edge."""
+    b = int(carry_full_bytes) // max(1, int(dp))
+    return b * (2 if train else 1)
+
+
+def p2p_time_s(nbytes: int, n_hops: int, sizes: Dict[str, int],
+               topology) -> Tuple[float, bool]:
+    """(seconds, crosses_hosts) for the p2p leg: bytes over the ICI or
+    DCI tier — whichever the pp axis's neighbor hops ride, decided by
+    the same row-major predicate the collective pricing uses — plus a
+    per-hop launch latency."""
+    from ..parallel.distributed import axis_spans_hosts
+    from .comm import DCI_HOP_LATENCY_S, ICI_HOP_LATENCY_S
+    crosses = axis_spans_hosts(sizes, "pp", topology.chips_per_host)
+    if crosses:
+        bw, lat = float(topology.dci_gbps) * 1e9, DCI_HOP_LATENCY_S
+    else:
+        bw, lat = float(topology.ici_bandwidth_gbps()) * 1e9, \
+            ICI_HOP_LATENCY_S
+    return nbytes / bw + max(0, int(n_hops)) * lat, crosses
+
+
+# ---------------------------------------------------------------------------
+# the pipeline-stage verifier pass
+# ---------------------------------------------------------------------------
+
+@verifier_pass("pipeline-stage")
+def _check_pipeline_stage(program: Program, ctx) -> List[Diagnostic]:
+    """Stage-cut legality as typed diagnostics (the transpiler/lowering
+    asserts, surfaced statically): stacked layer counts must equal
+    num_stages x layers_per_stage, a pp mesh axis must match the stage
+    count, static batch dims must divide over microbatches, every
+    stacked stage param must be pp-sharded on its layer dim (param
+    confinement — a replicated stack means no stage holds only its
+    slice), and the schedule must be one the runtime implements."""
+    diags: List[Diagnostic] = []
+    block = program.global_block
+    pp_size = int((ctx.axis_sizes or {}).get("pp", 1))
+    for i, op in enumerate(block.ops):
+        if op.type != "pipeline":
+            continue
+        attrs = op.attrs or {}
+        s = int(attrs.get("num_stages", 1))
+        ls = int(attrs.get("layers_per_stage", 1))
+        m = int(attrs.get("n_microbatches", 1))
+        sched = str(attrs.get("schedule", "gpipe"))
+        if sched not in SCHEDULES:
+            diags.append(Diagnostic(
+                ERROR, "pipeline-schedule",
+                f"pipeline op declares schedule {sched!r} but the "
+                f"runtime implements {list(SCHEDULES)}", block.idx, i,
+                op.type))
+        if s < 1 or ls < 1 or m < 1:
+            diags.append(Diagnostic(
+                ERROR, "pipeline-stage-count",
+                f"pipeline op declares num_stages={s} "
+                f"layers_per_stage={ls} n_microbatches={m} — all must "
+                "be >= 1", block.idx, i, op.type))
+            continue
+        carries = op.inputs.get("X", [])
+        if len(carries) != 1:
+            diags.append(Diagnostic(
+                ERROR, "pipeline-carry",
+                f"pipeline op has {len(carries)} carry inputs — the "
+                "residual stream must cross the stage boundary exactly "
+                "once", block.idx, i, op.type))
+        for name in op.inputs.get("Params", ()):
+            try:
+                v = block.var(name)
+            except KeyError:
+                continue
+            total = int(v.shape[0]) if v.shape else 0
+            if total != s * ls:
+                diags.append(Diagnostic(
+                    ERROR, "pipeline-stage-count",
+                    f"stacked param {name!r} holds {total} layers but "
+                    f"num_stages={s} x layers_per_stage={ls} = {s * ls} "
+                    "— n_layers % pp must be 0", block.idx, i, op.type,
+                    name))
+            spec = v.sharding or ()
+            dim0 = spec[0] if spec else None
+            axes = dim0 if isinstance(dim0, (list, tuple)) else (dim0,)
+            if "pp" not in axes:
+                diags.append(Diagnostic(
+                    ERROR, "pipeline-param-confinement",
+                    f"stacked param {name!r} is not sharded over 'pp' on "
+                    "its layer dim — every stage would hold EVERY "
+                    "stage's weights instead of its own slice",
+                    block.idx, i, op.type, name))
+        if pp_size > 1 and pp_size != s:
+            diags.append(Diagnostic(
+                ERROR, "pipeline-pp-mismatch",
+                f"mesh pp axis has size {pp_size} but the pipeline op "
+                f"declares {s} stages — the schedule needs exactly one "
+                "stage per pp device", block.idx, i, op.type))
+        if carries:
+            try:
+                d0 = int(block.var(carries[0]).shape[0])
+            except (KeyError, IndexError):
+                d0 = -1
+            if d0 > 0 and d0 % m:
+                diags.append(Diagnostic(
+                    ERROR, "pipeline-microbatch",
+                    f"carry {carries[0]!r} batch dim {d0} does not "
+                    f"divide over n_microbatches={m}", block.idx, i,
+                    op.type, carries[0]))
+    return diags

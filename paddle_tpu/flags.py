@@ -359,6 +359,31 @@ declare_env_knob("PT_PLAN_TOPOLOGY",
                  "Topology.parse). Lets an off-TPU host plan for the "
                  "deployment pod, like PT_COST_CHIP does for the "
                  "roofline")
+declare_env_knob("PT_PLAN_PP",
+                 "placement planner: pipeline-stage counts to search as "
+                 "pp x dp candidates, comma-separated (e.g. '2,4'); "
+                 "0 disables the pp axis. Default: every stacked-layer "
+                 "divisor of an already-pipeline-transpiled program "
+                 "that also divides the chip count (a program without "
+                 "a pipeline op searches none — run "
+                 "transpiler.pipeline_transpile BEFORE "
+                 "optimizer.minimize to open the axis)")
+declare_env_knob("PT_PLAN_MICROBATCH",
+                 "placement planner: microbatch count pp candidates "
+                 "are scheduled and priced at (default 4, clamped to "
+                 "the batch; batch % microbatches must be 0). More "
+                 "microbatches shrink the pipeline bubble "
+                 "(S-1)/(S+M-1) but raise GPipe's activation stash — "
+                 "1F1B's stash stays bounded at min(S, M)")
+declare_env_knob("PT_PLAN_COLL",
+                 "placement planner: pin the per-collective reduction "
+                 "algorithm — ring | tree | hierarchical (where an "
+                 "algorithm has no implementation for a collective it "
+                 "falls back to ring). Default/auto: the planner "
+                 "chooses the cheapest algorithm per collective from "
+                 "the comm.py cost formulas — the searched dimension; "
+                 "pin it to A/B a convention (forced-ring is the "
+                 "regression baseline)")
 declare_env_knob("PT_FLEET_REPLICAS",
                  "fleet tier (serving/fleet/): initial replica count "
                  "of a ReplicaPool (default 1); constructor args win")

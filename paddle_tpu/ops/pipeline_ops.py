@@ -20,7 +20,7 @@ from ..core.registry import register_op
 @register_op("pipeline")
 def pipeline_op(ctx, ins, attrs):
     from ..core import lowering
-    from ..parallel.pipeline import gpipe, sequential_stages
+    from ..parallel.pipeline import gpipe, one_f1b, sequential_stages
 
     program = ctx.program
     sub = program.block(attrs["sub_block"])
@@ -77,14 +77,20 @@ def pipeline_op(ctx, ins, attrs):
     from ..parallel.mesh import PP
     mesh = ctx.mesh
     params = tuple(stacked)
+    from ..analysis.schedule import SCHEDULES
+    schedule = str(attrs.get("schedule", "gpipe"))
+    if schedule not in SCHEDULES:
+        raise ValueError(f"pipeline: unknown schedule {schedule!r} "
+                         f"(know {' | '.join(SCHEDULES)})")
     if mesh is not None and PP in mesh.axis_names \
             and int(mesh.shape[PP]) > 1:
         pp = int(mesh.shape[PP])
         if pp != s:
             raise ValueError(f"pipeline: {s} stages but pp axis size {pp}")
         xs = x.reshape((m, b // m) + tuple(x.shape[1:]))
-        out = gpipe(lambda p, xmb: stage_fn(tuple(p), xmb), params, xs,
-                    mesh=mesh)
+        run = one_f1b if schedule == "1f1b" else gpipe
+        out = run(lambda p, xmb: stage_fn(tuple(p), xmb), params, xs,
+                  mesh=mesh)
         out = out.reshape((b,) + tuple(out.shape[2:]))
     else:
         # no pp axis: run the stages sequentially on the FULL batch — the

@@ -1,4 +1,4 @@
-"""Pipeline parallelism: the GPipe schedule over the 'pp' mesh axis.
+"""Pipeline parallelism: GPipe and 1F1B schedules over the 'pp' axis.
 
 ADDITIVE capability (SURVEY §2.4 last row: the reference has no pipeline
 parallelism; this is north-star work designed TPU-first). Homogeneous
@@ -7,6 +7,15 @@ stages hold their parameter slice on their own devices (stacked leaves
 via jax.lax.ppermute inside ONE lax.scan of S+M-1 ticks — the classic
 bubble fraction (S-1)/(S+M-1). The whole schedule is differentiable
 (scan + ppermute VJPs), so training just works through it.
+
+Two schedules, one oracle: `gpipe` runs all M microbatches through one
+fill-drain pipe (every microbatch's activations resident before the
+backward); `one_f1b` bounds the in-flight window at the pipeline depth
+S — the 1F1B stash bound the planner's memory model prices
+(analysis/schedule.stash_microbatches: min(S, M) vs GPipe's M).
+Microbatches are independent in the forward, so both schedules are
+numerically identical to `sequential_stages`, and parity tests run all
+three against each other.
 """
 
 from __future__ import annotations
@@ -19,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import DP, PP
 
-__all__ = ["gpipe", "sequential_stages"]
+__all__ = ["gpipe", "one_f1b", "sequential_stages"]
 
 
 def sequential_stages(stage_fn: Callable, params, x):
@@ -83,3 +92,32 @@ def gpipe(stage_fn: Callable, params, xs, *, mesh: Mesh, axis: str = PP):
     fn = shard_map(body, mesh=mesh, in_specs=(P(axis), x_spec),
                    out_specs=x_spec, check_vma=False)
     return fn(params, xs)
+
+
+def one_f1b(stage_fn: Callable, params, xs, *, mesh: Mesh,
+            axis: str = PP):
+    """The 1F1B-windowed schedule: microbatches enter the pipe in waves
+    of at most S in flight — the 1F1B window (stash bound min(S, M), vs
+    GPipe's M). Within a wave the fill-drain tick scan is reused
+    verbatim; forward microbatches are independent, so the output is
+    numerically identical to `gpipe`/`sequential_stages` (parity-tested)
+    — the schedule only changes ORDER.
+
+    Residency caveat (ROADMAP open item): the wave structure bounds
+    IN-FLIGHT microbatches, but jax's whole-program reverse-mode AD
+    still saves every wave's residuals until the backward runs — so on
+    THIS runtime the min(S, M) activation stash is the 1F1B schedule's
+    semantic bound (what the planner's memory model prices for the
+    deployment target), not yet a measured residency guarantee; a
+    staged custom-VJP backward is the realization path.
+
+    Same contract as gpipe: params [S, ...]-stacked over `axis`,
+    xs [M, mb, ...], returns [M, mb, ...].
+    """
+    s = int(mesh.shape[axis])
+    m = int(xs.shape[0])
+    if m <= s:
+        return gpipe(stage_fn, params, xs, mesh=mesh, axis=axis)
+    waves = [gpipe(stage_fn, params, xs[w:w + s], mesh=mesh, axis=axis)
+             for w in range(0, m, s)]
+    return jnp.concatenate(waves, axis=0)

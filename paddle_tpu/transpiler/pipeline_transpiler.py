@@ -224,24 +224,32 @@ def _carry_analysis(block, ops, start: int, w: int, r: int,
 
 def pipeline_transpile(program: Optional[Program] = None,
                        startup_program: Optional[Program] = None,
-                       num_stages: int = 1, num_microbatches: int = 4):
+                       num_stages: int = 1, num_microbatches: int = 4,
+                       schedule: str = "gpipe"):
     """Rewrite `program`'s repeated layer region into a `pipeline` op.
 
     Call BEFORE optimizer.minimize (the stacked params become the
-    trainables). Returns the region summary dict (for tests/logging).
+    trainables). The cut decision is the liveness-cut stage search
+    (analysis/schedule.stage_cut_search): cuts land on the run
+    boundaries where only the residual stream is live, carry legality
+    and per-stage param confinement checked statically — the search IS
+    the rewrite's decision procedure, and raises StageCutError (a
+    ValueError) on an illegal partition. `schedule` selects the
+    microbatch schedule the lowering runs ('gpipe' | '1f1b' —
+    parallel/pipeline.py); the placement planner retunes stages/
+    microbatches/schedule on the emitted op when a pp plan applies
+    (analysis/schedule.retune_pipeline). Returns the region summary
+    dict (for tests/logging).
     """
     program = program if program is not None else default_main_program()
     block = program.global_block
-    region = find_repeated_region(block)
-    if region is None:
-        raise ValueError(
-            "pipeline_transpile: no repeated layer region found in block 0 "
-            "(needs >= 2 structurally identical consecutive layer blocks)")
+    from ..analysis.schedule import SCHEDULES, stage_cut_search
+    if schedule not in SCHEDULES:
+        raise ValueError(f"pipeline_transpile: unknown schedule "
+                         f"{schedule!r} (know {list(SCHEDULES)})")
+    cut_plan = stage_cut_search(program, num_stages)
+    region = cut_plan.region
     start, w, r = region["start"], region["w"], region["r"]
-    if r % num_stages:
-        raise ValueError(
-            f"pipeline_transpile: {r} layers do not divide into "
-            f"{num_stages} stages")
     ops = block.ops
     occ0 = ops[start:start + w]
 
@@ -333,14 +341,16 @@ def pipeline_transpile(program: Optional[Program] = None,
                                       region["carry_out"]),
                "n_microbatches": int(num_microbatches),
                "num_stages": int(num_stages),
-               "layers_per_stage": r // int(num_stages)})
+               "layers_per_stage": r // int(num_stages),
+               "schedule": str(schedule)})
     block.ops[start:start + r * w] = [pipe_op]
     program.invalidate_cache()
 
     # post-condition gate (PT_VERIFY): the pipeline op's sub-block index
-    # and inner-var bindings must be real before anything lowers them
+    # and inner-var bindings must be real — and the emitted stage split
+    # legal (the typed pipeline-stage pass) — before anything lowers them
     from ..analysis import verify_enabled, verify_program
     if verify_enabled():
-        verify_program(program,
-                       passes=["shard-check"]).raise_if_errors()
+        verify_program(program, passes=["shard-check", "pipeline-stage"]
+                       ).raise_if_errors()
     return region

@@ -128,7 +128,7 @@ fi
 if [[ "${1:-}" == "analyze" ]]; then
   echo "== analyze: cost model + memory estimator + collective audit =="
   python -m pytest tests/test_cost_model.py tests/test_analysis.py \
-    tests/test_planner.py -q
+    tests/test_planner.py tests/test_schedule.py -q
   echo "== analyze: schema-checked cost reports (bench programs) =="
   for prog in resnet transformer decode; do
     python tools/cost_report.py "$prog" --check > /dev/null
@@ -137,11 +137,17 @@ if [[ "${1:-}" == "analyze" ]]; then
   # schema-checked on the transpiled transformer
   python tools/cost_report.py transformer --check \
     --mesh dp=8 --mesh dp=4,tp=2 --mesh dp=2,sp=2,tp=2 > /dev/null
+  # the auto-pp rewrite: stage-cut table + pipelined costing
+  python tools/cost_report.py transformer --check --pp 2 > /dev/null
   echo "== analyze: placement planner (schema-checked plans) =="
   # decode is inference-shaped (batch = engine slots); the training
   # builders plan at a dp-splittable batch
   python tools/plan.py resnet --batch 8 --check > /dev/null
   python tools/plan.py transformer --batch 8 --check > /dev/null
+  # the pp axis: pipeline-transpiled transformer, pp x dp candidates +
+  # the per-collective algorithm table, floors checked
+  python tools/plan.py transformer --batch 8 --pp 2 --microbatches 4 \
+    --check > /dev/null
   python tools/plan.py decode --batch 2 --infer --check > /dev/null
   echo "== analyze: planner rank-correlation gate (predicted vs measured"
   echo "   step-time ordering over the hand-picked dryrun meshes) =="

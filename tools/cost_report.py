@@ -60,7 +60,11 @@ def build_resnet(train: bool):
     return main, startup
 
 
-def build_transformer(train: bool):
+def build_transformer(train: bool, pp: int = 0, microbatches: int = 4):
+    """pp > 1: pipeline-transpile the repeated layer region into pp
+    stages BEFORE the optimizer builds (the auto-pp contract) — the
+    program the planner's pp x dp search and the --pp CLI flags need.
+    BENCH_TFM_LAYERS must then divide by pp."""
     from paddle_tpu.models.transformer import transformer_lm_loss
     cfg = dict(
         vocab_size=int(os.environ.get("BENCH_TFM_VOCAB", 1000)),
@@ -74,6 +78,10 @@ def build_transformer(train: bool):
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
         avg, _ = transformer_lm_loss(max_len=max(cfg["seq_len"], 128), **cfg)
+        if pp > 1:
+            from paddle_tpu.transpiler import pipeline_transpile
+            pipeline_transpile(main, startup, num_stages=pp,
+                               num_microbatches=microbatches)
         if train:
             pt.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(avg)
     return main, startup
@@ -118,6 +126,12 @@ def main(argv=None):
                     help="audit collectives on this mesh (repeatable)")
     ap.add_argument("--zero", action="store_true",
                     help="price ZeRO grad sync (reduce-scatter+all-gather)")
+    ap.add_argument("--pp", type=int, default=0,
+                    help="pipeline-transpile the transformer into this "
+                         "many stages before costing (auto-pp rewrite; "
+                         "the report gains the stage-cut table)")
+    ap.add_argument("--microbatches", type=int, default=4,
+                    help="microbatch count for --pp (default 4)")
     ap.add_argument("--plan", default=None, metavar="PLAN_JSON",
                     help="audit + re-score the program under a saved "
                          "placement plan (tools/plan.py artifact); the "
@@ -132,7 +146,20 @@ def main(argv=None):
     # inference accounting even when the builder's model appends its own
     # optimizer (resnet.get_model does)
     train = False if args.infer else None
-    program, _startup = BUILDERS[args.program](not args.infer)
+    if args.pp > 1:
+        if args.program != "transformer":
+            ap.error("--pp applies the auto-pp rewrite, which needs the "
+                     "transformer builder's repeated layer region")
+        # the cut decision itself, BEFORE the rewrite consumes it: the
+        # liveness table of every candidate boundary + the chosen cuts
+        from paddle_tpu.analysis.schedule import stage_cut_search
+        raw, _ = BUILDERS[args.program](not args.infer)
+        cut = stage_cut_search(raw, args.pp, batch=args.batch)
+        program, _startup = BUILDERS[args.program](
+            not args.infer, pp=args.pp, microbatches=args.microbatches)
+    else:
+        cut = None
+        program, _startup = BUILDERS[args.program](not args.infer)
     pc = program_cost(program, batch=args.batch, train=train)
     est = estimate_memory(program, batch=args.batch, train=train)
     chip = resolve_chip()
@@ -160,6 +187,20 @@ def main(argv=None):
         "memory": est.to_dict(),
         "prediction": pred.to_dict(),
     }
+    if cut is not None:
+        report["stage_cuts"] = {
+            "n_stages": cut.n_stages, "n_layers": cut.n_layers,
+            "layers_per_stage": cut.layers_per_stage,
+            "carry": cut.carry, "carry_bytes": cut.carry_bytes,
+            "cut_op_idx": list(cut.cut_op_idx),
+            "liveness_minimal": cut.minimal,
+            "stage_flops": list(cut.stage_flops),
+            "boundaries": [
+                {"op_idx": p.op_idx, "live_bytes": p.live_bytes,
+                 "crossing": list(p.crossing), "legal": p.legal}
+                for p in cut.cut_points],
+            "microbatches": args.microbatches,
+        }
     if args.mesh:
         report["comm"] = {}
         for spec in args.mesh:
@@ -201,6 +242,8 @@ def main(argv=None):
             "recorded_prediction": entry["prediction"],
             "prediction": rescored["prediction"],
             "peak_hbm_bytes": rescored["peak_hbm_bytes"],
+            "pipeline": entry.get("pipeline"),
+            "collectives": entry.get("collectives"),
         }
 
     text = json.dumps(report, indent=2)

@@ -69,6 +69,13 @@ def main(argv=None) -> int:
                     choices=["resnet", "transformer", "decode"],
                     help="build this bench program (tools/cost_report.py "
                          "builders) instead of loading a program JSON")
+    ap.add_argument("--pp", type=int, default=0,
+                    help="pipeline-transpile the transformer builder "
+                         "into this many stages (needed to verify a pp "
+                         "plan: the plan re-stages the program's own "
+                         "pipeline op)")
+    ap.add_argument("--microbatches", type=int, default=4,
+                    help="microbatch count for --pp (default 4)")
     ap.add_argument("--transpile", action="store_true",
                     help="run the sharding transpiler on a clone before "
                          "verifying (requires --mesh) — makes the "
@@ -123,7 +130,14 @@ def main(argv=None) -> int:
         if args.builder:
             sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
             from cost_report import BUILDERS
-            program, _startup = BUILDERS[args.builder](True)
+            if args.pp > 1:
+                if args.builder != "transformer":
+                    ap.error("--pp needs the transformer builder's "
+                             "repeated layer region")
+                program, _startup = BUILDERS[args.builder](
+                    True, pp=args.pp, microbatches=args.microbatches)
+            else:
+                program, _startup = BUILDERS[args.builder](True)
         else:
             try:
                 with open(args.program) as f:
