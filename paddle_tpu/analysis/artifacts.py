@@ -447,6 +447,94 @@ def validate_op_report(doc) -> List[str]:
 
 
 # ---------------------------------------------------------------------------
+# cost-calibration artifact floors (analysis/calibrate.py)
+# ---------------------------------------------------------------------------
+
+#: declared validity band for a per-op-type correction factor: a factor
+#: at/below the floor says the model over-predicts 20x+ (that is a
+#: broken fit, not a correction), one at/above the ceiling says the
+#: measurement was garbage (the 0.0 ms autotune poisoning, inverted).
+#: The FIT clamps into a narrower band (calibrate.FIT_FACTOR_BAND);
+#: this band is what save/load refuses outright.
+CALIB_FACTOR_FLOOR = 0.05
+CALIB_FACTOR_CEILING = 20.0
+#: a per-dispatch collective launch overhead of a full second is not a
+#: fabric constant on any hardware this repo prices — it is a clock bug
+CALIB_OVERHEAD_CEILING_S = 1.0
+
+_CALIB_REQUIRED = ("schema_version", "kind", "chip", "jax", "factors",
+                   "samples", "dispatch_overhead_s")
+
+
+def validate_calibration(doc) -> List[str]:
+    """Floor checks for a cost-calibration artifact
+    (analysis/calibrate.py), applied at SAVE and LOAD like the
+    gconv-autotune floors ([] = valid): schema-versioned, every per-op-
+    type factor finite and inside the declared band, every factor's fit
+    sample count recorded as a positive int, the fitted per-dispatch
+    collective overhead finite/non-negative/under the ceiling, and the
+    chip + jax-version provenance stamped. A calibration that fails
+    these is the cost-model analogue of a 0.0 ms autotune reading — it
+    must never correct a prediction."""
+    if not isinstance(doc, dict):
+        return [f"calibration root is {type(doc).__name__}, not an object"]
+    problems = [f"$.{k}: required field missing"
+                for k in _CALIB_REQUIRED if k not in doc]
+    if doc.get("kind") not in (None, "cost_calibration"):
+        problems.append(f"$.kind: {doc.get('kind')!r} is not "
+                        "'cost_calibration'")
+    if "schema_version" in doc and doc["schema_version"] != 1:
+        problems.append(f"$.schema_version: {doc['schema_version']!r} is "
+                        "not a known version (1)")
+    chip = doc.get("chip")
+    if "chip" in doc and (not isinstance(chip, str) or not chip.strip()):
+        problems.append(f"$.chip: {chip!r} — the fitted chip must be "
+                        "stamped (stale-calibration refusal keys on it)")
+    jaxv = doc.get("jax")
+    if "jax" in doc and not isinstance(jaxv, str):
+        problems.append(f"$.jax: {jaxv!r} is not a version string")
+    factors = doc.get("factors")
+    samples = doc.get("samples")
+    if "factors" in doc and not isinstance(factors, dict):
+        problems.append(f"$.factors: {type(factors).__name__}, not an "
+                        "object")
+        factors = {}
+    if "samples" in doc and not isinstance(samples, dict):
+        problems.append(f"$.samples: {type(samples).__name__}, not an "
+                        "object")
+        samples = {}
+    for op_type, f in (factors or {}).items():
+        if not isinstance(f, (int, float)) or isinstance(f, bool) \
+                or not math.isfinite(float(f)) \
+                or not CALIB_FACTOR_FLOOR < float(f) < CALIB_FACTOR_CEILING:
+            problems.append(
+                f"$.factors.{op_type}: {f!r} must be a finite factor "
+                f"strictly inside ({CALIB_FACTOR_FLOOR}, "
+                f"{CALIB_FACTOR_CEILING}) — outside the band it is a "
+                "broken fit, not a correction")
+        n = (samples or {}).get(op_type)
+        if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+            problems.append(
+                f"$.samples.{op_type}: {n!r} — every factor must record "
+                "its positive fit sample count")
+    ovh = doc.get("dispatch_overhead_s")
+    if "dispatch_overhead_s" in doc and (
+            not isinstance(ovh, (int, float)) or isinstance(ovh, bool)
+            or not math.isfinite(float(ovh)) or float(ovh) < 0
+            or float(ovh) >= CALIB_OVERHEAD_CEILING_S):
+        problems.append(
+            f"$.dispatch_overhead_s: {ovh!r} must be a finite "
+            f"non-negative overhead under {CALIB_OVERHEAD_CEILING_S} s")
+    fps = doc.get("fingerprints")
+    if fps is not None:
+        if not isinstance(fps, list) \
+                or not all(isinstance(f, str) and f for f in fps):
+            problems.append("$.fingerprints: must be a list of non-empty "
+                            "program-fingerprint strings when present")
+    return problems
+
+
+# ---------------------------------------------------------------------------
 # on-wire feed codec A/B floors (bench.py data_codec config)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,461 @@
+"""Self-calibrating cost model: measured correction factors fitted from
+the per-op observatory, applied to every prediction path.
+
+The static stack predicts (cost.predict_step, the planner's candidate
+scoring), the drift monitor measures live disagreement
+(pt_model_drift_ratio), and the op observatory names WHICH ops lag
+(obs/opprof.py) — but until here no measurement ever flowed back into a
+prediction. This module closes that loop, the way "Synthesizing Optimal
+Parallelism Placement and Reduction Strategies on Hierarchical Systems"
+treats measured calibration as the other half of placement synthesis:
+
+  * `fit_calibration` turns OpLedger rows (measured-vs-predicted ms per
+    op) into per-op-type MULTIPLICATIVE correction factors — the robust
+    fit is the MEDIAN ratio per type, with a minimum-sample floor
+    (fewer than MIN_SAMPLES measured rows of a type → factor 1.0, never
+    a guess from one noisy segment) and a sane-range clamp
+    (FIT_FACTOR_BAND) so one poisoned reading can't become a 40x
+    "correction";
+  * the same fit extracts the PER-DISPATCH COLLECTIVE OVERHEAD constant
+    `comm.collective_time_s` omits: the profiled per-segment step pays
+    one dispatch per segment where the fused step pays one total, so
+    (total_measured - fused_step) / (n_segments - 1) reads the
+    launch+sync overhead a scan-resident ppermute pays per tick — the
+    exact gap PR 15's rank gate documented on the dp=4,pp=2 mesh;
+  * the artifact persists beside the gconv-autotune cache, schema-
+    versioned and floor-validated at save AND load
+    (artifacts.validate_calibration), stamped with the fitted chip,
+    jax version, and source-program fingerprints so a stale calibration
+    REFUSES to apply (falls back to raw with one warning) instead of
+    silently mispricing a different fabric;
+  * `cost.op_roofline_ms` / `cost.roofline_step` /
+    `comm.collective_time_s` accept a Calibration, so `predict_step`,
+    planner scoring, and `rescore_plan` all price through ONE corrected
+    model — winning plans record `calibration_version` and the exact-
+    rescore drift property extends to calibrated plans;
+  * at runtime the Trainer watches the drift monitor: a drift_ratio
+    sustained above PT_CALIB_REPLAN_THRESHOLD for REPLAN_WINDOWS log
+    windows triggers a re-plan under the current calibration and a
+    hot-resume from the in-memory scope (`replan` trace span +
+    pt_calib_* metrics).
+
+PT_CALIB_PATH arms the ambient calibration (default_calibration); when
+unset, every prediction is raw unless a Calibration is passed
+explicitly. Pass `calibrate.RAW` to force uncalibrated pricing even
+when the env is armed (the rank gate's raw arm does)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import statistics
+import threading
+import warnings
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from . import artifacts
+
+__all__ = ["Calibration", "fit_calibration", "default_path",
+           "default_calibration", "resolve", "active_version",
+           "replan_threshold", "RAW", "METRICS",
+           "CALIB_SCHEMA_VERSION", "FIT_FACTOR_BAND", "MIN_SAMPLES",
+           "REPLAN_WINDOWS", "PATH_ENV", "REPLAN_ENV"]
+
+CALIB_SCHEMA_VERSION = 1
+
+#: the FIT's clamp band — deliberately narrower than the artifact
+#: validity band (artifacts.CALIB_FACTOR_FLOOR/CEILING): a measured
+#: median outside [0.25, 8] says the model is missing a TERM, not a
+#: factor, and shipping it as a multiplier would hide the real gap
+FIT_FACTOR_BAND: Tuple[float, float] = (0.25, 8.0)
+
+#: fewer measured rows of an op type than this → factor 1.0 (recorded
+#: with its sample count so the artifact shows WHY it stayed neutral)
+MIN_SAMPLES = 2
+
+#: fitted per-dispatch overhead clamp: a profiled overhead above 50 ms
+#: per dispatch is a contended/broken run, not a fabric constant
+OVERHEAD_FIT_CEILING_S = 0.05
+
+#: log windows the drift ratio must SUSTAIN above the threshold before
+#: the Trainer re-plans — one slow scrape is co-tenant noise, three
+#: consecutive windows is the fabric disagreeing with the model
+REPLAN_WINDOWS = 3
+
+PATH_ENV = "PT_CALIB_PATH"
+REPLAN_ENV = "PT_CALIB_REPLAN_THRESHOLD"
+
+_DEFAULT_PATH = os.path.join(os.path.expanduser("~"), ".cache",
+                             "paddle_tpu", "calibration.json")
+
+
+# ---------------------------------------------------------------------------
+# the artifact
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Calibration:
+    """A fitted, validated correction set. Immutable — its `version`
+    (content hash) is recorded into PlacementPlans, so two predictions
+    under the same Calibration object are exactly reproducible."""
+
+    factors: Mapping[str, float] = field(default_factory=dict)
+    samples: Mapping[str, int] = field(default_factory=dict)
+    dispatch_overhead_s: float = 0.0
+    chip: str = "cpu"
+    jax: str = ""
+    fingerprints: Tuple[str, ...] = ()
+
+    def factor(self, op_type: str) -> float:
+        return float(self.factors.get(op_type, 1.0))
+
+    @property
+    def version(self) -> str:
+        """Content hash — the identity plans record. Canonical JSON of
+        the correction CONTENT (not provenance prose), so re-fitting
+        identical measurements yields the identical version."""
+        payload = json.dumps(
+            {"schema_version": CALIB_SCHEMA_VERSION,
+             "factors": {k: round(float(v), 6)
+                         for k, v in sorted(self.factors.items())},
+             "dispatch_overhead_s": round(float(self.dispatch_overhead_s),
+                                          9),
+             "chip": self.chip},
+            sort_keys=True)
+        return hashlib.sha256(payload.encode()).hexdigest()[:12]
+
+    def to_doc(self) -> dict:
+        return {
+            "schema_version": CALIB_SCHEMA_VERSION,
+            "kind": "cost_calibration",
+            "version": self.version,
+            "chip": self.chip,
+            "jax": self.jax,
+            "factors": {k: round(float(v), 6)
+                        for k, v in sorted(self.factors.items())},
+            "samples": {k: int(v) for k, v in sorted(self.samples.items())},
+            "dispatch_overhead_s": float(self.dispatch_overhead_s),
+            "fingerprints": list(self.fingerprints),
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Calibration":
+        problems = artifacts.validate_calibration(doc)
+        if problems:
+            raise ValueError("invalid calibration artifact:\n  "
+                             + "\n  ".join(problems))
+        return cls(
+            factors={str(k): float(v)
+                     for k, v in doc.get("factors", {}).items()},
+            samples={str(k): int(v)
+                     for k, v in doc.get("samples", {}).items()},
+            dispatch_overhead_s=float(doc.get("dispatch_overhead_s", 0.0)),
+            chip=str(doc.get("chip", "cpu")),
+            jax=str(doc.get("jax", "")),
+            fingerprints=tuple(str(f)
+                               for f in doc.get("fingerprints") or ()))
+
+    def save(self, path: str) -> str:
+        """Validate-then-write, atomically (the gconv-autotune pattern:
+        tmp + os.replace, so a crashed writer never leaves a torn
+        artifact for the next load to trip on)."""
+        doc = self.to_doc()
+        problems = artifacts.validate_calibration(doc)
+        if problems:
+            raise ValueError("refusing to save invalid calibration:\n  "
+                             + "\n  ".join(problems))
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "Calibration":
+        with open(path) as f:
+            doc = json.load(f)
+        return cls.from_doc(doc)   # from_doc validates
+
+
+#: sentinel: force RAW (uncalibrated) pricing even when PT_CALIB_PATH
+#: is armed — the rank gate's baseline arm and delta columns use it
+RAW = object()
+
+
+# ---------------------------------------------------------------------------
+# the fit
+# ---------------------------------------------------------------------------
+
+def _iter_rows(ledger):
+    """OpLedger object or its to_dict() — the fit accepts both, so
+    `op_report --fit` works from a live profile AND from a saved
+    report JSON."""
+    if isinstance(ledger, dict):
+        att = ledger.get("attribution", ledger)
+        return att.get("rows", []), att
+    return ledger.rows, ledger
+
+
+def _row_fields(row) -> Tuple[Optional[str], Optional[float],
+                              Optional[float], bool]:
+    if isinstance(row, dict):
+        return (row.get("type"), row.get("predicted_ms"),
+                row.get("measured_ms"), bool(row.get("covered", False)))
+    return (row.op_type, row.predicted_ms, row.measured_ms,
+            bool(row.covered))
+
+
+def _ledger_attr(led, name, default=None):
+    if isinstance(led, dict):
+        return led.get(name, default)
+    return getattr(led, name, default)
+
+
+def fit_calibration(ledgers: Sequence,
+                    *,
+                    min_samples: int = MIN_SAMPLES,
+                    band: Tuple[float, float] = FIT_FACTOR_BAND,
+                    fingerprints: Optional[Sequence[str]] = None,
+                    dispatch_overhead_s: Optional[float] = None
+                    ) -> Calibration:
+    """The robust fit: per op type, factor = median(measured/predicted)
+    over every COVERED, MEASURED row across all ledgers, clamped into
+    `band`; types with fewer than `min_samples` ratios stay 1.0 (their
+    observed count is still recorded). One noisy segment therefore
+    moves a median by at most one rank and can never push a factor
+    outside the band — the poisoned-autotune lesson applied to fitting.
+
+    `dispatch_overhead_s=None` fits the per-dispatch collective
+    overhead from the same profiles: each ledger's per-segment sweep
+    paid (n_measured_segments) dispatches where the fused step paid
+    one, so the per-ledger estimate is
+    (total_measured_ms - fused_step_ms) / (n_segments - 1), and the
+    cross-ledger median (clamped to [0, OVERHEAD_FIT_CEILING_S])
+    becomes the constant comm.collective_time_s adds per collective."""
+    if not ledgers:
+        raise ValueError("fit_calibration needs at least one OpLedger")
+    lo, hi = band
+    ratios: Dict[str, List[float]] = {}
+    counts: Dict[str, int] = {}
+    overheads_ms: List[float] = []
+    chip = None
+    fps: List[str] = list(fingerprints or [])
+    for led in ledgers:
+        rows, att = _iter_rows(led)
+        chip = chip or _ledger_attr(att, "chip")
+        fp = _ledger_attr(att, "fingerprint")
+        if fp and fp not in fps and fingerprints is None:
+            fps.append(str(fp))
+        for row in rows:
+            op_type, pred, meas, covered = _row_fields(row)
+            if not op_type:
+                continue
+            counts[op_type] = counts.get(op_type, 0) + 1
+            if not covered or meas is None or pred is None:
+                continue
+            pred = float(pred)
+            meas = float(meas)
+            if pred <= 0.0 or meas <= 0.0 \
+                    or not math.isfinite(pred) or not math.isfinite(meas):
+                continue
+            ratios.setdefault(op_type, []).append(meas / pred)
+        if dispatch_overhead_s is None:
+            total = _ledger_attr(att, "total_measured_ms")
+            fused = _ledger_attr(att, "fused_step_ms")
+            segs = _ledger_attr(att, "segments") or []
+            n_meas = sum(
+                1 for s in segs
+                if (s.get("measured_fwd_ms") if isinstance(s, dict)
+                    else s.measured_fwd_ms) is not None)
+            if total and fused and n_meas > 1:
+                per = (float(total) - float(fused)) / (n_meas - 1)
+                overheads_ms.append(max(0.0, per))
+    factors: Dict[str, float] = {}
+    samples: Dict[str, int] = {}
+    for op_type, rs in sorted(ratios.items()):
+        samples[op_type] = len(rs)
+        if len(rs) < max(int(min_samples), 1):
+            factors[op_type] = 1.0
+            continue
+        factors[op_type] = min(hi, max(lo, statistics.median(rs)))
+    if dispatch_overhead_s is None:
+        ovh = (statistics.median(overheads_ms) / 1e3
+               if overheads_ms else 0.0)
+        dispatch_overhead_s = min(OVERHEAD_FIT_CEILING_S, max(0.0, ovh))
+    jax_version = ""
+    try:
+        import jax
+        jax_version = str(jax.__version__)
+    except Exception:   # noqa: BLE001 — provenance, not a dependency
+        pass
+    return Calibration(factors=factors, samples=samples,
+                       dispatch_overhead_s=float(dispatch_overhead_s),
+                       chip=str(chip or "cpu"), jax=jax_version,
+                       fingerprints=tuple(fps))
+
+
+# ---------------------------------------------------------------------------
+# ambient calibration (the PT_CALIB_PATH env arm)
+# ---------------------------------------------------------------------------
+
+def default_path() -> str:
+    return os.environ.get(PATH_ENV, "").strip() or _DEFAULT_PATH
+
+_memo_lock = threading.Lock()
+_memo: Optional[Tuple[str, float, Optional[Calibration]]] = None
+
+
+def default_calibration() -> Optional[Calibration]:
+    """The ambient Calibration, armed ONLY by an explicit PT_CALIB_PATH
+    — the home-dir default path is where `op_report --fit` writes, but
+    it is never read implicitly (a leftover fit from last week must not
+    silently change every prediction in an unrelated process). Memoized
+    by (path, mtime): a refit on disk is picked up on the next call
+    without a reload knob. Never raises — a broken artifact warns once
+    and prices raw."""
+    global _memo
+    path = os.environ.get(PATH_ENV, "").strip()
+    if not path:
+        return None
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        _warn_once(f"{PATH_ENV}={path}: not readable; pricing raw")
+        return None
+    with _memo_lock:
+        if _memo and _memo[0] == path and _memo[1] == mtime:
+            return _memo[2]
+    try:
+        cal: Optional[Calibration] = Calibration.load(path)
+    except Exception as e:   # noqa: BLE001 — never kill a prediction
+        _warn_once(f"{PATH_ENV}={path}: {e}; pricing raw")
+        cal = None
+    with _memo_lock:
+        _memo = (path, mtime, cal)
+    return cal
+
+
+def active_version() -> Optional[str]:
+    """Version of the ambient calibration (pt_build_info label), or
+    None when unarmed/broken."""
+    cal = default_calibration()
+    return cal.version if cal is not None else None
+
+
+# ---------------------------------------------------------------------------
+# staleness refusal
+# ---------------------------------------------------------------------------
+
+_warned = set()
+_warned_lock = threading.Lock()
+
+
+def _warn_once(msg: str) -> None:
+    with _warned_lock:
+        if msg in _warned:
+            return
+        _warned.add(msg)
+    warnings.warn(msg, stacklevel=3)
+
+
+def resolve(cal, chip: Optional[str] = None,
+            fingerprint: Optional[str] = None,
+            context: str = "") -> Optional[Calibration]:
+    """Staleness gate every consumer prices through: returns the
+    Calibration if it applies, None (= raw) with ONE warning if it is
+    stale. A calibration fitted on another chip is refused outright; a
+    calibration stamped with source fingerprints is refused for a
+    program not among them (empty fingerprints = program-agnostic —
+    per-op-TYPE factors transfer across programs on the same fabric).
+    `RAW` and None pass through as None."""
+    if cal is None or cal is RAW:
+        return None
+    if chip and cal.chip and chip != cal.chip:
+        _warn_once(
+            f"calibration {cal.version} fitted on chip {cal.chip!r} does "
+            f"not apply to {chip!r}{' (' + context + ')' if context else ''}"
+            "; pricing raw")
+        return None
+    if fingerprint and cal.fingerprints \
+            and str(fingerprint) not in cal.fingerprints:
+        _warn_once(
+            f"calibration {cal.version} was fitted from programs "
+            f"{list(cal.fingerprints)}, not {str(fingerprint)!r}"
+            f"{' (' + context + ')' if context else ''}; pricing raw")
+        return None
+    return cal
+
+
+# ---------------------------------------------------------------------------
+# re-plan knob + metrics (the Trainer's loop closure)
+# ---------------------------------------------------------------------------
+
+def replan_threshold() -> float:
+    """PT_CALIB_REPLAN_THRESHOLD as a float drift-ratio ceiling;
+    unset/non-positive = re-planning off."""
+    from ..flags import env_knob_float
+    return env_knob_float(REPLAN_ENV, 0.0)
+
+
+class ReplanMetrics:
+    """pt_calib_* exposition source (obs/metrics.py section 'calib'):
+    how many times the loop closed, the current sustain streak, and
+    the calibration identity in play."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.replans = 0
+        self.drift_streak = 0
+        self.last_drift_ratio: Optional[float] = None
+        self.last_version: Optional[str] = None
+
+    def note_window(self, ratio: Optional[float], over: bool) -> int:
+        with self._lock:
+            self.last_drift_ratio = ratio
+            self.drift_streak = self.drift_streak + 1 if over else 0
+            return self.drift_streak
+
+    def note_replan(self, version: Optional[str]) -> None:
+        with self._lock:
+            self.replans += 1
+            self.drift_streak = 0
+            self.last_version = version
+
+    def reset(self) -> None:
+        with self._lock:
+            self.replans = 0
+            self.drift_streak = 0
+            self.last_drift_ratio = None
+            self.last_version = None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "replans": self.replans,
+                "drift_streak": self.drift_streak,
+                "threshold": replan_threshold(),
+                "last_drift_ratio": self.last_drift_ratio,
+                "calibration_version": (self.last_version
+                                        or active_version()),
+            }
+
+
+METRICS = ReplanMetrics()
+
+
+def _register_metrics() -> None:
+    try:
+        from ..obs.metrics import REGISTRY
+        REGISTRY.register("calib", "trainer", METRICS)
+    except Exception:   # noqa: BLE001 — metrics plane is optional here
+        pass
+
+
+_register_metrics()

@@ -621,31 +621,50 @@ def _ring_steps(kind: str, n: int) -> int:
     return 2 * (n - 1) if kind == "all_reduce" else (n - 1)
 
 
+def per_dispatch_overhead_s(calibration=None) -> float:
+    """The fitted per-dispatch launch+sync constant a collective pays
+    ON TOP of the wire/latency formulas below — 0.0 uncalibrated (the
+    pre-calibration numbers, exactly). One place defines it so the
+    planner's scan-resident ppermute leg (hops x this — the PR-15 rank-
+    gate gap: a pipeline pays it once per scan TICK, which the pure
+    byte model cannot see) and collective_time_s price the same
+    constant."""
+    if calibration is None:
+        return 0.0
+    return float(calibration.dispatch_overhead_s)
+
+
 def collective_time_s(c: Collective, algo: str, sizes: Dict[str, int],
-                      topology) -> Optional[float]:
+                      topology, calibration=None) -> Optional[float]:
     """Predicted seconds for `c` under `algo` on `topology` (duck-typed:
     needs ici_bandwidth_gbps() / dci_gbps / chips_per_host — a
     parallel/mesh.py Topology). Returns None when the algorithm has no
     implementation for this collective (tree rotation, hierarchical on a
-    single-host group) — the chooser skips it. Pure host-side math."""
+    single-host group) — the chooser skips it. Pure host-side math.
+
+    A Calibration adds its fitted per-dispatch overhead ONCE per
+    collective — a constant addend across algorithms, so the chooser's
+    argmin (and therefore every recorded plan's algorithm column) is
+    identical calibrated or raw; only the priced total moves."""
     intra, inter = group_host_split(sizes, c.axes, topology.chips_per_host)
     crosses = inter > 1
     ici = float(topology.ici_bandwidth_gbps()) * 1e9
     dci = float(topology.dci_gbps) * 1e9
     n = max(1, c.group)
     payload = float(c.payload_bytes)
+    overhead = per_dispatch_overhead_s(calibration)
     # a flat schedule on a spanning group is throttled by its slowest
     # link: every hop pays the DCI tier
     bw, lat = (dci, DCI_HOP_LATENCY_S) if crosses \
         else (ici, ICI_HOP_LATENCY_S)
     if algo == "ring":
-        return c.wire_bytes / bw + _ring_steps(c.kind, n) * lat
+        return c.wire_bytes / bw + _ring_steps(c.kind, n) * lat + overhead
     if algo == "tree":
         if c.kind not in _TREE_KINDS:
             return None
         depth = max(1, math.ceil(math.log2(n)))
         trips = 2 if c.kind == "all_reduce" else 1
-        return trips * (payload / bw + depth * lat)
+        return trips * (payload / bw + depth * lat) + overhead
     if algo == "hierarchical":
         # ICI reduce-scatter -> DCI ring over the 1/intra shard -> ICI
         # all-gather; only meaningful for spanning reduction groups with
@@ -658,13 +677,14 @@ def collective_time_s(c: Collective, algo: str, sizes: Dict[str, int],
             shard / inter / dci + DCI_HOP_LATENCY_S)
         if c.kind == "all_reduce":
             t_ici *= 2  # reduce-scatter in, all-gather out
-        return t_ici + t_dci
+        return t_ici + t_dci + overhead
     raise ValueError(f"unknown collective algorithm {algo!r} "
                      f"(know {list(ALGORITHMS)})")
 
 
 def choose_algorithm(c: Collective, sizes: Dict[str, int], topology,
-                     force: Optional[str] = None) -> Tuple[str, float, bool]:
+                     force: Optional[str] = None,
+                     calibration=None) -> Tuple[str, float, bool]:
     """(algorithm, predicted seconds, crosses_hosts) for one collective:
     the cheapest applicable algorithm, or `force` where applicable
     (falling back to ring — ring implements everything). Ties break
@@ -672,14 +692,18 @@ def choose_algorithm(c: Collective, sizes: Dict[str, int], topology,
     _, inter = group_host_split(sizes, c.axes, topology.chips_per_host)
     crosses = inter > 1
     if force is not None:
-        t = collective_time_s(c, force, sizes, topology)
+        t = collective_time_s(c, force, sizes, topology,
+                              calibration=calibration)
         if t is None:
             force = "ring"
-            t = collective_time_s(c, "ring", sizes, topology)
+            t = collective_time_s(c, "ring", sizes, topology,
+                                  calibration=calibration)
         return force, float(t), crosses
-    best = ("ring", collective_time_s(c, "ring", sizes, topology))
+    best = ("ring", collective_time_s(c, "ring", sizes, topology,
+                                      calibration=calibration))
     for algo in ("tree", "hierarchical"):
-        t = collective_time_s(c, algo, sizes, topology)
+        t = collective_time_s(c, algo, sizes, topology,
+                              calibration=calibration)
         if t is not None and t < best[1]:
             best = (algo, t)
     return best[0], float(best[1]), crosses
@@ -687,16 +711,20 @@ def choose_algorithm(c: Collective, sizes: Dict[str, int], topology,
 
 def choose_algorithms(collectives: Sequence[Collective],
                       sizes: Dict[str, int], topology,
-                      force: Optional[str] = None
+                      force: Optional[str] = None,
+                      calibration=None
                       ) -> Tuple[float, List[dict]]:
     """Per-collective algorithm choice over a whole audit: returns
     (total predicted comm seconds, the algorithm table) — the planner's
     comm leg and the plan artifact's `collectives` record. Deterministic
-    (rescore_plan must reproduce the search's choice exactly)."""
+    (rescore_plan must reproduce the search's choice exactly — and the
+    calibrated overhead is a constant per collective, so the choice
+    itself never depends on whether a calibration was applied)."""
     total = 0.0
     table: List[dict] = []
     for c in collectives:
-        algo, t, crosses = choose_algorithm(c, sizes, topology, force)
+        algo, t, crosses = choose_algorithm(c, sizes, topology, force,
+                                            calibration=calibration)
         total += t
         table.append({
             "kind": c.kind, "op_type": c.op_type, "var": c.var,

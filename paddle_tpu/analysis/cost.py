@@ -627,9 +627,29 @@ def resolve_chip(device=None) -> ChipSpec:
     return chip_spec_for(getattr(device, "device_kind", str(device)))
 
 
+def calibration_scale(per_op, chip: ChipSpec, calibration=None) -> float:
+    """The whole-program correction the per-op-type factors imply: the
+    RAW-roofline-ms-weighted mean factor over `per_op` (ProgramCost
+    .per_op — (index, op_type, OpCost) triples). Weighting by each op's
+    raw roofline share makes the scale exactly the calibrated-sum /
+    raw-sum ratio — a factor on an op that is 60% of the step moves the
+    step 60% as far as the factor says, and ops the fit never measured
+    (factor 1.0) dilute it honestly. 1.0 when uncalibrated or when
+    nothing has weight (an empty program prices raw)."""
+    if calibration is None or not per_op:
+        return 1.0
+    total = 0.0
+    corrected = 0.0
+    for _idx, op_type, c in per_op:
+        ms, _bound = op_roofline_ms(c, chip)
+        total += ms
+        corrected += ms * calibration.factor(op_type)
+    return corrected / total if total > 0.0 else 1.0
+
+
 def roofline_step(hw_mxu_flops: float, hbm_bytes: float,
                   model_mxu_flops: float, n_dev: int, chip: ChipSpec,
-                  t_comm_s: float):
+                  t_comm_s: float, calibration=None, per_op=None):
     """The shared roofline: per-device compute/HBM legs vs an
     already-priced comm leg, overlap-as-max step time, the bound
     tie-break, and predicted MFU. ONE definition — predict_step and the
@@ -639,9 +659,20 @@ def roofline_step(hw_mxu_flops: float, hbm_bytes: float,
 
     Returns (t_compute_s, t_hbm_s, t_step_s, bound, predicted_mfu).
     hw_mxu_flops is hardware MXU work (model + remat recompute);
-    model_mxu_flops is the MFU numerator (recompute excluded)."""
-    t_compute = (hw_mxu_flops / n_dev) / chip.peak_flops
-    t_hbm = (hbm_bytes / n_dev) / (chip.hbm_gbps * 1e9)
+    model_mxu_flops is the MFU numerator (recompute excluded).
+
+    A Calibration (with the program's ProgramCost.per_op triples)
+    scales BOTH device legs by calibration_scale — one measured
+    whole-program correction, so the bound tie-break between compute
+    and bandwidth is unchanged (one factor scales both) and MFU falls
+    exactly as far as the fabric measured slower. The comm leg arrives
+    already calibrated: the CALLER scales its wire part by the same
+    calibration_scale (the fit cannot observe collectives, and a
+    partially-scaled roofline would not stay monotone in the raw one)
+    and adds the measured per-dispatch constants unscaled."""
+    scale = calibration_scale(per_op, chip, calibration)
+    t_compute = scale * (hw_mxu_flops / n_dev) / chip.peak_flops
+    t_hbm = scale * (hbm_bytes / n_dev) / (chip.hbm_gbps * 1e9)
     t = max(t_compute, t_hbm, t_comm_s, 1e-12)
     # tie-break: compute wins any tie; comm beats bandwidth only strictly
     if t_compute >= t_hbm and t_compute >= t_comm_s:
@@ -654,18 +685,26 @@ def roofline_step(hw_mxu_flops: float, hbm_bytes: float,
     return t_compute, t_hbm, t, bound, mfu
 
 
-def op_roofline_ms(c: OpCost, chip: ChipSpec) -> Tuple[float, str]:
+def op_roofline_ms(c: OpCost, chip: ChipSpec, op_type: str = None,
+                   calibration=None) -> Tuple[float, str]:
     """ONE op's roofline time on `chip`: max of the MXU-compute and
     HBM-traffic legs (the same two device legs roofline_step overlaps
     for the whole program), in ms, plus the leg that set it. The per-op
     profiler (obs/opprof.py) uses this both as each op's predicted_ms
     and as the weight that distributes a measured segment's time across
     its member ops — so the ledger's predicted column and its
-    attribution shares come from one formula."""
+    attribution shares come from one formula.
+
+    With a Calibration and the op's type, the measured per-op-type
+    correction factor multiplies the time (the bound stays the raw
+    leg: one factor scales both legs, so their order is unchanged)."""
     t_compute = c.mxu_flops / chip.peak_flops
     t_hbm = c.bytes_total / (chip.hbm_gbps * 1e9)
     bound = "compute" if t_compute >= t_hbm else "bandwidth"
-    return max(t_compute, t_hbm) * 1e3, bound
+    ms = max(t_compute, t_hbm) * 1e3
+    if calibration is not None and op_type:
+        ms *= calibration.factor(op_type)
+    return ms, bound
 
 
 def predict_grouped_conv_ms(n, cin, h, w, cout, groups, stride, k=3,
@@ -776,7 +815,7 @@ def feed_wire_mbps() -> float:
 def predict_step(program: Optional[Program] = None, batch: int = 1,
                  chip: Optional[ChipSpec] = None, mesh=None,
                  train: Optional[bool] = None,
-                 comm_report=None) -> Prediction:
+                 comm_report=None, calibration=None) -> Prediction:
     """Roofline prediction for one step of block 0.
 
     The device legs overlap on real hardware (XLA's latency-hiding
@@ -792,8 +831,28 @@ def predict_step(program: Optional[Program] = None, batch: int = 1,
     max, the declared bound is `host` — the thin-pipe reading BENCH r05
     measured, now predicted. Unset, the leg is 0 and predictions are
     byte-identical to before.
+
+    `calibration`: None reads the ambient PT_CALIB_PATH artifact
+    (calibrate.default_calibration — unset env means raw, exactly the
+    pre-calibration numbers); `calibrate.RAW` forces raw; an explicit
+    Calibration is staleness-checked (chip + program fingerprint) and
+    falls back to raw with one warning if it does not apply. Applied:
+    the device legs scale by the measured per-op-type factors
+    (roofline_step) and the audited collective set pays the fitted
+    per-dispatch overhead once on the comm leg (one combined dispatch
+    group per step — the XLA collective-combiner behavior PR 15's rank
+    gate documented).
     """
     chip = chip or resolve_chip()
+    from . import calibrate
+    if calibration is None:
+        calibration = calibrate.default_calibration()
+    try:
+        fp = (program or default_main_program()).fingerprint()
+    except Exception:   # noqa: BLE001 — a fingerprint failure prices raw
+        fp = None
+    cal = calibrate.resolve(calibration, chip=chip.name, fingerprint=fp,
+                            context="predict_step")
     pc = program_cost(program, batch=batch, train=train)
     flops = pc.train.mxu_flops + pc.train.vector_flops
     # hardware MXU work: the model flops plus the remat segments' forward
@@ -803,19 +862,38 @@ def predict_step(program: Optional[Program] = None, batch: int = 1,
     hbm = pc.train_bytes
     comm_bytes = 0
     n_dev = 1
+    n_coll = 0
     if comm_report is not None:
         axes = dict(comm_report.axis_sizes)
         n_dev = max(1, _prod(list(axes.values())))
         comm_bytes = comm_report.total_bytes
+        n_coll = len(comm_report.collectives)
     elif mesh is not None:
         from .comm import audit_collectives, mesh_axis_sizes
         axes = mesh_axis_sizes(mesh)
         n_dev = max(1, _prod(list(axes.values())))
         report = audit_collectives(program, axes, batch=batch)
         comm_bytes = report.total_bytes
-    t_comm = comm_bytes / (chip.ici_gbps * 1e9)
+        n_coll = len(report.collectives)
+    # fabric scale first, measured dispatch constant second: the fit
+    # cannot observe collectives (profiles are single-device), so the
+    # wire leg rides the SAME fitted scale as the device legs — scaling
+    # only the legs the fit saw would let the bound flip to an unscaled
+    # leg and break the monotone raw->calibrated property the rank gate
+    # pins. The per-dispatch constant then adds UNSCALED: it is a
+    # wall-clock reading, not a modeled time.
+    t_comm = (comm_bytes / (chip.ici_gbps * 1e9)
+              * calibration_scale(pc.per_op, chip, cal))
+    if cal is not None and n_coll:
+        # ONE per-dispatch overhead for the whole audited set: XLA's
+        # collective combiner folds a step's inline collectives into a
+        # single dispatch group (planner._score prices the same way;
+        # scan-resident ppermutes, which dispatch per tick, pay per hop
+        # there)
+        t_comm += cal.dispatch_overhead_s
     t_compute, t_hbm, t, bound, mfu = roofline_step(
-        mxu, hbm, pc.train.mxu_flops, n_dev, chip, t_comm)
+        mxu, hbm, pc.train.mxu_flops, n_dev, chip, t_comm,
+        calibration=cal, per_op=pc.per_op)
     feed_bytes = program_feed_bytes(program, batch=batch)
     mbps = feed_wire_mbps()
     t_feed = feed_bytes / (mbps * 1e6) if mbps else 0.0

@@ -65,8 +65,8 @@ from ..core.program import (Program, default_main_program,
 from ..flags import env_knob_int
 from ..parallel.mesh import DP, EP, PP, SP, TP, Topology
 from .comm import (ALGORITHMS, _normalize, _spec_factor, audit_collectives,
-                   choose_algorithms)
-from .cost import _prod, program_cost, roofline_step
+                   choose_algorithms, per_dispatch_overhead_s)
+from .cost import _prod, calibration_scale, program_cost, roofline_step
 from .memory import (_classify, batch_shard_factor, estimate_memory,
                      safe_nbytes_raw)
 from . import schedule as sched_mod
@@ -463,7 +463,8 @@ def _plan_memory(program_t: Program, sizes: Dict[str, int],
 
 
 def _score(program_t: Program, axes: Dict[str, int], topology: Topology,
-           batch: int, zero: bool, coll_force: Optional[str] = None
+           batch: int, zero: bool, coll_force: Optional[str] = None,
+           calibration=None
            ) -> Tuple[dict, int, Dict[str, int], List[dict],
                       Optional[dict]]:
     """Memory gate -> collective audit -> per-collective algorithm
@@ -474,7 +475,13 @@ def _score(program_t: Program, axes: Dict[str, int], topology: Topology,
     applied plan re-scores through (rescore_plan), so it must stay
     deterministic. pp facts (stages/microbatches/schedule) come from the
     prepared program's own pipeline op, so search-time scoring and plan
-    re-scoring read one truth."""
+    re-scoring read one truth.
+
+    `calibration` must arrive already RESOLVED (calibrate.resolve —
+    plan_placement / rescore_plan gate staleness at their entries):
+    the same Calibration object then yields the identical prediction
+    here every time, which is what extends the exact-rescore drift
+    property to calibrated plans."""
     sizes = {a: int(s) for a, s in axes.items()}
     pp = sizes.get(PP, 1)
     pipe_facts = sched_mod.pipeline_facts(program_t) if pp > 1 else None
@@ -519,6 +526,24 @@ def _score(program_t: Program, axes: Dict[str, int], topology: Topology,
     # forced-ring regression baseline).
     t_comm, coll_table = choose_algorithms(report.collectives, sizes,
                                            topology, force=coll_force)
+    # fabric scale first, measured dispatch constants second: the fit
+    # cannot observe collectives (profiles are single-device), so the
+    # wire legs ride the SAME fitted scale as the device legs — scaling
+    # only the legs the fit saw would let a candidate's bound flip to
+    # an unscaled leg and collapse the predicted ordering (calibrated
+    # pricing must stay a monotone transform of the byte model; only
+    # dispatch COUNTS may reorder candidates)
+    cal_scale = calibration_scale(pc.per_op, chip, calibration)
+    t_comm *= cal_scale
+    # the fitted per-dispatch constant lands per DISPATCH, not per
+    # table row: XLA's collective combiner folds a step's inline
+    # collectives into one dispatch group (the PR-15 rank-gate finding
+    # — per-row overheads are hidden for inline meshes), so the whole
+    # audited table pays the constant ONCE. Scan-resident ppermutes
+    # are priced per hop below — the combiner cannot reach across scan
+    # iterations.
+    if coll_table:
+        t_comm += per_dispatch_overhead_s(calibration)
     infl = 1.0
     if pipe_facts is not None:
         s_stages = pipe_facts["stages"]
@@ -538,6 +563,12 @@ def _score(program_t: Program, axes: Dict[str, int], topology: Topology,
         hops = (2 if pc.has_backward else 1) * ticks
         t_p2p, pp_crosses = sched_mod.p2p_time_s(p2p, hops, sizes,
                                                  topology)
+        t_p2p *= cal_scale   # same fabric scale as every wire leg
+        # the scan-resident ppermute dispatches once per pipe tick (not
+        # once per step like an audited collective), so under a
+        # calibration it pays the fitted per-dispatch overhead PER HOP —
+        # the PR-15 rank-gate gap the pure byte model could not price
+        t_p2p += hops * per_dispatch_overhead_s(calibration)
         t_comm += t_p2p
         # the inter-stage p2p IS a collective of the plan — a neighbor
         # ppermute over pp — so it rides the algorithm table like every
@@ -565,7 +596,8 @@ def _score(program_t: Program, axes: Dict[str, int], topology: Topology,
     wire_dci = sum(c["wire_bytes"] for c in coll_table
                    if c["crosses_hosts"])
     t_compute, t_hbm, t, bound, mfu = roofline_step(
-        mxu * infl, hbm * infl, pc.train.mxu_flops, n_dev, chip, t_comm)
+        mxu * infl, hbm * infl, pc.train.mxu_flops, n_dev, chip, t_comm,
+        calibration=calibration, per_op=pc.per_op)
     prediction = {
         "flops": int(flops), "hbm_bytes": int(hbm),
         "comm_bytes": int(wire_ici + wire_dci),
@@ -585,7 +617,8 @@ def score_mesh(program: Program, axes: Dict[str, int], topology: Topology,
                sp_mode: Optional[str] = None,
                microbatches: Optional[int] = None,
                pp_schedule: Optional[str] = None,
-               coll_algo: Optional[str] = None) -> dict:
+               coll_algo: Optional[str] = None,
+               calibration=None) -> dict:
     """Prepare + score ONE candidate placement (the search's inner loop,
     exposed for the rank-correlation gate and tests). Raises
     PlacementRejected when the candidate fails a pruning stage. pp
@@ -593,7 +626,14 @@ def score_mesh(program: Program, axes: Dict[str, int], topology: Topology,
     program; microbatches/pp_schedule select the schedule the clone is
     retuned to (defaults: PT_PLAN_MICROBATCH, '1f1b'). coll_algo pins
     the per-collective reduction algorithm ('ring'|'tree'|
-    'hierarchical'; default PT_PLAN_COLL or per-collective choice)."""
+    'hierarchical'; default PT_PLAN_COLL or per-collective choice).
+
+    `calibration` is applied as given (no staleness re-check here —
+    plan_placement resolves at its entry; the rank gate deliberately
+    passes one resolved Calibration across mesh REBUILDS whose
+    fingerprints differ from the fit's). The candidate records the
+    calibration's version so an applied plan knows the corrected model
+    it was chosen under."""
     traits = _traits(program, batch)
     pp = int(axes.get(PP, 1))
     m = _default_microbatches(microbatches, batch) if pp > 1 else None
@@ -602,7 +642,8 @@ def score_mesh(program: Program, axes: Dict[str, int], topology: Topology,
                                 traits, microbatches=m,
                                 pp_schedule=pp_schedule)
     prediction, peak, breakdown, coll_table, pipe_info = _score(
-        program_t, axes, topology, batch, zero, coll_force=force)
+        program_t, axes, topology, batch, zero, coll_force=force,
+        calibration=calibration)
     cand = {
         "mesh": {a: int(s) for a, s in axes.items()},
         "zero": bool(zero), "sp_mode": sp_mode,
@@ -618,6 +659,8 @@ def score_mesh(program: Program, axes: Dict[str, int], topology: Topology,
         "coll_algo": force or "auto",
         "program_fingerprint": program.fingerprint(),
     }
+    if calibration is not None:
+        cand["calibration_version"] = calibration.version
     if pipe_info is not None:
         cand["pipeline"] = pipe_info
     return cand
@@ -688,7 +731,8 @@ def plan_placement(program: Optional[Program] = None,
                    pp_schedules: Sequence[str] = sched_mod.SCHEDULES,
                    coll_algo: Optional[str] = None,
                    beam: Optional[int] = None,
-                   program_name: str = "") -> PlanArtifact:
+                   program_name: str = "",
+                   calibration=None) -> PlanArtifact:
     """Search placements for `program` on `topology` at global `batch`.
 
     Pure host-side static analysis: candidates are transpiled CLONES,
@@ -702,11 +746,25 @@ def plan_placement(program: Optional[Program] = None,
     overrides, '0' disables), each scored per schedule in pp_schedules
     at `microbatches` (PT_PLAN_MICROBATCH, default 4). Every candidate's
     comm leg synthesizes the reduction algorithm per collective
-    (ring/tree/hierarchical; coll_algo / PT_PLAN_COLL pins one)."""
+    (ring/tree/hierarchical; coll_algo / PT_PLAN_COLL pins one).
+
+    `calibration=None` reads the ambient PT_CALIB_PATH artifact
+    (calibrate.default_calibration); calibrate.RAW forces raw pricing.
+    The calibration is staleness-resolved ONCE here (topology chip +
+    this program's fingerprint — stale falls back to raw with one
+    warning) and then every candidate scores through the same corrected
+    model; the artifact records calibration_version so rescore_plan can
+    refuse a version drift."""
     program = program or default_main_program()
     topology = topology or default_topology()
     width = _beam_width(beam)
     force = _coll_force(coll_algo)
+    from . import calibrate
+    if calibration is None:
+        calibration = calibrate.default_calibration()
+    calibration = calibrate.resolve(
+        calibration, chip=topology.chip_spec().name,
+        fingerprint=program.fingerprint(), context="plan_placement")
     plans: List[dict] = []
     scored: List[dict] = []
     rejections: List[dict] = []
@@ -724,7 +782,8 @@ def plan_placement(program: Optional[Program] = None,
         try:
             cand = score_mesh(program, axes, topology, batch, zero=zero,
                               sp_mode=sp_mode, microbatches=mb,
-                              pp_schedule=pp_sched, coll_algo=force)
+                              pp_schedule=pp_sched, coll_algo=force,
+                              calibration=calibration)
         except PlacementRejected as e:
             rejections.append(dict(desc, stage=e.stage, reason=e.reason))
             return
@@ -794,6 +853,8 @@ def plan_placement(program: Optional[Program] = None,
         "rejections": rejections[:200],
         "rejections_truncated": max(0, len(rejections) - 200),
     }
+    if calibration is not None:
+        doc["calibration_version"] = calibration.version
     return PlanArtifact(doc)
 
 
@@ -871,12 +932,37 @@ def apply_plan(program: Program, plan) -> Dict[str, int]:
 
 
 def rescore_plan(program: Program, plan, topology: Optional[Topology] = None,
-                 batch: Optional[int] = None) -> dict:
+                 batch: Optional[int] = None, calibration=None) -> dict:
     """Apply `plan` to a CLONE of `program` and re-run the scoring leg.
     The returned prediction must equal the plan's recorded one — the
-    no-search/score-drift property tests/test_planner.py pins."""
+    no-search/score-drift property tests/test_planner.py pins, and it
+    EXTENDS to calibrated plans: a plan recording calibration_version V
+    re-scored under the same Calibration reproduces its prediction
+    exactly.
+
+    calibration=None re-derives from the plan itself: a plan recording
+    a calibration_version loads the ambient artifact (PT_CALIB_PATH)
+    and checks the version matches — a refit-since-then or a missing
+    artifact warns and re-scores raw (the honest comparison is then
+    visibly against the uncorrected model). Raw plans re-score raw.
+    calibrate.RAW forces raw; an explicit Calibration is used as
+    given."""
     plan = resolve_plan(plan)
     topology = topology or default_topology()
+    from . import calibrate
+    recorded = plan.get("calibration_version")
+    if calibration is None and recorded:
+        ambient = calibrate.default_calibration()
+        if ambient is None or ambient.version != recorded:
+            have = ambient.version if ambient is not None else "none"
+            warnings.warn(
+                f"plan was scored under calibration {recorded} but the "
+                f"ambient calibration is {have} — re-scoring RAW; expect "
+                "prediction drift against the recorded one", stacklevel=2)
+        else:
+            calibration = ambient
+    cal = calibrate.resolve(calibration, chip=topology.chip_spec().name,
+                            context="rescore_plan")
     clone = program.clone()
     axes = apply_plan(clone, plan)
     b = int(plan.get("batch", 1)) if batch is None else batch
@@ -884,7 +970,7 @@ def rescore_plan(program: Program, plan, topology: Optional[Topology] = None,
     force = None if force in (None, "auto") else str(force)
     prediction, peak, breakdown, coll_table, pipe_info = _score(
         clone, axes, topology, b, bool(plan.get("zero")),
-        coll_force=force)
+        coll_force=force, calibration=cal)
     return {"prediction": prediction, "peak_hbm_bytes": peak,
             "memory_breakdown": breakdown, "collectives": coll_table,
             "pipeline": pipe_info}

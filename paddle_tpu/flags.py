@@ -18,7 +18,8 @@ import os
 from typing import Any, Dict
 
 __all__ = ["FLAGS", "DEFINE_flag", "reset_flags_from_env",
-           "ENV_KNOBS", "declare_env_knob", "env_knob_int"]
+           "ENV_KNOBS", "declare_env_knob", "env_knob_int",
+           "env_knob_float"]
 
 
 def env_knob_int(name: str, default: int) -> int:
@@ -31,6 +32,21 @@ def env_knob_int(name: str, default: int) -> int:
         val = int(raw) if raw else 0
     except ValueError as e:
         raise ValueError(f"malformed {name}={raw!r}: {e}") from e
+    return val if val > 0 else default
+
+
+def env_knob_float(name: str, default: float) -> float:
+    """Positive-float PT_* knob parse, same contract as env_knob_int:
+    malformed raises, unset/non-positive/non-finite falls back to
+    `default` (thresholds and ratios read through it — PT_CALIB_REPLAN_
+    THRESHOLD's drift-ratio ceiling is the canonical consumer)."""
+    raw = os.environ.get(name, "").strip()
+    try:
+        val = float(raw) if raw else 0.0
+    except ValueError as e:
+        raise ValueError(f"malformed {name}={raw!r}: {e}") from e
+    if val != val or val in (float("inf"), float("-inf")):
+        return default
     return val if val > 0 else default
 
 
@@ -399,6 +415,27 @@ declare_env_knob("PT_FLEET_POLICY",
                  "EWMA-service-time score) | round_robin. Requests "
                  "carrying a session key always route session-affine "
                  "(rendezvous hash)")
+declare_env_knob("PT_CALIB_PATH",
+                 "cost-model calibration artifact (analysis/"
+                 "calibrate.py): path of a `tools/op_report.py --fit` "
+                 "JSON. When set, predict_step / planner scoring / "
+                 "rescore_plan all price through the fitted per-op-type "
+                 "correction factors and the per-dispatch collective "
+                 "overhead constant; a stale artifact (other chip, "
+                 "unknown program fingerprint, failed floors) warns "
+                 "once and prices raw. Unset = uncalibrated (the "
+                 "default ~/.cache/paddle_tpu/calibration.json is a "
+                 "WRITE target only, never read implicitly)")
+declare_env_knob("PT_CALIB_REPLAN_THRESHOLD",
+                 "drift-triggered re-planning (Trainer + obs/drift.py): "
+                 "when the live pt_model_drift_ratio of the training "
+                 "program sustains above this ratio for "
+                 "calibrate.REPLAN_WINDOWS consecutive log windows, a "
+                 "parallel Trainer re-invokes the placement planner "
+                 "under the current calibration, re-transpiles, and "
+                 "hot-resumes from the in-memory scope (`replan` trace "
+                 "span + pt_calib_* metrics). Unset/0 = off; 1.5 means "
+                 "'measured 50% over predicted'")
 declare_env_knob("PT_FLEET_AUTOSCALE",
                  "1 = fleet.make_fleet attaches + starts the "
                  "metrics-driven Autoscaler (queue-depth + EWMA "

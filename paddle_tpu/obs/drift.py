@@ -47,7 +47,8 @@ from typing import Callable, Optional
 from .metrics import REGISTRY
 
 __all__ = ["ProgramDrift", "DriftMonitor", "MONITOR",
-           "observe_prediction", "step_recorder", "DRIFT_ALPHA"]
+           "observe_prediction", "step_recorder", "current_ratio",
+           "DRIFT_ALPHA"]
 
 #: EWMA smoothing factor: new = alpha * sample + (1 - alpha) * old.
 #: 0.2 ~ a ~10-step memory — fast enough to see a regression within a
@@ -213,6 +214,17 @@ class DriftMonitor:
         for e in others:
             e.reset_baseline()
 
+    def current_ratio(self, fingerprint: str) -> Optional[float]:
+        """READ-ONLY drift_ratio lookup for `fingerprint` — None when
+        the program is untracked or either side of the ratio is missing.
+        Unlike entry(), never creates (or LRU-touches) an entry: the
+        Trainer's re-plan poll must observe the monitor, not grow it."""
+        with self._lock:
+            e = self._entries.get(str(fingerprint))
+        if e is None:
+            return None
+        return e.snapshot().get("drift_ratio")
+
     def reset(self) -> None:
         with self._lock:
             for fp in list(self._entries):
@@ -252,6 +264,12 @@ def observe_prediction(program, batch: int = 1, timer=None) -> None:
                          predicted_mfu=pred.predicted_mfu)
     except Exception:   # noqa: BLE001 — measured-only entry is still useful
         pass
+
+
+def current_ratio(fingerprint: str) -> Optional[float]:
+    """Module-level shorthand for MONITOR.current_ratio (the Trainer's
+    re-plan trigger reads through it)."""
+    return MONITOR.current_ratio(fingerprint)
 
 
 def step_recorder(fingerprint: str, n_steps: int = 1):

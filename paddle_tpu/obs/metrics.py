@@ -329,7 +329,16 @@ def build_info_labels() -> Dict[str, str]:
                          if os.environ.get(k, "") != "")
     except Exception:   # noqa: BLE001
         armed = ""
-    return {"jax": jax_version, "chip": chip, "knobs": armed}
+    labels = {"jax": jax_version, "chip": chip, "knobs": armed}
+    try:
+        # the ambient cost-model calibration's content hash (mtime-
+        # memoized inside calibrate — stays live across refits); empty
+        # when PT_CALIB_PATH is unarmed or the artifact fails its floors
+        from ..analysis.calibrate import active_version
+        labels["calibration"] = active_version() or ""
+    except Exception:   # noqa: BLE001 — a scrape must never fail
+        labels["calibration"] = ""
+    return labels
 
 
 def render_prometheus(snapshot: dict) -> str:
@@ -477,6 +486,18 @@ def render_prometheus(snapshot: dict) -> str:
                       "type": str(row.get("type"))}
             for key in _OP_ROW_GAUGES:
                 emit(f"pt_op_{key}", labels, row.get(key))
+    for name, snap in sorted(snapshot.get("calib", {}).items()):
+        # the calibration loop (analysis/calibrate.py + the Trainer's
+        # drift-triggered re-plan): closure count, the current sustain
+        # streak against the armed threshold, and the calibration
+        # identity in play as an info-style series
+        cl = {"trainer": str(name)}
+        emit("pt_calib_replans_total", cl, snap.get("replans"), "counter")
+        for key in ("drift_streak", "threshold", "last_drift_ratio"):
+            emit(f"pt_calib_{key}", cl, snap.get(key))
+        if snap.get("calibration_version"):
+            emit("pt_calib_info",
+                 dict(cl, version=str(snap["calibration_version"])), 1)
     return "\n".join(lines) + "\n"
 
 

@@ -169,6 +169,10 @@ class OpLedger:
     #                               cost-model-covered segments
     fused_step_ms: Optional[float] = None   # the real one-dispatch step
     uncovered_ops: List[str] = field(default_factory=list)
+    #: full program fingerprint (not the 12-char display name) — the
+    #: calibration fit stamps it into the artifact's provenance so a
+    #: program-specific calibration can refuse a foreign program
+    fingerprint: Optional[str] = None
 
     def ranked(self) -> List[OpRow]:
         """Rows by measured time, laggards first (unmeasured rows last,
@@ -208,6 +212,7 @@ class OpLedger:
         return {
             "program": self.program, "batch": self.batch,
             "chip": self.chip, "train": self.train,
+            "fingerprint": self.fingerprint,
             "total_measured_ms": round(self.total_measured_ms, 4),
             "total_predicted_ms": round(self.total_predicted_ms, 4),
             "coverage_pct": round(self.coverage_pct, 2),
@@ -600,15 +605,16 @@ def profile_program(program: Optional[Program] = None,
             fused_ms = None
 
     try:
-        pname = name or str(program.fingerprint())[:12]
+        fp = str(program.fingerprint())
     except Exception:   # noqa: BLE001
-        pname = name or "program"
+        fp = None
+    pname = name or (fp[:12] if fp else "program")
     ledger = OpLedger(program=pname, batch=batch, chip=chip.name,
                       train=train, rows=rows, segments=segments,
                       total_measured_ms=total_measured,
                       total_predicted_ms=total_predicted,
                       coverage_pct=coverage, fused_step_ms=fused_ms,
-                      uncovered_ops=uncovered)
+                      uncovered_ops=uncovered, fingerprint=fp)
 
     # merge the measured intervals into the Chrome-trace timeline: with
     # PT_TRACE armed (and PT_TRACE_DIR set for the device profile), the

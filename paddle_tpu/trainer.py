@@ -608,6 +608,76 @@ class Trainer:
                 delta = getattr(executor, "compile_count", 0) - compile0
                 self._compile_events_prior = compile_prior + delta
                 tm.observe_compiles(self._compile_events_prior)
+            # -- drift-triggered re-planning (analysis/calibrate.py) ----
+            # armed by PT_CALIB_REPLAN_THRESHOLD on the parallel path:
+            # when the drift monitor's live ratio for THIS program
+            # sustains above the threshold for REPLAN_WINDOWS log
+            # boundaries, the planner re-runs under the current
+            # calibration and a fresh ParallelExecutor hot-resumes from
+            # the in-memory scope (weights never move; the compile-miss
+            # barrier the new executor already owns records the new
+            # prediction, and the re-planned program's new fingerprint
+            # opens a fresh drift entry — the natural cooldown).
+            from .analysis import calibrate as calib_mod
+            from .obs import drift as drift_mod
+            replan_ceiling = (calib_mod.replan_threshold()
+                              if self.parallel else 0.0)
+            last_batch = [1]
+
+            def _note_batch(feed, stacked):
+                if not replan_ceiling or not isinstance(feed, dict):
+                    return
+                for v in feed.values():
+                    shape = getattr(v, "shape", None)
+                    if shape and len(shape) > (1 if stacked else 0):
+                        last_batch[0] = int(shape[1 if stacked else 0])
+                        return
+
+            def _maybe_replan():
+                nonlocal executor, compile0, compile_prior
+                if not replan_ceiling:
+                    return
+                try:
+                    ratio = drift_mod.current_ratio(
+                        self.train_program.fingerprint())
+                except Exception:   # noqa: BLE001 — never kill training
+                    return
+                streak = calib_mod.METRICS.note_window(
+                    ratio, ratio is not None and ratio > replan_ceiling)
+                if streak < calib_mod.REPLAN_WINDOWS:
+                    return
+                import warnings
+                from .analysis import planner as planner_mod
+                try:
+                    cal = calib_mod.default_calibration()
+                    ver = cal.version if cal is not None else None
+                    with obs_trace.span("replan", cat="train",
+                                        drift_ratio=ratio,
+                                        calibration=ver):
+                        art = planner_mod.plan_placement(
+                            self.train_program,
+                            planner_mod.default_topology(),
+                            batch=last_batch[0], calibration=cal)
+                        new_exe = ParallelExecutor(
+                            loss_name=self.loss.name,
+                            main_program=self.train_program,
+                            scope=self.scope, plan=art.top)
+                    # compile accounting re-baselines on the NEW
+                    # executor (its lifetime counter starts fresh)
+                    compile_prior = self._compile_events_prior
+                    compile0 = getattr(new_exe, "compile_count", 0)
+                    executor = new_exe
+                    calib_mod.METRICS.note_replan(ver)
+                    obs_trace.instant("replan_applied", cat="train",
+                                      mesh=str(art.top.get("mesh")))
+                except Exception as e:   # noqa: BLE001
+                    # a failed re-plan must never kill a training run —
+                    # reset the streak so the next attempt waits a full
+                    # sustain window instead of retrying every boundary
+                    calib_mod.METRICS.note_window(ratio, False)
+                    warnings.warn("drift-triggered re-plan failed "
+                                  f"({e}); continuing on the current "
+                                  "placement")
             start_epoch = (self.checkpoint_cfg.epoch_id
                            if self.checkpoint_cfg else 0)
             use_loop = steps_per_loop > 1
@@ -695,6 +765,7 @@ class Trainer:
                 # causal timeline) and its epoch/step attrs ride every
                 # lazy handle's provenance.
                 full = list(fetch) + ht_fetch
+                _note_batch(feed, stacked=True)
                 with obs_trace.span("step", cat="train", epoch=epoch_id,
                                     step=step0, n=n):
                     if self.parallel:
@@ -714,6 +785,7 @@ class Trainer:
 
             def _run_one(feed, fetch, epoch_id, step_id):
                 full = list(fetch) + ht_fetch
+                _note_batch(feed, stacked=False)
                 with obs_trace.span("step", cat="train", epoch=epoch_id,
                                     step=step_id, n=1):
                     if self.parallel:
@@ -816,6 +888,7 @@ class Trainer:
                                                    metrics))
                         if log_boundary:
                             self._drain_health()
+                            _maybe_replan()
                         if tm is not None:
                             now = time.perf_counter()
                             tm_pending_steps += n_in_window
@@ -866,6 +939,7 @@ class Trainer:
                     event_handler(EndStepEvent(epoch_id, step_id, metrics))
                     if step_id % log_every == 0:
                         self._drain_health()
+                        _maybe_replan()
                     if tm is not None:
                         now = time.perf_counter()
                         tm_pending_steps += 1

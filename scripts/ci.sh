@@ -22,8 +22,11 @@
 #           decode bench programs, incl. the collective audit on the
 #           MULTICHIP dryrun meshes (dp, dp x tp, dp x sp x tp) + the
 #           placement planner (tools/plan.py): schema-checked plans for
-#           all three builders, plus the predicted-vs-measured
-#           rank-correlation gate over the hand-picked dryrun meshes
+#           all three builders, the calibration loop (fit suite +
+#           op_report --fit -> plan --calibration round-trip, artifact
+#           floor-checked), plus the predicted-vs-measured
+#           rank-correlation gate over the hand-picked dryrun meshes —
+#           run CALIBRATED, gating both arms' Spearman
 #   obs   = lint gate + the unified-observability suite (span core,
 #           cross-thread trace correctness, ring-buffer bounds,
 #           drift-monitor EWMA, Chrome-trace JSON schema, pt_train_*/
@@ -128,7 +131,7 @@ fi
 if [[ "${1:-}" == "analyze" ]]; then
   echo "== analyze: cost model + memory estimator + collective audit =="
   python -m pytest tests/test_cost_model.py tests/test_analysis.py \
-    tests/test_planner.py tests/test_schedule.py -q
+    tests/test_planner.py tests/test_schedule.py tests/test_calibrate.py -q
   echo "== analyze: schema-checked cost reports (bench programs) =="
   for prog in resnet transformer decode; do
     python tools/cost_report.py "$prog" --check > /dev/null
@@ -149,9 +152,35 @@ if [[ "${1:-}" == "analyze" ]]; then
   python tools/plan.py transformer --batch 8 --pp 2 --microbatches 4 \
     --check > /dev/null
   python tools/plan.py decode --batch 2 --infer --check > /dev/null
+  echo "== analyze: calibration round-trip (op_report --fit -> plan"
+  echo "   --calibration; artifact floor-checked) =="
+  # BENCH_TFM_* pinned to the rank gate's GATE_CFG dims, so the fitted
+  # artifact's fingerprint stamp matches the gate program exactly
+  CALIB_TMP="$(mktemp -d)"
+  trap 'rm -rf "$CALIB_TMP"' EXIT
+  BENCH_TFM_VOCAB=64 BENCH_TFM_SEQ=256 BENCH_TFM_LAYERS=2 \
+    BENCH_TFM_DMODEL=64 BENCH_TFM_HEADS=4 BENCH_TFM_DFF=256 \
+    python tools/op_report.py transformer --batch 8 \
+    --fit "$CALIB_TMP/calibration.json" > /dev/null
+  python - "$CALIB_TMP/calibration.json" <<'PYEOF'
+import json, sys
+from paddle_tpu.analysis.artifacts import validate_calibration
+doc = json.load(open(sys.argv[1]))
+problems = validate_calibration(doc)
+if problems:
+    sys.exit("CALIBRATION ARTIFACT INVALID:\n  " + "\n  ".join(problems))
+print(f"calibration artifact ok: version={doc['version']} "
+      f"chip={doc['chip']} factors={len(doc['factors'])}")
+PYEOF
+  BENCH_TFM_VOCAB=64 BENCH_TFM_SEQ=256 BENCH_TFM_LAYERS=2 \
+    BENCH_TFM_DMODEL=64 BENCH_TFM_HEADS=4 BENCH_TFM_DFF=256 \
+    python tools/plan.py transformer \
+    --calibration "$CALIB_TMP/calibration.json" --check > /dev/null
   echo "== analyze: planner rank-correlation gate (predicted vs measured"
-  echo "   step-time ordering over the hand-picked dryrun meshes) =="
-  python tools/plan.py transformer --rank-gate
+  echo "   step-time ordering over the hand-picked dryrun meshes;"
+  echo "   calibrated arm must rank no worse than raw) =="
+  python tools/plan.py transformer --rank-gate \
+    --calibration "$CALIB_TMP/calibration.json"
   echo "ANALYZE OK"
   exit 0
 fi

@@ -1,0 +1,585 @@
+"""Self-calibrating cost model (analysis/calibrate.py).
+
+Acceptance pins of the calibration issue:
+  * the fit is ROBUST: median measured/predicted ratio per op type,
+    clamped into FIT_FACTOR_BAND, with types under MIN_SAMPLES measured
+    rows staying 1.0 — one poisoned segment never becomes a correction;
+  * the per-dispatch collective overhead constant is fitted from the
+    same profiles ((total - fused) / (segments - 1)) and prices the
+    scan-resident ppermute leg PR 15's rank gate documented: under a
+    calibration the dp=4,pp=2 mesh is no longer under-priced relative
+    to the sp mesh;
+  * artifacts are floor-validated at SAVE and LOAD
+    (artifacts.validate_calibration — the gconv-autotune pattern);
+  * the exact-rescore drift property EXTENDS to calibrated plans:
+    a plan recording calibration_version V re-scored under the same
+    Calibration reproduces its prediction exactly;
+  * calibrated pricing is a MONOTONE transform of the byte model on
+    inline meshes (uniform fabric scale + one dispatch constant), so a
+    calibration can never collapse or invert the raw ranking — only
+    dispatch COUNTS (pipeline hops) may reorder candidates;
+  * a stale calibration (other chip / unknown fingerprint) REFUSES to
+    apply: one warning, raw pricing;
+  * drift-triggered re-planning: a drift_ratio sustained above
+    PT_CALIB_REPLAN_THRESHOLD for REPLAN_WINDOWS windows makes the
+    Trainer re-plan under the current calibration and hot-resume from
+    the in-memory scope, with the loss still falling.
+"""
+
+import itertools
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.analysis import calibrate, planner
+from paddle_tpu.analysis.artifacts import validate_calibration
+from paddle_tpu.analysis.calibrate import (RAW, Calibration,
+                                           fit_calibration)
+from paddle_tpu.analysis.cost import predict_step
+from paddle_tpu.models.transformer import transformer_lm_loss
+from paddle_tpu.parallel.mesh import Topology
+from paddle_tpu.transpiler import pipeline_transpile
+
+TOPO8 = Topology(chip="cpu", n_devices=8)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_calibrate_state():
+    """The once-per-process warning dedupe and the replan metrics are
+    module-global; tests must not hide each other's warnings."""
+    calibrate._warned.clear()
+    calibrate.METRICS.reset()
+    yield
+    calibrate._warned.clear()
+    calibrate.METRICS.reset()
+
+
+# ---------------------------------------------------------------------------
+# synthetic ledgers (the dict form op_report saves — fit accepts both)
+# ---------------------------------------------------------------------------
+
+def _row(op_type, pred, meas, covered=True):
+    return {"type": op_type, "predicted_ms": pred, "measured_ms": meas,
+            "covered": covered}
+
+
+def _ledger(rows, total=None, fused=None, n_segments=0, chip="cpu",
+            fingerprint=None):
+    return {"attribution": {
+        "rows": rows, "chip": chip, "fingerprint": fingerprint,
+        "total_measured_ms": total, "fused_step_ms": fused,
+        "segments": [{"measured_fwd_ms": 1.0}] * n_segments,
+    }}
+
+
+def _cal(factors=None, overhead=0.0, chip="cpu", fps=()):
+    factors = dict(factors or {})
+    return Calibration(factors=factors,
+                       samples={k: 4 for k in factors},
+                       dispatch_overhead_s=overhead, chip=chip,
+                       fingerprints=tuple(fps))
+
+
+# ---------------------------------------------------------------------------
+# the fit
+# ---------------------------------------------------------------------------
+
+class TestFit:
+    def test_median_ratio_per_type(self):
+        led = _ledger([_row("mul", 1.0, 1.0), _row("mul", 1.0, 2.0),
+                       _row("mul", 1.0, 3.0),
+                       _row("softmax", 2.0, 1.0), _row("softmax", 2.0, 1.0)])
+        cal = fit_calibration([led])
+        assert cal.factors["mul"] == 2.0
+        assert cal.factors["softmax"] == 0.5
+        assert cal.samples == {"mul": 3, "softmax": 2}
+        assert cal.chip == "cpu"
+
+    def test_band_clamp_both_sides(self):
+        led = _ledger([_row("mul", 1.0, 100.0), _row("mul", 1.0, 100.0),
+                       _row("gelu", 100.0, 1.0), _row("gelu", 100.0, 1.0)])
+        cal = fit_calibration([led])
+        lo, hi = calibrate.FIT_FACTOR_BAND
+        assert cal.factors["mul"] == hi
+        assert cal.factors["gelu"] == lo
+
+    def test_min_samples_fallback_to_neutral(self):
+        led = _ledger([_row("mul", 1.0, 7.0)])
+        cal = fit_calibration([led])
+        # one noisy segment is never a correction — but its count shows
+        # WHY the factor stayed neutral
+        assert cal.factors["mul"] == 1.0
+        assert cal.samples["mul"] == 1
+        assert fit_calibration([led], min_samples=1).factors["mul"] == 7.0
+
+    def test_median_resists_one_poisoned_reading(self):
+        led = _ledger([_row("mul", 1.0, 2.0)] * 4
+                      + [_row("mul", 1.0, 4000.0)])
+        assert fit_calibration([led]).factors["mul"] == 2.0
+
+    def test_uncovered_and_degenerate_rows_skipped(self):
+        led = _ledger([_row("mul", 1.0, 9.0, covered=False),
+                       _row("mul", 1.0, None), _row("mul", 0.0, 5.0),
+                       _row("mul", 1.0, float("nan")),
+                       _row("mul", 1.0, 3.0), _row("mul", 1.0, 3.0)])
+        cal = fit_calibration([led])
+        assert cal.factors["mul"] == 3.0
+        assert cal.samples["mul"] == 2
+
+    def test_overhead_from_profile_gap(self):
+        # 6 measured segments paid 6 dispatches, the fused step paid 1:
+        # (16 - 10) / (6 - 1) = 1.2 ms per dispatch
+        led = _ledger([], total=16.0, fused=10.0, n_segments=6)
+        cal = fit_calibration([led])
+        assert cal.dispatch_overhead_s == pytest.approx(1.2e-3)
+
+    def test_overhead_clamped_and_never_negative(self):
+        fast_fused = _ledger([], total=10.0, fused=16.0, n_segments=6)
+        assert fit_calibration([fast_fused]).dispatch_overhead_s == 0.0
+        broken = _ledger([], total=1e6, fused=10.0, n_segments=3)
+        assert (fit_calibration([broken]).dispatch_overhead_s
+                == calibrate.OVERHEAD_FIT_CEILING_S)
+
+    def test_overhead_median_across_ledgers_and_override(self):
+        leds = [_ledger([], total=10.0 + gap * 5, fused=10.0, n_segments=6)
+                for gap in (1.0, 2.0, 30.0)]
+        assert fit_calibration(leds).dispatch_overhead_s \
+            == pytest.approx(2e-3)
+        assert fit_calibration(
+            leds, dispatch_overhead_s=7e-4).dispatch_overhead_s == 7e-4
+
+    def test_provenance_stamped(self):
+        led = _ledger([_row("mul", 1.0, 2.0)] * 2, chip="tpu_v4",
+                      fingerprint="abcd1234")
+        cal = fit_calibration([led])
+        assert cal.chip == "tpu_v4"
+        assert cal.fingerprints == ("abcd1234",)
+        assert fit_calibration([led], fingerprints=[]).fingerprints == ()
+
+    def test_empty_ledger_list_refused(self):
+        with pytest.raises(ValueError):
+            fit_calibration([])
+
+    def test_version_is_content_hash(self):
+        a = _cal({"mul": 2.0})
+        b = _cal({"mul": 2.0})
+        c = _cal({"mul": 2.5})
+        assert a.version == b.version
+        assert a.version != c.version
+        assert a.version != _cal({"mul": 2.0}, overhead=1e-4).version
+
+
+# ---------------------------------------------------------------------------
+# artifact floors at save AND load
+# ---------------------------------------------------------------------------
+
+def _valid_doc():
+    return _cal({"mul": 2.0, "gelu": 0.5}, overhead=3e-4).to_doc()
+
+
+def _corruptions():
+    def missing(key):
+        def f(doc):
+            del doc[key]
+        f.__name__ = f"missing_{key}"
+        return f
+
+    def setter(key, val, name):
+        def f(doc):
+            doc[key] = val
+        f.__name__ = name
+        return f
+
+    out = [missing(k) for k in ("schema_version", "kind", "chip", "jax",
+                                "factors", "samples",
+                                "dispatch_overhead_s")]
+    out += [
+        setter("kind", "placement_plan", "wrong_kind"),
+        setter("schema_version", 2, "unknown_schema"),
+        setter("chip", "", "empty_chip"),
+        setter("factors", {"mul": 0.01}, "factor_below_floor"),
+        setter("factors", {"mul": 25.0}, "factor_above_ceiling"),
+        setter("factors", {"mul": "x"}, "factor_not_numeric"),
+        setter("dispatch_overhead_s", 2.0, "overhead_above_ceiling"),
+        setter("dispatch_overhead_s", -1e-3, "negative_overhead"),
+        setter("fingerprints", [""], "empty_fingerprint"),
+    ]
+
+    def no_sample_count(doc):
+        doc["samples"] = {}
+    out.append(no_sample_count)
+
+    def non_positive_sample(doc):
+        doc["samples"] = {"mul": 0, "gelu": 1}
+    out.append(non_positive_sample)
+    return out
+
+
+class TestArtifactFloors:
+    def test_valid_doc_round_trips(self, tmp_path):
+        assert validate_calibration(_valid_doc()) == []
+        cal = _cal({"mul": 2.0}, overhead=3e-4, fps=("fp1",))
+        p = tmp_path / "calib.json"
+        cal.save(str(p))
+        loaded = Calibration.load(str(p))
+        assert loaded == cal
+        assert loaded.version == cal.version
+
+    @pytest.mark.parametrize("corrupt", _corruptions(),
+                             ids=lambda f: f.__name__)
+    def test_corruption_refused_at_load(self, tmp_path, corrupt):
+        doc = _valid_doc()
+        corrupt(doc)
+        assert validate_calibration(doc), corrupt.__name__
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps(doc))
+        with pytest.raises(ValueError, match="invalid calibration"):
+            Calibration.load(str(p))
+
+    def test_save_refuses_out_of_band_factor(self, tmp_path):
+        # the fit band is strictly inside the artifact band, so only a
+        # hand-built (or corrupted) calibration can hit this — and save
+        # must refuse it BEFORE it lands on disk
+        bad = _cal({"mul": 30.0})
+        with pytest.raises(ValueError, match="refusing to save"):
+            bad.save(str(tmp_path / "bad.json"))
+        assert not (tmp_path / "bad.json").exists()
+
+    def test_save_is_atomic(self, tmp_path):
+        p = tmp_path / "calib.json"
+        _cal({"mul": 2.0}).save(str(p))
+        _cal({"mul": 3.0}).save(str(p))
+        assert Calibration.load(str(p)).factors["mul"] == 3.0
+        assert list(tmp_path.iterdir()) == [p]   # no torn .tmp left
+
+
+# ---------------------------------------------------------------------------
+# the corrected model: exact rescore, monotonicity, pp repricing
+# ---------------------------------------------------------------------------
+
+def _build_lm(*, seq_len=64, n_layers=2, pp=0, seed=None):
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    if seed is not None:
+        main.random_seed = seed
+    with pt.program_guard(main, startup):
+        avg, _ = transformer_lm_loss(vocab_size=64, seq_len=seq_len,
+                                     n_layers=n_layers, d_model=32,
+                                     n_heads=4, d_ff=64,
+                                     max_len=max(seq_len, 128))
+        if pp:
+            pipeline_transpile(main, startup, num_stages=pp,
+                               num_microbatches=2)
+        pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(avg)
+    return main, startup, avg
+
+
+#: railed-at-band factors — the CPU-fit regime the CI gate sees
+RAILED = {t: 8.0 for t in ("mul", "elementwise_add", "softmax", "adam",
+                           "layer_norm", "gelu",
+                           "scaled_dot_product_attention")}
+
+
+class TestCalibratedScoring:
+    def test_plan_records_version_and_rescores_exactly(self):
+        cal = _cal(RAILED, overhead=2e-4)
+        main, _s, _a = _build_lm()
+        art = planner.plan_placement(main, TOPO8, batch=8, calibration=cal)
+        for entry in art.ranked[:3]:
+            assert entry["calibration_version"] == cal.version
+            rescored = planner.rescore_plan(main, entry, TOPO8,
+                                            calibration=cal)
+            assert rescored["prediction"] == entry["prediction"]
+
+    def test_raw_plan_records_no_version(self):
+        main, _s, _a = _build_lm()
+        art = planner.plan_placement(main, TOPO8, batch=8,
+                                     calibration=RAW)
+        assert "calibration_version" not in art.top
+        rescored = planner.rescore_plan(main, art.top, TOPO8,
+                                        calibration=RAW)
+        assert rescored["prediction"] == art.top["prediction"]
+
+    def test_pp_candidate_rescores_exactly_under_calibration(self):
+        cal = _cal({}, overhead=5e-4)
+        main_pp, _s, _a = _build_lm(pp=2)
+        cand = planner.score_mesh(main_pp, {"dp": 4, "pp": 2}, TOPO8,
+                                  batch=8, microbatches=2,
+                                  calibration=cal)
+        assert cand["calibration_version"] == cal.version
+        rescored = planner.rescore_plan(main_pp, cand, TOPO8,
+                                        calibration=cal)
+        assert rescored["prediction"] == cand["prediction"]
+
+    def test_rescore_without_ambient_warns_and_prices_raw(self):
+        cal = _cal(RAILED, overhead=2e-4)
+        main, _s, _a = _build_lm()
+        art = planner.plan_placement(main, TOPO8, batch=8, calibration=cal)
+        with pytest.warns(UserWarning, match="re-scoring RAW"):
+            rescored = planner.rescore_plan(main, art.top, TOPO8)
+        raw = planner.rescore_plan(main, art.top, TOPO8, calibration=RAW)
+        assert rescored["prediction"] == raw["prediction"]
+        assert rescored["prediction"] != art.top["prediction"]
+
+    def test_calibration_is_monotone_on_inline_meshes(self):
+        # railed factors are the worst case: every measured type scales
+        # by the band ceiling. The raw ordering of the dryrun meshes
+        # must survive — the fabric scale rides EVERY leg, so a
+        # calibration cannot flip which candidate wins
+        cal = _cal(RAILED)
+        main, _s, _a = _build_lm()
+        meshes = ({"dp": 8}, {"dp": 4, "tp": 2}, {"dp": 2, "sp": 2,
+                                                  "tp": 2})
+        raws, cals = [], []
+        for axes in meshes:
+            sp = "ring" if axes.get("sp", 1) > 1 else None
+            raws.append(planner.score_mesh(
+                main, axes, TOPO8, batch=8,
+                sp_mode=sp)["prediction"]["predicted_step_ms"])
+            cals.append(planner.score_mesh(
+                main, axes, TOPO8, batch=8, sp_mode=sp,
+                calibration=cal)["prediction"]["predicted_step_ms"])
+        assert len(set(cals)) == len(cals)   # no collapse into ties
+        for i, j in itertools.combinations(range(len(meshes)), 2):
+            assert (raws[i] < raws[j]) == (cals[i] < cals[j])
+
+    def test_calibrated_model_reprices_pp_vs_sp(self):
+        # PR 15 documented the gap: the byte model cannot see that a
+        # scan-resident ppermute dispatches once per pipe TICK. The
+        # fitted per-dispatch constant prices exactly that — so the
+        # calibrated dp=4,pp=2 prediction must rise RELATIVE to the sp
+        # mesh (which pays the constant once for its whole combined
+        # dispatch group)
+        cal = _cal({}, overhead=5e-4)
+        main, _s, _a = _build_lm()
+        main_pp, _s2, _a2 = _build_lm(pp=2)
+        ms = lambda c: c["prediction"]["predicted_step_ms"]   # noqa: E731
+        pp_raw = planner.score_mesh(main_pp, {"dp": 4, "pp": 2}, TOPO8,
+                                    batch=8, microbatches=2)
+        pp_cal = planner.score_mesh(main_pp, {"dp": 4, "pp": 2}, TOPO8,
+                                    batch=8, microbatches=2,
+                                    calibration=cal)
+        sp_raw = planner.score_mesh(main, {"dp": 4, "sp": 2}, TOPO8,
+                                    batch=8, sp_mode="ring")
+        sp_cal = planner.score_mesh(main, {"dp": 4, "sp": 2}, TOPO8,
+                                    batch=8, sp_mode="ring",
+                                    calibration=cal)
+        # the pp leg pays hops x overhead, the inline mesh ONE dispatch
+        assert ms(pp_cal) - ms(pp_raw) > ms(sp_cal) - ms(sp_raw)
+        assert ms(pp_cal) / ms(sp_cal) > ms(pp_raw) / ms(sp_raw)
+
+    def test_predict_step_scales_with_explicit_calibration(self):
+        main, _s, _a = _build_lm()
+        cal = _cal({t: 2.0 for t in RAILED},
+                   fps=(str(main.fingerprint()),))
+        raw = predict_step(main, batch=8, calibration=RAW)
+        calp = predict_step(main, batch=8, calibration=cal)
+        assert calp.predicted_step_ms > raw.predicted_step_ms
+        assert calp.bound == raw.bound   # one scale, tie-break intact
+
+
+# ---------------------------------------------------------------------------
+# staleness refusal
+# ---------------------------------------------------------------------------
+
+class TestStaleness:
+    def test_chip_mismatch_refused_with_one_warning(self):
+        cal = _cal({"mul": 2.0}, chip="tpu_v4")
+        with pytest.warns(UserWarning, match="does not apply"):
+            assert calibrate.resolve(cal, chip="cpu") is None
+        # dedup: the same staleness warns once per process
+        assert calibrate.resolve(cal, chip="cpu") is None
+
+    def test_fingerprint_mismatch_refused(self):
+        cal = _cal({"mul": 2.0}, fps=("fp_a", "fp_b"))
+        with pytest.warns(UserWarning, match="fitted from programs"):
+            assert calibrate.resolve(cal, chip="cpu",
+                                     fingerprint="fp_other") is None
+        assert calibrate.resolve(cal, chip="cpu",
+                                 fingerprint="fp_b") is cal
+
+    def test_fingerprint_agnostic_calibration_transfers(self):
+        cal = _cal({"mul": 2.0})
+        assert calibrate.resolve(cal, chip="cpu",
+                                 fingerprint="anything") is cal
+
+    def test_raw_and_none_pass_through(self):
+        assert calibrate.resolve(None, chip="cpu") is None
+        assert calibrate.resolve(RAW, chip="cpu") is None
+
+    def test_stale_calibration_prices_raw_in_predict_step(self):
+        main, _s, _a = _build_lm()
+        stale = _cal({t: 2.0 for t in RAILED}, chip="tpu_v4")
+        raw = predict_step(main, batch=8, calibration=RAW)
+        with pytest.warns(UserWarning, match="does not apply"):
+            fell_back = predict_step(main, batch=8, calibration=stale)
+        assert fell_back.predicted_step_ms == raw.predicted_step_ms
+
+    def test_plan_placement_resolves_at_entry(self):
+        main, _s, _a = _build_lm()
+        stale = _cal({t: 2.0 for t in RAILED},
+                     fps=("not_this_program",))
+        with pytest.warns(UserWarning, match="fitted from programs"):
+            art = planner.plan_placement(main, TOPO8, batch=8,
+                                         calibration=stale)
+        assert "calibration_version" not in art.top
+
+
+# ---------------------------------------------------------------------------
+# ambient arming (PT_CALIB_PATH) + knobs
+# ---------------------------------------------------------------------------
+
+class TestAmbient:
+    def test_unarmed_is_raw(self, monkeypatch):
+        monkeypatch.delenv(calibrate.PATH_ENV, raising=False)
+        assert calibrate.default_calibration() is None
+        assert calibrate.active_version() is None
+
+    def test_armed_loads_and_memoizes(self, tmp_path, monkeypatch):
+        cal = _cal({"mul": 2.0}, overhead=3e-4)
+        p = tmp_path / "calib.json"
+        cal.save(str(p))
+        monkeypatch.setenv(calibrate.PATH_ENV, str(p))
+        got = calibrate.default_calibration()
+        assert got is not None and got.version == cal.version
+        assert calibrate.default_calibration() is got   # memo hit
+        assert calibrate.active_version() == cal.version
+        # a refit on disk is picked up without a reload knob
+        import os
+        refit = _cal({"mul": 3.0})
+        refit.save(str(p))
+        os.utime(str(p), (0, 0))   # force a distinct mtime either way
+        assert calibrate.default_calibration().version == refit.version
+
+    def test_broken_artifact_warns_once_and_prices_raw(self, tmp_path,
+                                                       monkeypatch):
+        p = tmp_path / "broken.json"
+        p.write_text("{not json")
+        monkeypatch.setenv(calibrate.PATH_ENV, str(p))
+        with pytest.warns(UserWarning, match="pricing raw"):
+            assert calibrate.default_calibration() is None
+
+    def test_missing_path_warns_and_prices_raw(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.setenv(calibrate.PATH_ENV,
+                           str(tmp_path / "nope.json"))
+        with pytest.warns(UserWarning, match="not readable"):
+            assert calibrate.default_calibration() is None
+
+    def test_replan_threshold_knob(self, monkeypatch):
+        monkeypatch.delenv(calibrate.REPLAN_ENV, raising=False)
+        assert calibrate.replan_threshold() == 0.0
+        monkeypatch.setenv(calibrate.REPLAN_ENV, "2.5")
+        assert calibrate.replan_threshold() == 2.5
+        monkeypatch.setenv(calibrate.REPLAN_ENV, "-1")
+        assert calibrate.replan_threshold() == 0.0
+        monkeypatch.setenv(calibrate.REPLAN_ENV, "inf")
+        assert calibrate.replan_threshold() == 0.0
+        monkeypatch.setenv(calibrate.REPLAN_ENV, "bogus")
+        with pytest.raises(ValueError, match="malformed"):
+            calibrate.replan_threshold()
+
+    def test_calib_metrics_on_exposition(self):
+        calibrate.METRICS.note_window(2.0, True)
+        calibrate.METRICS.note_replan("deadbeef0000")
+        from paddle_tpu.obs.metrics import (global_snapshot,
+                                            render_prometheus)
+        text = render_prometheus(global_snapshot())
+        assert "pt_calib_replans_total" in text
+        assert "pt_calib_drift_streak" in text
+        assert 'version="deadbeef0000"' in text
+
+    def test_build_info_carries_calibration_label(self, tmp_path,
+                                                  monkeypatch):
+        from paddle_tpu.obs.metrics import build_info_labels
+        monkeypatch.delenv(calibrate.PATH_ENV, raising=False)
+        assert build_info_labels().get("calibration") == ""
+        cal = _cal({"mul": 2.0})
+        p = tmp_path / "calib.json"
+        cal.save(str(p))
+        monkeypatch.setenv(calibrate.PATH_ENV, str(p))
+        assert build_info_labels().get("calibration") == cal.version
+
+
+# ---------------------------------------------------------------------------
+# drift-triggered re-planning (the Trainer loop closure)
+# ---------------------------------------------------------------------------
+
+def _build_mlp():
+    from paddle_tpu import layers
+    x = layers.data("x", [32])
+    y = layers.data("y", [1], dtype="int64")
+    h = layers.fc(x, size=64, act="relu")
+    pred = layers.fc(h, size=10, act="softmax")
+    return layers.mean(layers.cross_entropy(pred, y))
+
+
+class TestDriftReplan:
+    def _train(self, monkeypatch, threshold):
+        import paddle_tpu.trainer as trainer_mod
+        if threshold is None:
+            monkeypatch.delenv(calibrate.REPLAN_ENV, raising=False)
+        else:
+            monkeypatch.setenv(calibrate.REPLAN_ENV, str(threshold))
+        rng = np.random.RandomState(0)
+        x = rng.rand(64, 32).astype(np.float32)
+        y = (x.sum(axis=1) * 3).astype(np.int64).reshape(-1, 1) % 10
+
+        def reader():
+            for i in range(0, 64, 16):
+                yield {"x": x[i:i + 16], "y": y[i:i + 16]}
+
+        losses = []
+
+        def handler(ev):
+            if isinstance(ev, trainer_mod.EndStepEvent) and ev.metrics:
+                losses.extend(
+                    np.ravel(np.asarray(ev.metrics[0])).tolist())
+
+        t = trainer_mod.Trainer(
+            train_func=lambda: [_build_mlp()],
+            optimizer_func=lambda: pt.optimizer.SGDOptimizer(
+                learning_rate=0.1),
+            parallel=True)
+        t.train(num_epochs=6, event_handler=handler, reader=reader,
+                feed_order=["x", "y"], steps_per_loop=4)
+        return losses
+
+    def test_sustained_drift_replans_and_training_continues(
+            self, monkeypatch):
+        from paddle_tpu.obs import drift as drift_mod
+        # inject a fabric that runs 9.9x the model's prediction — every
+        # window is over the threshold, so the streak reaches
+        # REPLAN_WINDOWS and the Trainer re-plans mid-run
+        monkeypatch.setattr(drift_mod, "current_ratio", lambda fp: 9.9)
+        losses = self._train(monkeypatch, threshold=1.5)
+        snap = calibrate.METRICS.snapshot()
+        assert snap["replans"] >= 1
+        assert snap["last_drift_ratio"] == 9.9
+        # the hot-resume kept training on the SAME weights: every batch
+        # produced a loss and the loss kept falling through the re-plan
+        assert len(losses) == 24
+        assert losses[-1] < losses[0]
+
+    def test_below_threshold_never_replans(self, monkeypatch):
+        from paddle_tpu.obs import drift as drift_mod
+        monkeypatch.setattr(drift_mod, "current_ratio", lambda fp: 1.01)
+        losses = self._train(monkeypatch, threshold=1.5)
+        snap = calibrate.METRICS.snapshot()
+        assert snap["replans"] == 0
+        assert snap["drift_streak"] == 0   # under-threshold resets
+        assert len(losses) == 24 and losses[-1] < losses[0]
+
+    def test_unarmed_threshold_is_off(self, monkeypatch):
+        from paddle_tpu.obs import drift as drift_mod
+
+        def bomb(fp):
+            raise AssertionError("replan poll must be off when "
+                                 "PT_CALIB_REPLAN_THRESHOLD is unset")
+
+        monkeypatch.setattr(drift_mod, "current_ratio", bomb)
+        losses = self._train(monkeypatch, threshold=None)
+        assert calibrate.METRICS.snapshot()["replans"] == 0
+        assert len(losses) == 24

@@ -22,6 +22,8 @@ Usage:
         --mesh dp=2,sp=2,tp=2
     python tools/cost_report.py decode --check      # schema-validated
     python tools/cost_report.py transformer --infer
+    python tools/cost_report.py transformer \
+        --calibration calib.json        # fitted model + per-leg delta
 
 --check validates the emitted document with
 analysis/artifacts.validate_cost_report (the scripts/ci.sh analyze leg)
@@ -137,6 +139,13 @@ def main(argv=None):
                          "placement plan (tools/plan.py artifact); the "
                          "plan's own prediction is reported beside the "
                          "re-derived one so drift is visible")
+    ap.add_argument("--calibration", default=None, metavar="CALIB_JSON",
+                    help="price through a fitted cost-model calibration "
+                         "(tools/op_report.py --fit artifact): the "
+                         "report gains calibrated_prediction blocks and "
+                         "stderr shows the raw-vs-calibrated per-leg "
+                         "delta (a stale artifact — other chip/program — "
+                         "warns and prices raw)")
     ap.add_argument("--check", action="store_true",
                     help="schema-validate the report; exit 1 on problems")
     ap.add_argument("--out", help="also write the JSON here")
@@ -163,7 +172,19 @@ def main(argv=None):
     pc = program_cost(program, batch=args.batch, train=train)
     est = estimate_memory(program, batch=args.batch, train=train)
     chip = resolve_chip()
-    pred = predict_step(program, batch=args.batch, chip=chip, train=train)
+    cal = raw_arm = None
+    if args.calibration:
+        from paddle_tpu.analysis import calibrate
+        cal = calibrate.Calibration.load(args.calibration)
+        # the baseline arm pins RAW even when PT_CALIB_PATH is armed in
+        # the environment — the delta column must compare the two
+        # models, not two calibrated copies
+        raw_arm = calibrate.RAW
+    pred = predict_step(program, batch=args.batch, chip=chip, train=train,
+                        calibration=raw_arm)
+    pred_cal = (predict_step(program, batch=args.batch, chip=chip,
+                             train=train, calibration=cal)
+                if cal is not None else None)
 
     def leg(c):
         return {"mxu_flops": int(c.mxu_flops),
@@ -187,6 +208,10 @@ def main(argv=None):
         "memory": est.to_dict(),
         "prediction": pred.to_dict(),
     }
+    if pred_cal is not None:
+        report["calibration"] = {"path": args.calibration,
+                                 "version": cal.version}
+        report["calibrated_prediction"] = pred_cal.to_dict()
     if cut is not None:
         report["stage_cuts"] = {
             "n_stages": cut.n_stages, "n_layers": cut.n_layers,
@@ -223,7 +248,12 @@ def main(argv=None):
             report["comm"][spec] = audit.to_dict()
             report["comm"][spec]["prediction"] = predict_step(
                 prog_m, batch=args.batch, chip=chip, train=train,
-                comm_report=audit).to_dict()
+                comm_report=audit, calibration=raw_arm).to_dict()
+            if cal is not None:
+                report["comm"][spec]["calibrated_prediction"] = \
+                    predict_step(prog_m, batch=args.batch, chip=chip,
+                                 train=train, comm_report=audit,
+                                 calibration=cal).to_dict()
     if args.plan:
         from paddle_tpu.analysis.planner import (PlanArtifact, rescore_plan,
                                                  resolve_plan)
@@ -246,6 +276,32 @@ def main(argv=None):
             "collectives": entry.get("collectives"),
         }
 
+    if cal is not None:
+        # raw-vs-calibrated per-leg delta (stderr — stdout stays JSON)
+        legs = ("t_compute_ms", "t_bandwidth_ms", "t_comm_ms",
+                "predicted_step_ms", "predicted_mfu")
+        print(f"calibration {cal.version} ({args.calibration}): "
+              "raw -> calibrated per leg", file=sys.stderr)
+
+        def _delta(tag, raw_d, cal_d):
+            print(f"  {tag}:", file=sys.stderr)
+            for leg_key in legs:
+                r, c = raw_d.get(leg_key), cal_d.get(leg_key)
+                if r is None or c is None:
+                    continue
+                dx = f"{(c / r - 1.0) * 100:+.1f}%" if r else "n/a"
+                print(f"    {leg_key:18} {r:12.4f} -> {c:12.4f}  {dx}",
+                      file=sys.stderr)
+            if raw_d.get("bound") != cal_d.get("bound"):
+                print(f"    bound              {raw_d.get('bound')} -> "
+                      f"{cal_d.get('bound')}", file=sys.stderr)
+
+        _delta("whole-program", report["prediction"],
+               report["calibrated_prediction"])
+        for spec in args.mesh:
+            mesh_leg = report["comm"][spec]
+            _delta(f"mesh {spec}", mesh_leg["prediction"],
+                   mesh_leg["calibrated_prediction"])
     text = json.dumps(report, indent=2)
     print(text)
     if args.out:

@@ -22,6 +22,18 @@ the transformer exactly like tools/cost_report.py. With PT_TRACE (and
 PT_TRACE_DIR) armed, the measured per-op intervals additionally land in
 the Chrome-trace ring and a Perfetto-loadable dump is written next to
 the device profile.
+
+--fit <path> closes the measurement loop (analysis/calibrate.py): the
+profiled ledger's measured-vs-predicted ratios become a cost-model
+calibration artifact — per-op-type median correction factors plus the
+fitted per-dispatch collective overhead — floor-validated at save
+(artifacts.validate_calibration) and stamped with the chip, jax
+version, and this program's fingerprint. Point PT_CALIB_PATH (or
+`cost_report/plan --calibration`) at the file and every prediction
+prices through the corrected model:
+
+    python tools/op_report.py transformer --fit calib.json
+    python tools/plan.py transformer --calibration calib.json
 """
 
 from __future__ import annotations
@@ -105,6 +117,10 @@ def main(argv=None) -> int:
     ap.add_argument("--check", action="store_true",
                     help="schema-validate the report; exit 1 on problems")
     ap.add_argument("--out", help="also write the JSON document here")
+    ap.add_argument("--fit", metavar="CALIB_JSON",
+                    help="fit a cost-model calibration artifact from "
+                         "this profile and write it here (validated at "
+                         "save; analysis/calibrate.py)")
     args = ap.parse_args(argv)
 
     main_prog, startup = BUILDERS[args.program](not args.infer)
@@ -129,6 +145,18 @@ def main(argv=None) -> int:
                                               "").strip():
         from trace_dump import dump
         print(f"trace: wrote {dump()}", file=sys.stderr)
+    if args.fit:
+        from paddle_tpu.analysis import calibrate
+        cal = calibrate.fit_calibration([ledger])
+        cal.save(args.fit)   # floor-validated at save
+        fitted = {k: v for k, v in cal.factors.items() if v != 1.0}
+        print(f"calibration {cal.version}: "
+              f"{len(fitted)}/{len(cal.factors)} op types corrected, "
+              f"dispatch overhead {cal.dispatch_overhead_s * 1e6:.1f} us, "
+              f"chip={cal.chip} -> {args.fit}", file=sys.stderr)
+        for op_type in sorted(fitted, key=lambda t: -abs(fitted[t] - 1.0)):
+            print(f"  {op_type:22} x{cal.factors[op_type]:.3f} "
+                  f"(n={cal.samples.get(op_type, 0)})", file=sys.stderr)
     if args.check:
         from paddle_tpu.analysis.artifacts import validate_op_report
         problems = validate_op_report(doc)
