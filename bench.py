@@ -1767,6 +1767,114 @@ def bench_fleet(on_tpu, peak):
     return out
 
 
+def bench_elastic(on_tpu, peak):
+    """Elastic recovery (resilience/elastic.py): a deterministic
+    mesh_shrink fault kills a checkpointing trainer mid-run; the
+    ElasticSupervisor restores the newest verified checkpoint, re-plans
+    for the surviving chips, validates the reshard, and resumes at the
+    recorded step. Reported: recovery time (crash -> the next attempt
+    training, i.e. restore + re-plan + reshard), steps lost (completed
+    steps whose work the restore discarded — measured as re-trained
+    duplicates, not derived from the schedule), restart/reshard counts,
+    and chip accounting. Floored by artifacts.validate_elastic: the
+    fault must actually fire, recovery bounded, steps_lost strictly
+    under the checkpoint interval, the run must complete."""
+    import tempfile
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.resilience import faults as pfaults
+    from paddle_tpu.resilience.elastic import ElasticSupervisor
+    from paddle_tpu.resilience.retry import RetryPolicy
+
+    n_steps = int(os.environ.get("BENCH_ELASTIC_STEPS", 24))
+    interval = int(os.environ.get("BENCH_ELASTIC_INTERVAL", 4))
+    crash_hit = int(os.environ.get("BENCH_ELASTIC_CRASH_STEP", 11))
+    batch = 8
+
+    rs = np.random.RandomState(1234)
+    data = [(rs.randn(16).astype(np.float32),
+             rs.randn(1).astype(np.float32))
+            for _ in range(n_steps * batch)]
+
+    def raw():
+        yield from data
+
+    ckpt = os.path.join(tempfile.mkdtemp(prefix="bench_elastic_"), "ckpt")
+
+    def make_trainer():
+        pt.core.program.reset_unique_names()
+
+        def train_func():
+            x = layers.data("x", [16])
+            y = layers.data("y", [1])
+            h = layers.fc(x, size=32, act="relu")
+            pred = layers.fc(h, size=1)
+            return [layers.mean(layers.square_error_cost(pred, y))]
+
+        cfg = pt.CheckpointConfig(ckpt, step_interval=interval)
+        return pt.Trainer(train_func,
+                          lambda: pt.optimizer.SGDOptimizer(0.05),
+                          checkpoint_config=cfg)
+
+    steps = []
+
+    def handler(event):
+        if isinstance(event, pt.EndStepEvent):
+            steps.append(event.step)
+
+    prior_plan = os.environ.get("PT_FAULT_INJECT")
+    os.environ["PT_FAULT_INJECT"] = f"mesh_shrink@{crash_hit}"
+    pfaults.reset()
+    sup = ElasticSupervisor(
+        make_trainer, batch=batch,
+        policy=RetryPolicy(retries=3, base_delay=0.0, jitter=0.0,
+                           sleep=lambda _d: None))
+    t0 = time.time()
+    try:
+        sup.run(num_epochs=1, event_handler=handler,
+                reader=pt.reader.batch(raw, batch))
+    finally:
+        if prior_plan is None:
+            os.environ.pop("PT_FAULT_INJECT", None)
+        else:
+            os.environ["PT_FAULT_INJECT"] = prior_plan
+        pfaults.reset()
+    wall = time.time() - t0
+
+    snap = sup.metrics.snapshot()
+    # the Nth hit fires BEFORE step index N-1 runs; the restore rolls
+    # back to the newest checkpoint boundary, so any steps between that
+    # boundary and the crash re-train — they appear twice in `steps`
+    crash_step = crash_hit - 1
+    dup = len(steps) - len(set(steps))
+    resume_step = min((s for s in set(steps) if steps.count(s) > 1),
+                      default=crash_step)
+    out = {
+        "steps_total": n_steps,
+        "step_interval": interval,
+        "crash_step": crash_step,
+        "resume_step": int(resume_step),
+        "steps_lost": int(dup),
+        "restarts": snap["restarts"],
+        "reshards": snap["reshards"],
+        "recovery_s": snap["downtime_s"],
+        "chips": {"current": snap["current_chips"],
+                  "target": snap["target_chips"]},
+        "completed": bool(steps and steps[-1] == n_steps - 1
+                          and set(steps) == set(range(n_steps))),
+        "wall_s": round(wall, 3),
+    }
+
+    from paddle_tpu.analysis.artifacts import validate_elastic
+    problems = validate_elastic(out)
+    if problems:
+        out["floor_violations"] = problems
+        print(f"bench_elastic FLOOR VIOLATIONS: {problems}",
+              file=sys.stderr)
+    return out
+
+
 def bench_planner(on_tpu, peak):
     """Static placement planner (analysis/planner.py): search the bench
     transformer's placement space for an 8-chip topology of the current
@@ -1948,6 +2056,7 @@ def main():
               lambda: bench_data_codec(on_tpu, configs.get("resnet50"))),
              ("serving", lambda: bench_serving(on_tpu, peak)),
              ("fleet", lambda: bench_fleet(on_tpu, peak)),
+             ("elastic", lambda: bench_elastic(on_tpu, peak)),
              ("planner", lambda: bench_planner(on_tpu, peak)),
              ("decode", lambda: bench_decode(on_tpu, peak)),
              ("transformer", lambda: bench_transformer(on_tpu, peak)),

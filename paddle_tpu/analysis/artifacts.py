@@ -685,3 +685,70 @@ def validate_codec_ab(doc) -> List[str]:
             problems.append("$.parity.tolerance: declared tolerance band "
                             "missing")
     return problems
+
+
+_ELASTIC_REQUIRED = ("steps_total", "step_interval", "crash_step",
+                     "resume_step", "steps_lost", "restarts", "reshards",
+                     "recovery_s", "completed")
+
+#: an elastic 'recovery' on the bench's toy model that takes longer than
+#: this is a hang being recorded as a measurement
+ELASTIC_RECOVERY_CEILING_S = 120.0
+
+
+def validate_elastic(doc) -> List[str]:
+    """Floor checks for bench.py's `elastic` recovery bench ([] =
+    valid), the gconv pattern applied to the restart loop: an
+    impossible recovery reading must never be committed.
+
+      * the injected shrink FIRED: restarts >= 1 (a recovery bench
+        whose fault never fired measured the happy path);
+      * recovery_s is finite, non-negative, and under the
+        ELASTIC_RECOVERY_CEILING_S ceiling;
+      * steps_lost is a non-negative int strictly below the checkpoint
+        interval — exact-step resume can re-train at most interval-1
+        steps; more means the resume point regressed;
+      * the run resumed to COMPLETION (completed is True) — a partial
+        resume is a failed recovery, not a slow one.
+    """
+    if not isinstance(doc, dict):
+        return [f"elastic root is {type(doc).__name__}, not an object"]
+    problems = [f"$.{k}: required field missing"
+                for k in _ELASTIC_REQUIRED if k not in doc]
+    for k in ("steps_total", "step_interval"):
+        v = doc.get(k)
+        if k in doc and (not isinstance(v, int) or isinstance(v, bool)
+                         or v < 1):
+            problems.append(f"$.{k}: {v!r} must be a positive int")
+    restarts = doc.get("restarts")
+    if "restarts" in doc and (not isinstance(restarts, int)
+                              or isinstance(restarts, bool)
+                              or restarts < 1):
+        problems.append(
+            f"$.restarts: {restarts!r} — the injected shrink must "
+            "actually fire (>= 1 restart), else the bench measured the "
+            "happy path")
+    rec = doc.get("recovery_s")
+    if "recovery_s" in doc and (
+            not isinstance(rec, (int, float)) or isinstance(rec, bool)
+            or _bad_pred_num(rec) or float(rec) < 0
+            or float(rec) >= ELASTIC_RECOVERY_CEILING_S):
+        problems.append(
+            f"$.recovery_s: {rec!r} must be finite, non-negative, and "
+            f"under {ELASTIC_RECOVERY_CEILING_S} s")
+    lost = doc.get("steps_lost")
+    interval = doc.get("step_interval")
+    if "steps_lost" in doc:
+        if not isinstance(lost, int) or isinstance(lost, bool) or lost < 0:
+            problems.append(f"$.steps_lost: {lost!r} must be a "
+                            "non-negative int")
+        elif isinstance(interval, int) and interval >= 1 \
+                and lost >= interval:
+            problems.append(
+                f"$.steps_lost: {lost} >= step_interval {interval} — "
+                "exact-step resume can lose at most interval-1 steps")
+    if "completed" in doc and doc.get("completed") is not True:
+        problems.append(
+            f"$.completed: {doc.get('completed')!r} — the resumed run "
+            "must train to completion")
+    return problems

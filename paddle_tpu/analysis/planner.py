@@ -74,7 +74,8 @@ from . import schedule as sched_mod
 __all__ = ["PlacementRejected", "NoFeasiblePlacementError", "PlanArtifact",
            "Topology", "plan_placement", "score_mesh", "apply_plan",
            "resolve_plan", "rescore_plan", "rank_correlation",
-           "default_topology", "SEARCH_AXES", "PLAN_SCHEMA_VERSION"]
+           "default_topology", "shrink_topology", "plan_for_devices",
+           "SEARCH_AXES", "PLAN_SCHEMA_VERSION"]
 
 #: searched mesh axes, OUTERMOST first — the order make_mesh lays devices
 #: out, so under a multi-host topology the leading axes are the ones
@@ -856,6 +857,50 @@ def plan_placement(program: Optional[Program] = None,
     if calibration is not None:
         doc["calibration_version"] = calibration.version
     return PlanArtifact(doc)
+
+
+# ---------------------------------------------------------------------------
+# degraded-topology re-planning (the elastic path; resilience/elastic.py)
+# ---------------------------------------------------------------------------
+
+def shrink_topology(base: Topology, n_devices: int) -> Topology:
+    """`base` with `n_devices` surviving chips: the fabric description a
+    preempted slice re-plans under. Chip class and link bandwidths
+    carry over (losing a host does not change the wire); the host count
+    scales to whole surviving hosts — a partial host (device_loss of
+    one chip) degrades to the single-host description, which only makes
+    the cost model PESSIMISTIC about cross-host traffic, never wrong
+    about feasibility."""
+    n = int(n_devices)
+    if n < 1:
+        raise ValueError(f"shrink_topology: need >= 1 device, got {n}")
+    # growth (chips came back) takes the same path: re-describe, re-plan
+    # — "shrink" names the common direction, not a limit
+    cph = max(1, base.chips_per_host)
+    hosts = max(1, n // cph) if n % cph == 0 else 1
+    return Topology(chip=base.chip, n_devices=n, hosts=hosts,
+                    dci_gbps=base.dci_gbps, ici_gbps=base.ici_gbps,
+                    hbm_gb=base.hbm_gb)
+
+
+def plan_for_devices(program: Optional[Program] = None,
+                     n_devices: Optional[int] = None,
+                     base_topology: Optional[Topology] = None,
+                     batch: int = 1, calibration=None,
+                     **kwargs) -> "PlanArtifact":
+    """Re-plan `program` for the currently available device count — the
+    elastic supervisor's planner entry. `base_topology` (default:
+    default_topology()) describes the ORIGINAL fabric; `n_devices`
+    (default: the base's count) is how many chips survive. The search
+    space needs nothing new: _mesh_candidates already enumerates every
+    factorization for every divisor device count, with {dp: 1} as the
+    always-feasible floor, so a shrunk topology plans exactly like a
+    fresh one."""
+    base = base_topology or default_topology()
+    n = int(n_devices) if n_devices else base.n_devices
+    topo = shrink_topology(base, n) if n != base.n_devices else base
+    return plan_placement(program, topo, batch=batch,
+                          calibration=calibration, **kwargs)
 
 
 # ---------------------------------------------------------------------------

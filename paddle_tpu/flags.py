@@ -442,3 +442,18 @@ declare_env_knob("PT_FLEET_AUTOSCALE",
                  "signals, hysteresis; scale-up fast on sustained "
                  "depth, scale-down slow after an idle window, "
                  "bounded by PT_FLEET_MIN/PT_FLEET_MAX)")
+declare_env_knob("PT_ELASTIC_TOPOLOGY",
+                 "elastic training (resilience/elastic.py): the "
+                 "topology that SURVIVES a preemption, same grammar as "
+                 "PT_PLAN_TOPOLOGY — the supervisor re-plans onto it "
+                 "on the next restart. Unset = the launch topology "
+                 "shrunk by the fault sites' reported losses "
+                 "(mesh_shrink halves, device_loss drops one chip)")
+declare_env_knob("PT_ELASTIC_RESTARTS",
+                 "elastic supervisor restart budget: bounded attempts "
+                 "after the first run (default 3); exhaustion "
+                 "re-raises the original training error")
+declare_env_knob("PT_ELASTIC_BACKOFF_S",
+                 "elastic supervisor base restart backoff in seconds "
+                 "(default 0.05; exponential with seeded jitter, "
+                 "capped at 30 s)")

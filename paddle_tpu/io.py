@@ -32,6 +32,8 @@ __all__ = [
     "export_serving_model", "export_decode_model", "load_serving_model",
     "save_checkpoint", "load_checkpoint", "clean_checkpoint",
     "get_latest_checkpoint_serial", "CheckpointCorruptError",
+    "PlanMismatchError", "plan_stamp", "read_plan_stamp",
+    "check_plan_stamp", "PLAN_STAMP_KEYS",
 ]
 
 SUCCESS_MARK_FILENAME = "_SUCCESS"
@@ -1011,9 +1013,66 @@ def get_latest_checkpoint_serial(checkpoint_dir: str,
     return -1
 
 
+#: the subset of a PlacementPlan a checkpoint records as its plan stamp:
+#: everything needed to decide "can this state restore onto THAT mesh
+#: as-is, and if not, how to reshard it" — and nothing else (predictions,
+#: collectives, costs are re-derived by the planner on the new topology)
+PLAN_STAMP_KEYS = ("mesh", "specs", "zero", "sp_mode", "batch",
+                   "devices_used", "program_fingerprint",
+                   "calibration_version")
+
+
+def plan_stamp(plan: Optional[dict]) -> Optional[dict]:
+    """Project a plan dict down to the fields a checkpoint stamps into
+    its manifest (PLAN_STAMP_KEYS). None in, None out."""
+    if not plan:
+        return None
+    return {k: plan[k] for k in PLAN_STAMP_KEYS if k in plan}
+
+
+def read_plan_stamp(checkpoint_dir: str,
+                    serial: Optional[int] = None) -> Optional[dict]:
+    """The plan stamp recorded in a committed checkpoint's manifest, or
+    None (unstamped / pre-elastic / legacy checkpoint). `serial=None`
+    reads the newest committed serial."""
+    if serial is None:
+        serial = get_latest_checkpoint_serial(checkpoint_dir, verify=False)
+    if serial < 0:
+        return None
+    man = _manifest.read_manifest(_serial_dir(checkpoint_dir, serial))
+    if not man:
+        return None
+    stamp = man.get("plan_stamp")
+    return stamp if isinstance(stamp, dict) else None
+
+
+class PlanMismatchError(IOError):
+    """The checkpoint's plan stamp does not match the mesh/specs it is
+    being restored onto, and the caller did not opt into resharding.
+    Restoring dp-sharded (ZeRO) state onto a different mesh without a
+    reshard silently loads wrong optimizer slices — refuse loudly."""
+
+
+def check_plan_stamp(stamp: Optional[dict],
+                     expect_plan: Optional[dict]) -> List[str]:
+    """Mismatches between a checkpoint's plan stamp and the plan it is
+    about to be restored under. Empty list = compatible as-is. An
+    unstamped checkpoint or no expectation checks nothing (legacy
+    acceptance — same contract as manifest 'legacy')."""
+    if not stamp or not expect_plan:
+        return []
+    problems: List[str] = []
+    for key in ("mesh", "specs", "zero", "sp_mode"):
+        a, b = stamp.get(key), expect_plan.get(key)
+        if a is not None and b is not None and a != b:
+            problems.append(f"plan_stamp.{key}: checkpoint {a!r} != "
+                            f"target {b!r}")
+    return problems
+
+
 def save_checkpoint(executor=None, checkpoint_dir: str = "", trainer_id: int = 0,
                     trainer_args: Optional[dict] = None, main_program=None,
-                    max_num_checkpoints: int = 3, scope=None):
+                    max_num_checkpoints: int = 3, scope=None, plan=None):
     """io.py:466: write serial dir, then _SUCCESS marker, then scroll old.
 
     Multi-host safe (≙ each pserver checkpointing only its own shard,
@@ -1057,7 +1116,10 @@ def save_checkpoint(executor=None, checkpoint_dir: str = "", trainer_id: int = 0
         # barrier above guarantees it): a crash anywhere in this window
         # leaves an uncommitted dir the next save clears, never a
         # _SUCCESS-marked serial that cannot be verified
-        _manifest.write_manifest(cur, layout="checkpoint")
+        stamp = plan_stamp(plan)
+        _manifest.write_manifest(
+            cur, layout="checkpoint",
+            extra={"plan_stamp": stamp} if stamp else None)
         faults.crash_point("commit_crash")
         marker = os.path.join(cur, SUCCESS_MARK_FILENAME)
         tmp = marker + f".tmp{os.getpid()}"
@@ -1070,13 +1132,23 @@ def save_checkpoint(executor=None, checkpoint_dir: str = "", trainer_id: int = 0
 
 def load_checkpoint(executor=None, checkpoint_dir: str = "", serial: Optional[int] = None,
                     main_program=None, trainer_id: int = 0, scope=None,
-                    verify: Optional[bool] = None):
+                    verify: Optional[bool] = None,
+                    expect_plan: Optional[dict] = None,
+                    reshard: bool = False):
     """io.py:504: restore persistables (+ trainer args if present).
 
     `verify=False` skips manifest re-verification of an explicit serial —
     for callers that just selected it via the verifying
     get_latest_checkpoint_serial (re-digesting a multi-GB checkpoint
-    doubles resume I/O for nothing)."""
+    doubles resume I/O for nothing).
+
+    `expect_plan` declares the PlacementPlan the restored state is about
+    to run under. If the checkpoint is plan-stamped and the stamp
+    disagrees (mesh axes / per-var specs / zero / sp_mode), the load
+    raises PlanMismatchError — unless `reshard=True`, the elastic path's
+    opt-in: full host arrays load fine here, and the caller (the elastic
+    supervisor / ParallelExecutor(plan=...)) rescatters them onto the new
+    mesh. Unstamped checkpoints check nothing (legacy acceptance)."""
     if serial is None:
         # verified selection: quarantines corrupt serials, falls back to
         # the newest one that verifies
@@ -1093,6 +1165,16 @@ def load_checkpoint(executor=None, checkpoint_dir: str = "", serial: Optional[in
     if serial < 0:
         return None
     cur = _serial_dir(checkpoint_dir, serial)
+    if expect_plan is not None and not reshard:
+        problems = check_plan_stamp(
+            read_plan_stamp(checkpoint_dir, serial), expect_plan)
+        if problems:
+            raise PlanMismatchError(
+                f"checkpoint serial {serial} in {checkpoint_dir!r} was "
+                f"written under a different plan: "
+                f"{'; '.join(problems[:5])} — pass reshard=True (or use "
+                "resilience.elastic / tools/reshard.py) to restore onto "
+                "the new mesh")
     retry_call(load_persistables, executor, cur, main_program, scope=scope,
                policy=_LOAD_RETRY)
     args_path = os.path.join(cur, f"trainer_{trainer_id}.json")

@@ -498,6 +498,20 @@ def render_prometheus(snapshot: dict) -> str:
         if snap.get("calibration_version"):
             emit("pt_calib_info",
                  dict(cl, version=str(snap["calibration_version"])), 1)
+    for name, snap in sorted(snapshot.get("elastic", {}).items()):
+        # the elastic supervisor (resilience/elastic.py): restart /
+        # reshard counters, accumulated downtime, and the degraded-mode
+        # chip gauges (current vs the fleet the run was launched for)
+        el = {"supervisor": str(snap.get("name", name))}
+        for key in ("restarts", "reshards"):
+            emit(f"pt_elastic_{key}_total", el, snap.get(key), "counter")
+        emit("pt_elastic_downtime_seconds_total", el,
+             snap.get("downtime_s"), "counter")
+        for key in ("current_chips", "target_chips"):
+            emit(f"pt_elastic_{key}", el, snap.get(key))
+        for site, n in sorted((snap.get("restarts_by_site") or {}).items()):
+            emit("pt_elastic_restart_site_total", dict(el, site=str(site)),
+                 n, "counter")
     return "\n".join(lines) + "\n"
 
 

@@ -24,10 +24,14 @@ from . import guard
 from . import watchdog
 from .guard import GuardConfigError, StepAnomalyError
 from .watchdog import StepHungError
+from . import elastic
+from .elastic import (ElasticMetrics, ElasticSupervisor, ReshardError,
+                      reshard_state)
 
 __all__ = [
     "FaultInjected", "FaultPlan", "active_plan", "crash_point", "fire",
     "reset", "RetryPolicy", "resilient_reader", "retry_call", "manifest",
     "guard", "watchdog", "GuardConfigError", "StepAnomalyError",
-    "StepHungError",
+    "StepHungError", "elastic", "ElasticSupervisor", "ElasticMetrics",
+    "ReshardError", "reshard_state",
 ]

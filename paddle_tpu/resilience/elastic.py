@@ -1,0 +1,411 @@
+"""Elastic training: survive preemption and topology shrink.
+
+A checkpoint written under one PlacementPlan used to be restorable only
+onto the *same* mesh — lose a host on a preemptible slice and the run
+was dead until the exact topology returned. This module closes the loop
+the planner opened: checkpoints are plan-stamped (io.save_checkpoint
+merges the plan's mesh axes + per-var specs + calibration version into
+the manifest, bound by the _SUCCESS marker like every other byte), and
+the ``ElasticSupervisor`` wraps a Trainer factory in a bounded restart
+loop that, on every crash/preemption/topology change:
+
+  1. restores the latest *verified* checkpoint (the Trainer's own
+     auto-resume — manifest-verified selection, corrupt serials
+     quarantined),
+  2. invokes the planner for the topology that actually survives
+     (``PT_ELASTIC_TOPOLOGY`` override, else the launch topology shrunk
+     by the losses the fault sites reported: ``mesh_shrink`` halves it,
+     ``device_loss`` drops one chip),
+  3. reshards the restored state from the checkpoint's recorded plan
+     onto the new winning plan — ``reshard_state`` gathers to full host
+     arrays, structurally validates every dim of the new layout
+     (dp/tp/sp re-splits including ZeRO dp-sharded accumulators), and
+     the fresh ``ParallelExecutor(plan=...)`` rescatters on dispatch,
+  4. resumes at the exact recorded step with the data-pipeline cursor
+     intact (trainer_args + reader fast-forward) — degraded but alive
+     on fewer chips.
+
+The restart budget reuses ``retry.RetryPolicy`` (bounded attempts,
+exponential backoff + seeded jitter, injectable sleep/clock), and
+exhaustion re-raises the ORIGINAL error. Every leg is observable:
+``pt_elastic_*`` metrics (restarts, reshards, downtime seconds,
+current/target chips) on the unified registry, ``elastic:restart``
+trace spans on the obs plane. ``tools/reshard.py`` is the offline CLI
+over the same ``reshard_state``. Chaos-driven end to end: the
+``device_loss`` / ``mesh_shrink`` sites fire deterministically at
+trainer step boundaries under ``PT_FAULT_INJECT``. See
+docs/resilience.md ("Elastic training").
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .faults import FaultInjected
+from .retry import RetryPolicy
+
+__all__ = ["ElasticSupervisor", "ElasticMetrics", "ReshardError",
+           "reshard_state", "current_topology", "DEFAULT_RESTARTS",
+           "DEFAULT_BACKOFF_S"]
+
+#: restart budget default (PT_ELASTIC_RESTARTS)
+DEFAULT_RESTARTS = 3
+#: base backoff default in seconds (PT_ELASTIC_BACKOFF_S)
+DEFAULT_BACKOFF_S = 0.05
+
+
+class ReshardError(RuntimeError):
+    """The restored state cannot be laid out under the target plan
+    (a dim not divisible by its new mesh-axis factor, a var the plan
+    shards that the state lacks, a cross-process array this in-process
+    gather cannot assemble). Structural — retrying cannot help, which
+    is why it is not an OSError: retry layers must not re-run it."""
+
+
+# ---------------------------------------------------------------------------
+# resharding: gather -> validate -> (executor rescatters on dispatch)
+# ---------------------------------------------------------------------------
+
+def _dim_factor(entry, mesh: Dict[str, int]) -> int:
+    """The shard factor one per-dim spec entry imposes: an axis name,
+    a list of axis names (multi-axis dim), or None (replicated)."""
+    if entry is None:
+        return 1
+    axes = entry if isinstance(entry, (list, tuple)) else (entry,)
+    f = 1
+    for a in axes:
+        f *= int(mesh.get(a, 1))
+    return f
+
+
+def reshard_state(state: Dict[str, "np.ndarray"],
+                  from_plan: Optional[dict], to_plan: dict,
+                  place: bool = False) -> Dict[str, np.ndarray]:
+    """Re-lay out checkpointed/live state from `from_plan` onto
+    `to_plan`: gather every value to a full host array, then validate
+    that the target plan's per-var specs structurally fit the actual
+    shapes (every sharded dim divisible by the product of its mesh-axis
+    sizes — the ZeRO dp-sharded accumulators are ordinary specs here,
+    because ``_annotate_defaults`` made the dp feed split and the
+    zero accumulators explicit in the plan).
+
+    Checkpoints hold FULL arrays per var (single-process saves; the
+    multi-process shard pieces were reassembled by the loader), so the
+    gather is exact and a round-trip A→B→A is bit-identical. The
+    rescatter itself is the executor's job — ``ParallelExecutor
+    (plan=to_plan)`` device_puts host arrays under the plan's
+    NamedShardings on first dispatch — so this function returns host
+    arrays; ``place=True`` additionally device_puts them eagerly under
+    the target mesh (tools/reshard.py leaves it False: offline).
+
+    Raises ReshardError on structural impossibility, listing every
+    offending (var, dim). `from_plan` may be None (unstamped/legacy
+    checkpoint — nothing to gather differently; validation still
+    runs)."""
+    mesh = {str(a): int(s) for a, s in (to_plan.get("mesh") or {}).items()}
+    specs = to_plan.get("specs") or {}
+    problems: List[str] = []
+    gathered: Dict[str, np.ndarray] = {}
+    for name, val in state.items():
+        if val is None:
+            continue
+        if getattr(val, "is_fully_addressable", True) is False:
+            raise ReshardError(
+                f"{name!r} is a cross-process array — in-process "
+                "resharding needs every shard addressable; gather the "
+                "per-process checkpoint shard files into one directory "
+                "and use tools/reshard.py offline instead")
+        gathered[name] = np.asarray(val)  # host-sync: ok — the gather
+    for name, spec in specs.items():
+        arr = gathered.get(name)
+        if arr is None:
+            # a plan var the state lacks: the executor's own missing-var
+            # handling owns absence; resharding only validates presence
+            continue
+        for dim, entry in enumerate(spec):
+            f = _dim_factor(entry, mesh)
+            if f <= 1:
+                continue
+            size = int(arr.shape[dim]) if dim < arr.ndim else 1
+            if size % f:
+                problems.append(
+                    f"{name}: dim {dim} of size {size} not divisible by "
+                    f"its mesh factor {f} ({entry!r} under {mesh})")
+    if problems:
+        raise ReshardError(
+            "state cannot be laid out under the target plan:\n  "
+            + "\n  ".join(problems))
+    if place:
+        import jax
+        from jax.sharding import NamedSharding
+        from ..parallel.mesh import mesh_from_plan, spec_for
+        device_mesh = mesh_from_plan(to_plan)
+        for name, arr in gathered.items():
+            spec = specs.get(name)
+            if spec is None:
+                continue
+            gathered[name] = jax.device_put(
+                arr, NamedSharding(device_mesh, spec_for(spec,
+                                                         device_mesh)))
+    return gathered
+
+
+# ---------------------------------------------------------------------------
+# metrics provider (pt_elastic_*, REGISTRY section "elastic")
+# ---------------------------------------------------------------------------
+
+class ElasticMetrics:
+    """One supervisor's counters. Thread-safe: the restart loop records
+    while HTTP scrapes read."""
+
+    def __init__(self, name: str = "elastic",
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.restarts = 0
+            self.reshards = 0
+            self.downtime_s = 0.0
+            self.current_chips: Optional[int] = None
+            self.target_chips: Optional[int] = None
+            self.restarts_by_site: Dict[str, int] = {}
+
+    def on_restart(self, site: Optional[str] = None) -> None:
+        with self._lock:
+            self.restarts += 1
+            key = site or "error"
+            self.restarts_by_site[key] = \
+                self.restarts_by_site.get(key, 0) + 1
+
+    def on_reshard(self) -> None:
+        with self._lock:
+            self.reshards += 1
+
+    def add_downtime(self, seconds: float) -> None:
+        with self._lock:
+            self.downtime_s += max(0.0, float(seconds))
+
+    def set_chips(self, current: Optional[int],
+                  target: Optional[int]) -> None:
+        with self._lock:
+            if current is not None:
+                self.current_chips = int(current)
+            if target is not None:
+                self.target_chips = int(target)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "restarts": self.restarts,
+                "reshards": self.reshards,
+                "downtime_s": round(self.downtime_s, 6),
+                "current_chips": self.current_chips,
+                "target_chips": self.target_chips,
+                "restarts_by_site": dict(self.restarts_by_site),
+            }
+
+
+# ---------------------------------------------------------------------------
+# topology detection
+# ---------------------------------------------------------------------------
+
+def current_topology(base=None):
+    """The topology the next attempt should plan for: the
+    ``PT_ELASTIC_TOPOLOGY`` override when set (the operator — or the
+    resource manager's eviction hook — describing what actually
+    survives, same grammar as PT_PLAN_TOPOLOGY), else `base`, else the
+    planner's default. Read per restart, so a changed env between
+    attempts is honored."""
+    from ..parallel.mesh import Topology
+    raw = os.environ.get("PT_ELASTIC_TOPOLOGY", "").strip()
+    if raw:
+        return Topology.parse(raw)
+    if base is not None:
+        return base
+    from ..analysis import planner
+    return planner.default_topology()
+
+
+# ---------------------------------------------------------------------------
+# the supervisor
+# ---------------------------------------------------------------------------
+
+class ElasticSupervisor:
+    """Run a Trainer to completion across crashes, preemptions, and
+    topology changes.
+
+    `make_trainer` is a zero-arg factory returning a FRESH Trainer
+    (new programs, new scope, a CheckpointConfig pointing at the run's
+    checkpoint dir). Construction already performs the verified
+    auto-resume; the supervisor then plans for the surviving topology
+    (``analysis.planner.plan_for_devices`` — the search space needs
+    nothing new, every divisor device count is already enumerated),
+    validates the restored state against the winning plan
+    (``reshard_state``), assigns it (``trainer.plan`` — the parallel
+    executor rescatters, checkpoints stamp the NEW plan), and trains.
+
+    On an exception the loop classifies it (``FaultInjected.site`` —
+    ``mesh_shrink`` halves the tracked chip count, ``device_loss``
+    drops one; anything else restarts on the same topology), backs off
+    per the RetryPolicy (PT_ELASTIC_RESTARTS attempts,
+    PT_ELASTIC_BACKOFF_S base, seeded jitter), and goes again.
+    Exhaustion re-raises the ORIGINAL error. ``planning=False`` keeps
+    the restart/restore loop but never re-plans (single-chip runs).
+
+    Not multi-host: a multi-process slice restarts whole processes
+    through the cluster scheduler; this supervisor is the single-
+    process (and emulated-mesh) recovery path the chaos harness can
+    drive deterministically."""
+
+    def __init__(self, make_trainer: Callable[[], "object"],
+                 batch: int = 1, base_topology=None,
+                 policy: Optional[RetryPolicy] = None,
+                 planning: bool = True, calibration=None,
+                 metrics: Optional[ElasticMetrics] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 plan_kwargs: Optional[dict] = None):
+        from ..flags import env_knob_float, env_knob_int
+        self.make_trainer = make_trainer
+        self.batch = int(batch)
+        self.base_topology = base_topology
+        self.planning = bool(planning)
+        self.calibration = calibration
+        self.plan_kwargs = dict(plan_kwargs or {})
+        self._clock = clock
+        if policy is None:
+            policy = RetryPolicy(
+                retries=env_knob_int("PT_ELASTIC_RESTARTS",
+                                     DEFAULT_RESTARTS),
+                base_delay=env_knob_float("PT_ELASTIC_BACKOFF_S",
+                                          DEFAULT_BACKOFF_S),
+                max_delay=30.0)
+        self.policy = policy
+        self.metrics = metrics or ElasticMetrics()
+        from ..obs.metrics import REGISTRY
+        REGISTRY.register("elastic", self.metrics.name, self.metrics)
+        #: chips the supervisor believes survive (None until first run)
+        self.current_chips: Optional[int] = None
+        self.trainer = None
+        self.restarts = 0
+
+    # -- one attempt's setup: restore + re-plan + reshard-validate ---------
+    def _site_of(self, exc: BaseException) -> Optional[str]:
+        e: Optional[BaseException] = exc
+        while e is not None:
+            if isinstance(e, FaultInjected):
+                return e.site
+            e = e.__cause__ or e.__context__
+        return None
+
+    def _shrink_for(self, site: Optional[str]) -> None:
+        if self.current_chips is None:
+            return
+        if site == "mesh_shrink":
+            self.current_chips = max(1, self.current_chips // 2)
+        elif site == "device_loss":
+            self.current_chips = max(1, self.current_chips - 1)
+
+    def _checkpoint_state(self, trainer) -> Dict[str, np.ndarray]:
+        """The restored persistable state, by name, from the trainer's
+        scope (params + optimizer accumulators — what checkpoints
+        hold)."""
+        out: Dict[str, np.ndarray] = {}
+        for v in trainer.train_program.list_vars():
+            if not getattr(v, "persistable", False):
+                continue
+            val = trainer.scope.find_var(v.name)
+            if val is not None:
+                out[v.name] = val
+        return out
+
+    def _prepare(self, restart_n: int, site: Optional[str]):
+        """Build the attempt's trainer: restore, re-plan for the
+        surviving topology, validate the reshard. Returns the trainer,
+        ready to train."""
+        from .. import io as io_mod
+        from ..obs import trace as obs_trace
+        topo = current_topology(self.base_topology)
+        if self.base_topology is None:
+            self.base_topology = topo
+        if self.current_chips is None or topo is not self.base_topology:
+            # a PT_ELASTIC_TOPOLOGY override IS the surviving fabric —
+            # it wins over the in-process loss accounting
+            self.current_chips = topo.n_devices
+        with obs_trace.span("elastic:restart", cat="elastic",
+                            restart=restart_n, site=site or "",
+                            chips=self.current_chips):
+            trainer = self.make_trainer()
+            plan = None
+            if self.planning:
+                from ..analysis import planner
+                art = planner.plan_for_devices(
+                    trainer.train_program,
+                    n_devices=self.current_chips,
+                    base_topology=self.base_topology,
+                    batch=self.batch, calibration=self.calibration,
+                    **self.plan_kwargs)
+                plan = art.top
+                cfg = trainer.checkpoint_cfg
+                stamp = (io_mod.read_plan_stamp(cfg.checkpoint_dir)
+                         if cfg else None)
+                if stamp and io_mod.check_plan_stamp(stamp, plan):
+                    # the restore crossed plans: validate the new
+                    # layout against the actual restored shapes, then
+                    # count the reshard (the executor rescatters on
+                    # first dispatch)
+                    reshard_state(self._checkpoint_state(trainer),
+                                  from_plan=stamp, to_plan=plan)
+                    self.metrics.on_reshard()
+                    obs_trace.instant(
+                        "elastic_reshard", cat="elastic",
+                        from_mesh=str(stamp.get("mesh")),
+                        to_mesh=str(plan.get("mesh")))
+                trainer.plan = plan
+                trainer.parallel = True
+        self.metrics.set_chips(self.current_chips,
+                               self.base_topology.n_devices)
+        return trainer
+
+    def run(self, *args, **train_kwargs):
+        """Train to completion under the restart budget; returns the
+        (last) Trainer on success. Positional/keyword args are passed
+        through to ``Trainer.train`` on every attempt — the reader must
+        be re-invocable (any pipeline/callable reader is)."""
+        delays = self.policy.delays()
+        restart_n = 0
+        site: Optional[str] = None
+        down_since: Optional[float] = None
+        while True:
+            trainer = self._prepare(restart_n, site)
+            self.trainer = trainer
+            if down_since is not None:
+                self.metrics.add_downtime(self._clock() - down_since)
+                down_since = None
+            try:
+                trainer.train(*args, **train_kwargs)
+                return trainer
+            except Exception as e:  # noqa: BLE001 — policy filters below
+                down_since = self._clock()
+                site = self._site_of(e)
+                delay = next(delays, None)
+                if delay is None or not self.policy.should_retry(e):
+                    raise
+                self._shrink_for(site)
+                self.restarts = restart_n = restart_n + 1
+                self.metrics.on_restart(site)
+                from ..obs import trace as obs_trace
+                obs_trace.instant("elastic_crash", cat="elastic",
+                                  site=site or type(e).__name__,
+                                  restart=restart_n,
+                                  chips=self.current_chips)
+                self.policy.sleep(delay)

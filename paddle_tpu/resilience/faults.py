@@ -47,6 +47,12 @@ error, not a silently-never-firing spec):
                         micro-batcher's dispatcher loop — the batch's
                         requests fail with a typed RequestFailed and the
                         loop keeps serving
+    device_loss         Trainer.train, at the top of each step: one chip
+                        drops out of the mesh (preemptible-VM eviction) —
+                        the elastic supervisor re-plans on one fewer chip
+    mesh_shrink         Trainer.train, at the top of each step: the mesh
+                        halves (a host is preempted) — the elastic
+                        supervisor re-plans on the surviving topology
 """
 
 from __future__ import annotations
@@ -79,6 +85,12 @@ SITES: Dict[str, str] = {
                        "routed request (serving/fleet/router.py): the "
                        "router fails over to the next-best replica and "
                        "rebuilds the crashed one",
+    "device_loss": "one chip drops out of the mesh at a trainer step "
+                   "boundary (preemptible eviction); the elastic "
+                   "supervisor re-plans on one fewer chip",
+    "mesh_shrink": "the mesh halves at a trainer step boundary (host "
+                   "preemption); the elastic supervisor re-plans on "
+                   "the surviving topology",
 }
 
 ENV_VAR = "PT_FAULT_INJECT"

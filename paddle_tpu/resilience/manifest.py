@@ -77,9 +77,15 @@ def _digest(path: str) -> Tuple[int, int]:
     return size, crc & 0xFFFFFFFF
 
 
-def write_manifest(dirname: str, layout: str = "checkpoint") -> dict:
+def write_manifest(dirname: str, layout: str = "checkpoint",
+                   extra: Optional[dict] = None) -> dict:
     """Digest every regular file in `dirname` (flat — checkpoint serial
-    dirs have no nesting) into manifest.json, atomically."""
+    dirs have no nesting) into manifest.json, atomically.
+
+    `extra` keys are merged into the manifest document (e.g. the
+    checkpoint's plan stamp). Because _SUCCESS stores the manifest
+    file's own size+crc32, anything merged here rides the same
+    marker -> manifest -> data integrity binding for free."""
     files: Dict[str, dict] = {}
     for name in sorted(os.listdir(dirname)):
         path = os.path.join(dirname, name)
@@ -88,6 +94,12 @@ def write_manifest(dirname: str, layout: str = "checkpoint") -> dict:
         size, crc = _digest(path)
         files[name] = {"size": size, "crc32": crc}
     man = {"version": 1, "layout": layout, "files": files}
+    if extra:
+        for k, v in extra.items():
+            if k in man:
+                raise ValueError(f"manifest extra key {k!r} collides "
+                                 "with a reserved manifest field")
+            man[k] = v
     path = os.path.join(dirname, MANIFEST_FILENAME)
     tmp = path + f".tmp{os.getpid()}"
     with open(tmp, "w") as f:

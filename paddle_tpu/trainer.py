@@ -130,10 +130,26 @@ class Trainer:
     def __init__(self, train_func: Callable, optimizer_func: Callable,
                  place: Optional[Place] = None, param_path: Optional[str] = None,
                  parallel: bool = False,
-                 checkpoint_config: Optional[CheckpointConfig] = None):
+                 checkpoint_config: Optional[CheckpointConfig] = None,
+                 plan=None, reshard: bool = False):
         self.parallel = parallel
         self.place = place
         self.checkpoint_cfg = checkpoint_config
+        #: PlacementPlan (dict/artifact/path — planner.resolve_plan forms)
+        #: the parallel executor runs under; checkpoints are stamped with
+        #: it so elastic restore can reshard onto a different mesh
+        self.plan = None
+        if plan is not None:
+            from .analysis import planner as planner_mod
+            self.plan = planner_mod.resolve_plan(plan)
+            self.parallel = True
+        #: reshard=True lets auto-resume restore a checkpoint stamped
+        #: under a DIFFERENT plan (the elastic supervisor's opt-in — full
+        #: host arrays load fine; ParallelExecutor(plan=...) rescatters
+        #: them onto the new mesh). Default False: a mismatched stamp
+        #: refuses with PlanMismatchError instead of silently re-laying
+        #: out dp-sharded state.
+        self._reshard_on_resume = bool(reshard)
         #: set True when train() exited early on SIGTERM/SIGINT (after
         #: checkpointing at the step boundary) — the preemption contract
         self.preempted = False
@@ -207,7 +223,9 @@ class Trainer:
                     args = io_mod.load_checkpoint(
                         self.exe, self.checkpoint_cfg.checkpoint_dir, serial,
                         self.train_program, trainer_id=jax.process_index(),
-                        scope=self.scope, verify=False)
+                        scope=self.scope, verify=False,
+                        expect_plan=self.plan,
+                        reshard=self._reshard_on_resume)
                     self._restore_trainer_args(args)
 
     def _restore_trainer_args(self, args: Optional[dict]) -> None:
@@ -595,7 +613,7 @@ class Trainer:
             feeder = DataFeeder(feed_vars, program=self.train_program)
             executor = (ParallelExecutor(loss_name=self.loss.name,
                                          main_program=self.train_program,
-                                         scope=self.scope)
+                                         scope=self.scope, plan=self.plan)
                         if self.parallel else self.exe)
             # pt_train_compile_events_total counts compiles THIS run
             # caused: the executor's lifetime counter already includes
@@ -667,6 +685,10 @@ class Trainer:
                     compile_prior = self._compile_events_prior
                     compile0 = getattr(new_exe, "compile_count", 0)
                     executor = new_exe
+                    # future checkpoints must stamp the plan actually
+                    # running, or an elastic restore would reshard FROM
+                    # the stale pre-replan layout
+                    self.plan = art.top
                     calib_mod.METRICS.note_replan(ver)
                     obs_trace.instant("replan_applied", cat="train",
                                       mesh=str(art.top.get("mesh")))
@@ -856,6 +878,11 @@ class Trainer:
                     step_id = resume_step
                     for window in windows:
                         faults.crash_point("step_crash")
+                        # elastic sites: a chip eviction / host preemption
+                        # at a step boundary — the supervisor re-plans on
+                        # the surviving topology (resilience/elastic.py)
+                        faults.crash_point("device_loss")
+                        faults.crash_point("mesh_shrink")
                         n_in_window = (steps_per_loop
                                        if isinstance(window, dict)
                                        else len(window))
@@ -929,6 +956,8 @@ class Trainer:
                     continue
                 for step_id, feed in enumerate(batches, start=resume_step):
                     faults.crash_point("step_crash")
+                    faults.crash_point("device_loss")
+                    faults.crash_point("mesh_shrink")
                     begin = BeginStepEvent(epoch_id, step_id)
                     event_handler(begin)
                     fetch = self.train_func_outputs if begin.fetch_metrics else []
@@ -1071,7 +1100,7 @@ class Trainer:
                               "run_counter": self.exe._run_counter},
                 main_program=self.train_program,
                 max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
-                scope=self.scope)
+                scope=self.scope, plan=self.plan)
         tm = getattr(self, "train_metrics", None)
         if tm is not None:
             tm.on_checkpoint()

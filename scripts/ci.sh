@@ -65,12 +65,14 @@ if [[ "${1:-}" == "chaos" ]]; then
   # the recovery invariants are exercised on two distinct failure
   # schedules, both reproducible.
   for seed in 0 7; do
-    echo "== chaos: resilience + guardrail + fleet suites (PT_CHAOS_SEED=$seed) =="
+    echo "== chaos: resilience + guardrail + elastic + fleet suites (PT_CHAOS_SEED=$seed) =="
     # the fleet suite rides along: its router_dispatch chaos site
     # (deterministic replica-crash injection at dispatch) exercises the
-    # failover/rebuild path under the same seeded harness
+    # failover/rebuild path under the same seeded harness; the elastic
+    # suite drives mesh_shrink/device_loss through the supervisor's
+    # restore -> re-plan -> reshard -> resume loop
     PT_CHAOS_SEED=$seed python -m pytest tests/test_resilience.py \
-      tests/test_guardrails.py tests/test_fleet.py -q
+      tests/test_guardrails.py tests/test_elastic.py tests/test_fleet.py -q
   done
   echo "CHAOS OK"
   exit 0

@@ -1,0 +1,552 @@
+"""Elastic training tests: plan-stamped checkpoints, reshard-restore,
+re-planning onto the surviving mesh (resilience/elastic.py).
+
+Every scenario drives a REAL topology change — an injected mesh_shrink /
+device_loss fault at a trainer step boundary, or an explicit cross-plan
+restore — and asserts the run comes back: restored from a verified
+checkpoint, re-planned for the surviving device count, state resharded
+(or the mismatch refused loudly), training resumed at the exact recorded
+step. scripts/ci.sh chaos replays this file under two PT_CHAOS_SEED
+values alongside test_resilience.py.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io as io_mod
+from paddle_tpu import layers
+from paddle_tpu.analysis import planner
+from paddle_tpu.resilience import FaultInjected, faults
+from paddle_tpu.resilience.elastic import (ElasticMetrics, ElasticSupervisor,
+                                           ReshardError, reshard_state)
+from paddle_tpu.resilience.retry import RetryPolicy
+
+CHAOS_SEED = int(os.environ.get("PT_CHAOS_SEED", "0"))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_fault_plan(monkeypatch):
+    """Each test starts with no armed plan and fresh hit counters."""
+    monkeypatch.delenv("PT_FAULT_INJECT", raising=False)
+    monkeypatch.delenv("PT_ELASTIC_TOPOLOGY", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv("PT_FAULT_INJECT", spec)
+    faults.reset()
+
+
+def _plan(mesh, specs, **extra):
+    return dict({"mesh": mesh, "specs": specs}, **extra)
+
+
+# ---------------------------------------------------------------------------
+# reshard_state: gather + structural validation
+# ---------------------------------------------------------------------------
+
+class TestReshardState:
+    @pytest.mark.parametrize("from_mesh,to_mesh", [
+        ({"dp": 8}, {"dp": 4}),                # preemption halves the slice
+        ({"dp": 4}, {"dp": 2, "tp": 2}),       # dp -> dp x tp re-split
+        ({"dp": 2, "tp": 2}, {"dp": 8}),       # growth: chips came back
+    ])
+    def test_cross_mesh_gather_is_bit_identical(self, from_mesh, to_mesh):
+        rs = np.random.RandomState(7 + CHAOS_SEED)
+        state = {"fc_0.w_0": rs.randn(8, 4).astype(np.float32),
+                 "fc_0.b_0": rs.randn(4).astype(np.float32)}
+        specs = {"fc_0.w_0": ["dp", None], "fc_0.b_0": [None]}
+        out = reshard_state(state,
+                            from_plan=_plan(from_mesh, specs),
+                            to_plan=_plan(to_mesh, specs))
+        assert set(out) == set(state)
+        for name in state:
+            np.testing.assert_array_equal(out[name], state[name])
+
+    def test_round_trip_a_b_a_is_bit_identical(self):
+        rs = np.random.RandomState(11 + CHAOS_SEED)
+        state = {"w": rs.randn(16, 8).astype(np.float32)}
+        a = _plan({"dp": 8}, {"w": ["dp", None]})
+        b = _plan({"dp": 2, "tp": 2}, {"w": ["dp", "tp"]})
+        there = reshard_state(state, from_plan=a, to_plan=b)
+        back = reshard_state(there, from_plan=b, to_plan=a)
+        np.testing.assert_array_equal(back["w"], state["w"])
+
+    def test_indivisible_dim_refused_listing_every_offender(self):
+        state = {"w": np.zeros((7, 5), np.float32),
+                 "ok": np.zeros((8,), np.float32)}
+        to = _plan({"tp": 4}, {"w": ["tp", "tp"], "ok": ["tp"]})
+        with pytest.raises(ReshardError) as ei:
+            reshard_state(state, from_plan=None, to_plan=to)
+        msg = str(ei.value)
+        # both offending dims of `w` reported at once; `ok` is fine
+        assert "w: dim 0 of size 7" in msg
+        assert "w: dim 1 of size 5" in msg
+        assert "ok:" not in msg
+
+    def test_multi_axis_dim_uses_the_product_factor(self):
+        # one dim sharded over BOTH axes: factor dp*tp = 8
+        to = _plan({"dp": 4, "tp": 2}, {"w": [["dp", "tp"], None]})
+        out = reshard_state({"w": np.zeros((16, 3), np.float32)},
+                            from_plan=None, to_plan=to)
+        assert out["w"].shape == (16, 3)
+        with pytest.raises(ReshardError, match="mesh factor 8"):
+            reshard_state({"w": np.zeros((12, 3), np.float32)},
+                          from_plan=None, to_plan=to)
+
+    def test_zero_dp_sharded_accumulators_reshard_like_any_spec(self):
+        # a ZeRO plan's optimizer-moment specs are ordinary dp-sharded
+        # entries; moving to a non-ZeRO plan replicates them (spec None)
+        rs = np.random.RandomState(13 + CHAOS_SEED)
+        state = {"fc_0.w_0": rs.randn(8, 2).astype(np.float32),
+                 "fc_0.w_0_moment": rs.randn(8, 2).astype(np.float32)}
+        zero = _plan({"dp": 4},
+                     {"fc_0.w_0": [None, None],
+                      "fc_0.w_0_moment": ["dp", None]}, zero=True)
+        plain = _plan({"dp": 2},
+                      {"fc_0.w_0": [None, None],
+                       "fc_0.w_0_moment": [None, None]}, zero=False)
+        out = reshard_state(state, from_plan=zero, to_plan=plain)
+        for name in state:
+            np.testing.assert_array_equal(out[name], state[name])
+        # and back onto the ZeRO layout: dp must divide the moment rows
+        back = reshard_state(out, from_plan=plain, to_plan=zero)
+        np.testing.assert_array_equal(back["fc_0.w_0_moment"],
+                                      state["fc_0.w_0_moment"])
+
+    def test_cross_process_array_is_refused_toward_the_cli(self):
+        class FakeGlobal:
+            is_fully_addressable = False
+        with pytest.raises(ReshardError, match="tools/reshard.py"):
+            reshard_state({"w": FakeGlobal()}, from_plan=None,
+                          to_plan=_plan({"dp": 2}, {"w": ["dp"]}))
+
+    def test_vars_absent_from_the_plan_pass_through(self):
+        out = reshard_state({"extra": np.ones((3,), np.float32)},
+                            from_plan=None,
+                            to_plan=_plan({"dp": 8}, {}))
+        np.testing.assert_array_equal(out["extra"], np.ones((3,)))
+
+
+# ---------------------------------------------------------------------------
+# plan-stamped checkpoints (io.save_checkpoint / load_checkpoint)
+# ---------------------------------------------------------------------------
+
+def _linreg():
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(0.05).minimize(loss)
+    return main, startup, loss
+
+
+PLAN_A = _plan({"dp": 8}, {"fc_0.w_0": [None, None]}, zero=False,
+               sp_mode="ring", batch=8, devices_used=8)
+PLAN_B = _plan({"dp": 4}, {"fc_0.w_0": [None, None]}, zero=False,
+               sp_mode="ring", batch=8, devices_used=4)
+
+
+class TestPlanStamp:
+    def _save(self, tmp_path, plan):
+        main, startup, _ = _linreg()
+        exe = pt.Executor()
+        exe.run(startup)
+        ckpt = str(tmp_path / "ckpt")
+        pt.io.save_checkpoint(exe, ckpt,
+                              trainer_args={"epoch_id": 0, "step_id": 0},
+                              main_program=main, plan=plan)
+        return main, exe, ckpt
+
+    def test_save_stamps_the_manifest_inside_the_success_binding(
+            self, tmp_path):
+        _, _, ckpt = self._save(tmp_path, PLAN_A)
+        man = json.load(open(os.path.join(ckpt, "checkpoint_0",
+                                          "manifest.json")))
+        stamp = man["plan_stamp"]
+        assert stamp["mesh"] == {"dp": 8}
+        assert io_mod.read_plan_stamp(ckpt) == stamp
+        # the stamp rides the verified payload: serial still commits
+        assert pt.io.get_latest_checkpoint_serial(ckpt) == 0
+
+    def test_matching_expect_plan_loads(self, tmp_path):
+        main, exe, ckpt = self._save(tmp_path, PLAN_A)
+        args = pt.io.load_checkpoint(exe, ckpt, main_program=main,
+                                     expect_plan=PLAN_A)
+        assert args["epoch_id"] == 0
+
+    def test_cross_plan_load_refused_without_reshard_opt_in(self, tmp_path):
+        main, exe, ckpt = self._save(tmp_path, PLAN_A)
+        with pytest.raises(io_mod.PlanMismatchError) as ei:
+            pt.io.load_checkpoint(exe, ckpt, main_program=main,
+                                  expect_plan=PLAN_B)
+        msg = str(ei.value)
+        assert "mesh" in msg and "reshard" in msg
+        # the reshard opt-in is exactly the bypass
+        args = pt.io.load_checkpoint(exe, ckpt, main_program=main,
+                                     expect_plan=PLAN_B, reshard=True)
+        assert args["epoch_id"] == 0
+
+    @pytest.mark.parametrize("field,value", [
+        ("mesh", {"dp": 2, "tp": 4}),
+        ("specs", {"fc_0.w_0": ["tp", None]}),
+        ("zero", True),
+        ("sp_mode", "p2p"),
+    ])
+    def test_mismatch_matrix_each_stamped_field_is_checked(
+            self, tmp_path, field, value):
+        main, exe, ckpt = self._save(tmp_path, PLAN_A)
+        expect = dict(PLAN_A, **{field: value})
+        with pytest.raises(io_mod.PlanMismatchError, match=field):
+            pt.io.load_checkpoint(exe, ckpt, main_program=main,
+                                  expect_plan=expect)
+
+    def test_legacy_unstamped_checkpoint_loads_under_any_plan(
+            self, tmp_path):
+        main, exe, ckpt = self._save(tmp_path, None)
+        assert io_mod.read_plan_stamp(ckpt) is None
+        args = pt.io.load_checkpoint(exe, ckpt, main_program=main,
+                                     expect_plan=PLAN_B)
+        assert args["epoch_id"] == 0
+
+
+# ---------------------------------------------------------------------------
+# degraded-topology re-planning
+# ---------------------------------------------------------------------------
+
+class TestShrinkReplan:
+    def test_shrink_keeps_fabric_and_scales_hosts(self):
+        from paddle_tpu.parallel.mesh import Topology
+        base = Topology(chip="cpu", n_devices=8, hosts=2, dci_gbps=12.5)
+        half = planner.shrink_topology(base, 4)
+        assert (half.n_devices, half.hosts) == (4, 1)
+        assert half.chip == base.chip and half.dci_gbps == base.dci_gbps
+        # a partial host degrades to the single-host description
+        lost_one = planner.shrink_topology(base, 7)
+        assert (lost_one.n_devices, lost_one.hosts) == (7, 1)
+        with pytest.raises(ValueError, match=">= 1"):
+            planner.shrink_topology(base, 0)
+
+    def test_plan_for_devices_wins_a_plan_that_fits_the_survivors(self):
+        main, _, _ = _linreg()
+        art = planner.plan_for_devices(main, n_devices=4, batch=8)
+        top = art.top
+        used = 1
+        for size in top["mesh"].values():
+            used *= int(size)
+        assert used <= 4
+        assert top["specs"], "plan carries per-var specs for the stamp"
+
+
+# ---------------------------------------------------------------------------
+# the supervisor: chaos-driven restart + reshard + resume
+# ---------------------------------------------------------------------------
+
+N_STEPS = 12
+STEP_INTERVAL = 4
+BATCH = 8
+
+
+def _det_reader():
+    rs = np.random.RandomState(1234 + CHAOS_SEED)
+    data = [(rs.randn(4).astype(np.float32),
+             rs.randn(1).astype(np.float32))
+            for _ in range(N_STEPS * BATCH)]
+
+    def reader():
+        yield from data
+    return reader
+
+
+def _make_trainer_factory(ckpt_dir):
+    def make_trainer():
+        pt.core.program.reset_unique_names()
+
+        def train_func():
+            x = layers.data("x", [4])
+            y = layers.data("y", [1])
+            pred = layers.fc(x, size=1)
+            return [layers.mean(layers.square_error_cost(pred, y))]
+
+        cfg = pt.CheckpointConfig(ckpt_dir, step_interval=STEP_INTERVAL)
+        return pt.Trainer(train_func,
+                          lambda: pt.optimizer.SGDOptimizer(0.05),
+                          checkpoint_config=cfg)
+    return make_trainer
+
+
+@pytest.fixture
+def pin_dp_plans(monkeypatch):
+    """Rank the dp-only mesh first so the chaos scenario is the ISSUE's
+    literal one — planned dp=8, resumed on dp=4 — independent of which
+    feasible candidate the cost model happens to favor for a toy model.
+    The plans are still the planner's own (searched, scored, validated);
+    only the tie-break among ranked survivors is pinned."""
+    real = planner.plan_for_devices
+
+    def pinned(program=None, n_devices=None, **kw):
+        kw.setdefault("beam", 64)
+        art = real(program, n_devices=n_devices, **kw)
+        want = {"dp": int(n_devices)}
+        ranked = art.doc["ranked"]
+        for i, p in enumerate(ranked):
+            if p["mesh"] == want and not p.get("zero"):
+                art.doc["ranked"] = [p] + ranked[:i] + ranked[i + 1:]
+                break
+        return art
+    monkeypatch.setattr(planner, "plan_for_devices", pinned)
+
+
+def _quiet_policy(retries=3):
+    return RetryPolicy(retries=retries, base_delay=0.0, jitter=0.0,
+                       seed=CHAOS_SEED, sleep=lambda _d: None)
+
+
+class TestElasticSupervisor:
+    def test_mesh_shrink_resumes_on_half_the_mesh(
+            self, tmp_path, monkeypatch, pin_dp_plans):
+        _arm(monkeypatch, "mesh_shrink@5")
+        steps, losses = [], []
+
+        def handler(event):
+            if isinstance(event, pt.EndStepEvent):
+                steps.append((event.epoch, event.step))
+                if event.metrics:
+                    losses.append(
+                        float(np.asarray(event.metrics[0]).reshape(-1)[0]))
+
+        sup = ElasticSupervisor(_make_trainer_factory(str(tmp_path / "c")),
+                                batch=BATCH, policy=_quiet_policy())
+        trainer = sup.run(num_epochs=1, event_handler=handler,
+                          reader=pt.reader.batch(_det_reader(), BATCH))
+
+        # one restart, halved mesh, one cross-plan reshard
+        assert sup.restarts == 1
+        assert sup.current_chips == 4
+        assert trainer.plan["mesh"] == {"dp": 4}
+        snap = sup.metrics.snapshot()
+        assert snap["restarts"] == 1 and snap["reshards"] == 1
+        assert snap["restarts_by_site"] == {"mesh_shrink": 1}
+        assert (snap["current_chips"], snap["target_chips"]) == (4, 8)
+
+        # the checkpoint's stamp crossed dp8 -> dp4 with the run
+        stamp = io_mod.read_plan_stamp(str(tmp_path / "c"))
+        assert stamp["mesh"] == {"dp": 4}
+
+        # crash at step index 4 (hit 5); steps 0..3 were checkpointed,
+        # so the second attempt resumes at EXACTLY step 4 — the data
+        # cursor fast-forwards, nothing is re-trained or skipped: every
+        # step of the epoch is seen exactly once, in order
+        assert steps == [(0, s) for s in range(N_STEPS)]
+
+        # degraded but alive: the resumed run still learns
+        assert losses[-1] < losses[0]
+
+    def test_device_loss_drops_one_chip(self, tmp_path, monkeypatch):
+        _arm(monkeypatch, "device_loss@3")
+        sup = ElasticSupervisor(_make_trainer_factory(str(tmp_path / "c")),
+                                batch=BATCH, planning=False,
+                                policy=_quiet_policy())
+        sup.run(num_epochs=1, event_handler=lambda e: None,
+                reader=pt.reader.batch(_det_reader(), BATCH))
+        assert sup.restarts == 1
+        assert sup.current_chips == 7  # 8 - 1
+        assert sup.metrics.snapshot()["restarts_by_site"] == \
+            {"device_loss": 1}
+
+    def test_plain_crash_restarts_on_the_same_topology(
+            self, tmp_path, monkeypatch):
+        _arm(monkeypatch, "step_crash@7")
+        steps = []
+
+        def handler(event):
+            if isinstance(event, pt.EndStepEvent):
+                steps.append(event.step)
+
+        sup = ElasticSupervisor(_make_trainer_factory(str(tmp_path / "c")),
+                                batch=BATCH, planning=False,
+                                policy=_quiet_policy())
+        sup.run(num_epochs=1, event_handler=handler,
+                reader=pt.reader.batch(_det_reader(), BATCH))
+        assert sup.restarts == 1
+        assert sup.current_chips == 8  # no topology change
+        assert steps[-1] == N_STEPS - 1
+
+    def test_supervised_resume_is_bit_exact_when_the_mesh_survives(
+            self, tmp_path, monkeypatch):
+        # "where layouts permit": with the topology unchanged the
+        # supervised crash-restore-resume must reproduce the
+        # uninterrupted run bit for bit — same consumed batches, same
+        # resumed loss, same final params
+        def final_params(trainer):
+            with pt.scope_guard(trainer.scope):
+                return {v.name: np.array(trainer.scope.find_var(v.name))
+                        for v in trainer.train_program.global_block
+                        .all_parameters()}
+
+        a = _make_trainer_factory(str(tmp_path / "a"))()
+        a.train(num_epochs=1, event_handler=lambda e: None,
+                reader=pt.reader.batch(_det_reader(), BATCH))
+        want = final_params(a)
+
+        _arm(monkeypatch, "step_crash@7")
+        sup = ElasticSupervisor(_make_trainer_factory(str(tmp_path / "b")),
+                                batch=BATCH, planning=False,
+                                policy=_quiet_policy())
+        b = sup.run(num_epochs=1, event_handler=lambda e: None,
+                    reader=pt.reader.batch(_det_reader(), BATCH))
+        got = final_params(b)
+        assert set(got) == set(want)
+        for name in want:
+            np.testing.assert_array_equal(
+                got[name], want[name],
+                err_msg=f"{name}: supervised resume diverged from the "
+                        "uninterrupted run")
+
+    def test_budget_exhaustion_reraises_the_original_error(
+            self, tmp_path, monkeypatch):
+        _arm(monkeypatch, "step_crash@*")  # every attempt dies
+        sup = ElasticSupervisor(_make_trainer_factory(str(tmp_path / "c")),
+                                batch=BATCH, planning=False,
+                                policy=_quiet_policy(retries=2))
+        with pytest.raises(FaultInjected):
+            sup.run(num_epochs=1, event_handler=lambda e: None,
+                    reader=pt.reader.batch(_det_reader(), BATCH))
+        assert sup.restarts == 2  # budget spent, then re-raise
+
+    def test_elastic_topology_override_wins(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PT_ELASTIC_TOPOLOGY", "cpu:4")
+        sup = ElasticSupervisor(_make_trainer_factory(str(tmp_path / "c")),
+                                batch=BATCH, planning=False,
+                                policy=_quiet_policy())
+        sup.run(num_epochs=1, event_handler=lambda e: None,
+                reader=pt.reader.batch(_det_reader(), BATCH))
+        assert sup.current_chips == 4
+
+    def test_metrics_reach_the_prometheus_exposition(self):
+        from paddle_tpu.obs import metrics as obs_metrics
+        m = ElasticMetrics("sup-test")
+        m.on_restart("mesh_shrink")
+        m.on_reshard()
+        m.add_downtime(0.25)
+        m.set_chips(4, 8)
+        text = obs_metrics.render_prometheus(
+            {"elastic": {"sup-test": m.snapshot()}})
+        assert 'pt_elastic_restarts_total{supervisor="sup-test"} 1' in text
+        assert 'pt_elastic_reshards_total{supervisor="sup-test"} 1' in text
+        assert "pt_elastic_downtime_seconds_total" in text
+        assert 'pt_elastic_restart_site_total{site="mesh_shrink"' in text \
+            or 'site="mesh_shrink"' in text
+        assert obs_metrics.validate_exposition(text) == []
+
+
+# ---------------------------------------------------------------------------
+# tools/reshard.py: the offline CLI over the same reshard_state
+# ---------------------------------------------------------------------------
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "reshard_cli", os.path.join(REPO, "tools", "reshard.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_plan(path, plan):
+    with open(path, "w") as f:
+        json.dump(plan, f)
+    return str(path)
+
+
+class TestReshardCLI:
+    def _stamped_checkpoint(self, tmp_path, plan):
+        main, startup, _ = _linreg()
+        exe = pt.Executor()
+        exe.run(startup)
+        ckpt = str(tmp_path / "ckpt")
+        pt.io.save_checkpoint(exe, ckpt,
+                              trainer_args={"epoch_id": 0, "step_id": 4},
+                              main_program=main, plan=plan)
+        cur = os.path.join(ckpt, "checkpoint_0")
+        arrays = {n[:-4]: np.load(os.path.join(cur, n))
+                  for n in os.listdir(cur) if n.endswith(".npy")}
+        return ckpt, arrays
+
+    def test_round_trip_between_two_plans_is_bit_identical(self, tmp_path):
+        cli = _load_cli()
+        ckpt, want = self._stamped_checkpoint(tmp_path, PLAN_A)
+        plan_b = _write_plan(tmp_path / "b.json", PLAN_B)
+        plan_a = _write_plan(tmp_path / "a.json", PLAN_A)
+
+        out_b = str(tmp_path / "as_b")
+        assert cli.main(["--checkpoint", ckpt, "--to-plan", plan_b,
+                         "--out", out_b]) == 0
+        assert io_mod.read_plan_stamp(out_b)["mesh"] == {"dp": 4}
+        # the re-stamped serial is a first-class verified checkpoint
+        assert pt.io.get_latest_checkpoint_serial(out_b) == 0
+
+        out_a = str(tmp_path / "back_to_a")
+        assert cli.main(["--checkpoint", out_b, "--to-plan", plan_a,
+                         "--out", out_a]) == 0
+        assert io_mod.read_plan_stamp(out_a)["mesh"] == {"dp": 8}
+        cur = os.path.join(out_a, "checkpoint_0")
+        for name, arr in want.items():
+            got = np.load(os.path.join(cur, name + ".npy"))
+            np.testing.assert_array_equal(
+                got, arr, err_msg=f"{name}: A->B->A round trip drifted")
+        # the resume point rode along untouched
+        args = json.load(open(os.path.join(cur, "trainer_0.json")))
+        assert args["step_id"] == 4
+
+    def test_in_place_restamp(self, tmp_path):
+        cli = _load_cli()
+        ckpt, want = self._stamped_checkpoint(tmp_path, PLAN_A)
+        plan_b = _write_plan(tmp_path / "b.json", PLAN_B)
+        assert cli.main(["--checkpoint", ckpt, "--to-plan", plan_b]) == 0
+        assert io_mod.read_plan_stamp(ckpt)["mesh"] == {"dp": 4}
+        assert pt.io.get_latest_checkpoint_serial(ckpt) == 0
+        cur = os.path.join(ckpt, "checkpoint_0")
+        for name, arr in want.items():
+            np.testing.assert_array_equal(
+                np.load(os.path.join(cur, name + ".npy")), arr)
+
+    def test_dry_run_changes_nothing(self, tmp_path):
+        cli = _load_cli()
+        ckpt, _ = self._stamped_checkpoint(tmp_path, PLAN_A)
+        before = io_mod.read_plan_stamp(ckpt)
+        plan_b = _write_plan(tmp_path / "b.json", PLAN_B)
+        assert cli.main(["--checkpoint", ckpt, "--to-plan", plan_b,
+                         "--dry-run"]) == 0
+        assert io_mod.read_plan_stamp(ckpt) == before
+
+    def test_structural_refusal_exits_one(self, tmp_path):
+        cli = _load_cli()
+        ckpt, _ = self._stamped_checkpoint(tmp_path, PLAN_A)
+        # fc weight is [4, 1]: tp=8 over dim 0 cannot divide 4
+        bad = _write_plan(tmp_path / "bad.json",
+                          _plan({"tp": 8}, {"fc_0.w_0": ["tp", None]}))
+        assert cli.main(["--checkpoint", ckpt, "--to-plan", bad]) == 1
+        # refusal leaves the checkpoint stamped as before
+        assert io_mod.read_plan_stamp(ckpt)["mesh"] == {"dp": 8}
+
+    def test_missing_checkpoint_and_bad_plan_are_usage_errors(
+            self, tmp_path):
+        cli = _load_cli()
+        plan_b = _write_plan(tmp_path / "b.json", PLAN_B)
+        assert cli.main(["--checkpoint", str(tmp_path / "nope"),
+                         "--to-plan", plan_b]) == 1
+        missing = str(tmp_path / "missing.json")
+        ckpt, _ = self._stamped_checkpoint(tmp_path, PLAN_A)
+        assert cli.main(["--checkpoint", ckpt,
+                         "--to-plan", missing]) == 2

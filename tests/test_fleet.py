@@ -277,13 +277,17 @@ class TestPriorityAdmission:
         assert v.cls == 1   # strictly the lowest occupied, always
 
     def test_overload_free_tier_absorbs_sheds(self):
-        # end to end: one slow replica, arrivals far above service
-        # rate, 3:1 free:paid mix — the free tier absorbs >= 90% of
-        # sheds (paid arrivals displace queued free requests; a paid
-        # request sheds only when the queue holds no free request),
-        # every shed is typed with its class, nothing is dropped
-        # silently
-        router, _ = _make(1, queue_depth=8, default_delay=0.01,
+        # end to end: one replica held shut behind a gate while ALL 200
+        # requests (3:1 free:paid) arrive, so the overload is
+        # deterministic — no wall-clock race between arrival rate and
+        # service rate. The free tier absorbs the sheds (paid arrivals
+        # displace queued free requests; a paid request sheds only when
+        # the queue holds no free request — queue_depth 64 exceeds the
+        # 50 paid arrivals, so no paid ever sheds), every shed is typed
+        # with its class, nothing is dropped silently. Once the gate
+        # opens, everything still queued drains and serves.
+        gate = threading.Event()
+        router, _ = _make(1, queue_depth=64, gate=gate,
                           engine_opts={"queue_depth": 4,
                                        "max_wait_ms": 0.5})
         outcomes = {"served": 0, "shed": []}
@@ -297,7 +301,7 @@ class TestPriorityAdmission:
                 except Overloaded as e:
                     assert e.shed_class == cls
                     outcomes["shed"].append(cls)
-                time.sleep(0.0001)
+            gate.set()   # open the gate: drain everything admitted
             for cls, f in futs:
                 try:
                     f.result(timeout=30)
@@ -318,6 +322,7 @@ class TestPriorityAdmission:
             assert snap["sheds"].get("0", 0) == shed.count(0)
             assert snap["sheds"].get("1", 0) == shed.count(1)
         finally:
+            gate.set()
             router.close()
 
     def test_hostile_priority_cannot_kill_the_dispatcher(self):

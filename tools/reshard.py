@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Offline checkpoint resharding (resilience/elastic.py reshard_state):
+re-stamp a plan-stamped checkpoint for a different PlacementPlan.
+
+A preempted run's checkpoint was written under the OLD mesh's plan; the
+surviving topology wins a different plan. In-process the elastic
+supervisor handles that transparently, but sometimes the reshard should
+happen before any trainer starts — e.g. preparing a checkpoint for a
+smaller reserved slice, or gathering a multi-host run's shard pieces
+into single full arrays. This CLI does exactly what the supervisor
+does, offline:
+
+    # re-stamp the newest committed serial for plan B, in place
+    python tools/reshard.py --checkpoint ckpt/ --to-plan planB.json
+
+    # write a fresh serial dir instead of re-stamping in place
+    python tools/reshard.py --checkpoint ckpt/ --serial 2 \
+        --to-plan planB.json --out ckpt_resharded/
+
+    # dry run: validate the re-layout, print the verdict, change nothing
+    python tools/reshard.py --checkpoint ckpt/ --to-plan planB.json \
+        --dry-run
+
+The gather side reads whatever the serial dir holds — full `<name>.npy`
+arrays and/or multi-process `<name>.shard.<slices>.npy` pieces (the
+pieces must cover every element; partial gathers fail loudly). The
+output is always FULL host arrays plus a manifest stamped with the
+target plan and a fresh _SUCCESS binding, so the result restores onto
+the new mesh like any verified checkpoint (the executor rescatters on
+first dispatch). Because checkpoints hold full arrays, a round-trip
+A -> B -> A is bit-identical.
+
+Exit status: 0 ok, 1 reshard refused/failed, 2 usage problems.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_state(serial_dir):
+    """Gather every persisted var in a serial dir to full host arrays:
+    `<name>.npy` loads directly; `<name>.meta.json` + shard pieces
+    reassemble through io._load_sharded (missing pieces fail there)."""
+    import numpy as np
+    from paddle_tpu import io as io_mod
+    state, sharded = {}, []
+    for name in sorted(os.listdir(serial_dir)):
+        if name.endswith(".meta.json"):
+            sharded.append(name[:-len(".meta.json")])
+        elif name.endswith(".npy") and ".shard." not in name:
+            # no temp-file filter needed: _atomic_save temps end
+            # `.npy.tmp<pid>`, never `.npy` — and real vars ARE named
+            # e.g. `batch_norm_5.tmp_0.npy` (the manifest's own caveat)
+            state[name[:-len(".npy")]] = np.load(
+                os.path.join(serial_dir, name))
+    for base in sharded:
+        arr = io_mod._load_sharded(serial_dir, base)
+        if arr is not None:
+            state[base] = arr
+    return state
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="reshard.py",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--checkpoint", required=True,
+                    help="checkpoint root dir (holds checkpoint_N "
+                         "serial dirs)")
+    ap.add_argument("--serial", type=int, default=None,
+                    help="serial to reshard (default: newest committed)")
+    ap.add_argument("--to-plan", required=True,
+                    help="target plan: a plan.py artifact JSON (winner "
+                         "used) or a single plan dict")
+    ap.add_argument("--out", default=None,
+                    help="write a NEW serial dir under this checkpoint "
+                         "root instead of re-stamping in place")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="validate only; change nothing")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import io as io_mod
+    from paddle_tpu.analysis import planner
+    from paddle_tpu.resilience import manifest as manifest_mod
+    from paddle_tpu.resilience.elastic import ReshardError, reshard_state
+
+    try:
+        # load the JSON ourselves so a bare plan dict ({mesh, specs,
+        # ...}) works beside a full ranked artifact — resolve_plan
+        # normalizes both
+        with open(args.to_plan) as f:
+            to_plan = planner.resolve_plan(json.load(f))
+    except (OSError, ValueError, TypeError) as e:
+        print(f"reshard: cannot load --to-plan: {e}", file=sys.stderr)
+        return 2
+    serial = args.serial
+    if serial is None:
+        serial = io_mod.get_latest_checkpoint_serial(args.checkpoint)
+        if serial < 0:
+            print(f"reshard: no committed checkpoint serial in "
+                  f"{args.checkpoint!r}", file=sys.stderr)
+            return 1
+    src = os.path.join(args.checkpoint,
+                       f"{io_mod.CHECKPOINT_PREFIX}_{serial}")
+    if not os.path.isdir(src):
+        print(f"reshard: {src!r} does not exist", file=sys.stderr)
+        return 1
+    from_stamp = io_mod.read_plan_stamp(args.checkpoint, serial)
+
+    state = _load_state(src)
+    try:
+        gathered = reshard_state(state, from_plan=from_stamp,
+                                 to_plan=to_plan)
+    except ReshardError as e:
+        print(f"reshard REFUSED: {e}", file=sys.stderr)
+        return 1
+    n_vars = len(gathered)
+    from_mesh = (from_stamp or {}).get("mesh")
+    print(f"reshard: serial {serial}: {n_vars} vars ok under target "
+          f"mesh {to_plan.get('mesh')} (from {from_mesh})")
+    if args.dry_run:
+        return 0
+
+    if args.out:
+        root = args.out
+        os.makedirs(root, exist_ok=True)
+        dst = os.path.join(
+            root, f"{io_mod.CHECKPOINT_PREFIX}_"
+            f"{io_mod.get_latest_checkpoint_serial(root, verify=False) + 1}")
+        if os.path.isdir(dst):
+            shutil.rmtree(dst)
+        os.makedirs(dst)
+        import numpy as np
+        for name, arr in gathered.items():
+            np.save(os.path.join(dst, name + ".npy"), arr)
+        # carry the resume point (trainer args), host-table shards, and
+        # any other non-array sidecars verbatim — the reshard changes
+        # LAYOUT, never training position
+        for name in sorted(os.listdir(src)):
+            if (name.endswith(".npy") or name.endswith(".meta.json")
+                    or name == manifest_mod.MANIFEST_FILENAME
+                    or name.startswith("_SUCCESS")):
+                continue
+            s = os.path.join(src, name)
+            if os.path.isfile(s):
+                shutil.copy2(s, os.path.join(dst, name))
+    else:
+        dst = src
+        import numpy as np
+        for name, arr in gathered.items():
+            # full-array rewrite also collapses any shard pieces
+            np.save(os.path.join(dst, name + ".npy"), arr)
+        for name in list(os.listdir(dst)):
+            if ".shard." in name or name.endswith(".meta.json"):
+                os.remove(os.path.join(dst, name))
+
+    stamp = io_mod.plan_stamp(to_plan)
+    manifest_mod.write_manifest(
+        dst, layout="checkpoint",
+        extra={"plan_stamp": stamp} if stamp else None)
+    marker = os.path.join(dst, "_SUCCESS")
+    tmp = marker + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(manifest_mod.success_payload(dst))
+    os.replace(tmp, marker)
+    print(f"reshard: wrote {dst} stamped for mesh "
+          f"{json.dumps(to_plan.get('mesh'))}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
