@@ -246,9 +246,15 @@ def _train_loop(main_prog, startup, fetch, feed, steps, unroll=2,
         # repeats=2: the per-segment min-of-N at bench cost discipline.
         try:
             from paddle_tpu.obs import opprof
+            from paddle_tpu.analysis import fuse as conv_fuse
+            # attribute the program the executor actually ran: under
+            # PT_FUSE (default on) that is the conv-epilogue-fused
+            # rewrite, so fused_conv2d rows appear in the ledger and the
+            # conv-family MFU reflects the fused step. maybe_fuse is the
+            # identity when fusion is off or nothing fuses.
             op_attribution = opprof.profile_program(
-                main_prog, feed=feed, scope=scope, repeats=2,
-                fused_step=False).summary(top=5)
+                conv_fuse.maybe_fuse(main_prog), feed=feed, scope=scope,
+                repeats=2, fused_step=False).summary(top=5)
         except Exception as e:  # attribution must never cost a bench
             import logging
             logging.getLogger("paddle_tpu").warning(
@@ -297,6 +303,102 @@ def _train_loop(main_prog, startup, fetch, feed, steps, unroll=2,
 
 def collections_stack(feeds):
     return {k: np.stack([f[k] for f in feeds]) for k in feeds[0]}
+
+
+#: declared fused-vs-unfused parity band: the fused epilogue computes
+#: the SAME composition (_conv2d + _bn_train math) so CPU readings are
+#: bit-identical; the band absorbs Pallas/bf16 reduction-order noise on
+#: chip. analysis/artifacts.validate_fusion_ab rejects deltas outside it.
+FUSION_PARITY_TOL = 5e-3
+
+
+def _fusion_ab(main_prog, startup, fetch, feed, steps, unroll=2,
+               timed_windows=3, parity_steps=4):
+    """Conv-epilogue fusion A/B (analysis/fuse.py): min-of-windows step
+    time with PT_FUSE on vs off, plus a same-initial-state parity leg.
+
+    Parity restores a host snapshot of the freshly-initialized scope
+    between arms, so both arms train the identical model from identical
+    params on the identical feed — the recorded loss_delta_rel isolates
+    the rewrite, not init noise. The emitted row is schema-checked by
+    analysis/artifacts.validate_fusion_ab in the CI fusion leg: speedup
+    below 1.0 must carry an explanation (a CPU rig, where XLA already
+    fuses the unfused chain and the Pallas epilogue never engages, is
+    the expected one), and a parity delta outside FUSION_PARITY_TOL
+    fails the artifact — speed with broken numerics is not a result."""
+    import paddle_tpu as pt
+    from paddle_tpu.analysis import fuse as conv_fuse
+
+    out = {"schema_version": 1, "arms": {}}
+    try:
+        fused_prog, n_chains = conv_fuse.fuse_program(main_prog)
+        n_fused = sum(1 for op in fused_prog.global_block.ops
+                      if op.type == "fused_conv2d")
+        prev = os.environ.get("PT_FUSE")
+        parity = {}
+        try:
+            scope = pt.Scope()
+            with pt.scope_guard(scope):
+                exe = pt.Executor()
+                exe.run(startup)
+                # host copies: the compiled step DONATES its state
+                # buffers, so device references in a snapshot would be
+                # deleted by the first arm's run
+                snap = {}
+                for k in scope.local_var_names():
+                    v = scope.find_var(k)
+                    snap[k] = (np.asarray(v).copy()
+                               if hasattr(v, "dtype") else v)
+                for name, on in (("fused", True), ("unfused", False)):
+                    os.environ["PT_FUSE"] = "1" if on else "0"
+                    for k, v in snap.items():
+                        scope.set_var(k, v)
+                    (losses,) = exe.run_loop(main_prog, feed=feed,
+                                             fetch_list=[fetch],
+                                             n_steps=parity_steps,
+                                             unroll=1)
+                    parity[name] = float(
+                        np.asarray(losses, dtype=np.float32).reshape(-1)[-1])
+                    exe.run_loop(main_prog, feed=feed, fetch_list=[fetch],
+                                 n_steps=steps, unroll=unroll)  # compile
+                    ws = []
+                    for _ in range(max(timed_windows, 1)):
+                        t0 = time.time()
+                        exe.run_loop(main_prog, feed=feed,
+                                     fetch_list=[fetch], n_steps=steps,
+                                     unroll=unroll)
+                        ws.append(time.time() - t0)
+                    out["arms"][name] = {
+                        "step_ms": round(min(ws) / steps * 1000.0, 3),
+                        "steps": steps, "windows": max(timed_windows, 1),
+                        "last_loss": parity[name]}
+        finally:
+            if prev is None:
+                os.environ.pop("PT_FUSE", None)
+            else:
+                os.environ["PT_FUSE"] = prev
+        out["arms"]["fused"]["fused_ops"] = n_fused
+        out["arms"]["fused"]["chains"] = n_chains
+        speedup = (out["arms"]["unfused"]["step_ms"]
+                   / max(out["arms"]["fused"]["step_ms"], 1e-9))
+        out["speedup"] = round(speedup, 4)
+        if speedup < 1.0:
+            out["explanation"] = (
+                "off-TPU rig: the Pallas epilogue never engages and XLA "
+                "already fuses the lax chain, so the A/B measures "
+                "executor overhead noise; the fused win is the "
+                "eliminated HBM round-trip on chip")
+        delta = (abs(parity["fused"] - parity["unfused"])
+                 / max(abs(parity["unfused"]), 1e-8))
+        out["parity"] = {"loss_delta_rel": round(delta, 8),
+                         "tolerance": FUSION_PARITY_TOL,
+                         "parity_steps": parity_steps}
+    except Exception as e:  # the A/B must never cost the bench itself
+        import logging
+        logging.getLogger("paddle_tpu").warning(
+            "fusion A/B skipped: %s", e)
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 def _mfu_fields(train_flops, ms, peak, on_tpu):
@@ -352,12 +454,19 @@ def bench_resnet(on_tpu, peak):
                                              feed, steps,
                                              varied_feed_fn=varied,
                                              varied_steps=48)
+    # conv-epilogue fusion A/B (the fusion PR's acceptance row): step
+    # time fused vs PT_FUSE=0, same-init parity, and the fused config's
+    # attribution coverage riding beside the speedup claim
+    fusion_ab = _fusion_ab(main_prog, startup, avg_cost, feed, steps)
+    cov = (hot.get("op_attribution") or {}).get("coverage_pct")
+    if cov is not None:
+        fusion_ab["op_attribution_coverage"] = cov
     train_flops = program_train_flops(main_prog, batch)
     return {"batch": batch, "image": image, "dtype": dtype, "steps": steps,
             "ms_per_batch": round(ms, 2),
             "examples_per_sec": round(batch / ms * 1000.0, 1),
             "compile_s": round(compile_s, 1), **hot,
-            "varied_feeds": True,
+            "varied_feeds": True, "fusion_ab": fusion_ab,
             **_loss_fields(losses),
             **_mfu_fields(train_flops, ms if on_tpu else 0, peak, on_tpu)}
 
@@ -1998,7 +2107,36 @@ def bench_decode(on_tpu, peak):
     stat_out, stat_s, stat_snap = run(False)
     identical = cont_out == stat_out
 
+    # per-op attribution of ONE decode step (obs/opprof.py): the decode
+    # plane's laggard ledger — the paged-attention/pool-write ops'
+    # measured-vs-predicted gap, filed in docs/performance.md
+    # ("Decode-plane laggard hunt") — beside the tokens/s the engine
+    # measures above. Same model dims, fresh fixed-shape step program;
+    # opprof synthesizes the slot/pool feeds as zeros (an inactive-slot
+    # step times the same kernels).
+    try:
+        from paddle_tpu.obs import opprof
+        pt.core.program.reset_unique_names()
+        dec_prog, dec_start = pt.Program(), pt.Program()
+        with pt.program_guard(dec_prog, dec_start):
+            tfm.transformer_decode_step(
+                V, n_layers=L, d_model=DM, n_heads=H, d_ff=FF,
+                max_context=MAXC, slots=slots, block_size=8,
+                pool_blocks=128, max_blocks_per_seq=MAXC // 8)
+        dscope = pt.Scope()
+        with pt.scope_guard(dscope):
+            pt.Executor().run(dec_start)
+            op_attribution = opprof.profile_program(
+                dec_prog, scope=dscope, repeats=2,
+                fused_step=False).summary(top=5)
+    except Exception as e:  # attribution must never cost the bench
+        import logging
+        logging.getLogger("paddle_tpu").warning(
+            "decode op attribution skipped: %s", e)
+        op_attribution = {"error": f"{type(e).__name__}: {e}"}
+
     out = {
+        "op_attribution": op_attribution,
         "slots": slots,
         "sequences": n_seqs,
         "total_new_tokens": total,

@@ -28,6 +28,10 @@ The compile-time checking layer the interpreted reference never had
   and transpile(plan=...) execute. CLI: tools/plan.py. Loaded lazily —
   the search layer sits on top of cost/memory/comm and the parallel
   package.
+* `fuse` — the conv-epilogue fusion pre-pass: conv2d→batch_norm→
+  relu/add chains rewritten into `fused_conv2d` on a clone inside the
+  executor's compile path (PT_FUSE=0 restores the original object
+  bit-for-bit); the `conv-fusion` verifier pass re-checks every rewrite.
 * `source_lint` — custom repo lint rules behind tools/lint.py (kept
   stdlib-only so the lint gate never imports jax).
 
@@ -48,6 +52,8 @@ from .comm import (Collective, CommReport, audit_collectives,  # noqa: F401
 from . import schedule  # noqa: F401  (registers the pipeline-stage pass)
 from .schedule import (StageCutError, StageCutPlan,  # noqa: F401
                        stage_cut_search)
+from . import fuse  # noqa: F401
+from .fuse import fuse_program, maybe_fuse  # noqa: F401
 
 __all__ = [
     "Diagnostic", "ProgramVerificationError", "VerifyResult",
@@ -60,6 +66,7 @@ __all__ = [
     "Collective", "CommReport", "audit_collectives", "mesh_axis_sizes",
     "choose_algorithms",
     "schedule", "StageCutError", "StageCutPlan", "stage_cut_search",
+    "fuse", "fuse_program", "maybe_fuse",
     "planner", "plan_placement", "apply_plan", "PlanArtifact",
     "NoFeasiblePlacementError",
 ]

@@ -38,21 +38,29 @@ def _bad_ms(value) -> bool:
     return not math.isfinite(v) or v <= MS_FLOOR or v >= MS_CEILING
 
 
-def check_autotune_entry(key: str, ent: dict) -> List[str]:
-    """Problems with one gconv autotune cache entry ([] = valid).
+def check_autotune_entry(key: str, ent: dict,
+                         decision_field: str = "prefers_dense",
+                         ms_fields=("native_ms", "dense_ms")) -> List[str]:
+    """Problems with one autotune cache entry ([] = valid).
+
+    Parameterized per cache namespace (utils/kernel_autotune.py):
+    `decision_field` is the entry key carrying that namespace's
+    fallback-safe decision (gconv: prefers_dense; fused conv epilogue:
+    prefers_pallas) and `ms_fields` its measured candidates. Defaults
+    keep the historical gconv contract.
 
     Entries that *declare* themselves non-measurements are legal:
     {"error": ...} (measurement raised) and {"invalid": True} (readings
-    rejected twice) both carry prefers_dense=False fallbacks.
+    rejected twice) both carry the decision field's fallback.
     """
     if not isinstance(ent, dict):
         return [f"{key}: entry is {type(ent).__name__}, not an object"]
-    if "prefers_dense" not in ent:
-        return [f"{key}: missing required field 'prefers_dense'"]
+    if decision_field not in ent:
+        return [f"{key}: missing required field {decision_field!r}"]
     if ent.get("error") or ent.get("invalid"):
         return []
     problems = []
-    for field in ("native_ms", "dense_ms"):
+    for field in ms_fields:
         if field not in ent:
             problems.append(f"{key}: missing measurement field {field!r}")
         elif _bad_ms(ent[field]):
@@ -62,21 +70,34 @@ def check_autotune_entry(key: str, ent: dict) -> List[str]:
     return problems
 
 
-def validate_autotune_cache(cache: dict) -> List[str]:
-    """Problems across a whole autotune cache dict ([] = valid)."""
+def validate_autotune_cache(cache: dict,
+                            decision_field: str = "prefers_dense",
+                            ms_fields=("native_ms", "dense_ms")) -> List[str]:
+    """Problems across a whole autotune cache dict ([] = valid).
+
+    Accepts both the legacy flat dict and the schema-versioned
+    ``{"schema": N, "entries": {...}}`` envelope (which tools pass
+    through verbatim from disk)."""
     if not isinstance(cache, dict):
         return [f"cache root is {type(cache).__name__}, not an object"]
+    if "schema" in cache and isinstance(cache.get("entries"), dict):
+        cache = cache["entries"]
     problems: List[str] = []
     for key, ent in cache.items():
-        problems.extend(check_autotune_entry(str(key), ent))
+        problems.extend(check_autotune_entry(str(key), ent,
+                                             decision_field, ms_fields))
     return problems
 
 
-def filter_autotune_cache(cache: dict) -> Dict[str, dict]:
+def filter_autotune_cache(cache: dict,
+                          decision_field: str = "prefers_dense",
+                          ms_fields=("native_ms", "dense_ms")
+                          ) -> Dict[str, dict]:
     """Drop entries with impossible readings (load-time self-heal); the
     dropped keys simply re-measure on next use."""
     return {k: v for k, v in cache.items()
-            if not check_autotune_entry(str(k), v)}
+            if not check_autotune_entry(str(k), v, decision_field,
+                                        ms_fields)}
 
 
 _MS_KEY_MARKERS = ("_ms", "ms_per_batch", "ms_per_step")
@@ -684,6 +705,95 @@ def validate_codec_ab(doc) -> List[str]:
         if "tolerance" not in parity:
             problems.append("$.parity.tolerance: declared tolerance band "
                             "missing")
+    return problems
+
+
+_FUSION_ARM_REQUIRED = ("step_ms", "steps")
+
+
+def validate_fusion_ab(doc) -> List[str]:
+    """Floor checks for bench.py's `fusion_ab` conv-epilogue A/B
+    ([] = valid) — the same impossible-reading discipline as the codec
+    and gconv validators, applied to the fusion PR's acceptance row:
+
+      * both arms (fused / unfused) measured, finite positive step_ms,
+        and the fused arm actually fused something (fused_ops >= 1 — an
+        A/B where the pass rewrote nothing proves nothing);
+      * speedup = unfused/fused is finite and positive; a reading below
+        1.0 must carry a non-empty `explanation` (e.g. a CPU rig where
+        the Pallas epilogue never engages) — recorded-or-explained,
+        never silent;
+      * the parity leg RAN: loss_delta_rel is a finite non-negative
+        number, the tolerance band is declared, and the delta sits
+        inside it — speed with broken numerics is not a result;
+      * the per-op attribution on the fused config covers >= 90% of
+        step time, so the conv-family MFU claim rests on attributed
+        time, not a sliver.
+    """
+    if not isinstance(doc, dict):
+        return [f"fusion A/B root is {type(doc).__name__}, not an object"]
+    problems: List[str] = []
+    arms = doc.get("arms")
+    if not isinstance(arms, dict):
+        problems.append("$.arms: no measured arms recorded")
+        arms = {}
+    for name in ("fused", "unfused"):
+        arm = arms.get(name)
+        here = f"$.arms.{name}"
+        if not isinstance(arm, dict):
+            problems.append(f"{here}: arm not recorded")
+            continue
+        for k in _FUSION_ARM_REQUIRED:
+            if k not in arm:
+                problems.append(f"{here}.{k}: required field missing")
+        ms = arm.get("step_ms")
+        if ms is not None and (_bad_pred_num(ms) or float(ms) <= 0):
+            problems.append(f"{here}.step_ms: {ms!r} must be finite "
+                            "and positive")
+    fused_arm = arms.get("fused")
+    if isinstance(fused_arm, dict):
+        n = fused_arm.get("fused_ops")
+        if not isinstance(n, int) or n < 1:
+            problems.append(
+                f"$.arms.fused.fused_ops: {n!r} — the fused arm must "
+                "contain at least one fused_conv2d op, else the A/B "
+                "measured the pass doing nothing")
+    speedup = doc.get("speedup")
+    if speedup is None or _bad_pred_num(speedup) or float(speedup) <= 0:
+        problems.append(f"$.speedup: {speedup!r} must be recorded as a "
+                        "finite positive number")
+    elif float(speedup) < 1.0:
+        expl = doc.get("explanation")
+        if not isinstance(expl, str) or not expl.strip():
+            problems.append(
+                f"$.speedup: {float(speedup):.3f} < 1.0 with no "
+                "$.explanation — a slowdown must be explained, not "
+                "silently recorded")
+    parity = doc.get("parity")
+    if not isinstance(parity, dict):
+        problems.append("$.parity: fused-vs-unfused parity leg not "
+                        "recorded")
+    else:
+        delta = parity.get("loss_delta_rel")
+        tol = parity.get("tolerance")
+        if delta is None or _bad_pred_num(delta) or float(delta) < 0:
+            problems.append(
+                f"$.parity.loss_delta_rel: {delta!r} — the parity delta "
+                "must be recorded as a finite non-negative number")
+        if tol is None or _bad_pred_num(tol):
+            problems.append("$.parity.tolerance: declared tolerance band "
+                            "missing")
+        elif delta is not None and not _bad_pred_num(delta) \
+                and float(delta) > float(tol):
+            problems.append(
+                f"$.parity.loss_delta_rel: {delta!r} exceeds the "
+                f"declared tolerance {tol!r} — the fusion changed "
+                "semantics")
+    cov = doc.get("op_attribution_coverage")
+    if cov is None or _bad_pred_num(cov) or float(cov) < 90.0:
+        problems.append(
+            f"$.op_attribution_coverage: {cov!r} — the fused config's "
+            "per-op attribution must cover >= 90% of step time")
     return problems
 
 

@@ -319,6 +319,27 @@ def _conv_cost(op, ctx):
     return OpCost(mxu_flops=flops, bytes_read=r, bytes_written=wr)
 
 
+@cost_entry("fused_conv2d")
+def _fused_conv_cost(op, ctx):
+    # conv2d + BN(+add)(+relu) collapsed into one op (analysis/fuse.py):
+    # identical MXU work to the conv it absorbed, epilogue vector work at
+    # batch_norm's per-element weight (+1 each for the folded add/relu) —
+    # and, the point of the fusion, io_bytes over the op's ACTUAL slots:
+    # the conv output / BN Y / add out intermediates no longer exist, so
+    # their HBM round-trips drop out of the model structurally. The
+    # strict-decrease regression in tests/test_conv_fusion.py pins this
+    # against the unfused chain.
+    out = ctx.shape(op.outputs["Output"][0])
+    w = ctx.shape(op.inputs["Filter"][0])
+    flops = 2 * _prod(out) * _prod(w[1:])
+    a = op.attrs or {}
+    weight = _VECTOR_WEIGHT["batch_norm"] \
+        + (1 if a.get("with_add") else 0) + (1 if a.get("act") else 0)
+    r, wr = ctx.io_bytes(op)
+    return OpCost(mxu_flops=flops, vector_flops=weight * _prod(out),
+                  bytes_read=r, bytes_written=wr)
+
+
 @cost_entry("conv2d_transpose", "conv3d_transpose")
 def _conv_t_cost(op, ctx):
     x = ctx.shape(op.inputs["Input"][0])

@@ -65,6 +65,13 @@ _SAVES_IN = {
     "conv2d": ("Input",), "depthwise_conv2d": ("Input",),
     "conv3d": ("Input",), "conv2d_transpose": ("Input",),
     "conv3d_transpose": ("Input",), "fused_bottleneck": ("X",),
+    # conv epilogue fusion (analysis/fuse.py): the fused backward needs
+    # the conv Input (dW) plus ONE activation-sized residual — the
+    # epilogue VJP saves the pre-BN conv output, same size as Output,
+    # modeled below via _SAVES_OUT. The unfused chain's extra saves
+    # (batch_norm X = the conv output AND relu Out) are gone: fusing
+    # drops one full activation residual per chain from the estimate.
+    "fused_conv2d": ("Input",),
     "scaled_dot_product_attention": ("Q", "K", "V"),
     "layer_norm": ("X",), "batch_norm": ("X",),
     "gelu": ("X",), "tanh": ("X",), "sigmoid": ("X",), "swish": ("X",),
@@ -80,6 +87,7 @@ _SAVES_IN = {
 _SAVES_OUT = {
     "relu": ("Out",), "softmax": ("Out",), "exp": ("Out",),
     "scaled_dot_product_attention": ("Out",),
+    "fused_conv2d": ("Output",),
 }
 
 #: ops whose backward needs nothing from the forward (index/alias/

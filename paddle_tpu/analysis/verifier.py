@@ -31,6 +31,12 @@ Passes (each registered via @verifier_pass; run in registration order):
                 at real blocks, sp-rewritten attention has an 'sp' axis,
                 and no device op consumes a host op's output without a
                 registered boundary (core/registry.py).
+  wire-codec    dtype-narrowed feed boundary invariants (data/codec.py).
+  conv-fusion   fused_conv2d well-formedness after the conv-epilogue
+                fusion pass (analysis/fuse.py): slots resolve, attrs
+                JSON-round-trip, act known, with_add ⇔ Addend (exact
+                shape/dtype match), dtype agreement through the
+                epilogue, f32 (Cout,) BN params, stat outputs present.
 
 Severities: "error" aborts execution under PT_VERIFY=1 (the executor
 pre-pass raises ProgramVerificationError); "warning" is reported but
@@ -585,6 +591,104 @@ def _check_wire_codec(program: Program, ctx: _Ctx) -> List[Diagnostic]:
                     f"'<feed>{CODEC_SCALE_SUFFIX}' naming — the executor "
                     "only auto-feeds the conventional name",
                     block.idx, i, op.type, n))
+    return diags
+
+
+@verifier_pass("conv-fusion")
+def _check_conv_fusion(program: Program, ctx: _Ctx) -> List[Diagnostic]:
+    """Re-checks every fused_conv2d op the fusion pass (analysis/fuse.py)
+    emitted — the rewrite must never change semantics silently, so its
+    invariants are verified AFTER the fact, independent of the pass:
+    required slots resolve, attrs round-trip through JSON (fingerprint/
+    serialization safety), act is a known epilogue, with_add agrees with
+    the Addend slot (and the addend matches Output's shape/dtype exactly
+    — the fused epilogue does no broadcasting), dtype agreement through
+    the epilogue (Input vs Output; f32 BN params), and the running-stat
+    outputs are all present so state threading cannot drop updates."""
+    import json
+
+    diags: List[Diagnostic] = []
+    for block in program.blocks:
+        for i, op in enumerate(block.ops):
+            if op.type != "fused_conv2d":
+                continue
+
+            def err(code, msg, var=None):
+                diags.append(Diagnostic(ERROR, code, msg, block.idx, i,
+                                        op.type, var))
+
+            def var_of(slot, where="inputs"):
+                names = (op.inputs if where == "inputs"
+                         else op.outputs).get(slot, [])
+                if len(names) != 1:
+                    err("fusion-slot",
+                        f"fused_conv2d {where[:-1]} slot {slot!r} must "
+                        f"hold exactly one var, has {names}")
+                    return None
+                try:
+                    return block.var(names[0])
+                except KeyError:
+                    err("fusion-slot",
+                        f"fused_conv2d {where[:-1]} {slot!r} references "
+                        f"undeclared var {names[0]!r}", names[0])
+                    return None
+
+            a = op.attrs or {}
+            try:
+                json.loads(json.dumps(a))
+            except (TypeError, ValueError):
+                err("fusion-attrs",
+                    "fused_conv2d attrs do not round-trip through JSON — "
+                    "serialization/fingerprinting would diverge")
+            act = a.get("act", "")
+            if act not in ("", "relu"):
+                err("fusion-act",
+                    f"fused_conv2d act {act!r} is not a supported "
+                    "epilogue (know '', 'relu')")
+
+            x = var_of("Input")
+            out = var_of("Output", "outputs")
+            if x is not None and out is not None \
+                    and str(x.dtype) != str(out.dtype):
+                err("fusion-dtype",
+                    f"dtype must agree through the fused epilogue: "
+                    f"Input is {x.dtype}, Output is {out.dtype}")
+            cout = int(out.shape[1]) if out is not None \
+                and len(out.shape) == 4 else None
+            for slot in ("Scale", "Bias", "Mean", "Variance"):
+                v = var_of(slot)
+                if v is None:
+                    continue
+                if str(v.dtype) != "float32":
+                    err("fusion-dtype",
+                        f"BN param {slot} must be float32 (stats math is "
+                        f"f32 regardless of AMP), got {v.dtype}", v.name)
+                if cout is not None and tuple(v.shape) != (cout,):
+                    err("fusion-shape",
+                        f"BN param {slot} must have shape ({cout},) to "
+                        f"match Output channels, got {tuple(v.shape)}",
+                        v.name)
+
+            with_add = bool(a.get("with_add"))
+            has_addend = bool(op.inputs.get("Addend"))
+            if with_add != has_addend:
+                err("fusion-addend",
+                    f"with_add={with_add} but Addend slot "
+                    f"{'present' if has_addend else 'absent'} — the attr "
+                    "and the slot must agree")
+            elif with_add:
+                av = var_of("Addend")
+                if av is not None and out is not None and (
+                        tuple(av.shape) != tuple(out.shape)
+                        or str(av.dtype) != str(out.dtype)):
+                    err("fusion-addend",
+                        f"Addend {av.name!r} must match Output exactly "
+                        f"(no broadcast): {tuple(av.shape)}/{av.dtype} vs "
+                        f"{tuple(out.shape)}/{out.dtype}", av.name)
+
+            for slot in ("MeanOut", "VarianceOut", "SavedMean",
+                         "SavedVariance"):
+                var_of(slot, "outputs")
     return diags
 
 

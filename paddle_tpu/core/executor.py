@@ -305,6 +305,14 @@ class Executor(TimedExecutorMixin):
         fetch_names = [self._fetch_name(f) for f in fetch_list]
         feed_arrays = self._prep_feed(program, feed,
                                       per_step=per_step_feed_prep)
+        # conv-epilogue fusion pre-pass (analysis/fuse.py): rewrite
+        # conv2d→batch_norm→relu/add chains into fused_conv2d on a CLONE
+        # before the jit cache fingerprints the program, so fused and
+        # unfused compiles key separately and PT_FUSE=0 returns the
+        # caller's object bit-for-bit. Memoized per (fingerprint, fetch
+        # set) — steady-state cost is one dict hit.
+        from ..analysis import fuse as conv_fuse
+        program = conv_fuse.maybe_fuse(program, protect=fetch_names)
         if guard:
             from ..resilience import guard as guard_mod
             guard_mod.assert_instrumented(program)
@@ -367,6 +375,11 @@ class Executor(TimedExecutorMixin):
             # only, so any un-tuned shape must be measured BEFORE tracing
             from ..utils import gconv_autotune
             gconv_autotune.tune_program(program, bh)
+            # fused-conv epilogue autotune (kernels/fused_conv.py): same
+            # contract — the Pallas-vs-XLA epilogue choice inside the
+            # trace is cache-lookup only, so measure un-tuned shapes here
+            from ..kernels import fused_conv
+            fused_conv.tune_program(program, bh)
             raw, state_out, donate = build(program, list(feed_arrays),
                                            fetch_names, sorted(state))
             if FLAGS.check_nan_inf and not guard:

@@ -155,6 +155,25 @@ declare_env_knob("PT_GCONV_TUNE",
 declare_env_knob("PT_GCONV_DENSE",
                  "always|never overrides the measured grouped-conv "
                  "formulation choice")
+declare_env_knob("PT_GCONV_LAYOUT",
+                 "oihw|hwio pins the dense grouped-conv formulation's "
+                 "weight layout (default: the measured winner from the "
+                 "same autotune entry; untuned shapes keep oihw)")
+declare_env_knob("PT_FUSE",
+                 "0|never disables the conv-epilogue fusion pass "
+                 "(analysis/fuse.py) — the executor then runs the "
+                 "original program bit-for-bit (default on)")
+declare_env_knob("PT_FUSE_EPILOGUE",
+                 "fused_conv2d epilogue backend: auto (per-shape "
+                 "measured winner from the shared autotune cache) | "
+                 "always (force the Pallas epilogue kernel) | never "
+                 "(XLA lax composition only)")
+declare_env_knob("PT_FUSE_TUNE",
+                 "0|never disables fused-conv epilogue measurement "
+                 "(untuned shapes keep the XLA lax composition)")
+declare_env_knob("PT_FUSE_CACHE",
+                 "path of the fused-conv autotune cache JSON (default "
+                 "~/.cache/paddle_tpu/fused_conv_autotune.json)")
 declare_env_knob("PT_FUSED_LSTM",
                  "never reverts the whole-sequence Pallas LSTM kernel "
                  "to the lax.scan formulation")

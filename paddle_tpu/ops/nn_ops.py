@@ -209,23 +209,50 @@ def _gconv_prefers_dense(x, w, groups, stride=(1, 1), padding=None,
     return bool(hit) if hit is not None else False
 
 
+def _gconv_dense_layout(x, w, groups, stride=(1, 1), padding=None,
+                        dilation=(1, 1)) -> str:
+    """Weight layout for the DENSE grouped-conv formulation: 'oihw'
+    (operand as stored) or 'hwio' (pre-transposed before the conv — the
+    layout hint changes which tiling XLA's layout assignment hands the
+    MXU; measured as a second autotuned dimension of the same gconv
+    shootout). PT_GCONV_LAYOUT=oihw|hwio pins it; untuned shapes keep
+    the stored layout."""
+    mode = os.environ.get("PT_GCONV_LAYOUT", "auto")
+    if mode in ("oihw", "hwio"):
+        return mode
+    from ..utils import gconv_autotune as _gt
+    key = _gt.shape_key(int(x.shape[0]), int(x.shape[1]),
+                        int(x.shape[2]), int(x.shape[3]),
+                        int(w.shape[0]), int(groups),
+                        (int(stride[0]), int(stride[1])),
+                        str(x.dtype), int(w.shape[2]),
+                        padding=padding, dilation=dilation)
+    return _gt.lookup_layout(key) or "oihw"
+
+
 def _conv2d(x, w, attrs, feature_group_count=None):
     w = _harmonize_w(x, w)
     s = _pair(attrs.get("strides", 1))
     p = _pair(attrs.get("paddings", 0))
     d = _pair(attrs.get("dilations", 1))
     groups = feature_group_count or attrs.get("groups", 1) or 1
+    dn = ("NCHW", "OIHW", "NCHW")
     if groups > 1 and groups < x.shape[1] \
             and _gconv_prefers_dense(x, w, groups, stride=s, padding=p,
                                      dilation=d):
+        layout = _gconv_dense_layout(x, w, groups, stride=s, padding=p,
+                                     dilation=d)
         w = _dense_expand_grouped(w, groups)
+        if layout == "hwio":
+            w = jnp.transpose(w, (2, 3, 1, 0))
+            dn = ("NCHW", "HWIO", "NCHW")
         groups = 1
     # NOTE: no preferred_element_type upcast — the MXU accumulates bf16
     # operands in fp32 internally, and jax 0.9's conv transpose rule cannot
     # transpose a dtype-upcasting conv.
     return jax.lax.conv_general_dilated(
         x, w, window_strides=s, padding=[(p[0], p[0]), (p[1], p[1])],
-        rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        rhs_dilation=d, dimension_numbers=dn,
         feature_group_count=groups)
 
 

@@ -3,15 +3,18 @@
 ≙ the reference's cuDNN algorithm search (conv_cudnn_op.cu.cc:
 CUDNN_CONVOLUTION_FWD_PREFER_FASTEST + workspace probing, cached per
 shape in the op's scope) — rebuilt for the XLA world, where the choice is
-not between library algorithms but between two FORMULATIONS the compiler
+not between library algorithms but between FORMULATIONS the compiler
 then owns: XLA's native grouped conv vs a dense conv over a
-block-diagonal-expanded filter (ops/nn_ops._dense_expand_grouped).
+block-diagonal-expanded filter (ops/nn_ops._dense_expand_grouped), the
+dense side itself measured in two weight layouts (OIHW as stored vs a
+pre-transposed HWIO operand — the layout hint changes which tiling XLA
+assigns the MXU for the se_resnext grouped tail).
 
 Rounds 3-4 picked by a static rule (groups small AND output-spatial
 large, boundary measured once on one chip).  Here the rule is replaced by
 MEASUREMENT: before a program first compiles, the executor walks its
 grouped convs and, for any (shape, stride, dtype) not in the on-disk
-cache, times both formulations fwd+bwd on dummy data — the chained
+cache, times the formulations fwd+bwd on dummy data — the chained
 fori_loop slope method (a single dispatched loop whose iterations form a
 data chain; two window lengths difference out the fixed dispatch cost),
 because this fabric dedupes identical dispatches and bare wall-clock
@@ -19,63 +22,46 @@ lies.  Winners persist in PT_GCONV_CACHE (default
 ~/.cache/paddle_tpu/gconv_autotune.json) keyed by device kind, so the
 cost is one-time per shape per chip generation.
 
+The cache machinery itself (schema-versioned file envelope, load-time
+floor filtering, crash-safe merge-save, the retry-then-invalid-then-
+error measurement discipline) lives in utils/kernel_autotune.py, shared
+with every other measured kernel choice; this module owns only the
+gconv key schema and the shootout itself.
+
 PT_GCONV_DENSE=always|never still overrides everything (escape hatch);
+PT_GCONV_LAYOUT=oihw|hwio pins the dense weight layout;
 PT_GCONV_TUNE=0 disables measurement (falls back to native grouped).
 """
 
 from __future__ import annotations
 
-import json
 import os
-import threading
 from typing import Dict, Optional, Tuple
 
-_LOCK = threading.Lock()
-_MEM: Optional[Dict[str, dict]] = None
+from . import kernel_autotune
+
+#: every entry records the namespace decision (prefers_dense) even on
+#: error/invalid; these three candidates are the measured fields
+_CACHE = kernel_autotune.AutotuneCache(
+    "gconv", "PT_GCONV_CACHE",
+    decision_field="prefers_dense",
+    ms_fields=("native_ms", "dense_ms", "dense_hwio_ms"))
+
+#: the decision recorded when measurement fails: native formulation,
+#: stored weight layout
+_FALLBACK = {"prefers_dense": False, "layout": "oihw"}
 
 
 def _cache_path() -> str:
-    return os.environ.get(
-        "PT_GCONV_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
-                     "gconv_autotune.json"))
-
-
-def _read_disk(path: str) -> Dict[str, dict]:
-    """Load + sanity-filter the on-disk cache: entries with physically
-    impossible readings (the round-5 0.0 ms poisonings) are dropped so
-    they re-measure instead of steering formulation choices
-    (analysis/artifacts.py — the reject-at-LOAD half of the contract)."""
-    from ..analysis.artifacts import filter_autotune_cache
-    try:
-        with open(path) as f:
-            return filter_autotune_cache(json.load(f))
-    except Exception:
-        return {}
+    return _CACHE.path()
 
 
 def _load() -> Dict[str, dict]:
-    global _MEM
-    if _MEM is None:
-        _MEM = _read_disk(_cache_path())
-    return _MEM
+    return _CACHE.load()
 
 
 def _save() -> None:
-    global _MEM
-    path = _cache_path()
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    # re-merge the on-disk state immediately before the replace: two
-    # processes tuning DIFFERENT shapes each did read-modify-write of the
-    # whole file, so whoever wrote second clobbered the other's fresh
-    # entries (ADVICE r5). Our own measurements win on key conflicts.
-    merged = _read_disk(path)
-    merged.update(_MEM or {})
-    _MEM = merged
-    tmp = path + f".tmp{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(_MEM, f, indent=1, sort_keys=True)
-    os.replace(tmp, path)
+    _CACHE.save()
 
 
 def _norm_pair(v, default) -> Tuple[int, int]:
@@ -88,15 +74,20 @@ def _norm_pair(v, default) -> Tuple[int, int]:
 
 def shape_key(n, cin, h, w, cout, groups, stride, dtype, k=3,
               padding=None, dilation=(1, 1)) -> str:
-    """Cache key. padding=None means the historical SAME default (k//2);
-    convs with identical shapes but different padding/dilation measure in
-    different regimes and must not share an entry (ADVICE r5)."""
-    import jax
-    kind = getattr(jax.devices()[0], "device_kind", "cpu")
+    """Cache key. Audited so every attribute that can flip the winner is
+    keyed: padding=None means the historical SAME default (k//2); convs
+    with identical shapes but different padding/dilation measure in
+    different regimes and must not share an entry (ADVICE r5); the
+    trailing data-layout token names the activation layout the shootout
+    ran in (NCHW is the only one the framework emits today — keyed so a
+    future NHWC plane can never alias onto these winners). Key-schema
+    changes ride kernel_autotune.SCHEMA_VERSION: bumping it retires
+    every entry measured under the old key semantics at load."""
+    kind = kernel_autotune.device_kind()
     ph, pw = _norm_pair(padding, int(k) // 2)
     dh, dw = _norm_pair(dilation, 1)
     return (f"{kind}|n{n}c{cin}h{h}w{w}->o{cout}g{groups}k{k}"
-            f"s{stride[0]}x{stride[1]}p{ph}x{pw}d{dh}x{dw}|{dtype}")
+            f"s{stride[0]}x{stride[1]}p{ph}x{pw}d{dh}x{dw}|{dtype}|nchw")
 
 
 def lookup(key: str) -> Optional[bool]:
@@ -104,13 +95,24 @@ def lookup(key: str) -> Optional[bool]:
     return None if ent is None else bool(ent["prefers_dense"])
 
 
+def lookup_layout(key: str) -> Optional[str]:
+    """The dense formulation's measured weight layout for `key`:
+    'oihw' (as stored) or 'hwio' (pre-transposed operand). None when
+    untuned; entries predating the layout dimension read as 'oihw'."""
+    ent = _load().get(key)
+    if ent is None:
+        return None
+    return str(ent.get("layout", "oihw"))
+
+
 def measure(n, cin, h, w, cout, groups, stride, dtype, k=3,
             padding=None, dilation=(1, 1)) -> dict:
-    """Time native-grouped vs dense-expanded conv, fwd+bwd, on dummy data.
-    Runs OUTSIDE any trace (executor pre-pass). padding/dilation are the
-    op's ACTUAL attrs (padding=None keeps the historical SAME default) —
-    measuring a different regime than the trace runs was the ADVICE-r5
-    aliasing bug."""
+    """Time native-grouped vs dense-expanded conv (the dense side in both
+    OIHW-as-stored and pre-transposed-HWIO weight layouts), fwd+bwd, on
+    dummy data.  Runs OUTSIDE any trace (executor pre-pass).
+    padding/dilation are the op's ACTUAL attrs (padding=None keeps the
+    historical SAME default) — measuring a different regime than the
+    trace runs was the ADVICE-r5 aliasing bug."""
     import jax
     import jax.numpy as jnp
 
@@ -124,20 +126,30 @@ def measure(n, cin, h, w, cout, groups, stride, dtype, k=3,
     wg = (jax.random.normal(key_rng, (cout, cin // groups, kh, kw))
           * 0.1).astype(jnp.dtype(dtype))
 
-    def conv(x, wv, g):
+    def conv(x, wv, g, dn=("NCHW", "OIHW", "NCHW")):
         return jax.lax.conv_general_dilated(
             x, wv, stride, [(ph, ph), (pw, pw)],
             rhs_dilation=(dh, dw),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=dn,
             feature_group_count=g)
 
-    def make_step(dense):
+    def make_step(formulation):
         def step(c):
             xc, wc = c
             def loss(wv):
-                wv2 = (_dense_expand_grouped(wv, groups), 1) if dense \
-                    else (wv, groups)
-                y = conv(xc, wv2[0], wv2[1])
+                if formulation == "native":
+                    y = conv(xc, wv, groups)
+                else:
+                    wd = _dense_expand_grouped(wv, groups)
+                    if formulation == "dense_hwio":
+                        # the transpose is traced INSIDE the step, as
+                        # ops/nn_ops._conv2d traces it inside the jit:
+                        # the point is the operand-layout hint it hands
+                        # XLA's layout assignment, not the copy itself
+                        y = conv(xc, jnp.transpose(wd, (2, 3, 1, 0)), 1,
+                                 dn=("NCHW", "HWIO", "NCHW"))
+                    else:
+                        y = conv(xc, wd, 1)
                 return jnp.sum(y.astype(jnp.float32) * 1e-6), y
             (_, y), dw = jax.value_and_grad(loss, has_aux=True)(wc)
             # chain the BIG activation through a scalar consuming ALL of
@@ -154,11 +166,15 @@ def measure(n, cin, h, w, cout, groups, stride, dtype, k=3,
         * cout * (cin // groups) * kh * kw
     iters = max(8, min(96, int(2.5e11 / max(flops, 1))))
     from .chain_timer import time_step
-    t_native = time_step(make_step(False), (x, wg), iters)
-    t_dense = time_step(make_step(True), (x, wg), iters)
+    t_native = time_step(make_step("native"), (x, wg), iters)
+    t_dense = time_step(make_step("dense"), (x, wg), iters)
+    t_hwio = time_step(make_step("dense_hwio"), (x, wg), iters)
+    t_best_dense = min(t_dense, t_hwio)
     ent = {"native_ms": round(t_native * 1e3, 4),
            "dense_ms": round(t_dense * 1e3, 4),
-           "prefers_dense": bool(t_dense < t_native)}
+           "dense_hwio_ms": round(t_hwio * 1e3, 4),
+           "prefers_dense": bool(t_best_dense < t_native),
+           "layout": "hwio" if t_hwio < t_dense else "oihw"}
     # predicted-vs-measured join (obs/opprof.py discipline applied to
     # the autotune harness): every cache entry carries the cost model's
     # roofline for this conv shape plus each candidate FORMULATION's
@@ -173,6 +189,7 @@ def measure(n, cin, h, w, cout, groups, stride, dtype, k=3,
             ent["predicted_ms"] = round(pred, 6)
             ent["native_delta"] = round(t_native * 1e3 / pred, 3)
             ent["dense_delta"] = round(t_dense * 1e3 / pred, 3)
+            ent["hwio_delta"] = round(t_hwio * 1e3 / pred, 3)
     except Exception:   # noqa: BLE001 — prediction must never break tuning
         pass
     return ent
@@ -180,36 +197,14 @@ def measure(n, cin, h, w, cout, groups, stride, dtype, k=3,
 
 def ensure_tuned(n, cin, h, w, cout, groups, stride, dtype, k=3,
                  padding=None, dilation=(1, 1)) -> None:
-    if os.environ.get("PT_GCONV_TUNE", "1") in ("0", "never"):
-        return
-    from ..analysis.artifacts import check_autotune_entry
+    enabled = os.environ.get("PT_GCONV_TUNE", "1") not in ("0", "never")
     key = shape_key(n, cin, h, w, cout, groups, stride, dtype, k,
                     padding, dilation)
-    with _LOCK:
-        if key in _load():
-            return
-        try:
-            ent = measure(n, cin, h, w, cout, groups, stride, dtype, k,
-                          padding, dilation)
-            if check_autotune_entry(key, ent):
-                # impossible reading (≤ floor / non-finite): one retry —
-                # transient fabric contention does produce these — then
-                # give up loudly-in-the-entry and fall back to native
-                # (VERDICT r5 Weak #4: never decide from garbage)
-                ent = measure(n, cin, h, w, cout, groups, stride, dtype,
-                              k, padding, dilation)
-            if check_autotune_entry(key, ent):
-                ent = {"invalid": True, "prefers_dense": False,
-                       "native_ms": ent.get("native_ms"),
-                       "dense_ms": ent.get("dense_ms")}
-        except Exception as e:  # tuning must never break a run
-            ent = {"error": f"{type(e).__name__}: {e}",
-                   "prefers_dense": False}
-        _MEM[key] = ent
-        try:
-            _save()
-        except Exception:
-            pass
+    _CACHE.ensure(
+        key,
+        lambda: measure(n, cin, h, w, cout, groups, stride, dtype, k,
+                        padding, dilation),
+        fallback=dict(_FALLBACK), enabled=enabled)
 
 
 def tune_program(program, batch_hint: int) -> None:

@@ -1,0 +1,182 @@
+"""Shared measured-autotune harness: the persisted-winner cache behind
+every per-shape kernel choice.
+
+Generalizes what utils/gconv_autotune.py proved for dense-vs-grouped
+conv formulations (VERDICT r4 next #4: replace static rules with
+measurement) into one reusable cache + shootout discipline, so new
+kernel families (the fused conv-epilogue path in kernels/fused_conv.py,
+weight-layout choices, future Pallas candidates) inherit the whole
+contract instead of re-deriving it:
+
+* one JSON cache file per namespace under the same cache dir
+  (``~/.cache/paddle_tpu/<ns>_autotune.json``, path overridable per
+  namespace by an env knob), keyed by device kind + shape signature;
+* a **schema version stamped in the file**: the cache is stored as
+  ``{"schema": N, "entries": {...}}`` and a file whose schema does not
+  match (including the legacy flat-dict format) is DISCARDED at load —
+  stale entries re-measure instead of mis-keying a winner measured
+  under different key semantics (the satellite audit of
+  gconv_autotune.shape_key rides on this: bumping SCHEMA_VERSION
+  retires every pre-audit entry);
+* load-time + save-time floor validation through
+  analysis/artifacts.check_autotune_entry (reject-at-load and
+  reject-at-save halves of the same contract — a physically impossible
+  0.0 ms reading must never steer a kernel choice);
+* crash-safe multi-process persistence: read-merge-replace under a
+  process lock with tmp+rename, our own fresh measurements winning key
+  conflicts (the ADVICE-r5 clobber fix);
+* the retry-then-invalid-then-error measurement discipline: one retry
+  on an impossible reading, then a loud ``{"invalid": True}`` entry
+  carrying the declared fallback decision, and ``{"error": ...}`` when
+  measurement itself raised — tuning must never break a run.
+
+Timing itself stays in utils/chain_timer.py (the chained-fori_loop
+slope method); this module owns everything around it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, Optional
+
+#: bumped whenever any client's key or entry semantics change (the
+#: whole FILE is versioned: per-namespace keys measured under old
+#: semantics must all retire together). v2 = the shape_key audit —
+#: data-layout token in the gconv key, layout as a measured dimension.
+SCHEMA_VERSION = 2
+
+
+def device_kind() -> str:
+    """The cache's device namespace: winners are per chip generation."""
+    import jax
+    try:
+        return getattr(jax.devices()[0], "device_kind", "cpu")
+    except Exception:  # pragma: no cover - backend probing never fatal
+        return "cpu"
+
+
+class AutotuneCache:
+    """One namespace's persisted-winner cache.
+
+    ``decision_field`` is the per-entry key carrying the namespace's
+    fallback-safe decision (``prefers_dense`` for gconv,
+    ``prefers_pallas`` for the fused conv epilogue): every entry —
+    including error/invalid ones — must record it, and floor validation
+    is parameterized on it plus the namespace's measured ``ms_fields``.
+    """
+
+    def __init__(self, namespace: str, env_var: str,
+                 decision_field: str = "prefers_dense",
+                 ms_fields=("native_ms", "dense_ms")):
+        self.namespace = namespace
+        self.env_var = env_var
+        self.decision_field = decision_field
+        self.ms_fields = tuple(ms_fields)
+        self._lock = threading.Lock()
+        self._mem: Optional[Dict[str, dict]] = None
+
+    # -- paths / (de)serialization ----------------------------------------
+    def path(self) -> str:
+        return os.environ.get(
+            self.env_var,
+            os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                         f"{self.namespace}_autotune.json"))
+
+    def check_entry(self, key: str, ent) -> list:
+        from ..analysis.artifacts import check_autotune_entry
+        return check_autotune_entry(key, ent,
+                                    decision_field=self.decision_field,
+                                    ms_fields=self.ms_fields)
+
+    def _filter(self, entries: dict) -> Dict[str, dict]:
+        return {k: v for k, v in entries.items()
+                if not self.check_entry(str(k), v)}
+
+    def read_disk(self, path: Optional[str] = None) -> Dict[str, dict]:
+        """Load + schema-check + floor-filter the on-disk cache.
+
+        Tolerates (by discarding) every stale or corrupt shape: a
+        legacy flat dict (schema 1, pre-versioning), a mismatched
+        ``schema`` stamp, non-dict entries, or unparseable JSON — all
+        of them re-measure instead of steering choices."""
+        path = path or self.path()
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except Exception:
+            return {}
+        if not isinstance(doc, dict):
+            return {}
+        if doc.get("schema") != SCHEMA_VERSION:
+            # legacy flat-dict files have no "schema" key at all; files
+            # from a future/past schema mis-key by construction
+            return {}
+        entries = doc.get("entries")
+        if not isinstance(entries, dict):
+            return {}
+        return self._filter(entries)
+
+    def load(self) -> Dict[str, dict]:
+        if self._mem is None:
+            self._mem = self.read_disk()
+        return self._mem
+
+    def save(self) -> None:
+        path = self.path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # re-merge the on-disk state immediately before the replace: two
+        # processes tuning DIFFERENT shapes each did read-modify-write
+        # of the whole file; whoever wrote second must not clobber the
+        # other's fresh entries. Our own measurements win key conflicts.
+        merged = self.read_disk(path)
+        merged.update(self._mem or {})
+        self._mem = merged
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"schema": SCHEMA_VERSION, "entries": self._mem},
+                      f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+
+    # -- lookup / record ---------------------------------------------------
+    def get(self, key: str) -> Optional[dict]:
+        return self.load().get(key)
+
+    def reset(self) -> None:
+        """Drop the in-memory view (tests; env-var cache-path changes)."""
+        with self._lock:
+            self._mem = None
+
+    def ensure(self, key: str, measure: Callable[[], dict],
+               fallback: dict, enabled: bool = True) -> None:
+        """The shared ensure-tuned discipline: measure `key` once,
+        validating readings against the physical band with one retry,
+        then persist — an invalid double-reading records
+        ``{"invalid": True, **fallback}`` and an exception records
+        ``{"error": ..., **fallback}`` (tuning must never break a run).
+
+        `fallback` must carry the namespace's decision_field with its
+        safe default."""
+        if not enabled:
+            return
+        with self._lock:
+            if key in self.load():
+                return
+            try:
+                ent = measure()
+                if self.check_entry(key, ent):
+                    # impossible reading (<= floor / non-finite): one
+                    # retry — transient fabric contention does produce
+                    # these — then give up loudly-in-the-entry
+                    ent = measure()
+                if self.check_entry(key, ent):
+                    bad = {f: ent.get(f) for f in self.ms_fields}
+                    ent = {"invalid": True, **fallback, **bad}
+            except Exception as e:  # noqa: BLE001 - never break a run
+                ent = {"error": f"{type(e).__name__}: {e}", **fallback}
+            self._mem[key] = ent
+            try:
+                self.save()
+            except Exception:  # noqa: BLE001 - persistence best-effort
+                pass

@@ -2,7 +2,7 @@
 # CI entry (≙ paddle/scripts/paddle_build.sh: build + test in one place).
 # Runs the lint gate, the full suite on the 8-device virtual CPU mesh,
 # the multi-chip dryrun, and a bench sanity pass.
-# Usage: scripts/ci.sh [quick|lint|chaos|perf|serve|analyze|data|obs]
+# Usage: scripts/ci.sh [quick|lint|chaos|perf|serve|analyze|data|obs|fusion]
 #   lint  = just the lint gate
 #   chaos = lint gate + the resilience suite under two fixed fault seeds
 #   perf  = lint gate + the async-hot-path suite (lazy fetches, per-phase
@@ -36,6 +36,16 @@
 #           exposition-format conformance check over a live scrape +
 #           schema-checked tools/op_report.py attribution runs on the
 #           resnet and transformer bench programs
+#   fusion = lint gate + the conv-epilogue fusion suite (pass legality,
+#           fused-vs-unfused fwd+bwd parity, PT_FUSE=0 bit-for-bit
+#           restore, cost/memory strict decrease, conv-fusion verifier
+#           pass, Pallas epilogue interpret numerics) + the shared
+#           autotune-harness suite (gconv layout dimension, schema-
+#           versioned cache, corruption round-trips) + a live
+#           bench_resnet fused-vs-unfused A/B row schema-checked via
+#           analysis/artifacts.validate_fusion_ab (speedup recorded-or-
+#           explained, parity inside the declared band, attribution
+#           coverage >= 90 on the fused config)
 #   data  = lint gate + the production data-plane suite (pipeline
 #           determinism, sharding disjointness, parallel shard readers,
 #           cheap skip + checkpointable state, device-side augmentation,
@@ -184,6 +194,33 @@ PYEOF
   python tools/plan.py transformer --rank-gate \
     --calibration "$CALIB_TMP/calibration.json"
   echo "ANALYZE OK"
+  exit 0
+fi
+
+if [[ "${1:-}" == "fusion" ]]; then
+  echo "== fusion: conv-epilogue fusion + shared autotune harness suites =="
+  python -m pytest tests/test_conv_fusion.py tests/test_gconv_autotune.py -q
+  echo "== fusion: bench_resnet fused-vs-unfused A/B (schema-checked) =="
+  BENCH_STEPS="${BENCH_STEPS:-2}" BENCH_BATCH="${BENCH_BATCH:-2}" \
+    python - <<'PY'
+import json
+import bench
+out = bench.bench_resnet(on_tpu=False, peak=1e12)
+row = out.get("fusion_ab")
+from paddle_tpu.analysis.artifacts import validate_fusion_ab
+problems = validate_fusion_ab(row)
+if problems:
+    raise SystemExit("FUSION A/B ROW INVALID:\n  "
+                     + "\n  ".join(problems)
+                     + "\nrow: " + json.dumps(row, indent=1))
+print(f"fusion A/B ok: {row['arms']['fused']['fused_ops']} fused ops, "
+      f"speedup {row['speedup']}x"
+      f"{' (explained)' if 'explanation' in row else ''}, parity delta "
+      f"{row['parity']['loss_delta_rel']} (tol "
+      f"{row['parity']['tolerance']}), attribution coverage "
+      f"{row['op_attribution_coverage']}%")
+PY
+  echo "FUSION OK"
   exit 0
 fi
 

@@ -360,8 +360,8 @@ def test_decode_step_memory_estimate_prices_kv_pools():
 def test_pass_registry_is_extensible():
     names = registered_passes()
     assert names == ["def-use", "dtype-prop", "dead-code", "write-hazard",
-                     "shard-check", "wire-codec", "collective-audit",
-                     "pipeline-stage"]
+                     "shard-check", "wire-codec", "conv-fusion",
+                     "collective-audit", "pipeline-stage"]
     # pass subsetting: a dtype-defective program is clean under def-use only
     p = pt.Program()
     b = p.global_block

@@ -1,6 +1,7 @@
-"""Grouped-conv autotune cache (utils/gconv_autotune.py, ≙ the cuDNN
-algorithm-search role of conv_cudnn_op.cu.cc): mechanism tests with a
-fake measure function — the real shootout runs on the chip."""
+"""Grouped-conv autotune cache (utils/gconv_autotune.py over the shared
+utils/kernel_autotune.py harness, ≙ the cuDNN algorithm-search role of
+conv_cudnn_op.cu.cc): mechanism tests with a fake measure function — the
+real shootout runs on the chip."""
 
 import json
 import os
@@ -11,13 +12,28 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu import layers
 from paddle_tpu.utils import gconv_autotune as gt
+from paddle_tpu.utils import kernel_autotune as ka
 
 
 @pytest.fixture(autouse=True)
 def _fresh_cache(tmp_path, monkeypatch):
     monkeypatch.setenv("PT_GCONV_CACHE", str(tmp_path / "cache.json"))
-    monkeypatch.setattr(gt, "_MEM", None)
+    gt._CACHE.reset()
     yield
+    gt._CACHE.reset()
+
+
+def _good_entry(native=2.0, dense=1.0, hwio=3.0):
+    return {"native_ms": native, "dense_ms": dense, "dense_hwio_ms": hwio,
+            "prefers_dense": min(dense, hwio) < native,
+            "layout": "hwio" if hwio < dense else "oihw"}
+
+
+def _write_disk(entries, path=None, schema=ka.SCHEMA_VERSION):
+    path = path or os.environ["PT_GCONV_CACHE"]
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"schema": schema, "entries": entries}, f)
 
 
 def test_cache_roundtrip_and_lookup(monkeypatch):
@@ -26,7 +42,7 @@ def test_cache_roundtrip_and_lookup(monkeypatch):
     def fake_measure(n, cin, h, w, cout, groups, stride, dtype, k=3,
                      padding=None, dilation=(1, 1)):
         calls.append((n, cin, h, w, cout, groups, stride, dtype, k))
-        return {"native_ms": 2.0, "dense_ms": 1.0, "prefers_dense": True}
+        return _good_entry()
 
     monkeypatch.setattr(gt, "measure", fake_measure)
     gt.ensure_tuned(8, 128, 56, 56, 128, 4, (1, 1), "float32", 3)
@@ -36,11 +52,13 @@ def test_cache_roundtrip_and_lookup(monkeypatch):
     # second call: cache hit, no re-measure
     gt.ensure_tuned(8, 128, 56, 56, 128, 4, (1, 1), "float32", 3)
     assert len(calls) == 1
-    # persisted on disk and reloadable by a fresh process state
+    # persisted on disk in the schema-versioned envelope and reloadable
+    # by a fresh process state
     with open(os.environ["PT_GCONV_CACHE"]) as f:
         disk = json.load(f)
-    assert key in disk
-    gt._MEM = None
+    assert disk["schema"] == ka.SCHEMA_VERSION
+    assert key in disk["entries"]
+    gt._CACHE.reset()
     assert gt.lookup(key) is True
 
 
@@ -63,6 +81,57 @@ def test_trace_decision_reads_cache(monkeypatch):
     # the env override still wins
     monkeypatch.setenv("PT_GCONV_DENSE", "never")
     assert _gconv_prefers_dense(x, w, 4) is False
+
+
+def test_trace_layout_decision_reads_cache(monkeypatch):
+    """The dense formulation's weight layout is the second autotuned
+    dimension: the entry's measured winner steers the trace-time
+    pre-transpose, PT_GCONV_LAYOUT pins it, pre-layout entries read as
+    the stored OIHW layout."""
+    from paddle_tpu.ops.nn_ops import _gconv_dense_layout
+
+    class FakeArr:
+        def __init__(self, shape, dtype="float32"):
+            self.shape = shape
+            self.dtype = np.dtype(dtype)
+
+    x = FakeArr((8, 128, 56, 56))
+    w = FakeArr((128, 32, 3, 3))
+    assert _gconv_dense_layout(x, w, 4) == "oihw"   # untuned default
+    key = gt.shape_key(8, 128, 56, 56, 128, 4, (1, 1), "float32", 3)
+    gt._load()[key] = _good_entry(native=3.0, dense=2.0, hwio=1.0)
+    assert gt.lookup_layout(key) == "hwio"
+    assert _gconv_dense_layout(x, w, 4) == "hwio"
+    # an entry predating the layout dimension falls back to stored
+    gt._load()[key] = {"prefers_dense": True}
+    assert _gconv_dense_layout(x, w, 4) == "oihw"
+    # the env override still wins
+    gt._load()[key] = _good_entry(native=3.0, dense=2.0, hwio=1.0)
+    monkeypatch.setenv("PT_GCONV_LAYOUT", "oihw")
+    assert _gconv_dense_layout(x, w, 4) == "oihw"
+
+
+def test_hwio_layout_conv_matches_oihw(monkeypatch):
+    """The pre-transposed HWIO dense path is a pure layout change: same
+    numbers as the OIHW dense path on a grouped conv."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.nn_ops import _conv2d
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 8, 6, 6)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((8, 4, 3, 3)) * 0.1, jnp.float32)
+    attrs = {"strides": 1, "paddings": 1, "dilations": 1, "groups": 2}
+    monkeypatch.setenv("PT_GCONV_DENSE", "always")
+    monkeypatch.setenv("PT_GCONV_LAYOUT", "oihw")
+    y_oihw = _conv2d(x, w, attrs)
+    monkeypatch.setenv("PT_GCONV_LAYOUT", "hwio")
+    y_hwio = _conv2d(x, w, attrs)
+    monkeypatch.setenv("PT_GCONV_DENSE", "never")
+    y_native = _conv2d(x, w, attrs)
+    np.testing.assert_allclose(np.asarray(y_oihw), np.asarray(y_hwio),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y_native), np.asarray(y_hwio),
+                               rtol=1e-5, atol=1e-5)
 
 
 def test_tune_program_walks_grouped_convs(monkeypatch):
@@ -95,14 +164,16 @@ def test_shape_key_separates_padding_and_dilation():
                       dilation=(2, 2))
     assert base == same
     assert len({base, p0, d2}) == 3
+    # the audited key carries the activation data-layout token
+    assert base.endswith("|nchw")
 
 
 def test_impossible_reading_remeasures_once_then_falls_back(monkeypatch):
     """VERDICT r5 Weak #4: a <= floor reading is discarded and measured
     again; twice-bad marks the entry invalid with the native fallback."""
     seq = iter([
-        {"native_ms": 0.0, "dense_ms": 1.0, "prefers_dense": True},   # bad
-        {"native_ms": 2.0, "dense_ms": 1.0, "prefers_dense": True},   # good
+        _good_entry(native=0.0),   # bad
+        _good_entry(native=2.0),   # good
     ])
     monkeypatch.setattr(gt, "measure", lambda *a, **kw: next(seq))
     gt.ensure_tuned(8, 64, 28, 28, 64, 4, (1, 1), "float32", 3)
@@ -110,53 +181,88 @@ def test_impossible_reading_remeasures_once_then_falls_back(monkeypatch):
     assert gt.lookup(key) is True  # the retry's honest reading decided
 
     # twice-impossible (fresh shape): invalid entry, native fallback
-    monkeypatch.setattr(gt, "measure", lambda *a, **kw: {
-        "native_ms": 0.0, "dense_ms": float("nan"), "prefers_dense": True})
+    monkeypatch.setattr(gt, "measure", lambda *a, **kw: _good_entry(
+        native=0.0, dense=float("nan")))
     gt.ensure_tuned(4, 32, 14, 14, 32, 2, (1, 1), "float32", 3)
     key2 = gt.shape_key(4, 32, 14, 14, 32, 2, (1, 1), "float32", 3)
     ent = gt._load()[key2]
     assert ent["invalid"] is True
     assert gt.lookup(key2) is False
+    assert gt.lookup_layout(key2) == "oihw"
     # and an invalid entry never survives a disk round-trip as truth:
-    gt._MEM = None
+    gt._CACHE.reset()
     assert gt.lookup(key) is True  # good entry persisted
 
 
 def test_poisoned_disk_cache_self_heals_on_load():
     key = gt.shape_key(8, 64, 28, 28, 64, 4, (1, 1), "float32", 3)
+    good = gt.shape_key(4, 32, 14, 14, 32, 2, (1, 1), "float32", 3)
+    _write_disk({key: _good_entry(native=0.0, dense=0.0, hwio=0.0),
+                 good: _good_entry()})
+    gt._CACHE.reset()
+    assert gt.lookup(key) is None   # dropped at load => will re-measure
+    assert gt.lookup(good) is True  # healthy neighbors survive the heal
+
+
+def test_stale_schema_and_corrupt_files_discard_not_crash():
+    """The satellite audit's contract: a legacy flat-dict file (the
+    pre-versioning format), a mismatched schema stamp, or outright
+    garbage is DISCARDED wholesale at load — entries measured under old
+    key semantics must re-measure, never mis-key."""
+    key = gt.shape_key(8, 64, 28, 28, 64, 4, (1, 1), "float32", 3)
     path = os.environ["PT_GCONV_CACHE"]
     os.makedirs(os.path.dirname(path), exist_ok=True)
+
+    # legacy flat dict (no schema envelope)
     with open(path, "w") as f:
-        json.dump({key: {"native_ms": 0.0, "dense_ms": 0.0,
-                         "prefers_dense": True}}, f)
-    gt._MEM = None
-    assert gt.lookup(key) is None  # dropped at load => will re-measure
+        json.dump({key: _good_entry()}, f)
+    gt._CACHE.reset()
+    assert gt.lookup(key) is None
+
+    # wrong schema stamp
+    _write_disk({key: _good_entry()}, schema=ka.SCHEMA_VERSION + 1)
+    gt._CACHE.reset()
+    assert gt.lookup(key) is None
+
+    # unparseable JSON
+    with open(path, "w") as f:
+        f.write("{not json")
+    gt._CACHE.reset()
+    assert gt.lookup(key) is None
+
+    # envelope whose entries is not an object
+    with open(path, "w") as f:
+        json.dump({"schema": ka.SCHEMA_VERSION, "entries": [1, 2]}, f)
+    gt._CACHE.reset()
+    assert gt.lookup(key) is None
+
+    # ...and a fresh measurement round-trips through the same file
+    gt._load()[key] = _good_entry()
+    gt._save()
+    gt._CACHE.reset()
+    assert gt.lookup(key) is True
 
 
 def test_save_remerges_concurrent_disk_entries(monkeypatch):
     """The ADVICE-r5 race: another process wrote its entries between our
     load and our save; _save must merge them instead of clobbering."""
-    def fake_measure(*a, **kw):
-        return {"native_ms": 2.0, "dense_ms": 1.0, "prefers_dense": True}
-
-    monkeypatch.setattr(gt, "measure", fake_measure)
+    monkeypatch.setattr(gt, "measure", lambda *a, **kw: _good_entry())
     gt.ensure_tuned(8, 64, 28, 28, 64, 4, (1, 1), "float32", 3)
     ours = gt.shape_key(8, 64, 28, 28, 64, 4, (1, 1), "float32", 3)
 
     # simulate the OTHER process: write a foreign entry directly to disk
-    theirs = "otherchip|n1c8h8w8->o8g2k3s1x1p1x1d1x1|float32"
+    theirs = "otherchip|n1c8h8w8->o8g2k3s1x1p1x1d1x1|float32|nchw"
     path = os.environ["PT_GCONV_CACHE"]
     with open(path) as f:
         disk = json.load(f)
-    disk[theirs] = {"native_ms": 1.0, "dense_ms": 3.0,
-                    "prefers_dense": False}
+    disk["entries"][theirs] = _good_entry(native=1.0, dense=3.0, hwio=3.0)
     with open(path, "w") as f:
         json.dump(disk, f)
 
     # our process tunes another shape and saves: both survive
     gt.ensure_tuned(4, 32, 14, 14, 32, 2, (1, 1), "float32", 3)
     with open(path) as f:
-        final = json.load(f)
+        final = json.load(f)["entries"]
     assert ours in final and theirs in final
     assert gt.shape_key(4, 32, 14, 14, 32, 2, (1, 1), "float32", 3) in final
 
@@ -205,7 +311,8 @@ def test_measure_records_predicted_vs_measured_delta(monkeypatch):
                         lambda step, carry, iters: 0.004)
     ent = gt.measure(8, 16, 16, 16, 32, groups=4, stride=(1, 1),
                      dtype="float32")
-    assert ent["native_ms"] == ent["dense_ms"] == 4.0
+    assert ent["native_ms"] == ent["dense_ms"] == ent["dense_hwio_ms"] == 4.0
+    assert ent["layout"] == "oihw"  # ties keep the stored layout
     from paddle_tpu.analysis.cost import predict_grouped_conv_ms
     pred = predict_grouped_conv_ms(8, 16, 16, 16, 32, 4, (1, 1),
                                    dtype="float32")
@@ -214,6 +321,8 @@ def test_measure_records_predicted_vs_measured_delta(monkeypatch):
     assert ent["native_delta"] == pytest.approx(4.0 / ent["predicted_ms"],
                                                 rel=1e-2)
     assert ent["dense_delta"] == ent["native_delta"]
+    assert ent["hwio_delta"] == ent["native_delta"]
     # the schema layer still accepts the enriched entry
     from paddle_tpu.analysis.artifacts import check_autotune_entry
-    assert check_autotune_entry("k", ent) == []
+    assert check_autotune_entry(
+        "k", ent, ms_fields=("native_ms", "dense_ms", "dense_hwio_ms")) == []

@@ -125,7 +125,7 @@ class TestGroupedConvDenseExpansion:
         from paddle_tpu.utils import gconv_autotune as gt
         monkeypatch.setenv("PT_GCONV_DENSE", "auto")  # pin ambient mode
         monkeypatch.setenv("PT_GCONV_CACHE", str(tmp_path / "c.json"))
-        monkeypatch.setattr(gt, "_MEM", None)
+        monkeypatch.setattr(gt._CACHE, "_mem", None)
         x = jnp.zeros((1, 1024, 7, 7))
         w = jnp.zeros((1024, 32, 3, 3))
         assert not nn_ops._gconv_prefers_dense(x, w, 32)
