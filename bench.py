@@ -1984,6 +1984,171 @@ def bench_elastic(on_tpu, peak):
     return out
 
 
+def bench_orchestrated(on_tpu, peak):
+    """Host-level orchestration (resilience/orchestrator.py): a
+    thread-hosted chief training under an ElasticSupervisor plus a
+    lease-renewing peer; an injected heartbeat_loss hangs the peer
+    mid-run, so the measurement exercises the DISCRIMINATION path —
+    the peer's handle stays alive and only the lease goes stale.
+    Reported: detection latency (last renewal -> eviction), recovery
+    seconds (graceful stop -> survivors resumed on the shrunk
+    PT_ELASTIC_TOPOLOGY), chip accounting, exact-once step coverage
+    across the restart, and a streaming-reshard leg: the chief's final
+    checkpoint streamed under a deliberately small chunk budget with
+    the tracemalloc-measured peak held against it, next to the gather
+    path's header-based host-byte estimate. Floored by
+    artifacts.validate_orchestrated."""
+    import tempfile
+    import tracemalloc
+
+    import paddle_tpu as pt
+    from paddle_tpu import io as pio
+    from paddle_tpu import layers
+    from paddle_tpu.parallel.mesh import Topology
+    from paddle_tpu.resilience import faults as pfaults
+    from paddle_tpu.resilience import streaming
+    from paddle_tpu.resilience.elastic import ElasticSupervisor
+    from paddle_tpu.resilience.orchestrator import (Orchestrator,
+                                                    WorkerSpec,
+                                                    peer_worker)
+    from paddle_tpu.resilience.retry import RetryPolicy
+
+    n_steps = int(os.environ.get("BENCH_ORCH_STEPS", 12))
+    interval = 4
+    hang_hit = int(os.environ.get("BENCH_ORCH_HANG_HIT", 8))
+    lease_s, grace_s = 0.15, 0.1
+    batch = 8
+
+    rs = np.random.RandomState(4321)
+    data = [(rs.randn(16).astype(np.float32),
+             rs.randn(1).astype(np.float32))
+            for _ in range(n_steps * batch)]
+
+    ckpt = os.path.join(tempfile.mkdtemp(prefix="bench_orch_"), "ckpt")
+
+    def make_trainer():
+        pt.core.program.reset_unique_names()
+
+        def train_func():
+            x = layers.data("x", [16])
+            y = layers.data("y", [1])
+            h = layers.fc(x, size=32, act="relu")
+            pred = layers.fc(h, size=1)
+            return [layers.mean(layers.square_error_cost(pred, y))]
+
+        cfg = pt.CheckpointConfig(ckpt, step_interval=interval)
+        return pt.Trainer(train_func,
+                          lambda: pt.optimizer.SGDOptimizer(0.05),
+                          checkpoint_config=cfg)
+
+    steps, sups = [], []
+
+    def chief(ctx):
+        def raw():
+            yield from data
+
+        sup = ElasticSupervisor(
+            make_trainer, batch=batch,
+            base_topology=Topology.parse("cpu:4x2"),
+            policy=RetryPolicy(retries=3, base_delay=0.0, jitter=0.0,
+                               sleep=lambda _d: None))
+        sups.append(sup)
+
+        def handler(event):
+            if isinstance(event, pt.EndStepEvent):
+                steps.append((event.epoch, event.step))
+                ctx.heartbeat(step=event.step)
+                if ctx.should_stop() and sup.trainer is not None:
+                    sup.trainer.request_preemption()
+                # pace the epoch so the peer's silence threshold always
+                # elapses while the chief is still training
+                time.sleep(0.03)
+
+        sup.run(num_epochs=1, event_handler=handler,
+                reader=pt.reader.batch(raw, batch))
+
+    lease_dir = os.path.join(os.path.dirname(ckpt), "leases")
+    orch = Orchestrator(
+        [WorkerSpec("chief", chief, chips=4, primary=True, lease_s=60.0),
+         WorkerSpec("peer", lambda c: peer_worker(c, interval_s=0.02),
+                    chips=4, lease_s=lease_s)],
+        lease_dir=lease_dir, grace_s=grace_s, stop_grace_s=30.0,
+        poll_s=0.02, name="bench-orch")
+
+    prior_plan = os.environ.get("PT_FAULT_INJECT")
+    os.environ["PT_FAULT_INJECT"] = f"heartbeat_loss@{hang_hit}"
+    pfaults.reset()
+    t0 = time.time()
+    try:
+        report = orch.run()
+    finally:
+        if prior_plan is None:
+            os.environ.pop("PT_FAULT_INJECT", None)
+        else:
+            os.environ["PT_FAULT_INJECT"] = prior_plan
+        pfaults.reset()
+    wall = time.time() - t0
+    ev = report["evictions"][0] if report["evictions"] else {}
+
+    # -- streaming leg: the chief's final checkpoint, chunked ----------
+    serial = pio.get_latest_checkpoint_serial(ckpt)
+    src = os.path.join(ckpt, f"{pio.CHECKPOINT_PREFIX}_{serial}")
+    gather_bytes = pio.estimate_serial_host_bytes(src)
+    to_plan = sups[-1].trainer.plan if sups and sups[-1].trainer \
+        else {"mesh": {}, "specs": {}}
+    chunk_bytes = 1 << 12  # 4 KiB slabs: the toy vars still chunk
+    dst = os.path.join(os.path.dirname(ckpt), "streamed")
+    was_tracing = tracemalloc.is_tracing()
+    if not was_tracing:
+        tracemalloc.start()
+    tracemalloc.reset_peak()
+    stream_rep = streaming.stream_reshard(src, dst, to_plan,
+                                          chunk_bytes=chunk_bytes)
+    _, peak_bytes = tracemalloc.get_traced_memory()
+    if not was_tracing:
+        tracemalloc.stop()
+    identical = True
+    for name, info in pio.serial_var_sources(src).items():
+        got = np.load(os.path.join(dst, name + ".npy"))
+        if info["pieces"][0]["index"] is None:
+            want = np.load(info["pieces"][0]["path"])
+            identical = identical and np.array_equal(got, want)
+
+    out = {
+        "steps_total": n_steps,
+        "step_interval": interval,
+        "cause": ev.get("cause"),
+        "evicted": ev.get("wid"),
+        "detect_s": round(float(ev.get("detect_s", -1.0)), 4),
+        "recovery_s": round(float(report["recoveries"][0]), 4)
+        if report["recoveries"] else -1.0,
+        "rounds": report["rounds"],
+        "evictions": len(report["evictions"]),
+        "lease_s": lease_s,
+        "grace_s": grace_s,
+        "topology": report["topology"],
+        "chips": {"surviving": report["surviving_chips"],
+                  "target": report["target_chips"]},
+        "steps_exactly_once": steps == [(0, s) for s in range(n_steps)],
+        "completed": bool(report["completed"]),
+        "stream": {"chunk_bytes": chunk_bytes,
+                   "peak_bytes": int(peak_bytes),
+                   "gather_bytes": int(gather_bytes),
+                   "chunks": stream_rep["chunks_copied"],
+                   "bytes_copied": stream_rep["bytes_copied"],
+                   "bit_identical": bool(identical)},
+        "wall_s": round(wall, 3),
+    }
+
+    from paddle_tpu.analysis.artifacts import validate_orchestrated
+    problems = validate_orchestrated(out)
+    if problems:
+        out["floor_violations"] = problems
+        print(f"bench_orchestrated FLOOR VIOLATIONS: {problems}",
+              file=sys.stderr)
+    return out
+
+
 def bench_planner(on_tpu, peak):
     """Static placement planner (analysis/planner.py): search the bench
     transformer's placement space for an 8-chip topology of the current
@@ -2195,6 +2360,7 @@ def main():
              ("serving", lambda: bench_serving(on_tpu, peak)),
              ("fleet", lambda: bench_fleet(on_tpu, peak)),
              ("elastic", lambda: bench_elastic(on_tpu, peak)),
+             ("orchestrated", lambda: bench_orchestrated(on_tpu, peak)),
              ("planner", lambda: bench_planner(on_tpu, peak)),
              ("decode", lambda: bench_decode(on_tpu, peak)),
              ("transformer", lambda: bench_transformer(on_tpu, peak)),

@@ -862,3 +862,112 @@ def validate_elastic(doc) -> List[str]:
             f"$.completed: {doc.get('completed')!r} — the resumed run "
             "must train to completion")
     return problems
+
+
+_ORCHESTRATED_REQUIRED = ("steps_total", "step_interval", "cause",
+                          "detect_s", "recovery_s", "rounds", "evictions",
+                          "topology", "chips", "steps_exactly_once",
+                          "completed", "stream")
+
+#: streaming peak may exceed the chunk budget only by allocator /
+#: tracemalloc bookkeeping noise — a full extra chunk means a slab
+#: survived across the loop edge (the two-chunk-peak bug class)
+ORCH_STREAM_PEAK_SLACK_BYTES = 1 << 20
+
+
+def validate_orchestrated(doc) -> List[str]:
+    """Floor checks for bench.py's `orchestrated` bench ([] = valid):
+    a host-level recovery measurement that did not actually exercise
+    the orchestrator must never be committed.
+
+      * the injected HANG was discriminated as a hang: cause is
+        `heartbeat_loss` (a crash reading means the lease protocol was
+        bypassed — the peer died instead of going silent);
+      * detect_s is finite, at least the worker's lease (silence cannot
+        be detected faster than the lease expires), and under the
+        ELASTIC_RECOVERY_CEILING_S ceiling; recovery_s likewise bounded;
+      * at least one eviction and one recovery round, and the surviving
+        slice is strictly smaller than the target (chips.surviving <
+        chips.target) — recovery onto the full mesh measured nothing;
+      * steps_exactly_once and completed are True — the epoch's steps
+        seen once each across the restart is the whole acceptance;
+      * the streaming leg held its memory contract: stream.peak_bytes
+        <= stream.chunk_bytes + ORCH_STREAM_PEAK_SLACK_BYTES, at least
+        one chunk moved, and stream.bit_identical is True.
+    """
+    if not isinstance(doc, dict):
+        return [f"orchestrated root is {type(doc).__name__}, "
+                "not an object"]
+    problems = [f"$.{k}: required field missing"
+                for k in _ORCHESTRATED_REQUIRED if k not in doc]
+    if "cause" in doc and doc.get("cause") != "heartbeat_loss":
+        problems.append(
+            f"$.cause: {doc.get('cause')!r} — the injected hang must be "
+            "discriminated as heartbeat_loss, not recorded as a crash")
+    lease = doc.get("lease_s")
+    for k, floor in (("detect_s", lease), ("recovery_s", 0)):
+        v = doc.get(k)
+        if k not in doc:
+            continue
+        if (not isinstance(v, (int, float)) or isinstance(v, bool)
+                or _bad_pred_num(v) or float(v) < 0
+                or float(v) >= ELASTIC_RECOVERY_CEILING_S):
+            problems.append(
+                f"$.{k}: {v!r} must be finite, non-negative, and under "
+                f"{ELASTIC_RECOVERY_CEILING_S} s")
+        elif isinstance(floor, (int, float)) and float(v) < float(floor):
+            problems.append(
+                f"$.{k}: {v!r} below its physical floor {floor!r} — "
+                "silence cannot be detected before the lease expires")
+    for k in ("rounds", "evictions"):
+        v = doc.get(k)
+        if k in doc and (not isinstance(v, int) or isinstance(v, bool)
+                         or v < 1):
+            problems.append(
+                f"$.{k}: {v!r} — the injected hang must actually fire "
+                "(>= 1), else the bench measured the happy path")
+    chips = doc.get("chips")
+    if "chips" in doc:
+        if not isinstance(chips, dict):
+            problems.append(f"$.chips: {chips!r} is not an object")
+        else:
+            s, t = chips.get("surviving"), chips.get("target")
+            if not all(isinstance(v, int) and not isinstance(v, bool)
+                       and v > 0 for v in (s, t)) or s >= t:
+                problems.append(
+                    f"$.chips: surviving={s!r} target={t!r} — the "
+                    "surviving slice must be a strict shrink")
+    for k in ("steps_exactly_once", "completed"):
+        if k in doc and doc.get(k) is not True:
+            problems.append(
+                f"$.{k}: {doc.get(k)!r} — exact-once resume to "
+                "completion is the acceptance, not a nice-to-have")
+    stream = doc.get("stream")
+    if "stream" in doc:
+        if not isinstance(stream, dict):
+            problems.append(f"$.stream: {stream!r} is not an object")
+        else:
+            peak = stream.get("peak_bytes")
+            budget = stream.get("chunk_bytes")
+            if not all(isinstance(v, int) and not isinstance(v, bool)
+                       and v > 0 for v in (peak, budget)):
+                problems.append(
+                    f"$.stream: peak_bytes={peak!r} "
+                    f"chunk_bytes={budget!r} must be positive ints")
+            elif peak > budget + ORCH_STREAM_PEAK_SLACK_BYTES:
+                problems.append(
+                    f"$.stream.peak_bytes: {peak} exceeds chunk budget "
+                    f"{budget} + {ORCH_STREAM_PEAK_SLACK_BYTES} slack — "
+                    "the bounded-host-memory contract is broken")
+            chunks = stream.get("chunks")
+            if not isinstance(chunks, int) or isinstance(chunks, bool) \
+                    or chunks < 1:
+                problems.append(
+                    f"$.stream.chunks: {chunks!r} — the stream must "
+                    "actually move at least one chunk")
+            if stream.get("bit_identical") is not True:
+                problems.append(
+                    f"$.stream.bit_identical: "
+                    f"{stream.get('bit_identical')!r} — the streamed "
+                    "serial must match the source arrays bit-for-bit")
+    return problems

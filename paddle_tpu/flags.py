@@ -476,3 +476,44 @@ declare_env_knob("PT_ELASTIC_BACKOFF_S",
                  "elastic supervisor base restart backoff in seconds "
                  "(default 0.05; exponential with seeded jitter, "
                  "capped at 30 s)")
+declare_env_knob("PT_ORCH_LEASE_S",
+                 "orchestrator (resilience/orchestrator.py) default "
+                 "worker lease in seconds (default 10): a worker whose "
+                 "lease age exceeds lease + grace is evicted — dead "
+                 "handle = worker_crash, live handle = heartbeat_loss "
+                 "(killed). Per-worker override via WorkerSpec.lease_s")
+declare_env_knob("PT_ORCH_GRACE_S",
+                 "orchestrator eviction grace window in seconds past "
+                 "the lease before a silent worker is evicted "
+                 "(default: half the lease)")
+declare_env_knob("PT_ORCH_STOP_GRACE_S",
+                 "orchestrator graceful-stop budget in seconds "
+                 "(default 30): survivors get this long to checkpoint "
+                 "at a step boundary and return before being killed "
+                 "during a recovery or final shutdown")
+declare_env_knob("PT_ORCH_EVICTIONS",
+                 "orchestrator eviction budget (default 3): total "
+                 "evictions tolerated across the run; exhaustion "
+                 "raises OrchestratorError instead of shrinking again")
+declare_env_knob("PT_ORCH_WORKER_ID",
+                 "set by the subprocess runner on each spawned worker: "
+                 "its worker id, consumed by "
+                 "orchestrator.worker_context_from_env()")
+declare_env_knob("PT_ORCH_LEASE_DIR",
+                 "set by the subprocess runner on each spawned worker: "
+                 "the lease directory to renew into, consumed by "
+                 "orchestrator.worker_context_from_env()")
+declare_env_knob("PT_ORCH_ROUND",
+                 "set by the subprocess runner on each spawned worker: "
+                 "the orchestration round (increments per recovery), "
+                 "stamped into lease renewals")
+declare_env_knob("PT_RESHARD_CHUNK_MB",
+                 "streaming reshard (resilience/streaming.py) slab "
+                 "size in MiB (default 64): peak host memory of the "
+                 "streaming path is bounded by this budget plus a "
+                 "constant, independent of variable size")
+declare_env_knob("PT_RESHARD_MAX_HOST_GB",
+                 "gather-reshard guardrail: refuse the in-memory "
+                 "reshard path with ReshardMemoryError (naming "
+                 "tools/reshard.py --stream) when the up-front host "
+                 "byte estimate exceeds this many GB. Unset/0 = off")

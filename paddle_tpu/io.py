@@ -180,6 +180,91 @@ def _is_cross_process(val) -> bool:
     return isinstance(val, jax.Array) and not val.is_fully_addressable
 
 
+def _npy_header(path: str):
+    """(shape, dtype) straight from an .npy header — no data read. The
+    streaming reshard and the gather guardrail size a serial dir from
+    headers; loading the arrays to measure them would BE the OOM."""
+    with open(path, "rb") as f:
+        version = np.lib.format.read_magic(f)
+        if version == (1, 0):
+            shape, _fortran, dtype = np.lib.format.read_array_header_1_0(f)
+        elif version == (2, 0):
+            shape, _fortran, dtype = np.lib.format.read_array_header_2_0(f)
+        else:  # pragma: no cover — future npy format versions
+            shape, _fortran, dtype = np.lib.format._read_array_header(
+                f, version)
+    return tuple(shape), dtype
+
+
+def serial_var_sources(serial_dir: str) -> dict:
+    """Header-only description of every persisted var in a serial dir:
+    ``{base: {"shape", "dtype", "pieces": [{"path", "index"}]}}`` where
+    a full-array source has ``index=None`` and a multi-process shard
+    piece carries its global ``((start, stop), ...)`` spans. Same
+    precedence as the loaders (shard pieces win over a same-named full
+    file) and the same coverage contract as ``_load_sharded`` — missing
+    pieces fail loudly here, before any byte moves."""
+    sources: dict = {}
+    names = sorted(os.listdir(serial_dir))
+    sharded = [n[:-len(".meta.json")] for n in names
+               if n.endswith(".meta.json")]
+    for name in names:
+        if name.endswith(".npy") and ".shard." not in name:
+            path = os.path.join(serial_dir, name)
+            shape, dtype = _npy_header(path)
+            sources[name[:-len(".npy")]] = {
+                "shape": shape, "dtype": dtype,
+                "pieces": [{"path": path, "index": None}]}
+    from .core.types import np_dtype
+    for base in sharded:
+        with open(os.path.join(serial_dir, base + ".meta.json")) as f:
+            meta = json.load(f)
+        shape = tuple(int(d) for d in meta["shape"])
+        prefix = base + ".shard."
+        pieces, filled = [], 0
+        for name in names:
+            if not (name.startswith(prefix) and name.endswith(".npy")):
+                continue
+            tag = name[len(prefix):-len(".npy")]
+            if tag == "scalar":
+                spans = ()
+            else:
+                spans = tuple(tuple(int(x) for x in p.split("_"))
+                              for p in tag.split("x"))
+            n = 1
+            for a, b in spans:
+                n *= (b - a)
+            filled += n
+            pieces.append({"path": os.path.join(serial_dir, name),
+                           "index": spans})
+        if not pieces:
+            continue
+        total = int(np.prod(shape)) if shape else 1
+        if filled != total:
+            raise FileNotFoundError(
+                f"serial_var_sources: sharded var {base!r} in "
+                f"{serial_dir!r} covers {filled}/{total} elements — "
+                "missing pieces (were all processes' shard files "
+                "gathered into this directory?) or stale pieces from an "
+                "older save with a different layout")
+        sources[base] = {"shape": shape,
+                         "dtype": np_dtype(meta["dtype"]),
+                         "pieces": pieces}
+    return sources
+
+
+def estimate_serial_host_bytes(serial_dir: str) -> int:
+    """Host bytes a full gather of this serial dir materializes: the sum
+    of every var's GLOBAL nbytes, from headers alone."""
+    total = 0
+    for info in serial_var_sources(serial_dir).values():
+        n = 1
+        for d in info["shape"]:
+            n *= int(d)
+        total += n * np.dtype(info["dtype"]).itemsize
+    return total
+
+
 # ---------------------------------------------------------------------------
 # fused <-> op-by-op checkpoint name mapping (ADVICE r5 medium)
 #

@@ -512,6 +512,27 @@ def render_prometheus(snapshot: dict) -> str:
         for site, n in sorted((snap.get("restarts_by_site") or {}).items()):
             emit("pt_elastic_restart_site_total", dict(el, site=str(site)),
                  n, "counter")
+    for name, snap in sorted(snapshot.get("orch", {}).items()):
+        # the host-level orchestrator (resilience/orchestrator.py):
+        # live-worker and lease-age gauges, evictions split by recorded
+        # cause (worker_crash vs heartbeat_loss — dead vs hung), and the
+        # recovery clock (evict -> survivors beating on the new round)
+        ol = {"orchestrator": str(snap.get("name", name))}
+        for key in ("workers_live", "workers_total", "rounds",
+                    "current_chips", "target_chips"):
+            emit(f"pt_orch_{key}", ol, snap.get(key))
+        emit("pt_orch_lease_age_seconds", ol, snap.get("lease_age_max_s"))
+        emit("pt_orch_detect_seconds", ol, snap.get("last_detect_s"))
+        emit("pt_orch_last_recovery_seconds", ol,
+             snap.get("last_recovery_s"))
+        emit("pt_orch_recoveries_total", ol, snap.get("recoveries"),
+             "counter")
+        emit("pt_orch_recovery_seconds_total", ol,
+             snap.get("recovery_s_total"), "counter")
+        for cause, n in sorted((snap.get("evictions_by_cause") or {})
+                               .items()):
+            emit("pt_orch_evictions_total", dict(ol, cause=str(cause)),
+                 n, "counter")
     return "\n".join(lines) + "\n"
 
 

@@ -25,13 +25,21 @@ from . import watchdog
 from .guard import GuardConfigError, StepAnomalyError
 from .watchdog import StepHungError
 from . import elastic
-from .elastic import (ElasticMetrics, ElasticSupervisor, ReshardError,
-                      reshard_state)
+from .elastic import (ElasticMetrics, ElasticSupervisor,
+                      ReshardMemoryError, ReshardError, reshard_state)
+from . import orchestrator
+from .orchestrator import (OrchMetrics, Orchestrator, OrchestratorError,
+                           WorkerContext, WorkerSpec, peer_worker)
+from . import streaming
+from .streaming import ChunkCorruptError, stream_reshard
 
 __all__ = [
     "FaultInjected", "FaultPlan", "active_plan", "crash_point", "fire",
     "reset", "RetryPolicy", "resilient_reader", "retry_call", "manifest",
     "guard", "watchdog", "GuardConfigError", "StepAnomalyError",
     "StepHungError", "elastic", "ElasticSupervisor", "ElasticMetrics",
-    "ReshardError", "reshard_state",
+    "ReshardError", "ReshardMemoryError", "reshard_state",
+    "orchestrator", "Orchestrator", "OrchestratorError", "OrchMetrics",
+    "WorkerContext", "WorkerSpec", "peer_worker",
+    "streaming", "ChunkCorruptError", "stream_reshard",
 ]

@@ -50,8 +50,9 @@ from .faults import FaultInjected
 from .retry import RetryPolicy
 
 __all__ = ["ElasticSupervisor", "ElasticMetrics", "ReshardError",
-           "reshard_state", "current_topology", "DEFAULT_RESTARTS",
-           "DEFAULT_BACKOFF_S"]
+           "ReshardMemoryError", "reshard_state",
+           "validate_reshard_shapes", "current_topology",
+           "DEFAULT_RESTARTS", "DEFAULT_BACKOFF_S"]
 
 #: restart budget default (PT_ELASTIC_RESTARTS)
 DEFAULT_RESTARTS = 3
@@ -65,6 +66,15 @@ class ReshardError(RuntimeError):
     shards that the state lacks, a cross-process array this in-process
     gather cannot assemble). Structural — retrying cannot help, which
     is why it is not an OSError: retry layers must not re-run it."""
+
+
+class ReshardMemoryError(ReshardError):
+    """The gather-based reshard would materialize more host bytes than
+    PT_RESHARD_MAX_HOST_GB allows. Raised from the up-front estimate —
+    before any array is gathered — so a small survivor host refuses
+    instead of silently OOMing mid-gather. The streaming path
+    (``tools/reshard.py --stream``, resilience/streaming.py) moves the
+    same state chunk-by-chunk under PT_RESHARD_CHUNK_MB."""
 
 
 # ---------------------------------------------------------------------------
@@ -81,6 +91,57 @@ def _dim_factor(entry, mesh: Dict[str, int]) -> int:
     for a in axes:
         f *= int(mesh.get(a, 1))
     return f
+
+
+def validate_reshard_shapes(shapes: Dict[str, tuple],
+                            to_plan: dict) -> None:
+    """Structural half of the reshard contract, shared by the gather
+    path and the streaming path (which never holds full arrays, so it
+    validates from npy-header shapes): every dim the target plan shards
+    must divide by the product of its mesh-axis sizes. Raises
+    ReshardError listing every offending (var, dim)."""
+    mesh = {str(a): int(s)
+            for a, s in (to_plan.get("mesh") or {}).items()}
+    specs = to_plan.get("specs") or {}
+    problems: List[str] = []
+    for name, spec in specs.items():
+        shape = shapes.get(name)
+        if shape is None:
+            # a plan var the state lacks: the executor's own missing-var
+            # handling owns absence; resharding only validates presence
+            continue
+        for dim, entry in enumerate(spec):
+            f = _dim_factor(entry, mesh)
+            if f <= 1:
+                continue
+            size = int(shape[dim]) if dim < len(shape) else 1
+            if size % f:
+                problems.append(
+                    f"{name}: dim {dim} of size {size} not divisible by "
+                    f"its mesh factor {f} ({entry!r} under {mesh})")
+    if problems:
+        raise ReshardError(
+            "state cannot be laid out under the target plan:\n  "
+            + "\n  ".join(problems))
+
+
+def gather_guardrail(total_bytes: int, origin: str = "reshard") -> None:
+    """The PT_RESHARD_MAX_HOST_GB refusal, from an up-front estimate:
+    today's alternative is the survivor host silently OOMing halfway
+    through the gather. No-op when the knob is unset/0."""
+    from ..flags import env_knob_float
+    max_gb = env_knob_float("PT_RESHARD_MAX_HOST_GB", 0.0)
+    if max_gb <= 0:
+        return
+    limit = int(max_gb * (1 << 30))
+    if total_bytes > limit:
+        raise ReshardMemoryError(
+            f"{origin}: gathering full host arrays needs an estimated "
+            f"{total_bytes} bytes, over the PT_RESHARD_MAX_HOST_GB="
+            f"{max_gb:g} budget ({limit} bytes) — use the streaming "
+            "path (tools/reshard.py --stream, sized by "
+            "PT_RESHARD_CHUNK_MB) which bounds peak host memory by the "
+            "chunk budget instead of the gathered state")
 
 
 def reshard_state(state: Dict[str, "np.ndarray"],
@@ -107,10 +168,8 @@ def reshard_state(state: Dict[str, "np.ndarray"],
     offending (var, dim). `from_plan` may be None (unstamped/legacy
     checkpoint — nothing to gather differently; validation still
     runs)."""
-    mesh = {str(a): int(s) for a, s in (to_plan.get("mesh") or {}).items()}
     specs = to_plan.get("specs") or {}
-    problems: List[str] = []
-    gathered: Dict[str, np.ndarray] = {}
+    est = 0
     for name, val in state.items():
         if val is None:
             continue
@@ -120,26 +179,18 @@ def reshard_state(state: Dict[str, "np.ndarray"],
                 "resharding needs every shard addressable; gather the "
                 "per-process checkpoint shard files into one directory "
                 "and use tools/reshard.py offline instead")
-        gathered[name] = np.asarray(val)  # host-sync: ok — the gather
-    for name, spec in specs.items():
-        arr = gathered.get(name)
-        if arr is None:
-            # a plan var the state lacks: the executor's own missing-var
-            # handling owns absence; resharding only validates presence
+        nbytes = getattr(val, "nbytes", None)
+        if nbytes is not None:
+            est += int(nbytes)
+    gather_guardrail(est, origin="reshard_state")
+    gathered: Dict[str, np.ndarray] = {}
+    for name, val in state.items():
+        if val is None:
             continue
-        for dim, entry in enumerate(spec):
-            f = _dim_factor(entry, mesh)
-            if f <= 1:
-                continue
-            size = int(arr.shape[dim]) if dim < arr.ndim else 1
-            if size % f:
-                problems.append(
-                    f"{name}: dim {dim} of size {size} not divisible by "
-                    f"its mesh factor {f} ({entry!r} under {mesh})")
-    if problems:
-        raise ReshardError(
-            "state cannot be laid out under the target plan:\n  "
-            + "\n  ".join(problems))
+        gathered[name] = np.asarray(val)  # host-sync: ok — the gather
+    validate_reshard_shapes(
+        {name: tuple(arr.shape) for name, arr in gathered.items()},
+        to_plan)
     if place:
         import jax
         from jax.sharding import NamedSharding

@@ -53,6 +53,11 @@ error, not a silently-never-firing spec):
     mesh_shrink         Trainer.train, at the top of each step: the mesh
                         halves (a host is preempted) — the elastic
                         supervisor re-plans on the surviving topology
+    worker_crash        orchestrator.peer_worker, per heartbeat: the
+                        worker dies (dead handle -> evicted as a crash)
+    heartbeat_loss      orchestrator.peer_worker, per heartbeat: the
+                        worker goes silent but stays alive (hung
+                        collective -> killed, evicted as heartbeat_loss)
 """
 
 from __future__ import annotations
@@ -91,6 +96,13 @@ SITES: Dict[str, str] = {
     "mesh_shrink": "the mesh halves at a trainer step boundary (host "
                    "preemption); the elastic supervisor re-plans on "
                    "the surviving topology",
+    "worker_crash": "orchestrated worker dies at a heartbeat boundary "
+                    "(resilience/orchestrator.py): the supervisor sees "
+                    "a dead handle and evicts with cause worker_crash",
+    "heartbeat_loss": "orchestrated worker stops renewing its lease but "
+                      "stays alive — the hung-collective case "
+                      "(resilience/orchestrator.py): the supervisor "
+                      "kills it and evicts with cause heartbeat_loss",
 }
 
 ENV_VAR = "PT_FAULT_INJECT"

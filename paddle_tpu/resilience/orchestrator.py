@@ -1,0 +1,736 @@
+"""Host-level elastic orchestration: heartbeat leases, hang-vs-crash
+discrimination, and survivor restart onto the shrunk topology.
+
+PR 17's ``ElasticSupervisor`` makes a single *process* survive — restore,
+re-plan, reshard, resume. What it cannot see is the process that never
+raises: a host whose collective is wedged looks exactly like a healthy
+host to in-process supervision. This module is the layer above — the
+ROADMAP's "cluster-scheduler hook" — a supervisor that owns N workers
+through an injectable runner and a heartbeat-lease protocol:
+
+* Every worker renews a **lease** at its step boundaries: an atomic JSON
+  file in ``lease_dir`` carrying a monotonically increasing beat counter
+  (plus the orchestration round and the training step). The orchestrator
+  never trusts worker clocks — it records ``seen_at`` with its OWN
+  (injectable) clock whenever the ``(round, beat)`` marker advances, so
+  a worker with a skewed or frozen clock is still judged correctly.
+
+* A worker whose lease age exceeds ``lease_s + grace_s`` is evicted.
+  The *cause* is discriminated by the handle, not the lease:
+
+  - handle dead with an error  -> ``worker_crash`` (the device-loss
+    shape: the process is gone, nothing to kill)
+  - handle alive, lease stale  -> ``heartbeat_loss`` (a hung collective
+    or stuck step: the orchestrator KILLS it, then evicts)
+
+  Both paths converge on one recovery: gracefully stop the survivors
+  (cooperative stop -> ``Trainer.request_preemption()`` -> checkpoint at
+  the next step boundary), compute the surviving slice, write it to
+  ``PT_ELASTIC_TOPOLOGY``, and restart the survivors so each one's
+  ``ElasticSupervisor`` re-plans onto the shrunk fabric and resumes at
+  the exact recorded step.
+
+Runners follow the fleet tier's pattern (serving/fleet/pool.py): the
+default ``ThreadRunner`` hosts workers as daemon threads — what tier-1
+and the chaos harness drive on CPU, with an injectable clock so eviction
+timing is deterministic — while ``SubprocessRunner`` spawns real
+processes for clusters (graceful stop is SIGTERM, which the Trainer
+already treats as preemption; kill is SIGKILL). A killed thread cannot
+actually be destroyed, so ``ThreadHandle.kill`` abandons it exactly like
+the step watchdog abandons a wedged dispatch: the handle reports dead,
+the daemon thread unblocks on the stop event and exits on its own.
+
+Knobs: PT_ORCH_LEASE_S, PT_ORCH_GRACE_S, PT_ORCH_STOP_GRACE_S,
+PT_ORCH_EVICTIONS (all declared in flags.py). Metrics ride the unified
+exposition as the ``pt_orch_*`` family; evictions and recoveries emit
+``orch:evict`` / ``orch:recover`` trace spans.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..flags import env_knob_float, env_knob_int
+
+__all__ = [
+    "DEFAULT_LEASE_S", "DEFAULT_POLL_S", "DEFAULT_STOP_GRACE_S",
+    "LeaseTable", "OrchMetrics", "Orchestrator", "OrchestratorError",
+    "SubprocessRunner", "ThreadHandle", "ThreadRunner", "WorkerContext",
+    "WorkerSpec", "peer_worker", "read_lease", "worker_context_from_env",
+]
+
+DEFAULT_LEASE_S = 10.0
+DEFAULT_STOP_GRACE_S = 30.0
+DEFAULT_EVICTIONS = 3
+DEFAULT_POLL_S = 0.02
+
+CAUSE_CRASH = "worker_crash"
+CAUSE_HANG = "heartbeat_loss"
+
+
+class OrchestratorError(RuntimeError):
+    """Unrecoverable orchestration failure: eviction budget exhausted,
+    every worker evicted, or the primary (training) worker itself was
+    evicted — conditions where shrinking again has nothing to shrink
+    onto."""
+
+
+# ---------------------------------------------------------------------------
+# the lease protocol
+# ---------------------------------------------------------------------------
+
+def _lease_path(lease_dir: str, wid: str) -> str:
+    return os.path.join(lease_dir, f"{wid}.lease.json")
+
+
+def _write_atomic_json(path: str, payload: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_lease(lease_dir: str, wid: str) -> Optional[dict]:
+    """The worker's last renewal, or None when it never renewed (or the
+    file is unreadable — treated as no renewal, never as a crash of the
+    orchestrator)."""
+    try:
+        with open(_lease_path(lease_dir, wid)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class WorkerContext:
+    """What a worker body receives: its identity, the lease to renew,
+    and the cooperative stop signal. ``heartbeat()`` is the per-step
+    renewal; ``should_stop()`` is polled at the same boundaries (the
+    orchestrator's graceful-stop request during a recovery)."""
+
+    def __init__(self, wid: str, lease_dir: str, round_n: int = 0,
+                 stop_event: Optional[threading.Event] = None,
+                 clock: Callable[[], float] = time.time):
+        self.wid = wid
+        self.lease_dir = lease_dir
+        os.makedirs(lease_dir, exist_ok=True)
+        self.round_n = int(round_n)
+        self._stop = stop_event if stop_event is not None \
+            else threading.Event()
+        self._clock = clock
+        self._beat = 0
+
+    def heartbeat(self, step: Optional[int] = None) -> int:
+        """Renew the lease; returns the beat counter (monotonic within
+        this context — the orchestrator keys staleness off (round, beat)
+        advancing, so the counter restarting at 1 on a new round is
+        itself an advance)."""
+        self._beat += 1
+        _write_atomic_json(_lease_path(self.lease_dir, self.wid), {
+            "wid": self.wid, "round": self.round_n, "beat": self._beat,
+            "step": step, "pid": os.getpid(), "wall": self._clock(),
+        })
+        return self._beat
+
+    def should_stop(self) -> bool:
+        return self._stop.is_set()
+
+
+def worker_context_from_env(
+        clock: Callable[[], float] = time.time) -> WorkerContext:
+    """The subprocess side of the wire protocol: SubprocessRunner passes
+    identity via PT_ORCH_WORKER_ID / PT_ORCH_LEASE_DIR / PT_ORCH_ROUND;
+    a worker __main__ builds its context from them. Graceful stop for
+    real processes is SIGTERM — the Trainer's existing preemption path —
+    so ``should_stop`` stays False here."""
+    wid = os.environ.get("PT_ORCH_WORKER_ID", "").strip()
+    lease_dir = os.environ.get("PT_ORCH_LEASE_DIR", "").strip()
+    if not wid or not lease_dir:
+        raise OrchestratorError(
+            "worker_context_from_env: PT_ORCH_WORKER_ID / "
+            "PT_ORCH_LEASE_DIR unset — not launched by SubprocessRunner")
+    round_n = env_knob_int("PT_ORCH_ROUND", 1) - 1 \
+        if os.environ.get("PT_ORCH_ROUND") else 0
+    return WorkerContext(wid, lease_dir, round_n=round_n, clock=clock)
+
+
+class LeaseTable:
+    """Orchestrator-side lease ages. ``observe`` reads the worker's
+    file; ``seen_at`` advances on OUR clock only when the (round, beat)
+    marker changes, so staleness judgment never depends on worker
+    clocks — and an injectable clock makes it fake-time testable."""
+
+    def __init__(self, lease_dir: str,
+                 clock: Callable[[], float] = time.monotonic):
+        self.lease_dir = lease_dir
+        self._clock = clock
+        self._seen_at: Dict[str, float] = {}
+        self._marker: Dict[str, Optional[Tuple]] = {}
+        self._payload: Dict[str, Optional[dict]] = {}
+
+    def register(self, wid: str) -> None:
+        """(Re)start accounting for a worker: it gets a full lease from
+        now to produce its first beat of the new round."""
+        self._seen_at[wid] = self._clock()
+        self._marker[wid] = None
+        self._payload[wid] = None
+
+    def observe(self, wid: str) -> float:
+        """Refresh from disk; returns the lease age in orchestrator
+        seconds (0 right after a fresh beat or registration)."""
+        payload = read_lease(self.lease_dir, wid)
+        if payload is not None:
+            marker = (payload.get("round"), payload.get("beat"))
+            if marker != self._marker.get(wid):
+                self._marker[wid] = marker
+                self._seen_at[wid] = self._clock()
+                self._payload[wid] = payload
+        return self._clock() - self._seen_at.get(wid, self._clock())
+
+    def age(self, wid: str) -> float:
+        return self._clock() - self._seen_at.get(wid, self._clock())
+
+    def last_payload(self, wid: str) -> Optional[dict]:
+        return self._payload.get(wid)
+
+
+# ---------------------------------------------------------------------------
+# runners (the injectable process layer — fleet/pool.py's pattern)
+# ---------------------------------------------------------------------------
+
+class WorkerSpec:
+    """One worker's identity and resources. ``target`` is what the
+    runner executes: a callable taking the WorkerContext under
+    ThreadRunner, an argv list under SubprocessRunner. ``primary`` marks
+    the worker whose clean completion ends the run (the training chief
+    in the emulated-mesh setup; real clusters train on every worker and
+    mark rank 0)."""
+
+    def __init__(self, wid: str, target, chips: int = 1,
+                 primary: bool = False,
+                 lease_s: Optional[float] = None):
+        if chips < 1:
+            raise ValueError(f"WorkerSpec {wid!r}: chips must be >= 1")
+        self.wid = str(wid)
+        self.target = target
+        self.chips = int(chips)
+        self.primary = bool(primary)
+        self.lease_s = None if lease_s is None else float(lease_s)
+
+
+class ThreadHandle:
+    """A thread-hosted worker. ``kill`` abandons the daemon thread (a
+    thread cannot be destroyed) and reports it dead — the watchdog's
+    abandonment idiom; the body unblocks on the same event a graceful
+    stop sets, so an injected 'hang' only hangs the lease protocol, not
+    the interpreter."""
+
+    def __init__(self, thread: threading.Thread,
+                 stop_event: threading.Event):
+        self._thread = thread
+        self._stop_event = stop_event
+        self.error: Optional[BaseException] = None
+        self.stop_requested = False
+        self.killed = False
+
+    def alive(self) -> bool:
+        return not self.killed and self._thread.is_alive()
+
+    def stop(self) -> None:
+        self.stop_requested = True
+        self._stop_event.set()
+
+    def kill(self) -> None:
+        self.killed = True
+        self._stop_event.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+
+class ThreadRunner:
+    """Tier-1's runner: each worker is a daemon thread running
+    ``spec.target(ctx)``; exceptions land on ``handle.error`` (how the
+    orchestrator discriminates a crash from a clean return)."""
+
+    def __call__(self, spec: WorkerSpec, ctx: WorkerContext) \
+            -> ThreadHandle:
+        stop_event = ctx._stop
+        holder: List[ThreadHandle] = []
+
+        def body():
+            try:
+                spec.target(ctx)
+            except BaseException as e:  # noqa: BLE001 — recorded, judged
+                holder[0].error = e
+
+        thread = threading.Thread(
+            target=body, name=f"pt-orch-{spec.wid}", daemon=True)
+        handle = ThreadHandle(thread, stop_event)
+        holder.append(handle)
+        thread.start()
+        return handle
+
+
+class SubprocessHandle:
+    """A real-process worker (clusters). Graceful stop is SIGTERM — the
+    Trainer's installed preemption handler checkpoints at the next step
+    boundary; kill is SIGKILL."""
+
+    def __init__(self, proc: "subprocess.Popen"):
+        self._proc = proc
+        self.stop_requested = False
+        self.killed = False
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        rc = self._proc.poll()
+        if rc is None or rc == 0:
+            return None
+        return RuntimeError(
+            f"worker pid {self._proc.pid} exited with status {rc}")
+
+    def alive(self) -> bool:
+        return self._proc.poll() is None
+
+    def stop(self) -> None:
+        self.stop_requested = True
+        try:
+            self._proc.send_signal(signal.SIGTERM)
+        except OSError:  # pragma: no cover — already gone
+            pass
+
+    def kill(self) -> None:
+        self.killed = True
+        try:
+            self._proc.kill()
+        except OSError:  # pragma: no cover — already gone
+            pass
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        try:
+            self._proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+class SubprocessRunner:
+    """Cluster runner: ``spec.target`` is an argv list (e.g.
+    ``[sys.executable, "train.py"]``); identity rides the environment
+    (PT_ORCH_WORKER_ID / PT_ORCH_LEASE_DIR / PT_ORCH_ROUND — see
+    ``worker_context_from_env``) along with the current
+    PT_ELASTIC_TOPOLOGY, so a restarted worker plans for the surviving
+    slice without any new wire format."""
+
+    def __init__(self, python: Optional[str] = None):
+        self.python = python or sys.executable
+
+    def __call__(self, spec: WorkerSpec, ctx: WorkerContext) \
+            -> SubprocessHandle:
+        argv = list(spec.target)
+        env = dict(os.environ)
+        env["PT_ORCH_WORKER_ID"] = spec.wid
+        env["PT_ORCH_LEASE_DIR"] = ctx.lease_dir
+        env["PT_ORCH_ROUND"] = str(ctx.round_n + 1)
+        proc = subprocess.Popen(argv, env=env)
+        return SubprocessHandle(proc)
+
+
+# ---------------------------------------------------------------------------
+# metrics (pt_orch_* on the unified exposition)
+# ---------------------------------------------------------------------------
+
+class OrchMetrics:
+    """One orchestrator's counters. Thread-safe: the poll loop records
+    while HTTP scrapes read."""
+
+    def __init__(self, name: str = "orch"):
+        self.name = name
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.workers_live = 0
+            self.workers_total = 0
+            self.rounds = 0
+            self.current_chips: Optional[int] = None
+            self.target_chips: Optional[int] = None
+            self.lease_age_max_s = 0.0
+            self.last_detect_s: Optional[float] = None
+            self.last_recovery_s: Optional[float] = None
+            self.recoveries = 0
+            self.recovery_s_total = 0.0
+            self.evictions = 0
+            self.evictions_by_cause: Dict[str, int] = {}
+
+    def on_evict(self, cause: str, detect_s: float) -> None:
+        with self._lock:
+            self.evictions += 1
+            self.evictions_by_cause[cause] = \
+                self.evictions_by_cause.get(cause, 0) + 1
+            self.last_detect_s = max(0.0, float(detect_s))
+
+    def on_recover(self, recovery_s: float) -> None:
+        with self._lock:
+            self.recoveries += 1
+            self.last_recovery_s = max(0.0, float(recovery_s))
+            self.recovery_s_total += max(0.0, float(recovery_s))
+
+    def set_state(self, live: int, total: int, rounds: int,
+                  lease_age_max_s: float) -> None:
+        with self._lock:
+            self.workers_live = int(live)
+            self.workers_total = int(total)
+            self.rounds = int(rounds)
+            self.lease_age_max_s = max(0.0, float(lease_age_max_s))
+
+    def set_chips(self, current: Optional[int],
+                  target: Optional[int]) -> None:
+        with self._lock:
+            if current is not None:
+                self.current_chips = int(current)
+            if target is not None:
+                self.target_chips = int(target)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "workers_live": self.workers_live,
+                "workers_total": self.workers_total,
+                "rounds": self.rounds,
+                "current_chips": self.current_chips,
+                "target_chips": self.target_chips,
+                "lease_age_max_s": round(self.lease_age_max_s, 6),
+                "last_detect_s": self.last_detect_s,
+                "last_recovery_s": self.last_recovery_s,
+                "recoveries": self.recoveries,
+                "recovery_s_total": round(self.recovery_s_total, 6),
+                "evictions": self.evictions,
+                "evictions_by_cause": dict(self.evictions_by_cause),
+            }
+
+
+# ---------------------------------------------------------------------------
+# worker bodies
+# ---------------------------------------------------------------------------
+
+def peer_worker(ctx: WorkerContext, interval_s: float = 0.05,
+                sleep: Callable[[float], None] = time.sleep) -> None:
+    """A non-training host's worker body: renew the lease on a cadence
+    until asked to stop. Hosts the two chaos sites — ``worker_crash``
+    raises out of the body (a dead handle), ``heartbeat_loss`` silences
+    every later renewal while the body stays alive (the hung-collective
+    shape: only a kill ends it)."""
+    from . import faults
+    silent = False
+    step = 0
+    while not ctx.should_stop():
+        if not silent:
+            faults.crash_point(CAUSE_CRASH)
+            if faults.fire(CAUSE_HANG) is not None:
+                silent = True
+            else:
+                ctx.heartbeat(step=step)
+        step += 1
+        sleep(interval_s)
+
+
+# ---------------------------------------------------------------------------
+# the orchestrator
+# ---------------------------------------------------------------------------
+
+class _Worker:
+    __slots__ = ("spec", "ctx", "handle", "state", "cause", "round_n")
+
+    def __init__(self, spec: WorkerSpec):
+        self.spec = spec
+        self.ctx: Optional[WorkerContext] = None
+        self.handle = None
+        self.state = "new"       # live | done | stopped | evicted
+        self.cause: Optional[str] = None
+        self.round_n = 0
+
+
+class Orchestrator:
+    """Own N workers; evict on lease expiry (discriminating hang from
+    crash); recover by restarting survivors onto the shrunk topology.
+
+    ``run()`` drives the poll loop to completion and returns a report
+    dict. Completion: the primary worker returning cleanly (remaining
+    workers are stopped), or — with no primary — every worker returning
+    cleanly. Exhausting the eviction budget, losing every worker, or
+    losing the primary raises OrchestratorError (after killing what
+    remains: no orphaned threads/processes behind an exception)."""
+
+    def __init__(self, specs: Sequence[WorkerSpec], lease_dir: str,
+                 runner=None, chip: str = "cpu",
+                 lease_s: Optional[float] = None,
+                 grace_s: Optional[float] = None,
+                 stop_grace_s: Optional[float] = None,
+                 max_evictions: Optional[int] = None,
+                 poll_s: float = DEFAULT_POLL_S,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 metrics: Optional[OrchMetrics] = None,
+                 name: str = "orch"):
+        specs = list(specs)
+        if not specs:
+            raise ValueError("Orchestrator: no workers")
+        wids = [s.wid for s in specs]
+        if len(set(wids)) != len(wids):
+            raise ValueError(f"Orchestrator: duplicate worker ids {wids}")
+        if sum(1 for s in specs if s.primary) > 1:
+            raise ValueError("Orchestrator: at most one primary worker")
+        self.workers = [_Worker(s) for s in specs]
+        self.lease_dir = lease_dir
+        self.runner = runner or ThreadRunner()
+        self.chip = chip
+        self.lease_s = lease_s if lease_s is not None \
+            else env_knob_float("PT_ORCH_LEASE_S", DEFAULT_LEASE_S)
+        self.grace_s = grace_s if grace_s is not None \
+            else env_knob_float("PT_ORCH_GRACE_S", self.lease_s / 2.0)
+        self.stop_grace_s = stop_grace_s if stop_grace_s is not None \
+            else env_knob_float("PT_ORCH_STOP_GRACE_S",
+                                DEFAULT_STOP_GRACE_S)
+        self.max_evictions = max_evictions if max_evictions is not None \
+            else env_knob_int("PT_ORCH_EVICTIONS", DEFAULT_EVICTIONS)
+        self.poll_s = float(poll_s)
+        self._clock = clock
+        self._sleep = sleep
+        self.table = LeaseTable(lease_dir, clock=clock)
+        self.metrics = metrics or OrchMetrics(name)
+        from ..obs.metrics import REGISTRY
+        REGISTRY.register("orch", self.metrics.name, self.metrics)
+        self.round_n = 0
+        self.evictions: List[dict] = []
+        self.recoveries: List[float] = []
+        self.topology: Optional[str] = None
+        target = sum(s.chips for s in specs)
+        self.metrics.set_chips(target, target)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _worker_lease(self, w: _Worker) -> float:
+        return w.spec.lease_s if w.spec.lease_s is not None \
+            else self.lease_s
+
+    def _live(self) -> List[_Worker]:
+        return [w for w in self.workers if w.state == "live"]
+
+    def _start(self, w: _Worker) -> None:
+        w.round_n = self.round_n
+        w.ctx = WorkerContext(w.spec.wid, self.lease_dir,
+                              round_n=self.round_n)
+        self.table.register(w.spec.wid)
+        w.handle = self.runner(w.spec, w.ctx)
+        w.state = "live"
+        w.cause = None
+
+    def _topology_str(self, survivors: List[_Worker]) -> str:
+        per = sorted({w.spec.chips for w in survivors})
+        if len(per) == 1 and len(survivors) > 1:
+            return f"{self.chip}:{per[0]}x{len(survivors)}"
+        if len(per) == 1:
+            return f"{self.chip}:{per[0]}"
+        # heterogeneous survivors: describe the flat chip count
+        total = sum(w.spec.chips for w in survivors)
+        return f"{self.chip}:{total}"
+
+    def _stop_workers(self, targets: List[_Worker]) -> None:
+        """Graceful stop: cooperative stop request, wait out the stop
+        grace (the chief needs a step boundary to checkpoint at), then
+        kill stragglers."""
+        for w in targets:
+            w.handle.stop()
+        deadline = self._clock() + self.stop_grace_s
+        while (any(w.handle.alive() for w in targets)
+                and self._clock() < deadline):
+            self._sleep(self.poll_s)
+        for w in targets:
+            if w.handle.alive():
+                w.handle.kill()
+
+    def _kill_all_live(self) -> None:
+        for w in self._live():
+            w.handle.kill()
+            w.state = "stopped"
+
+    def _beat_round(self, w: _Worker) -> int:
+        payload = self.table.last_payload(w.spec.wid)
+        if not payload:
+            return -1
+        try:
+            return int(payload.get("round", -1))
+        except (TypeError, ValueError):
+            return -1
+
+    # -- recovery ----------------------------------------------------------
+
+    def _recover(self, evicted: List[Tuple[_Worker, str, float]]) -> None:
+        from ..obs import trace as obs_trace
+        for w, cause, age in evicted:
+            with obs_trace.span("orch:evict", cat="orch",
+                                wid=w.spec.wid, cause=cause,
+                                round=self.round_n,
+                                detect_s=round(age, 6)):
+                if w.handle.alive():
+                    # the hang case: a live worker holding a dead lease
+                    # is wedged — reclaim the slot before re-planning
+                    w.handle.kill()
+                w.state = "evicted"
+                w.cause = cause
+            self.evictions.append({
+                "wid": w.spec.wid, "cause": cause,
+                "round": self.round_n, "detect_s": round(age, 6)})
+            self.metrics.on_evict(cause, age)
+        if len(self.evictions) > self.max_evictions:
+            raise OrchestratorError(
+                f"eviction budget exhausted ({len(self.evictions)} > "
+                f"PT_ORCH_EVICTIONS={self.max_evictions}); last causes: "
+                + ", ".join(e["cause"] for e in self.evictions[-3:]))
+        if any(w.spec.primary and w.state == "evicted"
+               for w in self.workers):
+            raise OrchestratorError(
+                "primary worker evicted (cause "
+                + str(next(w.cause for w in self.workers
+                           if w.spec.primary))
+                + ") — nothing left to resume the run")
+        survivors = self._live()
+        if not survivors:
+            raise OrchestratorError("all workers evicted — no surviving "
+                                    "slice to restart onto")
+        t0 = self._clock()
+        # workers already finished cleanly keep their result; only live
+        # survivors are cycled through stop -> restart (restarting a
+        # completed trainer would replay steps past its final
+        # checkpoint)
+        done_early = [w for w in survivors
+                      if not w.handle.alive() and w.handle.error is None]
+        for w in done_early:
+            w.state = "done"
+        survivors = [w for w in survivors if w.state == "live"]
+        chips = sum(w.spec.chips for w in survivors)
+        with obs_trace.span("orch:recover", cat="orch",
+                            survivors=len(survivors), chips=chips,
+                            round=self.round_n + 1):
+            if survivors:
+                self._stop_workers(survivors)
+                self.topology = self._topology_str(survivors)
+                os.environ["PT_ELASTIC_TOPOLOGY"] = self.topology
+                self.round_n += 1
+                for w in survivors:
+                    self._start(w)
+                self._await_resumed(survivors)
+        recovery_s = self._clock() - t0
+        self.recoveries.append(round(recovery_s, 6))
+        self.metrics.on_recover(recovery_s)
+        self.metrics.set_chips(chips, None)
+
+    def _await_resumed(self, restarted: List[_Worker]) -> None:
+        """Block until every restarted worker has either beaten in the
+        new round or left the live state (finished / died — the main
+        loop classifies those next poll). This is what makes
+        recovery_seconds an end-to-end number: restore + re-plan +
+        reshard + compile + first step, not just the restart syscall.
+        Bounded by the workers' own lease windows: a restarted worker
+        that never beats is the MAIN loop's problem (it will be evicted
+        like any other silent worker), not a recovery deadlock."""
+        deadline = self._clock() + max(
+            self._worker_lease(w) + self.grace_s for w in restarted)
+        while self._clock() < deadline:
+            pending = False
+            for w in restarted:
+                self.table.observe(w.spec.wid)
+                if self._beat_round(w) >= w.round_n:
+                    continue
+                if w.handle.alive():
+                    pending = True
+            if not pending:
+                return
+            self._sleep(self.poll_s)
+
+    # -- the run loop ------------------------------------------------------
+
+    def run(self) -> dict:
+        """Drive to completion; returns the report. Restores the
+        pre-run PT_ELASTIC_TOPOLOGY on exit (the orchestrator mutates
+        process-global env so thread-hosted supervisors can read it —
+        single orchestrator per process at a time)."""
+        prior_topo = os.environ.get("PT_ELASTIC_TOPOLOGY")
+        started = self._clock()
+        for w in self.workers:
+            self._start(w)
+        try:
+            report = self._loop()
+            report["wall_s"] = round(self._clock() - started, 6)
+            return report
+        finally:
+            self._kill_all_live()
+            if prior_topo is None:
+                os.environ.pop("PT_ELASTIC_TOPOLOGY", None)
+            else:
+                os.environ["PT_ELASTIC_TOPOLOGY"] = prior_topo
+
+    def _loop(self) -> dict:
+        while True:
+            self._sleep(self.poll_s)
+            evicted: List[Tuple[_Worker, str, float]] = []
+            max_age = 0.0
+            primary_done = False
+            for w in self._live():
+                age = self.table.observe(w.spec.wid)
+                if not w.handle.alive():
+                    if w.handle.error is not None:
+                        evicted.append((w, CAUSE_CRASH, age))
+                    else:
+                        w.state = "done"
+                        if w.spec.primary:
+                            primary_done = True
+                    continue
+                max_age = max(max_age, age)
+                limit = self._worker_lease(w) + self.grace_s
+                if age > limit:
+                    evicted.append((w, CAUSE_HANG, age))
+            self.metrics.set_state(
+                live=len(self._live()), total=len(self.workers),
+                rounds=self.round_n, lease_age_max_s=max_age)
+            if primary_done:
+                self._stop_workers(self._live())
+                for w in self._live():
+                    w.state = "stopped"
+                return self._report(completed=True)
+            if evicted:
+                self._recover(evicted)
+                continue
+            if not self._live():
+                # no primary declared: completion means every worker
+                # that was not evicted returned cleanly
+                done = [w for w in self.workers if w.state == "done"]
+                ok = bool(done) and all(
+                    w.state in ("done", "evicted")
+                    for w in self.workers)
+                return self._report(completed=ok)
+
+    def _report(self, completed: bool) -> dict:
+        return {
+            "completed": bool(completed),
+            "rounds": self.round_n,
+            "evictions": list(self.evictions),
+            "recoveries": list(self.recoveries),
+            "workers": {w.spec.wid: w.state for w in self.workers},
+            "topology": self.topology,
+            "surviving_chips": sum(
+                w.spec.chips for w in self.workers
+                if w.state in ("live", "done", "stopped")),
+            "target_chips": sum(w.spec.chips for w in self.workers),
+        }

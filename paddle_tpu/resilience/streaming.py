@@ -1,0 +1,231 @@
+"""Streaming reshard: move a serial dir chunk-by-chunk under a bounded
+host-memory budget.
+
+The gather path (``elastic.reshard_state`` / ``tools/reshard.py``)
+materializes every var as a full host array — exactly what a small
+survivor host resharding a big model cannot do. This engine never holds
+more than one slab: sources are opened as read-only memmaps (full
+``<var>.npy`` files and multi-process ``<var>.shard.<spans>.npy``
+pieces alike), the destination is an ``open_memmap`` full array, and
+data moves in slabs of at most ``PT_RESHARD_CHUNK_MB`` (rows of the
+outer dim; a single row larger than the budget degrades to
+one-row slabs, so the bound is ``max(chunk, one row) + constant``).
+Because checkpoints hold full logical arrays, the result is
+bit-identical to the gather path.
+
+Every slab is digested (crc32) and recorded in a progress sidecar
+(atomic JSON, one write per chunk), which buys two properties:
+
+* **Resumable**: an interrupted stream re-run with the same chunk
+  budget verifies already-written chunks against their recorded digests
+  and copies only the remainder.
+* **Corruption refusal**: a verified chunk whose bytes on disk no
+  longer match its digest raises ``ChunkCorruptError`` (typed, names
+  the chunk) instead of silently shipping a bit-rotten region into a
+  "fresh" checkpoint.
+
+Structural validation is header-only (``elastic.validate_reshard_shapes``
+over npy-header shapes) — the whole point is never needing the arrays in
+memory. The caller (tools/reshard.py --stream) stamps the manifest +
+_SUCCESS after the stream completes; the progress sidecar is deleted on
+completion so a committed serial carries no streaming residue.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from .elastic import ReshardError, validate_reshard_shapes
+
+__all__ = ["ChunkCorruptError", "DEFAULT_CHUNK_MB", "PROGRESS_FILENAME",
+           "chunk_bytes_default", "iter_slabs", "stream_reshard"]
+
+#: sidecar recording per-chunk digests; lives in the DESTINATION dir
+PROGRESS_FILENAME = ".reshard_progress.json"
+DEFAULT_CHUNK_MB = 64
+
+
+class ChunkCorruptError(ReshardError):
+    """A chunk recorded as copied no longer matches its digest — the
+    destination rotted (or was edited) between the interrupted stream
+    and the resume. Refusal, not repair: the caller decides whether to
+    delete the destination and restream from scratch."""
+
+
+def chunk_bytes_default() -> int:
+    from ..flags import env_knob_int
+    return env_knob_int("PT_RESHARD_CHUNK_MB", DEFAULT_CHUNK_MB) << 20
+
+
+def iter_slabs(shape: Tuple[int, ...], itemsize: int,
+               chunk_bytes: int) -> List[Tuple[int, int]]:
+    """Row ranges over dim 0 sizing each slab at <= chunk_bytes (one
+    row minimum — the degenerate bound documented above). A 0-d or
+    empty array is a single (0, len) slab."""
+    if not shape:
+        return [(0, 1)]
+    rows = int(shape[0])
+    if rows == 0:
+        return [(0, 0)]
+    row_bytes = int(itemsize)
+    for d in shape[1:]:
+        row_bytes *= int(d)
+    per = max(1, chunk_bytes // max(1, row_bytes))
+    return [(a, min(a + per, rows)) for a in range(0, rows, per)]
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(memoryview(np.ascontiguousarray(arr)))
+
+
+def _write_atomic(path: str, payload: dict) -> None:
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _load_progress(dst_dir: str, chunk_bytes: int,
+                   mesh_key: str) -> dict:
+    """The resume ledger — discarded (fresh start) when the chunk
+    budget or target mesh changed, because chunk ids embed slab
+    boundaries and the digest set is only meaningful for one
+    (budget, target) pair."""
+    path = os.path.join(dst_dir, PROGRESS_FILENAME)
+    try:
+        with open(path) as f:
+            prog = json.load(f)
+    except (OSError, ValueError):
+        prog = None
+    if (not isinstance(prog, dict) or prog.get("version") != 1
+            or prog.get("chunk_bytes") != chunk_bytes
+            or prog.get("mesh") != mesh_key):
+        prog = {"version": 1, "chunk_bytes": chunk_bytes,
+                "mesh": mesh_key, "vars": {}}
+    return prog
+
+
+def stream_reshard(src_dir: str, dst_dir: str, to_plan: dict,
+                   chunk_bytes: Optional[int] = None,
+                   resume: bool = True,
+                   chunk_hook: Optional[Callable[[str, str], None]]
+                   = None) -> dict:
+    """Stream every persisted var of ``src_dir`` into full ``.npy``
+    arrays in ``dst_dir``, laid out for (and validated against)
+    ``to_plan``. Returns a report dict (vars, chunk counts, bytes).
+
+    ``chunk_hook(var, chunk_id)`` is called after each chunk commits —
+    the test harness's interruption point (raise to simulate dying
+    mid-stream); ``resume=False`` ignores any progress sidecar."""
+    from .. import io as io_mod
+    if chunk_bytes is None:
+        chunk_bytes = chunk_bytes_default()
+    chunk_bytes = int(chunk_bytes)
+    if chunk_bytes < 1:
+        raise ValueError(f"stream_reshard: chunk_bytes={chunk_bytes}")
+    if os.path.abspath(src_dir) == os.path.abspath(dst_dir):
+        raise ReshardError(
+            "stream_reshard: src and dst are the same directory — the "
+            "stream reads source memmaps while writing destination "
+            "arrays; in-place resharding is the gather path's job")
+    sources = io_mod.serial_var_sources(src_dir)
+    validate_reshard_shapes(
+        {name: tuple(info["shape"]) for name, info in sources.items()},
+        to_plan)
+    os.makedirs(dst_dir, exist_ok=True)
+    mesh_key = json.dumps(to_plan.get("mesh") or {}, sort_keys=True)
+    prog_path = os.path.join(dst_dir, PROGRESS_FILENAME)
+    if not resume:
+        try:
+            os.remove(prog_path)
+        except OSError:
+            pass
+    prog = _load_progress(dst_dir, chunk_bytes, mesh_key)
+    copied = skipped = moved_bytes = 0
+    for base in sorted(sources):
+        info = sources[base]
+        shape = tuple(int(d) for d in info["shape"])
+        dtype = np.dtype(info["dtype"])
+        dst_path = os.path.join(dst_dir, base + ".npy")
+        ledger = prog["vars"].setdefault(base, {"done": False,
+                                                "chunks": {}})
+        if ledger.get("done") and os.path.exists(dst_path):
+            head = io_mod._npy_header(dst_path)
+            if head == (shape, dtype):
+                continue
+            ledger.update(done=False, chunks={})
+        reuse = (bool(ledger["chunks"]) and os.path.exists(dst_path)
+                 and io_mod._npy_header(dst_path) == (shape, dtype))
+        if not reuse:
+            ledger.update(done=False, chunks={})
+        dst = np.lib.format.open_memmap(
+            dst_path, mode="r+" if reuse else "w+",
+            shape=shape, dtype=dtype)
+        try:
+            for pn, piece in enumerate(info["pieces"]):
+                src = np.load(piece["path"], mmap_mode="r")
+                spans = piece["index"]
+                if spans is None:
+                    spans = tuple((0, d) for d in shape)
+                p_shape = tuple(b - a for a, b in spans)
+                if spans and tuple(src.shape) != p_shape:
+                    raise ReshardError(
+                        f"stream_reshard: piece {piece['path']!r} has "
+                        f"shape {tuple(src.shape)}, expected {p_shape} "
+                        "— the directory mixes saves from different "
+                        "runs/layouts")
+                off = spans[0][0] if spans else 0
+                tail = tuple(slice(a, b) for a, b in spans[1:])
+                for a, b in iter_slabs(p_shape or (), dtype.itemsize,
+                                       chunk_bytes):
+                    cid = f"{pn}:{a}:{b}"
+                    if spans:
+                        dst_idx = (slice(off + a, off + b),) + tail
+                        src_idx = (slice(a, b),)
+                    else:  # 0-d
+                        dst_idx = src_idx = ()
+                    recorded = ledger["chunks"].get(cid)
+                    if recorded is not None:
+                        have = _crc(np.asarray(dst[dst_idx]))
+                        if have != recorded:
+                            raise ChunkCorruptError(
+                                f"stream_reshard: chunk {base}/{cid} in "
+                                f"{dst_path!r} fails digest verification "
+                                f"(crc {have} != recorded {recorded}) — "
+                                "the interrupted destination rotted; "
+                                "delete it and restream")
+                        skipped += 1
+                        continue
+                    # ONE slab materialized: this copy is the whole
+                    # peak-memory story (mmap pages on either side are
+                    # the OS's, evictable under pressure)
+                    slab = np.array(src[src_idx])
+                    dst[dst_idx] = slab
+                    dst.flush()
+                    ledger["chunks"][cid] = _crc(slab)
+                    moved_bytes += int(slab.nbytes)
+                    # free BEFORE the next slab allocates: holding it
+                    # across the loop edge would double the peak to two
+                    # chunks (caught by the pinned tracemalloc test)
+                    del slab
+                    copied += 1
+                    _write_atomic(prog_path, prog)
+                    if chunk_hook is not None:
+                        chunk_hook(base, cid)
+                del src
+        finally:
+            del dst
+        ledger["done"] = True
+        _write_atomic(prog_path, prog)
+    try:
+        os.remove(prog_path)
+    except OSError:  # pragma: no cover
+        pass
+    return {"vars": len(sources), "chunks_copied": copied,
+            "chunks_skipped": skipped, "bytes_copied": moved_bytes,
+            "chunk_bytes": chunk_bytes}

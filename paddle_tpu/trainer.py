@@ -432,6 +432,14 @@ class Trainer:
             for sig, old in restore_handlers.items():
                 signal.signal(sig, old)
 
+    def request_preemption(self, signum: int = signal.SIGTERM) -> None:
+        """Programmatic eviction notice: same contract as SIGTERM, but
+        callable from any thread. Signal handlers only install on the
+        main thread, so thread-hosted workers (the orchestrator's tier-1
+        runner) deliver graceful-stop this way; train() checkpoints at
+        the next step boundary and returns with ``preempted=True``."""
+        self._preempt_signal = signum
+
     def _preempt_exit(self, epoch_id: int, next_step: int,
                       already_saved: bool, agree: bool = True) -> bool:
         """At a step boundary: if a preemption signal arrived, checkpoint

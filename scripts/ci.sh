@@ -75,15 +75,31 @@ if [[ "${1:-}" == "chaos" ]]; then
   # the recovery invariants are exercised on two distinct failure
   # schedules, both reproducible.
   for seed in 0 7; do
-    echo "== chaos: resilience + guardrail + elastic + fleet suites (PT_CHAOS_SEED=$seed) =="
+    echo "== chaos: resilience + guardrail + elastic + fleet + orchestrator suites (PT_CHAOS_SEED=$seed) =="
     # the fleet suite rides along: its router_dispatch chaos site
     # (deterministic replica-crash injection at dispatch) exercises the
     # failover/rebuild path under the same seeded harness; the elastic
     # suite drives mesh_shrink/device_loss through the supervisor's
-    # restore -> re-plan -> reshard -> resume loop
+    # restore -> re-plan -> reshard -> resume loop; the orchestrator
+    # suite drives worker_crash/heartbeat_loss through the host-level
+    # lease protocol (hang-vs-crash discrimination + streaming reshard)
     PT_CHAOS_SEED=$seed python -m pytest tests/test_resilience.py \
-      tests/test_guardrails.py tests/test_elastic.py tests/test_fleet.py -q
+      tests/test_guardrails.py tests/test_elastic.py tests/test_fleet.py \
+      tests/test_orchestrator.py tests/test_streaming_reshard.py -q
   done
+  echo "== chaos: orchestrated bench row (schema-checked, validate_orchestrated) =="
+  # one real hang -> evict -> shrink -> resume measurement plus the
+  # streamed-checkpoint memory contract, floored in-process: bench
+  # emits floor_violations into the row and this gate refuses them
+  python - << 'PYEOF'
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import bench
+row = bench.bench_orchestrated(on_tpu=False, peak=1e12)
+print(json.dumps(row, indent=2))
+if row.get("floor_violations"):
+    sys.exit("orchestrated bench row violated its floors")
+PYEOF
   echo "CHAOS OK"
   exit 0
 fi

@@ -1,0 +1,543 @@
+"""Host-level orchestration tests (resilience/orchestrator.py): the
+heartbeat-lease protocol, hang-vs-crash discrimination, and recovery by
+restarting survivors onto the shrunk PT_ELASTIC_TOPOLOGY.
+
+Two layers:
+
+* Deterministic units — injectable clock + a scripted runner, so
+  eviction timing, cause classification, budgets, and topology strings
+  are exact (no real sleeps, no real threads).
+* The acceptance e2e — REAL thread-hosted workers: a chief training
+  through an ElasticSupervisor plus a lease-renewing peer; one injected
+  crash and one injected hang must each be detected with the correct
+  recorded cause, the chief restarted onto the halved topology
+  (dp8 -> dp4, pinned like test_elastic), and the epoch's steps seen
+  exactly once across the restart.
+
+scripts/ci.sh chaos replays this file under two PT_CHAOS_SEED values.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.analysis import planner
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.parallel.mesh import Topology
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.elastic import ElasticSupervisor
+from paddle_tpu.resilience.orchestrator import (CAUSE_CRASH, CAUSE_HANG,
+                                                LeaseTable, OrchMetrics,
+                                                Orchestrator,
+                                                OrchestratorError,
+                                                WorkerContext, WorkerSpec,
+                                                peer_worker, read_lease)
+from paddle_tpu.resilience.retry import RetryPolicy
+
+CHAOS_SEED = int(os.environ.get("PT_CHAOS_SEED", "0"))
+
+
+@pytest.fixture(autouse=True)
+def fresh_env(monkeypatch):
+    monkeypatch.delenv("PT_FAULT_INJECT", raising=False)
+    monkeypatch.delenv("PT_ELASTIC_TOPOLOGY", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv("PT_FAULT_INJECT", spec)
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# deterministic scaffolding
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+class FakeHandle:
+    """A scripted worker handle: dies on command, stops cleanly on
+    request, records kills."""
+
+    def __init__(self):
+        self._alive = True
+        self.error = None
+        self.stop_requested = False
+        self.killed = False
+
+    def alive(self):
+        return self._alive and not self.killed
+
+    def die(self, error=None):
+        self._alive = False
+        self.error = error
+
+    def stop(self):
+        self.stop_requested = True
+        self._alive = False  # clean cooperative exit, immediately
+
+    def kill(self):
+        self.killed = True
+
+    def join(self, timeout=None):
+        pass
+
+
+class FakeRunner:
+    """Hands out FakeHandles and beats each newborn once, like a real
+    worker announcing itself; keeps every handle/context per wid so the
+    script can reach round N's incarnation."""
+
+    def __init__(self):
+        self.handles = {}
+        self.ctxs = {}
+
+    def __call__(self, spec, ctx):
+        h = FakeHandle()
+        self.handles.setdefault(spec.wid, []).append(h)
+        self.ctxs[spec.wid] = ctx
+        ctx.heartbeat(step=0)
+        return h
+
+    def latest(self, wid):
+        return self.handles[wid][-1]
+
+
+class Script:
+    """The orchestrator's injectable sleep: advances the fake clock and
+    fires scheduled actions keyed by call count — single-threaded,
+    fully deterministic."""
+
+    def __init__(self, clock, runner, beating=()):
+        self.clock = clock
+        self.runner = runner
+        self.beating = set(beating)  # wids renewed on every tick
+        self.actions = {}
+        self.calls = 0
+
+    def at(self, call_n, fn):
+        self.actions.setdefault(call_n, []).append(fn)
+        return self
+
+    def __call__(self, seconds):
+        self.clock.sleep(seconds)
+        self.calls += 1
+        for wid in list(self.beating):
+            ctx = self.runner.ctxs.get(wid)
+            handle = self.runner.latest(wid)
+            if ctx is not None and handle.alive():
+                ctx.heartbeat(step=self.calls)
+        for fn in self.actions.pop(self.calls, ()):
+            fn()
+
+
+def _orch(tmp_path, specs, runner, clock, script, **kw):
+    kw.setdefault("lease_s", 1.0)
+    kw.setdefault("grace_s", 0.5)
+    kw.setdefault("stop_grace_s", 2.0)
+    kw.setdefault("poll_s", 0.1)
+    return Orchestrator(specs, lease_dir=str(tmp_path / "leases"),
+                        runner=runner, clock=clock, sleep=script, **kw)
+
+
+# ---------------------------------------------------------------------------
+# lease protocol units
+# ---------------------------------------------------------------------------
+
+class TestLeaseProtocol:
+    def test_heartbeat_roundtrip_is_atomic_json(self, tmp_path):
+        ctx = WorkerContext("w0", str(tmp_path), round_n=2)
+        ctx.heartbeat(step=7)
+        lease = read_lease(str(tmp_path), "w0")
+        assert lease["wid"] == "w0"
+        assert lease["round"] == 2 and lease["beat"] == 1
+        assert lease["step"] == 7 and lease["pid"] == os.getpid()
+        assert read_lease(str(tmp_path), "missing") is None
+
+    def test_age_advances_only_on_orchestrator_clock(self, tmp_path):
+        # the worker's wall clock is garbage on purpose: staleness is
+        # judged purely by (round, beat) advancing under OUR clock
+        clock = FakeClock()
+        table = LeaseTable(str(tmp_path), clock=clock)
+        ctx = WorkerContext("w0", str(tmp_path),
+                            clock=lambda: -12345.0)
+        table.register("w0")
+        clock.t = 5.0
+        assert table.observe("w0") == pytest.approx(5.0)  # never beat
+        ctx.heartbeat(step=0)
+        assert table.observe("w0") == pytest.approx(0.0)  # fresh beat
+        clock.t = 8.0
+        assert table.observe("w0") == pytest.approx(3.0)  # no new beat
+        ctx.heartbeat(step=1)
+        assert table.observe("w0") == pytest.approx(0.0)
+
+    def test_new_round_same_beat_counter_counts_as_advance(self, tmp_path):
+        clock = FakeClock()
+        table = LeaseTable(str(tmp_path), clock=clock)
+        table.register("w0")
+        WorkerContext("w0", str(tmp_path), round_n=0).heartbeat(step=0)
+        table.observe("w0")
+        clock.t = 9.0
+        # a restarted worker starts a fresh context: beat restarts at 1
+        # but the ROUND advanced, so the marker still moves
+        WorkerContext("w0", str(tmp_path), round_n=1).heartbeat(step=0)
+        assert table.observe("w0") == pytest.approx(0.0)
+        assert table.last_payload("w0")["round"] == 1
+
+
+# ---------------------------------------------------------------------------
+# discrimination + recovery units (scripted runner, fake clock)
+# ---------------------------------------------------------------------------
+
+def _specs():
+    return [WorkerSpec("chief", target=None, chips=2, primary=True),
+            WorkerSpec("peer", target=None, chips=2)]
+
+
+class TestDiscrimination:
+    def test_dead_handle_with_error_is_worker_crash(self, tmp_path):
+        clock, runner = FakeClock(), FakeRunner()
+        script = Script(clock, runner, beating=["chief", "peer"])
+        orch = _orch(tmp_path, _specs(), runner, clock, script)
+        script.at(3, lambda: runner.latest("peer").die(
+            RuntimeError("segfault")))
+        script.at(10, lambda: runner.latest("chief").die(None))  # done
+        report = orch.run()
+        assert report["completed"] is True
+        assert [e["cause"] for e in report["evictions"]] == [CAUSE_CRASH]
+        assert report["evictions"][0]["wid"] == "peer"
+        # a dead process is not killed — there is nothing to kill
+        assert runner.handles["peer"][0].killed is False
+        assert report["rounds"] == 1
+        assert report["topology"] == "cpu:2"
+        assert orch.metrics.snapshot()["evictions_by_cause"] == \
+            {CAUSE_CRASH: 1}
+
+    def test_live_handle_with_expired_lease_is_heartbeat_loss(
+            self, tmp_path):
+        clock, runner = FakeClock(), FakeRunner()
+        script = Script(clock, runner, beating=["chief", "peer"])
+        orch = _orch(tmp_path, _specs(), runner, clock, script)
+        # the peer goes silent but STAYS ALIVE: after lease(1.0) +
+        # grace(0.5) of fake time it must be killed and recorded as a
+        # hang, not a crash
+        script.at(3, lambda: script.beating.discard("peer"))
+        script.at(40, lambda: runner.latest("chief").die(None))
+        report = orch.run()
+        assert [e["cause"] for e in report["evictions"]] == [CAUSE_HANG]
+        assert runner.handles["peer"][0].killed is True
+        assert report["evictions"][0]["detect_s"] >= 1.5
+        assert report["completed"] is True
+        snap = orch.metrics.snapshot()
+        assert snap["evictions_by_cause"] == {CAUSE_HANG: 1}
+        assert snap["last_detect_s"] >= 1.5
+
+    def test_both_causes_converge_on_the_same_recovery(self, tmp_path):
+        # crash one peer, hang another: two evictions, two recoveries,
+        # surviving topology shrinks twice, chief restarted each time
+        clock, runner = FakeClock(), FakeRunner()
+        script = Script(clock, runner,
+                        beating=["chief", "p1", "p2"])
+        specs = [WorkerSpec("chief", None, chips=2, primary=True),
+                 WorkerSpec("p1", None, chips=2),
+                 WorkerSpec("p2", None, chips=2)]
+        orch = _orch(tmp_path, specs, runner, clock, script)
+        script.at(3, lambda: runner.latest("p1").die(
+            RuntimeError("boom")))
+        script.at(25, lambda: script.beating.discard("p2"))
+        script.at(70, lambda: runner.latest("chief").die(None))
+        report = orch.run()
+        causes = {e["wid"]: e["cause"] for e in report["evictions"]}
+        assert causes == {"p1": CAUSE_CRASH, "p2": CAUSE_HANG}
+        assert report["rounds"] == 2
+        assert report["topology"] == "cpu:2"  # only the chief remains
+        assert len(runner.handles["chief"]) == 3  # restarted twice
+        assert len(report["recoveries"]) == 2
+        snap = orch.metrics.snapshot()
+        assert snap["recoveries"] == 2
+        # fake clock: stops/restarts are instantaneous, so the recovery
+        # seconds are legitimately zero — just totals consistency here
+        assert snap["recovery_s_total"] >= snap["last_recovery_s"] >= 0
+
+    def test_survivors_get_the_shrunk_topology_env(self, tmp_path):
+        clock, runner = FakeClock(), FakeRunner()
+        script = Script(clock, runner, beating=["chief", "p1", "p2"])
+        specs = [WorkerSpec("chief", None, chips=2, primary=True),
+                 WorkerSpec("p1", None, chips=2),
+                 WorkerSpec("p2", None, chips=2)]
+        orch = _orch(tmp_path, specs, runner, clock, script)
+        seen = []
+        script.at(3, lambda: runner.latest("p1").die(RuntimeError("x")))
+        script.at(8, lambda: seen.append(
+            os.environ.get("PT_ELASTIC_TOPOLOGY")))
+        script.at(12, lambda: runner.latest("chief").die(None))
+        report = orch.run()
+        # two homogeneous 2-chip survivors -> the mesh grammar's 2x2
+        assert seen == ["cpu:2x2"]
+        assert Topology.parse(seen[0]).n_devices == 4
+        assert report["surviving_chips"] == 4
+        # restored after the run: the orchestrator does not leak env
+        assert os.environ.get("PT_ELASTIC_TOPOLOGY") is None
+
+    def test_graceful_stop_precedes_restart(self, tmp_path):
+        clock, runner = FakeClock(), FakeRunner()
+        script = Script(clock, runner, beating=["chief", "peer"])
+        orch = _orch(tmp_path, _specs(), runner, clock, script)
+        script.at(3, lambda: runner.latest("peer").die(
+            RuntimeError("boom")))
+        script.at(12, lambda: runner.latest("chief").die(None))
+        orch.run()
+        first_chief = runner.handles["chief"][0]
+        # round 0's chief was asked to stop (checkpoint at a boundary),
+        # never killed — and a second incarnation was started
+        assert first_chief.stop_requested is True
+        assert first_chief.killed is False
+        assert len(runner.handles["chief"]) == 2
+
+
+class TestBudgetsAndFailure:
+    def test_eviction_budget_exhaustion_raises(self, tmp_path):
+        clock, runner = FakeClock(), FakeRunner()
+        script = Script(clock, runner, beating=["chief", "p1", "p2"])
+        specs = [WorkerSpec("chief", None, chips=1, primary=True),
+                 WorkerSpec("p1", None, chips=1),
+                 WorkerSpec("p2", None, chips=1)]
+        orch = _orch(tmp_path, specs, runner, clock, script,
+                     max_evictions=1)
+        script.at(3, lambda: runner.latest("p1").die(RuntimeError("a")))
+        script.at(10, lambda: runner.latest("p2").die(RuntimeError("b")))
+        with pytest.raises(OrchestratorError, match="budget"):
+            orch.run()
+        # failure still reclaims every thread/process
+        assert not runner.latest("chief").alive()
+
+    def test_primary_eviction_raises(self, tmp_path):
+        clock, runner = FakeClock(), FakeRunner()
+        script = Script(clock, runner, beating=["chief", "peer"])
+        orch = _orch(tmp_path, _specs(), runner, clock, script)
+        script.at(3, lambda: runner.latest("chief").die(
+            RuntimeError("chief down")))
+        with pytest.raises(OrchestratorError, match="primary"):
+            orch.run()
+
+    def test_all_workers_evicted_raises(self, tmp_path):
+        clock, runner = FakeClock(), FakeRunner()
+        script = Script(clock, runner, beating=["solo"])
+        orch = _orch(tmp_path, [WorkerSpec("solo", None, chips=1)],
+                     runner, clock, script)
+        script.at(3, lambda: runner.latest("solo").die(
+            RuntimeError("gone")))
+        with pytest.raises(OrchestratorError, match="all workers"):
+            orch.run()
+
+    def test_no_primary_completion_is_everyone_done(self, tmp_path):
+        clock, runner = FakeClock(), FakeRunner()
+        script = Script(clock, runner, beating=["a", "b"])
+        orch = _orch(tmp_path, [WorkerSpec("a", None), WorkerSpec("b", None)],
+                     runner, clock, script)
+        script.at(3, lambda: runner.latest("a").die(None))
+        script.at(5, lambda: runner.latest("b").die(None))
+        report = orch.run()
+        assert report["completed"] is True
+        assert report["evictions"] == []
+        assert report["workers"] == {"a": "done", "b": "done"}
+
+    def test_spec_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="duplicate"):
+            Orchestrator([WorkerSpec("w", None), WorkerSpec("w", None)],
+                         lease_dir=str(tmp_path))
+        with pytest.raises(ValueError, match="primary"):
+            Orchestrator([WorkerSpec("a", None, primary=True),
+                          WorkerSpec("b", None, primary=True)],
+                         lease_dir=str(tmp_path))
+        with pytest.raises(ValueError, match="chips"):
+            WorkerSpec("w", None, chips=0)
+
+
+# ---------------------------------------------------------------------------
+# metrics exposition (satellite: pt_orch_* conformance)
+# ---------------------------------------------------------------------------
+
+class TestExposition:
+    def test_orch_family_is_conformant(self):
+        m = OrchMetrics("orch-test")
+        m.set_state(live=3, total=4, rounds=1, lease_age_max_s=0.25)
+        m.set_chips(6, 8)
+        m.on_evict(CAUSE_HANG, 1.75)
+        m.on_evict(CAUSE_CRASH, 0.5)
+        m.on_recover(3.5)
+        text = obs_metrics.render_prometheus(
+            {"orch": {"orch-test": m.snapshot()}})
+        assert 'pt_orch_workers_live{orchestrator="orch-test"} 3' in text
+        assert ('pt_orch_evictions_total{orchestrator="orch-test",'
+                'cause="heartbeat_loss"} 1') in text
+        assert ('pt_orch_evictions_total{orchestrator="orch-test",'
+                'cause="worker_crash"} 1') in text
+        assert 'pt_orch_recoveries_total' in text
+        assert 'pt_orch_recovery_seconds_total' in text
+        assert 'pt_orch_lease_age_seconds' in text
+        assert 'pt_orch_detect_seconds' in text
+        assert obs_metrics.validate_exposition(text) == []
+
+    def test_orchestrator_registers_on_the_global_registry(self, tmp_path):
+        orch = Orchestrator([WorkerSpec("w", None)],
+                            lease_dir=str(tmp_path), name="reg-test")
+        snap = obs_metrics.global_snapshot()
+        assert "reg-test" in snap.get("orch", {})
+        assert snap["orch"]["reg-test"]["target_chips"] == 1
+        del orch  # weakref registry: dropping the orchestrator unregisters
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e: real threads, injected crash AND hang
+# ---------------------------------------------------------------------------
+
+N_STEPS = 12
+STEP_INTERVAL = 4
+BATCH = 8
+
+
+def _det_reader():
+    rs = np.random.RandomState(1234 + CHAOS_SEED)
+    data = [(rs.randn(4).astype(np.float32),
+             rs.randn(1).astype(np.float32))
+            for _ in range(N_STEPS * BATCH)]
+
+    def reader():
+        yield from data
+    return reader
+
+
+def _make_trainer_factory(ckpt_dir):
+    def make_trainer():
+        pt.core.program.reset_unique_names()
+
+        def train_func():
+            x = layers.data("x", [4])
+            y = layers.data("y", [1])
+            pred = layers.fc(x, size=1)
+            return [layers.mean(layers.square_error_cost(pred, y))]
+
+        cfg = pt.CheckpointConfig(ckpt_dir, step_interval=STEP_INTERVAL)
+        return pt.Trainer(train_func,
+                          lambda: pt.optimizer.SGDOptimizer(0.05),
+                          checkpoint_config=cfg)
+    return make_trainer
+
+
+@pytest.fixture
+def pin_dp_plans(monkeypatch):
+    """Rank the dp-only mesh first (same pin as test_elastic) so the
+    restart crosses plans dp8 -> dp4 deterministically."""
+    real = planner.plan_for_devices
+
+    def pinned(program=None, n_devices=None, **kw):
+        kw.setdefault("beam", 64)
+        art = real(program, n_devices=n_devices, **kw)
+        want = {"dp": int(n_devices)}
+        ranked = art.doc["ranked"]
+        for i, p in enumerate(ranked):
+            if p["mesh"] == want and not p.get("zero"):
+                art.doc["ranked"] = [p] + ranked[:i] + ranked[i + 1:]
+                break
+        return art
+    monkeypatch.setattr(planner, "plan_for_devices", pinned)
+
+
+def _quiet_policy(retries=3):
+    return RetryPolicy(retries=retries, base_delay=0.0, jitter=0.0,
+                       seed=CHAOS_SEED, sleep=lambda _d: None)
+
+
+def _make_chief(ckpt_dir, steps, sups):
+    base = Topology.parse("cpu:4x2")
+
+    def chief(ctx):
+        sup = ElasticSupervisor(_make_trainer_factory(ckpt_dir),
+                                batch=BATCH, base_topology=base,
+                                policy=_quiet_policy())
+        sups.append(sup)
+
+        def handler(event):
+            if isinstance(event, pt.EndStepEvent):
+                steps.append((event.epoch, event.step))
+                ctx.heartbeat(step=event.step)
+                if ctx.should_stop() and sup.trainer is not None:
+                    sup.trainer.request_preemption()
+                # pace the epoch so the peer's silence threshold always
+                # elapses while the chief is still mid-run
+                time.sleep(0.03)
+
+        sup.run(num_epochs=1, event_handler=handler,
+                reader=pt.reader.batch(_det_reader(), BATCH))
+    return chief
+
+
+def _e2e_orchestrator(tmp_path, steps, sups):
+    specs = [
+        WorkerSpec("chief", _make_chief(str(tmp_path / "ckpt"), steps,
+                                        sups),
+                   chips=4, primary=True, lease_s=60.0),
+        WorkerSpec("peer", lambda ctx: peer_worker(ctx, interval_s=0.02),
+                   chips=4, lease_s=0.15),
+    ]
+    return Orchestrator(specs, lease_dir=str(tmp_path / "leases"),
+                        grace_s=0.1, stop_grace_s=30.0, poll_s=0.02,
+                        name=f"e2e-{os.path.basename(str(tmp_path))}")
+
+
+class TestOrchestratorE2E:
+    def _assert_recovered(self, report, steps, sups, cause):
+        assert report["completed"] is True
+        assert [e["cause"] for e in report["evictions"]] == [cause]
+        assert report["evictions"][0]["wid"] == "peer"
+        assert report["rounds"] == 1
+        # survivors restarted onto the shrunk slice: the chief's second
+        # supervisor planned for PT_ELASTIC_TOPOLOGY=cpu:4
+        assert report["topology"] == "cpu:4"
+        assert len(sups) == 2
+        assert sups[0].current_chips == 8
+        assert sups[1].current_chips == 4
+        assert sups[1].trainer.plan["mesh"] == {"dp": 4}
+        # training resumed at the exact recorded step: every step of
+        # the epoch seen exactly once, in order, across the restart
+        assert steps == [(0, s) for s in range(N_STEPS)]
+        assert len(report["recoveries"]) == 1
+        assert report["recoveries"][0] > 0
+
+    def test_injected_crash_detected_and_recovered(
+            self, tmp_path, monkeypatch, pin_dp_plans):
+        _arm(monkeypatch, "worker_crash@8")
+        steps, sups = [], []
+        orch = _e2e_orchestrator(tmp_path, steps, sups)
+        report = orch.run()
+        self._assert_recovered(report, steps, sups, CAUSE_CRASH)
+        # a crash is a dead handle: nothing was killed
+        assert orch.workers[1].handle.killed is False
+
+    def test_injected_hang_detected_and_recovered(
+            self, tmp_path, monkeypatch, pin_dp_plans):
+        _arm(monkeypatch, "heartbeat_loss@8")
+        steps, sups = [], []
+        orch = _e2e_orchestrator(tmp_path, steps, sups)
+        report = orch.run()
+        self._assert_recovered(report, steps, sups, CAUSE_HANG)
+        # a hang is a LIVE handle gone silent: the orchestrator killed
+        # it — the discrimination the lease protocol exists for
+        assert orch.workers[1].handle.killed is True
+        assert report["evictions"][0]["detect_s"] >= 0.25

@@ -1,0 +1,413 @@
+"""Streaming reshard tests (resilience/streaming.py + the guardrail in
+the gather path): bit-identity against the in-memory reshard on the
+ISSUE's two scenarios (dp8->dp4 and dp4->dp2xtp2, ZeRO accumulators
+included), measured peak allocation under the chunk budget, resume
+after a mid-stream interruption, corrupt-chunk digest refusal, and the
+PT_RESHARD_MAX_HOST_GB refusal that names the streaming path.
+
+scripts/ci.sh chaos replays this file under two PT_CHAOS_SEED values
+alongside the orchestrator suite.
+"""
+
+import importlib.util
+import json
+import os
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io as io_mod
+from paddle_tpu import layers
+from paddle_tpu.resilience import streaming
+from paddle_tpu.resilience.elastic import (ReshardError,
+                                           ReshardMemoryError,
+                                           reshard_state)
+from paddle_tpu.resilience.streaming import (ChunkCorruptError,
+                                             iter_slabs, stream_reshard)
+
+CHAOS_SEED = int(os.environ.get("PT_CHAOS_SEED", "0"))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_knobs(monkeypatch):
+    monkeypatch.delenv("PT_RESHARD_CHUNK_MB", raising=False)
+    monkeypatch.delenv("PT_RESHARD_MAX_HOST_GB", raising=False)
+
+
+def _plan(mesh, specs, **extra):
+    return dict({"mesh": mesh, "specs": specs}, **extra)
+
+
+def _write_serial(dirname, state):
+    os.makedirs(dirname, exist_ok=True)
+    for name, arr in state.items():
+        np.save(os.path.join(dirname, name + ".npy"), arr)
+    return dirname
+
+
+def _read_serial(dirname):
+    out = {}
+    for name in os.listdir(dirname):
+        if name.endswith(".npy") and ".shard." not in name:
+            out[name[:-len(".npy")]] = np.load(os.path.join(dirname, name))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# slab iterator
+# ---------------------------------------------------------------------------
+
+class TestIterSlabs:
+    def test_rows_per_slab_respect_the_byte_budget(self):
+        # 4-byte items, 8 per row = 32 B rows; 64 B budget = 2 rows/slab
+        slabs = iter_slabs((10, 8), 4, 64)
+        assert slabs == [(0, 2), (2, 4), (4, 6), (6, 8), (8, 10)]
+        for a, b in slabs:
+            assert (b - a) * 32 <= 64
+
+    def test_oversized_row_degrades_to_one_row_slabs(self):
+        slabs = iter_slabs((3, 100), 8, 64)  # 800 B rows, 64 B budget
+        assert slabs == [(0, 1), (1, 2), (2, 3)]
+
+    def test_scalar_and_empty(self):
+        assert iter_slabs((), 8, 64) == [(0, 1)]
+        assert iter_slabs((0, 4), 4, 64) == [(0, 0)]
+
+
+# ---------------------------------------------------------------------------
+# bit-identity vs the in-memory path (the acceptance scenarios)
+# ---------------------------------------------------------------------------
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("from_mesh,to_mesh,specs", [
+        # preemption halves the slice
+        ({"dp": 8}, {"dp": 4},
+         {"fc_0.w_0": ["dp", None], "fc_0.b_0": [None]}),
+        # dp -> dp x tp re-split
+        ({"dp": 4}, {"dp": 2, "tp": 2},
+         {"fc_0.w_0": ["dp", "tp"], "fc_0.b_0": [None]}),
+    ])
+    def test_stream_matches_gather(self, tmp_path, from_mesh, to_mesh,
+                                   specs):
+        rs = np.random.RandomState(7 + CHAOS_SEED)
+        state = {"fc_0.w_0": rs.randn(16, 8).astype(np.float32),
+                 "fc_0.b_0": rs.randn(8).astype(np.float32),
+                 "lr": np.float32(0.05)}  # 0-d rides along
+        src = _write_serial(str(tmp_path / "src"), state)
+        from_plan = _plan(from_mesh, specs)
+        to_plan = _plan(to_mesh, specs)
+        want = reshard_state(dict(state), from_plan=from_plan,
+                             to_plan=to_plan)
+        dst = str(tmp_path / "dst")
+        report = stream_reshard(src, dst, to_plan, chunk_bytes=64)
+        got = _read_serial(dst)
+        assert set(got) == set(want)
+        for name in want:
+            np.testing.assert_array_equal(
+                got[name], np.asarray(want[name]),
+                err_msg=f"{name}: stream diverged from gather")
+        assert report["chunks_copied"] > 1  # actually chunked
+        assert not os.path.exists(
+            os.path.join(dst, streaming.PROGRESS_FILENAME))
+
+    def test_zero_accumulators_stream_like_any_var(self, tmp_path):
+        # ZeRO's dp-sharded optimizer moments are ordinary specs; moving
+        # zero-dp4 -> plain-dp2xtp2 must carry them bit-identically
+        rs = np.random.RandomState(13 + CHAOS_SEED)
+        state = {"fc_0.w_0": rs.randn(8, 4).astype(np.float32),
+                 "fc_0.w_0_moment": rs.randn(8, 4).astype(np.float32)}
+        src = _write_serial(str(tmp_path / "src"), state)
+        zero = _plan({"dp": 4}, {"fc_0.w_0": [None, None],
+                                 "fc_0.w_0_moment": ["dp", None]},
+                     zero=True)
+        plain = _plan({"dp": 2, "tp": 2},
+                      {"fc_0.w_0": ["dp", None],
+                       "fc_0.w_0_moment": ["dp", None]}, zero=False)
+        want = reshard_state(dict(state), from_plan=zero, to_plan=plain)
+        dst = str(tmp_path / "dst")
+        stream_reshard(src, dst, plain, chunk_bytes=32)
+        got = _read_serial(dst)
+        for name in want:
+            np.testing.assert_array_equal(got[name], want[name])
+
+    def test_shard_pieces_reassemble_bit_identically(self, tmp_path):
+        # a multi-process serial: the var exists only as shard pieces +
+        # meta; streaming must reassemble the same full array the
+        # in-memory loader produces, slab by slab
+        rs = np.random.RandomState(11 + CHAOS_SEED)
+        full = rs.randn(8, 6).astype(np.float32)
+        src = str(tmp_path / "src")
+        os.makedirs(src)
+        with open(os.path.join(src, "w.meta.json"), "w") as f:
+            json.dump({"shape": [8, 6], "dtype": "float32"}, f)
+        np.save(os.path.join(src, "w.shard.0_4x0_6.npy"), full[0:4])
+        np.save(os.path.join(src, "w.shard.4_8x0_6.npy"), full[4:8])
+        want = io_mod._load_sharded(src, "w")
+        np.testing.assert_array_equal(want, full)
+        dst = str(tmp_path / "dst")
+        stream_reshard(src, dst, _plan({"dp": 2}, {"w": ["dp", None]}),
+                       chunk_bytes=48)  # 2 rows per slab
+        got = np.load(os.path.join(dst, "w.npy"))
+        np.testing.assert_array_equal(got, full)
+
+    def test_indivisible_dim_refused_before_any_byte_moves(
+            self, tmp_path):
+        src = _write_serial(str(tmp_path / "src"),
+                            {"w": np.zeros((7, 5), np.float32)})
+        dst = str(tmp_path / "dst")
+        with pytest.raises(ReshardError, match="dim 0 of size 7"):
+            stream_reshard(src, dst,
+                           _plan({"tp": 4}, {"w": ["tp", None]}))
+        assert not os.path.exists(dst)
+
+
+# ---------------------------------------------------------------------------
+# the bounded-memory pin (acceptance: peak <= chunk budget + constant)
+# ---------------------------------------------------------------------------
+
+class TestPeakMemory:
+    def test_peak_allocation_bounded_by_chunk_budget(self, tmp_path):
+        chunk = 1 << 20  # 1 MiB budget
+        total = 8 << 20  # an 8 MiB var the stream must never hold whole
+        arr = np.arange(total // 4, dtype=np.float32).reshape(2048, -1)
+        src = _write_serial(str(tmp_path / "src"), {"w": arr})
+        dst = str(tmp_path / "dst")
+        tracemalloc.start()
+        try:
+            tracemalloc.reset_peak()
+            report = stream_reshard(
+                src, dst, _plan({"dp": 4}, {"w": ["dp", None]}),
+                chunk_bytes=chunk)
+            _cur, peak = tracemalloc.get_traced_memory()
+        finally:
+            tracemalloc.stop()
+        assert report["bytes_copied"] == total
+        assert report["chunks_copied"] == total // chunk
+        # the pin: one slab plus a small constant (progress dict, crc
+        # buffers) — NOT the 8 MiB the gather path materializes
+        assert peak <= chunk + (1 << 20), \
+            f"peak {peak} blew the chunk budget {chunk}"
+        np.testing.assert_array_equal(np.load(os.path.join(dst, "w.npy")),
+                                      arr)
+
+
+# ---------------------------------------------------------------------------
+# resume + corruption refusal
+# ---------------------------------------------------------------------------
+
+class _DieAfter:
+    def __init__(self, n):
+        self.n = n
+        self.seen = 0
+
+    def __call__(self, var, cid):
+        self.seen += 1
+        if self.seen >= self.n:
+            raise KeyboardInterrupt(f"injected death after {var}/{cid}")
+
+
+class TestResume:
+    def _setup(self, tmp_path):
+        rs = np.random.RandomState(17 + CHAOS_SEED)
+        arr = rs.randn(16, 32).astype(np.float32)  # 128 B rows
+        src = _write_serial(str(tmp_path / "src"), {"w": arr})
+        dst = str(tmp_path / "dst")
+        plan = _plan({"dp": 4}, {"w": ["dp", None]})
+        # chunk_bytes=128 -> one row per slab -> 16 chunks
+        return src, dst, plan, arr
+
+    def test_resume_after_interrupt_copies_only_the_remainder(
+            self, tmp_path):
+        src, dst, plan, arr = self._setup(tmp_path)
+        with pytest.raises(KeyboardInterrupt):
+            stream_reshard(src, dst, plan, chunk_bytes=128,
+                           chunk_hook=_DieAfter(3))  # 3 of 16 chunks
+        assert os.path.exists(os.path.join(dst,
+                                           streaming.PROGRESS_FILENAME))
+        report = stream_reshard(src, dst, plan, chunk_bytes=128)
+        assert report["chunks_skipped"] == 3
+        assert report["chunks_copied"] == 13
+        np.testing.assert_array_equal(np.load(os.path.join(dst, "w.npy")),
+                                      arr)
+        assert not os.path.exists(os.path.join(dst,
+                                               streaming.PROGRESS_FILENAME))
+
+    def test_corrupt_verified_chunk_is_refused_typed(self, tmp_path):
+        src, dst, plan, _arr = self._setup(tmp_path)
+        with pytest.raises(KeyboardInterrupt):
+            stream_reshard(src, dst, plan, chunk_bytes=128,
+                           chunk_hook=_DieAfter(3))
+        # rot a byte inside chunk 0's region between interrupt and resume
+        mm = np.load(os.path.join(dst, "w.npy"), mmap_mode="r+")
+        mm[0, 0] += 1.0
+        mm.flush()
+        del mm
+        with pytest.raises(ChunkCorruptError, match="digest"):
+            stream_reshard(src, dst, plan, chunk_bytes=128)
+
+    def test_changed_chunk_budget_restreams_from_scratch(self, tmp_path):
+        src, dst, plan, arr = self._setup(tmp_path)
+        with pytest.raises(KeyboardInterrupt):
+            stream_reshard(src, dst, plan, chunk_bytes=128,
+                           chunk_hook=_DieAfter(2))
+        # a different budget invalidates the ledger (chunk ids shift)
+        report = stream_reshard(src, dst, plan, chunk_bytes=64)
+        assert report["chunks_skipped"] == 0
+        np.testing.assert_array_equal(np.load(os.path.join(dst, "w.npy")),
+                                      arr)
+
+    def test_same_dir_refused(self, tmp_path):
+        src = _write_serial(str(tmp_path / "src"),
+                            {"w": np.ones((4, 4), np.float32)})
+        with pytest.raises(ReshardError, match="same directory"):
+            stream_reshard(src, src, _plan({}, {}))
+
+
+# ---------------------------------------------------------------------------
+# the gather guardrail (satellite: typed refusal instead of silent OOM)
+# ---------------------------------------------------------------------------
+
+class TestGatherGuardrail:
+    def test_reshard_state_refuses_over_budget_naming_streaming(
+            self, monkeypatch):
+        monkeypatch.setenv("PT_RESHARD_MAX_HOST_GB", "1e-7")  # ~107 B
+        state = {"w": np.zeros((64, 64), np.float32)}  # 16 KiB
+        with pytest.raises(ReshardMemoryError) as ei:
+            reshard_state(state, from_plan=None,
+                          to_plan=_plan({"dp": 2}, {"w": ["dp", None]}))
+        msg = str(ei.value)
+        assert "--stream" in msg and "PT_RESHARD_CHUNK_MB" in msg
+        # typed as a ReshardError subclass: retry layers must not re-run
+        assert isinstance(ei.value, ReshardError)
+
+    def test_under_budget_passes(self, monkeypatch):
+        monkeypatch.setenv("PT_RESHARD_MAX_HOST_GB", "1")
+        out = reshard_state({"w": np.ones((4, 4), np.float32)},
+                            from_plan=None,
+                            to_plan=_plan({"dp": 2}, {"w": ["dp", None]}))
+        np.testing.assert_array_equal(out["w"], np.ones((4, 4)))
+
+    def test_estimate_counts_global_bytes_from_headers(self, tmp_path):
+        src = str(tmp_path / "src")
+        os.makedirs(src)
+        np.save(os.path.join(src, "a.npy"),
+                np.zeros((8, 8), np.float32))          # 256 B
+        with open(os.path.join(src, "b.meta.json"), "w") as f:
+            json.dump({"shape": [4, 4], "dtype": "float32"}, f)
+        np.save(os.path.join(src, "b.shard.0_2x0_4.npy"),
+                np.zeros((2, 4), np.float32))
+        np.save(os.path.join(src, "b.shard.2_4x0_4.npy"),
+                np.zeros((2, 4), np.float32))
+        assert io_mod.estimate_serial_host_bytes(src) == 256 + 64
+
+
+# ---------------------------------------------------------------------------
+# the CLI: --stream end-to-end + the guarded gather path
+# ---------------------------------------------------------------------------
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "reshard_cli_streaming", os.path.join(REPO, "tools", "reshard.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _linreg():
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(0.05).minimize(loss)
+    return main, startup, loss
+
+
+PLAN_A = _plan({"dp": 8}, {"fc_0.w_0": [None, None]}, zero=False,
+               sp_mode="ring", batch=8, devices_used=8)
+PLAN_B = _plan({"dp": 4}, {"fc_0.w_0": [None, None]}, zero=False,
+               sp_mode="ring", batch=8, devices_used=4)
+
+
+class TestStreamCLI:
+    def _stamped_checkpoint(self, tmp_path, plan=PLAN_A):
+        main, startup, _ = _linreg()
+        exe = pt.Executor()
+        exe.run(startup)
+        ckpt = str(tmp_path / "ckpt")
+        pt.io.save_checkpoint(exe, ckpt,
+                              trainer_args={"epoch_id": 0, "step_id": 4},
+                              main_program=main, plan=plan)
+        return ckpt
+
+    def _write_plan(self, path, plan):
+        with open(path, "w") as f:
+            json.dump(plan, f)
+        return str(path)
+
+    def test_stream_output_matches_gather_output(self, tmp_path):
+        cli = _load_cli()
+        ckpt = self._stamped_checkpoint(tmp_path)
+        plan_b = self._write_plan(tmp_path / "b.json", PLAN_B)
+        out_gather = str(tmp_path / "gathered")
+        out_stream = str(tmp_path / "streamed")
+        assert cli.main(["--checkpoint", ckpt, "--to-plan", plan_b,
+                         "--out", out_gather]) == 0
+        assert cli.main(["--checkpoint", ckpt, "--to-plan", plan_b,
+                         "--out", out_stream, "--stream",
+                         "--chunk-mb", "1"]) == 0
+        g = _read_serial(os.path.join(out_gather, "checkpoint_0"))
+        s = _read_serial(os.path.join(out_stream, "checkpoint_0"))
+        assert set(g) == set(s) and len(g) > 0
+        for name in g:
+            np.testing.assert_array_equal(
+                s[name], g[name],
+                err_msg=f"{name}: --stream diverged from gather")
+        # a first-class verified checkpoint: stamped, committed, resume
+        # point carried
+        assert io_mod.read_plan_stamp(out_stream)["mesh"] == {"dp": 4}
+        assert pt.io.get_latest_checkpoint_serial(out_stream) == 0
+        args = json.load(open(os.path.join(out_stream, "checkpoint_0",
+                                           "trainer_0.json")))
+        assert args["step_id"] == 4
+
+    def test_stream_requires_out(self, tmp_path, capsys):
+        cli = _load_cli()
+        ckpt = self._stamped_checkpoint(tmp_path)
+        plan_b = self._write_plan(tmp_path / "b.json", PLAN_B)
+        with pytest.raises(SystemExit) as ei:
+            cli.main(["--checkpoint", ckpt, "--to-plan", plan_b,
+                      "--stream"])
+        assert ei.value.code == 2
+
+    def test_gather_refuses_over_budget_and_stream_succeeds(
+            self, tmp_path, monkeypatch, capsys):
+        cli = _load_cli()
+        ckpt = self._stamped_checkpoint(tmp_path)
+        plan_b = self._write_plan(tmp_path / "b.json", PLAN_B)
+        monkeypatch.setenv("PT_RESHARD_MAX_HOST_GB", "1e-8")  # ~10 B
+        out = str(tmp_path / "out")
+        assert cli.main(["--checkpoint", ckpt, "--to-plan", plan_b,
+                         "--out", out]) == 1
+        err = capsys.readouterr().err
+        assert "REFUSED" in err and "--stream" in err
+        # the named alternative works under the same budget
+        assert cli.main(["--checkpoint", ckpt, "--to-plan", plan_b,
+                         "--out", out, "--stream"]) == 0
+        assert pt.io.get_latest_checkpoint_serial(out) == 0
+
+    def test_stream_structural_refusal_exits_one(self, tmp_path, capsys):
+        cli = _load_cli()
+        ckpt = self._stamped_checkpoint(tmp_path)
+        bad = self._write_plan(tmp_path / "bad.json",
+                               _plan({"tp": 8},
+                                     {"fc_0.w_0": ["tp", None]}))
+        assert cli.main(["--checkpoint", ckpt, "--to-plan", bad,
+                         "--out", str(tmp_path / "out"),
+                         "--stream"]) == 1
+        assert "REFUSED" in capsys.readouterr().err

@@ -30,6 +30,21 @@ the new mesh like any verified checkpoint (the executor rescatters on
 first dispatch). Because checkpoints hold full arrays, a round-trip
 A -> B -> A is bit-identical.
 
+Two memory regimes:
+
+* default (gather): full host arrays, guarded — when the up-front
+  header-based estimate exceeds PT_RESHARD_MAX_HOST_GB the tool
+  refuses with a typed error instead of silently OOMing the host.
+* `--stream` (requires `--out`): resilience/streaming.py moves the
+  state chunk-by-chunk (slabs of `--chunk-mb` / PT_RESHARD_CHUNK_MB,
+  per-chunk crc32, resumable via the destination's progress sidecar),
+  peak host memory bounded by the chunk budget plus a constant. The
+  output is bit-identical to the gather path.
+
+    # stream a model the survivor host cannot hold
+    python tools/reshard.py --checkpoint ckpt/ --to-plan planB.json \
+        --out ckpt_resharded/ --stream --chunk-mb 64
+
 Exit status: 0 ok, 1 reshard refused/failed, 2 usage problems.
 """
 
@@ -68,6 +83,34 @@ def _load_state(serial_dir):
     return state
 
 
+def _copy_sidecars(src, dst, manifest_mod):
+    """Carry the resume point (trainer args), host-table shards, and
+    any other non-array sidecars verbatim — the reshard changes LAYOUT,
+    never training position."""
+    for name in sorted(os.listdir(src)):
+        if (name.endswith(".npy") or name.endswith(".meta.json")
+                or name == manifest_mod.MANIFEST_FILENAME
+                or name.startswith("_SUCCESS")):
+            continue
+        s = os.path.join(src, name)
+        if os.path.isfile(s):
+            shutil.copy2(s, os.path.join(dst, name))
+
+
+def _commit(dst, to_plan, io_mod, manifest_mod):
+    """Stamp the target plan into a fresh manifest and bind it with
+    _SUCCESS — the result restores like any verified checkpoint."""
+    stamp = io_mod.plan_stamp(to_plan)
+    manifest_mod.write_manifest(
+        dst, layout="checkpoint",
+        extra={"plan_stamp": stamp} if stamp else None)
+    marker = os.path.join(dst, "_SUCCESS")
+    tmp = marker + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(manifest_mod.success_payload(dst))
+    os.replace(tmp, marker)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="reshard.py",
@@ -85,12 +128,24 @@ def main(argv=None) -> int:
                          "root instead of re-stamping in place")
     ap.add_argument("--dry-run", action="store_true",
                     help="validate only; change nothing")
+    ap.add_argument("--stream", action="store_true",
+                    help="move state chunk-by-chunk (bounded host "
+                         "memory, resumable); requires --out")
+    ap.add_argument("--chunk-mb", type=int, default=None,
+                    help="streaming slab size in MiB (default: "
+                         "PT_RESHARD_CHUNK_MB, else 64)")
     args = ap.parse_args(argv)
+    if args.stream and not args.out and not args.dry_run:
+        ap.error("--stream writes a fresh serial dir: pass --out")
 
     from paddle_tpu import io as io_mod
     from paddle_tpu.analysis import planner
     from paddle_tpu.resilience import manifest as manifest_mod
-    from paddle_tpu.resilience.elastic import ReshardError, reshard_state
+    from paddle_tpu.resilience import streaming
+    from paddle_tpu.resilience.elastic import (ReshardError,
+                                               gather_guardrail,
+                                               reshard_state,
+                                               validate_reshard_shapes)
 
     try:
         # load the JSON ourselves so a bare plan dict ({mesh, specs,
@@ -115,8 +170,48 @@ def main(argv=None) -> int:
         return 1
     from_stamp = io_mod.read_plan_stamp(args.checkpoint, serial)
 
-    state = _load_state(src)
+    if args.stream:
+        # -- streaming path: bounded host memory, resumable ----------------
+        try:
+            sources = io_mod.serial_var_sources(src)
+            validate_reshard_shapes(
+                {n: tuple(i["shape"]) for n, i in sources.items()},
+                to_plan)
+        except (ReshardError, OSError) as e:
+            print(f"reshard REFUSED: {e}", file=sys.stderr)
+            return 1
+        print(f"reshard: serial {serial}: {len(sources)} vars ok under "
+              f"target mesh {to_plan.get('mesh')} "
+              f"(from {(from_stamp or {}).get('mesh')}, streaming)")
+        if args.dry_run:
+            return 0
+        root = args.out
+        os.makedirs(root, exist_ok=True)
+        dst = os.path.join(
+            root, f"{io_mod.CHECKPOINT_PREFIX}_"
+            f"{io_mod.get_latest_checkpoint_serial(root, verify=False) + 1}")
+        chunk_bytes = (args.chunk_mb << 20) if args.chunk_mb \
+            else streaming.chunk_bytes_default()
+        try:
+            report = streaming.stream_reshard(src, dst, to_plan,
+                                              chunk_bytes=chunk_bytes)
+        except ReshardError as e:
+            print(f"reshard REFUSED: {e}", file=sys.stderr)
+            return 1
+        _copy_sidecars(src, dst, manifest_mod)
+        _commit(dst, to_plan, io_mod, manifest_mod)
+        print(f"reshard: streamed {report['chunks_copied']} chunks "
+              f"({report['chunks_skipped']} resumed) into {dst} stamped "
+              f"for mesh {json.dumps(to_plan.get('mesh'))}")
+        return 0
+
     try:
+        # guardrail BEFORE any array loads: the estimate comes from npy
+        # headers, so an over-budget state refuses here instead of
+        # OOMing the survivor host mid-gather
+        gather_guardrail(io_mod.estimate_serial_host_bytes(src),
+                         origin="reshard")
+        state = _load_state(src)
         gathered = reshard_state(state, from_plan=from_stamp,
                                  to_plan=to_plan)
     except ReshardError as e:
@@ -141,17 +236,7 @@ def main(argv=None) -> int:
         import numpy as np
         for name, arr in gathered.items():
             np.save(os.path.join(dst, name + ".npy"), arr)
-        # carry the resume point (trainer args), host-table shards, and
-        # any other non-array sidecars verbatim — the reshard changes
-        # LAYOUT, never training position
-        for name in sorted(os.listdir(src)):
-            if (name.endswith(".npy") or name.endswith(".meta.json")
-                    or name == manifest_mod.MANIFEST_FILENAME
-                    or name.startswith("_SUCCESS")):
-                continue
-            s = os.path.join(src, name)
-            if os.path.isfile(s):
-                shutil.copy2(s, os.path.join(dst, name))
+        _copy_sidecars(src, dst, manifest_mod)
     else:
         dst = src
         import numpy as np
@@ -162,15 +247,7 @@ def main(argv=None) -> int:
             if ".shard." in name or name.endswith(".meta.json"):
                 os.remove(os.path.join(dst, name))
 
-    stamp = io_mod.plan_stamp(to_plan)
-    manifest_mod.write_manifest(
-        dst, layout="checkpoint",
-        extra={"plan_stamp": stamp} if stamp else None)
-    marker = os.path.join(dst, "_SUCCESS")
-    tmp = marker + f".tmp{os.getpid()}"
-    with open(tmp, "w") as f:
-        f.write(manifest_mod.success_payload(dst))
-    os.replace(tmp, marker)
+    _commit(dst, to_plan, io_mod, manifest_mod)
     print(f"reshard: wrote {dst} stamped for mesh "
           f"{json.dumps(to_plan.get('mesh'))}")
     return 0
