@@ -797,6 +797,139 @@ def validate_fusion_ab(doc) -> List[str]:
     return problems
 
 
+#: per-arm fields the KV-economics capacity A/B must record
+_KV_ARM_REQUIRED = ("high_water_blocks", "tokens_per_s")
+
+
+def validate_kv_economics(doc) -> List[str]:
+    """Floor checks for bench.py's `kv_economics` A/B ([] = valid) —
+    the impossible-reading discipline applied to the decode plane's
+    prefix-sharing + speculative-decoding row:
+
+      * both capacity arms (unshared / shared) measured: positive-int
+        pool high-water marks, finite positive delivered tokens/s;
+      * the shared arm actually SHARED (shared_hits >= 1 and
+        shared_tokens >= 1 — an arm that never aliased a block measured
+        the feature doing nothing) and records its CoW count;
+      * capacity_ratio_x is recorded AND >= 2.0. Unlike a timing, the
+        ratio is deterministic block accounting (how many pool blocks N
+        same-prefix sequences touch with and without aliasing), so the
+        2x acceptance target is a hard floor here, not a warning;
+      * both parity bits are True — greedy acceptance is token-identical
+        BY CONSTRUCTION, so a False is a correctness bug being recorded
+        as a measurement, never a tradeoff;
+      * the speculation leg actually drafted (drafted >= 1), accepted
+        within [0, drafted], acceptance_rate finite in [0, 1], step
+        counts positive ints with spec <= plain (a verified draft can
+        only save dispatches, never add them);
+      * speedup_x is finite and positive; a reading below 1.0 must
+        carry a non-empty explanation — recorded-or-explained.
+    """
+    if not isinstance(doc, dict):
+        return [f"kv-economics root is {type(doc).__name__}, "
+                "not an object"]
+    problems: List[str] = []
+    arms = doc.get("arms")
+    if not isinstance(arms, dict):
+        problems.append("$.arms: no measured capacity arms recorded")
+        arms = {}
+    for name in ("unshared", "shared"):
+        arm = arms.get(name)
+        here = f"$.arms.{name}"
+        if not isinstance(arm, dict):
+            problems.append(f"{here}: arm not recorded")
+            continue
+        for k in _KV_ARM_REQUIRED:
+            if k not in arm:
+                problems.append(f"{here}.{k}: required field missing")
+        hw = arm.get("high_water_blocks")
+        if hw is not None and (not isinstance(hw, int) or hw < 1):
+            problems.append(f"{here}.high_water_blocks: {hw!r} must be "
+                            "a positive int")
+        tps = arm.get("tokens_per_s")
+        if tps is not None and (_bad_pred_num(tps) or float(tps) <= 0):
+            problems.append(f"{here}.tokens_per_s: {tps!r} must be "
+                            "finite and positive")
+    shared = arms.get("shared")
+    if isinstance(shared, dict):
+        for k in ("shared_hits", "shared_tokens"):
+            n = shared.get(k)
+            if not isinstance(n, int) or n < 1:
+                problems.append(
+                    f"$.arms.shared.{k}: {n!r} — the shared arm must "
+                    "have aliased at least one prefix, else the A/B "
+                    "measured sharing doing nothing")
+        cow = shared.get("cow_copies")
+        if not isinstance(cow, int) or cow < 0:
+            problems.append(f"$.arms.shared.cow_copies: {cow!r} must "
+                            "be recorded as a non-negative int")
+    ratio = doc.get("capacity_ratio_x")
+    if ratio is None or _bad_pred_num(ratio):
+        problems.append(f"$.capacity_ratio_x: {ratio!r} must be "
+                        "recorded, finite, positive")
+    elif float(ratio) < 2.0:
+        problems.append(
+            f"$.capacity_ratio_x: {float(ratio):.2f} < 2.0 — prefix "
+            "sharing must at least halve the same-prefix fleet's pool "
+            "residency (deterministic block accounting, not a timing)")
+    if doc.get("capacity_token_identical") is not True:
+        problems.append(
+            "$.capacity_token_identical: shared-prefix outputs must be "
+            "token-identical to unshared (aliased rows are the same "
+            "bytes the prefill would have written)")
+    spec = doc.get("spec")
+    if not isinstance(spec, dict):
+        problems.append("$.spec: speculation leg not recorded")
+        return problems
+    if spec.get("token_identical") is not True:
+        problems.append(
+            "$.spec.token_identical: speculative decode must be "
+            "token-identical to plain greedy decode (greedy acceptance "
+            "is identity-preserving by construction)")
+    drafted = spec.get("drafted")
+    if not isinstance(drafted, int) or drafted < 1:
+        problems.append(f"$.spec.drafted: {drafted!r} — the speculation "
+                        "leg never drafted; it measured nothing")
+    accepted = spec.get("accepted")
+    if not isinstance(accepted, int) or accepted < 0 or (
+            isinstance(drafted, int) and accepted > drafted):
+        problems.append(f"$.spec.accepted: {accepted!r} must be an int "
+                        "in [0, drafted]")
+    rate = spec.get("acceptance_rate")
+    if rate is None or _bad_pred_num(rate) \
+            or not 0.0 <= float(rate) <= 1.0:
+        problems.append(f"$.spec.acceptance_rate: {rate!r} must be "
+                        "recorded in [0, 1]")
+    steps = spec.get("decode_steps")
+    if not isinstance(steps, dict):
+        problems.append("$.spec.decode_steps: step counts not recorded")
+    else:
+        for k in ("plain", "spec"):
+            n = steps.get(k)
+            if not isinstance(n, int) or n < 1:
+                problems.append(f"$.spec.decode_steps.{k}: {n!r} must "
+                                "be a positive int")
+        if isinstance(steps.get("plain"), int) \
+                and isinstance(steps.get("spec"), int) \
+                and steps["spec"] > steps["plain"]:
+            problems.append(
+                f"$.spec.decode_steps: spec took {steps['spec']} steps "
+                f"vs plain {steps['plain']} — a verified draft can only "
+                "save dispatches, never add them")
+    speedup = spec.get("speedup_x")
+    if speedup is None or _bad_pred_num(speedup) or float(speedup) <= 0:
+        problems.append(f"$.spec.speedup_x: {speedup!r} must be "
+                        "recorded as a finite positive number")
+    elif float(speedup) < 1.0:
+        expl = spec.get("explanation")
+        if not isinstance(expl, str) or not expl.strip():
+            problems.append(
+                f"$.spec.speedup_x: {float(speedup):.3f} < 1.0 with no "
+                "$.spec.explanation — a slowdown must be explained, "
+                "not silently recorded")
+    return problems
+
+
 _ELASTIC_REQUIRED = ("steps_total", "step_interval", "crash_step",
                      "resume_step", "steps_lost", "restarts", "reshards",
                      "recovery_s", "completed")

@@ -422,15 +422,30 @@ def _paged_attn_cost(op, ctx):
     span = paged_max_context(op, ctx.block)
     mxu = 2 * 2 * slots * h * span * d
     vec = 5 * slots * h * span
-    # traffic: the pages actually attended (<= the whole pool), q, out
-    kv_rows = min(ctx.elems(op.inputs["KPool"][0]),
-                  slots * span * h * d)
+    # traffic (the gather-based decode path: flash_attention.py
+    # paged_attention_reference): jnp.take streams each resident pool —
+    # HBM moves whole pages regardless of which rows the tables hit —
+    # then MATERIALIZES the gathered [slots, span, H, D] copy, which
+    # the attention contraction reads back. Per pool that is a pool
+    # stream + a copy write + a copy read, for K and for V. The
+    # original entry priced one optimistic min(pool, gather) pass and
+    # came in ~45x under measurement on the decode report (every peer
+    # op sat at ~10-40x dispatch overhead; this one was off-family) —
+    # per-decode-step KV bytes are the dominant cost of the decode
+    # plane, and a model that misses them by an order of magnitude
+    # mis-ranks every serving plan. The residual constant factor rides
+    # on the measured calibration layer like every other op.
     kv_nbytes = device_nbytes(ctx.block.var(op.inputs["KPool"][0]), ctx.amp)
-    reads = (2 * kv_rows * kv_nbytes + ctx.nbytes(op.inputs["Q"][0])
+    pool_elems = ctx.elems(op.inputs["KPool"][0])
+    gather_elems = slots * span * h * d
+    reads = (2 * (pool_elems + gather_elems) * kv_nbytes
+             + ctx.nbytes(op.inputs["Q"][0])
              + ctx.nbytes(op.inputs["BlockTables"][0])
              + ctx.nbytes(op.inputs["ContextLens"][0]))
+    writes = (2 * gather_elems * kv_nbytes
+              + ctx.nbytes(op.outputs["Out"][0]))
     return OpCost(mxu_flops=mxu, vector_flops=vec, bytes_read=reads,
-                  bytes_written=ctx.nbytes(op.outputs["Out"][0]))
+                  bytes_written=writes)
 
 
 @cost_entry("paged_kv_write")

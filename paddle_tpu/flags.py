@@ -272,6 +272,29 @@ declare_env_knob("PT_DECODE_MAX_NEW_TOKENS",
                  "decode engine: default per-request generation budget "
                  "when the request does not pass max_new_tokens "
                  "(default 64); bounded by the artifact's max_context")
+declare_env_knob("PT_KV_SHARE",
+                 "decode engine: 1 = copy-on-write prefix sharing "
+                 "(serving/decode/prefix.py). Prompts whose prefix is "
+                 "already resident ALIAS the cached KV blocks (per-block "
+                 "refcounts in KVBlockPool) instead of rewriting them — "
+                 "one copy backs N sessions; the first decode write into "
+                 "a shared block copies it out first. Default 0: cached "
+                 "prefixes outlive their sequences, which changes the "
+                 "idle-pool accounting the plain engine guarantees")
+declare_env_knob("PT_SPEC_DRAFT",
+                 "decode engine: speculative-decoding drafter "
+                 "(serving/decode/spec.py). ngram = prompt-lookup "
+                 "self-drafting, self = the bundle's own prefill "
+                 "(acceptance 1.0 by construction), a path = a smaller "
+                 "decode bundle loaded as the drafter. Drafted tokens "
+                 "verify through IDLE slots of the same fixed-shape "
+                 "step; greedy acceptance keeps output token-identical "
+                 "to plain decode. Unset = off")
+declare_env_knob("PT_SPEC_K",
+                 "decode engine: drafted tokens per speculative step "
+                 "(default 4), bounded per step by idle slots, the "
+                 "remaining generation budget, and max_context. Only "
+                 "read when PT_SPEC_DRAFT arms a drafter")
 declare_env_knob("PT_MEM_BUDGET_GB",
                  "static peak-HBM budget gate (analysis/memory.py): on "
                  "every executor compile miss the liveness-based memory "

@@ -272,6 +272,15 @@ _DECODE_COUNTERS = ("received", "completed", "failed", "shed_overload",
 _DECODE_GAUGES = ("tokens_per_sec", "slot_occupancy", "active", "waiting",
                   "kv_blocks_in_use", "kv_blocks_capacity",
                   "kv_high_water")
+#: KV-economics families (serving/decode/prefix.py + spec.py): prefix
+#: sharing exports as pt_kv_*, speculative decoding as pt_spec_* —
+#: snapshot keys carry the kv_/spec_ prefix already, so the family name
+#: IS the key
+_KV_COUNTERS = ("kv_shared_hits", "kv_shared_tokens", "kv_cow_copies")
+_KV_GAUGES = ("kv_blocks_shared", "kv_blocks_indexed")
+_SPEC_COUNTERS = ("spec_steps", "spec_drafted", "spec_accepted",
+                  "spec_fallbacks")
+_SPEC_GAUGES = ("spec_acceptance_rate",)
 #: data-plane (input pipeline) counters/gauges exported as pt_data_*
 #: (data/metrics.py PipelineMetrics.snapshot). wire_bytes/raw_bytes/
 #: codec_ratio are the on-wire feed codec's accounting (data/codec.py)
@@ -408,6 +417,10 @@ def render_prometheus(snapshot: dict) -> str:
                  "counter")
         for key in _DECODE_GAUGES:
             emit(f"pt_decode_{key}", base, snap.get(key))
+        for key in _KV_COUNTERS + _SPEC_COUNTERS:
+            emit(f"pt_{key}_total", base, snap.get(key), "counter")
+        for key in _KV_GAUGES + _SPEC_GAUGES:
+            emit(f"pt_{key}", base, snap.get(key))
         for key in ("prefill_s", "decode_s"):
             emit("pt_decode_phase_seconds_total",
                  dict(base, phase=key[:-2]), snap.get(key),

@@ -103,6 +103,11 @@ SITES: Dict[str, str] = {
                       "stays alive — the hung-collective case "
                       "(resilience/orchestrator.py): the supervisor "
                       "kills it and evicts with cause heartbeat_loss",
+    "spec_verify": "drafter crash mid-step at the speculative-decode "
+                   "draft gathering point (serving/decode/scheduler.py "
+                   "_gather_drafts): the scheduler falls back to plain "
+                   "decode for that sequence's step — output stays "
+                   "token-identical, the session survives",
 }
 
 ENV_VAR = "PT_FAULT_INJECT"

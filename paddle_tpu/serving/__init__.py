@@ -163,6 +163,12 @@ class ServingEngine:
                 f"no decode model named {name!r} is loaded")
         return eng
 
+    def decode_engines(self) -> Dict[str, object]:
+        """Snapshot of the loaded decode engines (name -> DecodeEngine).
+        The fleet tier reads shared-KV residency and speculative
+        acceptance off these for replica health."""
+        return dict(self._decode)
+
     def generate(self, name: str, prompt_ids, **kw):
         """Admit one generation request; returns a GenerationHandle
         (stream() for live tokens, result() for the final dict). Typed

@@ -20,14 +20,26 @@ same artifact plane:
       │                     deadline-aware shedding by remaining-token
       │                     estimate (typed Overloaded /
       │                     DeadlineExceeded)
-      └── KVBlockPool       host accounting for the paged device pool:
-                            fixed-size blocks, per-sequence block
-                            tables, alloc/free/defrag
+      ├── KVBlockPool       host accounting for the paged device pool:
+      │                     fixed-size blocks, per-sequence block
+      │                     tables, per-block refcounts,
+      │                     alloc/share/free/defrag
+      ├── PrefixIndex       KV economics half 1 (prefix.py): hash of
+      │                     token prefixes at block granularity; prompts
+      │                     sharing a resident prefix ALIAS its blocks
+      │                     (one copy backs N sessions), copy-on-write
+      │                     keeps shared blocks immutable
+      └── drafters          KV economics half 2 (spec.py): speculative
+                            decoding — a drafter proposes k tokens, the
+                            SAME fixed-shape step verifies the chain
+                            through idle slots, greedy acceptance stays
+                            token-identical to plain decode
 
 Correctness contract (tested): continuous-batched paged decode is
 token-identical to a sequential per-sequence reference decode under
-greedy sampling — including sequences admitted mid-flight and sequences
-evicted then resumed.
+greedy sampling — including sequences admitted mid-flight, sequences
+evicted then resumed, sequences aliasing a shared prefix, and
+speculative steps under any drafter.
 
 Env knobs (export-time geometry + runtime budget; declared in
 paddle_tpu/flags.py):
@@ -36,14 +48,20 @@ paddle_tpu/flags.py):
     PT_DECODE_POOL_BLOCKS     pool blocks incl. the null block (64)
     PT_DECODE_MAX_SLOTS       decode-step slot count (8)
     PT_DECODE_MAX_NEW_TOKENS  default generation budget (64)
+    PT_KV_SHARE               1 = copy-on-write prefix sharing (off)
+    PT_SPEC_DRAFT             drafter: ngram | self | <bundle dir> (off)
+    PT_SPEC_K                 drafted tokens per speculative step (4)
 """
 
 from __future__ import annotations
 
 from .engine import DecodeEngine, DecodeModel
 from .kv_cache import KVBlockPool, PoolExhausted, blocks_for_tokens
+from .prefix import PrefixIndex
 from .scheduler import DecodeScheduler, GenerationHandle, Sequence
+from .spec import NGramDrafter, PrefillDrafter, accept_greedy
 
 __all__ = ["DecodeEngine", "DecodeModel", "DecodeScheduler",
            "GenerationHandle", "Sequence", "KVBlockPool", "PoolExhausted",
-           "blocks_for_tokens"]
+           "blocks_for_tokens", "PrefixIndex", "NGramDrafter",
+           "PrefillDrafter", "accept_greedy"]

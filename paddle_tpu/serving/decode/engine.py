@@ -22,7 +22,9 @@ from ..batcher import env_float, env_int
 from ..metrics import DecodeMetrics
 from ..registry import ModelVersion
 from .kv_cache import (KVBlockPool, blocks_for_tokens, write_prefill_pages)
+from .prefix import PrefixIndex
 from .scheduler import DecodeScheduler, GenerationHandle
+from .spec import resolve_drafter
 
 __all__ = ["DecodeModel", "DecodeEngine"]
 
@@ -98,13 +100,30 @@ class DecodeModel:
         kv = [(out[k][:n], out[v][:n]) for k, v in self._kv_roles]
         return logits, kv
 
-    def seed_sequence(self, block_ids: Sequence[int], kv_rows) -> None:
-        """Write one sequence's prefill K/V rows into its blocks."""
+    def seed_sequence(self, block_ids: Sequence[int], kv_rows,
+                      skip_rows: int = 0) -> None:
+        """Write one sequence's prefill K/V rows into its blocks.
+        `skip_rows` rows at the front are already resident (aliased
+        shared-prefix blocks, kv_cache.py refcounts) and MUST NOT be
+        rewritten — only the tail past the shared prefix is written,
+        into the tail blocks. A non-block-aligned skip means the whole
+        prompt was matched (partial-tail alias), so nothing is written
+        at all."""
+        skip = int(skip_rows)
+        nb = skip // self.block_size
         for i, (k_rows, v_rows) in enumerate(kv_rows):
+            if k_rows.shape[0] <= skip:
+                continue   # fully aliased: every row already resident
+            if skip % self.block_size:
+                raise ValueError(
+                    f"skip_rows {skip} neither block-aligned nor the "
+                    f"full prefill ({k_rows.shape[0]} rows)")
             self._pools[2 * i] = write_prefill_pages(
-                self._pools[2 * i], block_ids, k_rows, self.block_size)
+                self._pools[2 * i], block_ids[nb:], k_rows[skip:],
+                self.block_size)
             self._pools[2 * i + 1] = write_prefill_pages(
-                self._pools[2 * i + 1], block_ids, v_rows, self.block_size)
+                self._pools[2 * i + 1], block_ids[nb:], v_rows[skip:],
+                self.block_size)
 
     # -- the decode step -----------------------------------------------------
     def decode_step(self, token_ids: np.ndarray, context_lens: np.ndarray,
@@ -138,6 +157,12 @@ class DecodeModel:
         dst = jnp.asarray(list(mapping.values()), dtype=jnp.int32)
         self._pools = [p.at[dst].set(p[src]) for p in self._pools]
 
+    def copy_block(self, src: int, dst: int) -> None:
+        """Device-copy one pool block (every layer, K and V) — the
+        copy-on-write primitive: a sequence about to write into a
+        shared block gets its own copy first."""
+        self._pools = [p.at[dst].set(p[src]) for p in self._pools]
+
     def describe(self) -> dict:
         return {
             "model_dir": self.model_dir,
@@ -162,7 +187,9 @@ class DecodeEngine:
     Knobs (constructor args win; env supplies deployment defaults):
     PT_DECODE_MAX_NEW_TOKENS (default generation budget),
     PT_SERVE_QUEUE_DEPTH / PT_SERVE_DEADLINE_MS (admission — shared with
-    the one-shot engine on purpose: one admission policy per process).
+    the one-shot engine on purpose: one admission policy per process),
+    PT_KV_SHARE (copy-on-write prefix sharing, decode/prefix.py),
+    PT_SPEC_DRAFT / PT_SPEC_K (speculative decoding, decode/spec.py).
     """
 
     def __init__(self, model_dir: Optional[str] = None, *,
@@ -173,6 +200,9 @@ class DecodeEngine:
                  continuous: bool = True,
                  pool_blocks: Optional[int] = None,
                  metrics: Optional[DecodeMetrics] = None,
+                 kv_share: Optional[bool] = None,
+                 drafter: Optional[str] = None,
+                 spec_k: Optional[int] = None,
                  name: str = "model", warmup: bool = True):
         if model is None:
             if model_dir is None:
@@ -196,9 +226,23 @@ class DecodeEngine:
                                  if deadline_ms is None
                                  else float(deadline_ms)))
         self.metrics = metrics or DecodeMetrics(name)
+        # KV economics: both OFF unless asked for — the plain engine's
+        # accounting (exact block ids, zero blocks at idle) is a tested
+        # contract, and sharing retains blocks past sequence lifetime
+        self.kv_share = (bool(env_int("PT_KV_SHARE", 0))
+                         if kv_share is None else bool(kv_share))
+        self.index = (PrefixIndex(self.pool) if self.kv_share else None)
+        spec = (os.environ.get("PT_SPEC_DRAFT", "")
+                if drafter is None else drafter)
+        self.drafter = resolve_drafter(spec, model)
+        self.spec_k = (env_int("PT_SPEC_K", 4)
+                       if spec_k is None else int(spec_k))
         self.scheduler = DecodeScheduler(model, self.pool, self.admission,
                                          self.metrics,
-                                         continuous=continuous, name=name)
+                                         continuous=continuous, name=name,
+                                         prefix_index=self.index,
+                                         drafter=self.drafter,
+                                         spec_k=self.spec_k)
 
     # -- the request path ----------------------------------------------------
     def generate(self, prompt_ids: Sequence[int],
@@ -252,9 +296,26 @@ class DecodeEngine:
         def _do():
             mapping = self.pool.defrag()
             self.model.permute_blocks(mapping)
+            if self.index is not None:
+                # cached prefixes MOVE with their blocks — the index's
+                # chains stay valid across compaction
+                self.index.remap(mapping)
             return len(mapping)
 
         return self.scheduler.while_idle(_do)
+
+    def kv_residency(self) -> dict:
+        """Shared-block residency, the session-affinity health signal:
+        a session's cached prefix lives HERE, so the fleet router's
+        rendezvous hash should keep its follow-ups here too."""
+        out = {"kv_blocks_shared": self.pool.blocks_shared,
+               "kv_blocks_in_use": self.pool.blocks_in_use,
+               "kv_blocks_indexed": (self.index.blocks_indexed
+                                     if self.index is not None else 0)}
+        if self.index is not None:
+            out.update(prefix_hits=self.index.hits,
+                       prefix_hit_tokens=self.index.hit_tokens)
+        return out
 
     def metrics_snapshot(self) -> dict:
         return self.metrics.snapshot()
@@ -263,6 +324,10 @@ class DecodeEngine:
         out = self.model.describe()
         out["continuous"] = self.scheduler.continuous
         out["max_new_tokens_default"] = self.max_new_tokens
+        out["kv_share"] = self.kv_share
+        out["drafter"] = (getattr(self.drafter, "name", "custom")
+                          if self.drafter is not None else None)
+        out["spec_k"] = self.spec_k if self.drafter is not None else 0
         return out
 
     def shutdown(self, drain: bool = True) -> None:

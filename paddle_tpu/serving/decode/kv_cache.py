@@ -19,6 +19,19 @@ its blocks, each decode step writes exactly position context_len-1, and
 attention is masked to [0, context_len). A freed block's stale contents
 are unreachable from any later owner because the new owner rewrites
 every position below its own mask before reading it.
+
+Prefix sharing (serving/decode/prefix.py) extends the invariant with
+per-block REFCOUNTS: a block holding the K/V of a token prefix may back
+several owners at once — N sequences whose prompts share the prefix,
+plus the prefix index's own cache reference. `alloc` hands a block out
+at refcount 1, `share` adds an owner, `free` only RETURNS the block to
+the free list when the last owner lets go. Aliasing preserves the
+no-stale-leak reading because causal K/V rows are a pure function of
+the token prefix — an aliased row IS the row the new owner's own
+prefill would have written, byte for byte. A write into a shared block
+is never allowed: the scheduler copies-on-write into a fresh block
+first (DecodeModel.copy_block), so a shared block's contents are frozen
+for as long as anyone else can read them.
 """
 
 from __future__ import annotations
@@ -59,7 +72,8 @@ class KVBlockPool:
         self.block_size = int(block_size)
         self._free: List[int] = list(range(1, pool_blocks))
         heapq.heapify(self._free)
-        self._in_use: set = set()
+        #: block id -> owner count; a block is live while its count > 0
+        self._ref: Dict[int, int] = {}
         self.high_water = 0
 
     # -- accounting ----------------------------------------------------------
@@ -70,11 +84,16 @@ class KVBlockPool:
 
     @property
     def blocks_in_use(self) -> int:
-        return len(self._in_use)
+        return len(self._ref)
 
     @property
     def blocks_free(self) -> int:
         return len(self._free)
+
+    @property
+    def blocks_shared(self) -> int:
+        """Blocks with more than one live owner (the aliasing win)."""
+        return sum(1 for n in self._ref.values() if n > 1)
 
     def utilization(self) -> float:
         return self.blocks_in_use / max(self.capacity, 1)
@@ -85,6 +104,9 @@ class KVBlockPool:
     def blocks_for_tokens(self, tokens: int) -> int:
         return blocks_for_tokens(tokens, self.block_size)
 
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
     # -- alloc/free ----------------------------------------------------------
     def alloc(self, n: int) -> List[int]:
         if n > len(self._free):
@@ -92,24 +114,42 @@ class KVBlockPool:
                 f"need {n} blocks, {len(self._free)} free "
                 f"({self.blocks_in_use}/{self.capacity} in use)")
         out = [heapq.heappop(self._free) for _ in range(n)]
-        self._in_use.update(out)
+        for b in out:
+            self._ref[b] = 1
         self.high_water = max(self.high_water, self.blocks_in_use)
         return out
 
-    def free(self, ids: Sequence[int]) -> None:
+    def share(self, ids: Sequence[int]) -> None:
+        """Add one owner to each live block — aliasing a resident prefix
+        into another sequence's block table. Only live blocks can gain
+        owners; sharing a free block would resurrect stale contents."""
         for b in ids:
-            if b == 0 or b not in self._in_use:
+            if b == 0 or b not in self._ref:
+                raise ValueError(f"sharing block {b} not allocated")
+        for b in ids:
+            self._ref[b] += 1
+
+    def free(self, ids: Sequence[int]) -> None:
+        """Drop one owner per block; a block returns to the free list
+        only when its LAST owner lets go."""
+        for b in ids:
+            if b == 0 or b not in self._ref:
                 raise ValueError(f"freeing block {b} not allocated")
-            self._in_use.discard(b)
-            heapq.heappush(self._free, b)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                heapq.heappush(self._free, b)
 
     # -- defrag --------------------------------------------------------------
     def defrag(self) -> Dict[int, int]:
         """Compact live blocks onto the lowest ids. Returns the {old: new}
         mapping for every MOVED block (identity entries omitted); the
-        caller must remap its block tables and permute the device pools
-        accordingly before the next step."""
-        live = sorted(self._in_use)
+        caller must remap its block tables — including the prefix
+        index's (PrefixIndex.remap) — and permute the device pools
+        accordingly before the next step. Shared blocks MOVE like any
+        other live block (every owner sees the same remap); refcounts
+        ride along with the id."""
+        live = sorted(self._ref)
         mapping: Dict[int, int] = {}
         target = 1
         for b in live:
@@ -117,7 +157,7 @@ class KVBlockPool:
                 mapping[b] = target
             target += 1
         if mapping:
-            self._in_use = set(range(1, target))
+            self._ref = {mapping.get(b, b): n for b, n in self._ref.items()}
             self._free = list(range(target, self.pool_blocks))
             heapq.heapify(self._free)
         return mapping

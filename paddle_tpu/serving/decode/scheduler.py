@@ -30,6 +30,20 @@ typed through PR-5's admission machinery: `Overloaded` (queue/pool
 pressure, retryable) and `DeadlineExceeded` (the remaining-token
 estimate — tokens left x EWMA step seconds — says the deadline is
 unmeetable, or it already passed).
+
+KV economics (PT_KV_SHARE / PT_SPEC_DRAFT, decode/prefix.py +
+decode/spec.py): with a prefix index armed, admission aliases the
+resident prefix of a new prompt into its block table (pool refcounts;
+one copy backs N sessions) and copy-on-write keeps shared blocks
+immutable — the first decode write into an aliased partial block
+copies it out first (`_cow_for_write`). Under pool pressure the
+scheduler releases cached-prefix references LRU-leaf-first BEFORE
+preempting running sequences. With a drafter armed, idle slots verify
+drafted tokens in the same fixed-shape step (decode/spec.py explains
+the slot-packing), greedy acceptance keeps output token-identical to
+plain decode, and block growth is provisioned for the FULL draft
+window up front — speculation may be dropped for a step (never evicts
+a peer) when the pool can't cover it.
 """
 
 from __future__ import annotations
@@ -42,10 +56,12 @@ from typing import Dict, List, Optional, Sequence as Seq
 import numpy as np
 
 from ...obs import trace as obs_trace
+from ...resilience import faults
 from ..admission import (AdmissionController, DeadlineExceeded,
                          ModelUnavailable, Overloaded)
 from ..metrics import DecodeMetrics
 from .kv_cache import KVBlockPool, PoolExhausted, block_table_row
+from .spec import accept_greedy
 
 __all__ = ["GenerationHandle", "Sequence", "DecodeScheduler"]
 
@@ -164,13 +180,18 @@ class DecodeScheduler:
     def __init__(self, model, pool: KVBlockPool,
                  admission: AdmissionController,
                  metrics: Optional[DecodeMetrics] = None, *,
-                 continuous: bool = True, name: str = "model"):
+                 continuous: bool = True, name: str = "model",
+                 prefix_index=None, drafter=None, spec_k: int = 0):
         self.model = model
         self.pool = pool
         self.admission = admission
         self.metrics = metrics or DecodeMetrics(name)
         self.continuous = continuous
         self.name = name
+        #: scheduler-thread-owned, like _waiting/_running
+        self.index = prefix_index
+        self.drafter = drafter
+        self.spec_k = max(0, int(spec_k)) if drafter is not None else 0
         self._cv = threading.Condition()
         self._incoming: List[Sequence] = []
         self._waiting: List[Sequence] = []   # scheduler-thread-owned
@@ -284,7 +305,10 @@ class DecodeScheduler:
             active=len(self._running), waiting=len(self._waiting),
             blocks_in_use=self.pool.blocks_in_use,
             blocks_capacity=self.pool.capacity,
-            high_water=self.pool.high_water)
+            high_water=self.pool.high_water,
+            blocks_shared=self.pool.blocks_shared,
+            blocks_indexed=(self.index.blocks_indexed
+                            if self.index is not None else 0))
 
     # -- terminal transitions ------------------------------------------------
     def _terminate(self, seq: Sequence, *, result: Optional[dict] = None,
@@ -376,6 +400,11 @@ class DecodeScheduler:
             return (s.priority, -s.t_submit)   # low priority, young first
 
         while not self.pool.can_alloc(need):
+            # cached prefixes go first: dropping an index reference costs
+            # a future alias, evicting a running sequence costs a full
+            # re-prefill — cache beats nothing, live work beats cache
+            if self.index is not None and self.index.release_lru(1):
+                continue
             victims = [s for s in self._running if s is not seq
                        and (s.priority < seq.priority
                             or (allow_peers
@@ -400,7 +429,11 @@ class DecodeScheduler:
             if len(self._running) >= self.model.slots:
                 break
             tokens = seq.tokens_so_far
-            need = self.pool.blocks_for_tokens(len(tokens))
+            shared: List[int] = []
+            matched = 0
+            if self.index is not None:
+                shared, matched = self.index.match(tokens)
+            need = self.pool.blocks_for_tokens(len(tokens)) - len(shared)
             if not self.pool.can_alloc(need) and \
                     not self._evict_for(seq, need, allow_peers=False):
                 continue   # stays waiting; capacity frees as others end
@@ -409,11 +442,21 @@ class DecodeScheduler:
                 self.metrics.on_resumed()
                 obs_trace.instant("resume", cat="decode", parent=seq.ctx,
                                   model=self.name, sid=seq.sid)
-            seq.blocks = self.pool.alloc(need)
+            if shared:
+                # alias the resident prefix: take a reference per block,
+                # write NOTHING below `matched` — those rows are, byte
+                # for byte, what this prompt's prefill would write
+                self.pool.share(shared)
+                self.metrics.on_prefix_hit(matched, len(shared))
+                obs_trace.instant("prefix_hit", cat="decode",
+                                  parent=seq.ctx, model=self.name,
+                                  sid=seq.sid, tokens=matched)
+            seq.blocks = shared + (self.pool.alloc(need) if need else [])
             t0 = time.monotonic()
             try:
                 last_logits, kv_rows = self.model.prefill(tokens)
-                self.model.seed_sequence(seq.blocks, kv_rows)
+                self.model.seed_sequence(seq.blocks, kv_rows,
+                                         skip_rows=matched)
             except Exception as e:  # noqa: BLE001 — typed + delivered
                 self._terminate(seq, error=e if isinstance(
                     e, (Overloaded, DeadlineExceeded)) else
@@ -425,6 +468,11 @@ class DecodeScheduler:
                                parent=seq.ctx, model=self.name,
                                sid=seq.sid, tokens=len(tokens))
             seq.cached_len = len(tokens)
+            if self.index is not None:
+                # register this sequence's full prompt blocks (decode
+                # writes land strictly past the prompt, so they stay
+                # immutable while indexed)
+                self.index.insert(tokens, seq.blocks)
             tok = int(np.argmax(last_logits))
             seq.generated.append(tok)
             seq.handle._put_token(tok)
@@ -437,18 +485,100 @@ class DecodeScheduler:
             seq.slot = free_slots[0]
             self._running.append(seq)
 
+    # -- copy-on-write -------------------------------------------------------
+    def _cow_for_write(self, seq: Sequence) -> bool:
+        """Make the block holding this step's first write position
+        (cached_len) exclusively `seq`'s. Only an aliased PARTIAL tail
+        block can be hit — every block past the prompt was freshly
+        allocated — so at most ONE copy per sequence lifetime. Returns
+        False when the sequence had to be preempted for the copy target
+        (pool exhausted with no lower-ranked victim): a shared block is
+        NEVER written in place."""
+        bi = seq.cached_len // self.pool.block_size
+        if bi >= len(seq.blocks):
+            return True   # the write lands in a to-be-allocated block
+        old = seq.blocks[bi]
+        if self.pool.refcount(old) <= 1:
+            return True   # exclusively owned already
+        if not self.pool.can_alloc(1) and \
+                not self._evict_for(seq, 1, allow_peers=True):
+            self._evict(seq)
+            return False
+        new = self.pool.alloc(1)[0]
+        self.model.copy_block(old, new)
+        self.pool.free([old])   # drop OUR reference; other owners keep it
+        seq.blocks[bi] = new
+        self.metrics.on_cow()
+        obs_trace.instant("cow", cat="decode", parent=seq.ctx,
+                          model=self.name, sid=seq.sid, block=old)
+        return True
+
+    # -- speculation ---------------------------------------------------------
+    def _gather_drafts(self, budget: int) -> Dict[int, List[int]]:
+        """Ask the drafter for up to spec_k tokens per running sequence,
+        bounded by idle slots, the generation budget, and the context
+        limit. A drafter crash (chaos site spec_verify) falls back to
+        plain decode for that sequence's step — never kills it."""
+        out: Dict[int, List[int]] = {}
+        for seq in sorted(self._running,
+                          key=lambda s: (-s.priority, s.t_submit)):
+            if budget <= 0:
+                break
+            k = min(self.spec_k, budget, seq.remaining - 1,
+                    self.model.max_context - seq.cached_len - 1,
+                    (self.model.max_blocks_per_seq
+                     * self.pool.block_size) - seq.cached_len - 1)
+            if k < 1:
+                continue
+            try:
+                faults.crash_point("spec_verify")
+                proposed = self.drafter.propose(seq.tokens_so_far, k)
+            except Exception:   # noqa: BLE001 — degrade, don't die
+                self.metrics.on_spec_fallback()
+                obs_trace.instant("spec_fallback", cat="decode",
+                                  parent=seq.ctx, model=self.name,
+                                  sid=seq.sid)
+                continue
+            drafts: List[int] = []
+            for t in list(proposed)[:k]:
+                t = int(t)
+                if not 0 <= t < self.model.vocab_size:
+                    break   # truncate, don't filter: a chain has no holes
+                drafts.append(t)
+            if drafts:
+                out[seq.sid] = drafts
+                budget -= len(drafts)
+        return out
+
     # -- one decode step -----------------------------------------------------
     def _step(self) -> None:
         if not self._running:
             return
+        slots = self.model.slots
+        drafts: Dict[int, List[int]] = {}
+        if self.drafter is not None and self.spec_k > 0:
+            drafts = self._gather_drafts(slots - len(self._running))
         # grow block capacity in priority order so the important
         # sequences claim blocks (and pick victims) first
         for seq in sorted(list(self._running),
                           key=lambda s: (-s.priority, s.t_submit)):
             if seq not in self._running:
+                drafts.pop(seq.sid, None)
                 continue   # evicted by a higher-priority peer this pass
-            need = (self.pool.blocks_for_tokens(seq.cached_len + 1)
+            if not self._cow_for_write(seq):
+                drafts.pop(seq.sid, None)
+                continue   # preempted hunting a copy target
+            # provision the FULL draft window up front — acceptance is
+            # variable but the pool must cover the maximum
+            g = 1 + len(drafts.get(seq.sid, ()))
+            need = (self.pool.blocks_for_tokens(seq.cached_len + g)
                     - len(seq.blocks))
+            if need > 0 and g > 1 and not self.pool.can_alloc(need):
+                # speculation never evicts a peer: drop the drafts and
+                # retry as a plain one-token step
+                drafts.pop(seq.sid, None)
+                need = (self.pool.blocks_for_tokens(seq.cached_len + 1)
+                        - len(seq.blocks))
             if need <= 0:
                 continue
             if not self.pool.can_alloc(need) and \
@@ -460,26 +590,52 @@ class DecodeScheduler:
                 # toward completion rather than thrashing. (A sequence
                 # that can never fit at all was already shed typed at
                 # submit by the engine's peak-residency check.)
+                drafts.pop(seq.sid, None)
                 self._evict(seq)
                 continue
             seq.blocks.extend(self.pool.alloc(need))
         active = list(self._running)
         if not active:
             return
-        slots = self.model.slots
+        # slot packing: each drafted sequence borrows idle slots — slot
+        # j of its chain feeds draft j with context_len L+1+j over the
+        # SAME block table, so the step's kv-write phase lays down the
+        # whole chain's rows before its attention phase reads them
+        free_ids = [i for i in range(slots)
+                    if all(r.slot != i for r in active)]
+        spec_slots: Dict[int, List[int]] = {}
+        for seq in active:
+            d = drafts.get(seq.sid)
+            if not d:
+                continue
+            take = free_ids[:len(d)]
+            if len(take) < len(d):
+                drafts[seq.sid] = d = d[:len(take)]
+            if not d:
+                drafts.pop(seq.sid, None)
+                continue
+            spec_slots[seq.sid] = take
+            free_ids = free_ids[len(take):]
         tokens = np.zeros(slots, np.int64)
         lens = np.zeros(slots, np.int32)
         tables = np.zeros((slots, self.model.max_blocks_per_seq), np.int32)
         for seq in active:
+            row = block_table_row(seq.blocks,
+                                  self.model.max_blocks_per_seq)
             tokens[seq.slot] = seq.generated[-1]
             lens[seq.slot] = seq.cached_len + 1
-            tables[seq.slot] = block_table_row(
-                seq.blocks, self.model.max_blocks_per_seq)
+            tables[seq.slot] = row
+            for j, (sl, d) in enumerate(zip(spec_slots.get(seq.sid, ()),
+                                            drafts.get(seq.sid, ())),
+                                        start=1):
+                tokens[sl] = d
+                lens[sl] = seq.cached_len + 1 + j
+                tables[sl] = row
         t0 = time.monotonic()
         logits = self.model.decode_step(tokens, lens, tables)
         dt = time.monotonic() - t0
         self.admission.observe_batch(dt)
-        self.metrics.on_step(len(active), slots, dt, len(active))
+        used = len(active) + sum(len(v) for v in spec_slots.values())
         if obs_trace.enabled():
             # one fixed-shape dispatch serving every running sequence:
             # the span records which sids shared it (a single-sequence
@@ -489,15 +645,36 @@ class DecodeScheduler:
                 parent=(active[0].ctx if len(active) == 1 else None),
                 model=self.name, n=len(active),
                 sids=[s.sid for s in active])
+        emitted_total = 0
         for seq in active:
-            tok = int(np.argmax(logits[seq.slot]))
-            seq.cached_len += 1
-            seq.generated.append(tok)
-            seq.handle._put_token(tok)
-            reason = self._finish_reason(seq, tok)
+            d = drafts.get(seq.sid, [])
+            if d:
+                chain = accept_greedy(
+                    d, [int(np.argmax(logits[seq.slot]))]
+                    + [int(np.argmax(logits[sl]))
+                       for sl in spec_slots[seq.sid]])
+                self.metrics.on_spec(len(d), len(chain) - 1)
+            else:
+                chain = [int(np.argmax(logits[seq.slot]))]
+            reason = None
+            advanced = 0
+            for tok in chain:
+                seq.generated.append(tok)
+                seq.handle._put_token(tok)
+                advanced += 1
+                reason = self._finish_reason(seq, tok)
+                if reason is not None:
+                    break
+            # every emitted token's K/V row is now resident (the LAST
+            # one stays the next step's input, exactly as in plain
+            # decode); rejected draft rows sit past cached_len, masked,
+            # and are rewritten before the mask ever reaches them
+            seq.cached_len += advanced
+            emitted_total += advanced
             if reason is not None:
                 self._running.remove(seq)
                 self._finish(seq, reason)
+        self.metrics.on_step(used, slots, dt, emitted_total)
 
 
 def _request_failed(name: str, cause: BaseException):

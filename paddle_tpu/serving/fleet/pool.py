@@ -109,11 +109,42 @@ class Replica:
         return (depth + 1) * (est if est is not None
                               else DEFAULT_SERVICE_S)
 
+    def decode_residency(self) -> Optional[dict]:
+        """Shared-KV residency + speculative acceptance, summed over
+        this replica's decode engines (None when it hosts none). A
+        session's cached prefix is replica-local state the rendezvous
+        hash should respect: the router already keys sessions onto
+        replica ids; this makes the *value* of that affinity (resident
+        shared blocks, warm prefix index) visible next to queue depth
+        in the same health dict operators and the autoscaler read."""
+        engines = {name: eng
+                   for name, eng in self.engine.decode_engines().items()
+                   if hasattr(eng, "kv_residency")}  # duck-typed fakes
+        if not engines:
+            return None
+        out = {"kv_blocks_shared": 0, "kv_blocks_in_use": 0,
+               "kv_blocks_indexed": 0, "prefix_hits": 0,
+               "prefix_hit_tokens": 0}
+        drafted = accepted = 0
+        for eng in engines.values():
+            for key, val in eng.kv_residency().items():
+                out[key] = out.get(key, 0) + int(val)
+            snap = eng.metrics.snapshot()
+            drafted += int(snap.get("spec_drafted", 0) or 0)
+            accepted += int(snap.get("spec_accepted", 0) or 0)
+        out["spec_acceptance_rate"] = (round(accepted / drafted, 4)
+                                       if drafted else None)
+        return out
+
     def health(self) -> dict:
         depth, est = self.signals()
-        return {"queue_depth": depth,
-                "ewma_ms": None if est is None else round(est * 1e3, 3),
-                "healthy": bool(self.healthy)}
+        out = {"queue_depth": depth,
+               "ewma_ms": None if est is None else round(est * 1e3, 3),
+               "healthy": bool(self.healthy)}
+        decode = self.decode_residency()
+        if decode is not None:
+            out["decode"] = decode
+        return out
 
 
 class ReplicaPool:

@@ -202,6 +202,16 @@ class DecodeMetrics:
             self.kv_blocks_in_use = 0
             self.kv_blocks_capacity = 0
             self.kv_high_water = 0
+            # KV economics (decode/prefix.py + decode/spec.py)
+            self.kv_shared_hits = 0
+            self.kv_shared_tokens = 0
+            self.kv_cow_copies = 0
+            self.kv_blocks_shared = 0
+            self.kv_blocks_indexed = 0
+            self.spec_steps = 0
+            self.spec_drafted = 0
+            self.spec_accepted = 0
+            self.spec_fallbacks = 0
 
     # -- recording ----------------------------------------------------------
     def on_received(self) -> None:
@@ -245,14 +255,37 @@ class DecodeMetrics:
             self.decode_s += seconds
             self.tokens_out += tokens
 
+    def on_prefix_hit(self, tokens: int, blocks: int) -> None:
+        with self._lock:
+            self.kv_shared_hits += 1
+            self.kv_shared_tokens += tokens
+
+    def on_cow(self) -> None:
+        with self._lock:
+            self.kv_cow_copies += 1
+
+    def on_spec(self, drafted: int, accepted: int) -> None:
+        with self._lock:
+            self.spec_steps += 1
+            self.spec_drafted += drafted
+            self.spec_accepted += accepted
+
+    def on_spec_fallback(self) -> None:
+        with self._lock:
+            self.spec_fallbacks += 1
+
     def set_gauges(self, *, active: int, waiting: int, blocks_in_use: int,
-                   blocks_capacity: int, high_water: int) -> None:
+                   blocks_capacity: int, high_water: int,
+                   blocks_shared: int = 0,
+                   blocks_indexed: int = 0) -> None:
         with self._lock:
             self.active = active
             self.waiting = waiting
             self.kv_blocks_in_use = blocks_in_use
             self.kv_blocks_capacity = blocks_capacity
             self.kv_high_water = high_water
+            self.kv_blocks_shared = blocks_shared
+            self.kv_blocks_indexed = blocks_indexed
 
     # -- reading ------------------------------------------------------------
     def snapshot(self) -> dict:
@@ -281,6 +314,18 @@ class DecodeMetrics:
                 "kv_blocks_in_use": self.kv_blocks_in_use,
                 "kv_blocks_capacity": self.kv_blocks_capacity,
                 "kv_high_water": self.kv_high_water,
+                "kv_shared_hits": self.kv_shared_hits,
+                "kv_shared_tokens": self.kv_shared_tokens,
+                "kv_cow_copies": self.kv_cow_copies,
+                "kv_blocks_shared": self.kv_blocks_shared,
+                "kv_blocks_indexed": self.kv_blocks_indexed,
+                "spec_steps": self.spec_steps,
+                "spec_drafted": self.spec_drafted,
+                "spec_accepted": self.spec_accepted,
+                "spec_fallbacks": self.spec_fallbacks,
+                "spec_acceptance_rate": (
+                    round(self.spec_accepted / self.spec_drafted, 4)
+                    if self.spec_drafted else None),
                 "prefill_s": round(self.prefill_s, 6),
                 "decode_s": round(self.decode_s, 6),
                 "window_s": round(elapsed, 3),

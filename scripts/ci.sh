@@ -16,7 +16,10 @@
 #           the fleet-tier suite (replica pool, least-loaded/session-
 #           affine routing, priority WFQ admission + lowest-class-first
 #           shedding, crash failover, autoscaler hysteresis, pt_fleet_*
-#           exposition)
+#           exposition) + the kv-economics suite (copy-on-write prefix
+#           sharing, refcounted block pool, speculative decoding token
+#           identity, pt_kv_*/pt_spec_* exposition) with its
+#           schema-checked bench A/B row (capacity floor >= 2x)
 #   analyze = lint gate + the static cost-model suites + schema-checked
 #           tools/cost_report.py runs over the resnet / transformer /
 #           decode bench programs, incl. the collective audit on the
@@ -83,9 +86,12 @@ if [[ "${1:-}" == "chaos" ]]; then
     # restore -> re-plan -> reshard -> resume loop; the orchestrator
     # suite drives worker_crash/heartbeat_loss through the host-level
     # lease protocol (hang-vs-crash discrimination + streaming reshard)
+    # the kv-economics suite rides along for its spec_verify chaos site
+    # (drafter crash mid-step -> plain-decode fallback, token-identical)
     PT_CHAOS_SEED=$seed python -m pytest tests/test_resilience.py \
       tests/test_guardrails.py tests/test_elastic.py tests/test_fleet.py \
-      tests/test_orchestrator.py tests/test_streaming_reshard.py -q
+      tests/test_orchestrator.py tests/test_streaming_reshard.py \
+      tests/test_kv_economics.py -q
   done
   echo "== chaos: orchestrated bench row (schema-checked, validate_orchestrated) =="
   # one real hang -> evict -> shrink -> resume measurement plus the
@@ -151,7 +157,31 @@ fi
 if [[ "${1:-}" == "serve" ]]; then
   echo "== serve: online serving engine + C-API drivers + decode + fleet =="
   python -m pytest tests/test_serving.py tests/test_capi_serving.py \
-    tests/test_decode.py tests/test_fleet.py -q
+    tests/test_decode.py tests/test_fleet.py tests/test_kv_economics.py -q
+  echo "== serve: kv-economics A/B row (schema-checked, validate_kv_economics) =="
+  # prefix sharing must at least halve the same-prefix fleet's pool
+  # residency (deterministic block accounting — a hard floor inside the
+  # validator) and speculative decode must be token-identical to plain
+  # greedy; the tokens/s speedup is recorded-or-explained
+  python - <<'PY'
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import bench
+from paddle_tpu.analysis.artifacts import validate_kv_economics
+row = bench.bench_kv_economics(on_tpu=False, peak=1e12)
+problems = validate_kv_economics(row)
+if problems:
+    raise SystemExit("KV-ECONOMICS ROW INVALID:\n  "
+                     + "\n  ".join(problems)
+                     + "\nrow: " + json.dumps(row, indent=1))
+spec = row["spec"]
+print(f"kv economics ok: capacity {row['capacity_ratio_x']}x "
+      f"({row['arms']['unshared']['high_water_blocks']} -> "
+      f"{row['arms']['shared']['high_water_blocks']} blocks), spec "
+      f"{spec['speedup_x']}x at acceptance {spec['acceptance_rate']}"
+      f"{' (explained)' if 'explanation' in spec else ''}, "
+      f"token-identical both legs")
+PY
   echo "SERVE OK"
   exit 0
 fi

@@ -14,10 +14,13 @@ Two modes:
         Spin a synthetic in-process fleet (sleep-backed replicas behind
         the real router), fire a burst of mixed-priority traffic —
         including one injected `router_dispatch` replica crash, so the
-        failover/rebuild counters are nonzero — then print the same
-        status view and the pt_fleet_* scrape. A self-contained way to
-        see the tier's observability surface without artifacts or
-        hardware.
+        failover/rebuild counters are nonzero — plus a burst of
+        session-affine decode traffic against a tiny in-process decode
+        bundle (prefix sharing on, n-gram drafter), so the per-replica
+        shared-KV residency and speculative acceptance columns are live
+        data. Then print the same status view and the pt_fleet_*
+        scrape. A self-contained way to see the tier's observability
+        surface without artifacts or hardware.
 """
 
 from __future__ import annotations
@@ -42,6 +45,20 @@ def _print_status(status: dict, out=sys.stdout) -> None:
         w(f"{rid:<10}{str(bool(h.get('healthy'))):<9}"
           f"{h.get('queue_depth', 0):<8}"
           f"{h.get('ewma_ms') if h.get('ewma_ms') is not None else '-':<10}\n")
+    dec = {rid: h.get("decode")
+           for rid, h in (status.get("replicas") or {}).items()
+           if h.get("decode")}
+    if dec:
+        w("decode residency (shared KV + speculation):\n")
+        w(f"{'replica':<10}{'kv_shared':<11}{'kv_in_use':<11}"
+          f"{'indexed':<9}{'hits':<7}{'accept':<8}\n")
+        for rid, d in sorted(dec.items()):
+            rate = d.get("spec_acceptance_rate")
+            w(f"{rid:<10}{d.get('kv_blocks_shared', 0):<11}"
+              f"{d.get('kv_blocks_in_use', 0):<11}"
+              f"{d.get('kv_blocks_indexed', 0):<9}"
+              f"{d.get('prefix_hits', 0):<7}"
+              f"{rate if rate is not None else '-':<8}\n")
     queue = status.get("queue") or {}
     w("queued by class: "
       + (", ".join(f"{c}: {n}" for c, n in sorted(queue.items()))
@@ -76,7 +93,33 @@ def from_url(url: str) -> int:
     return 0
 
 
+def _export_demo_bundle(d: str) -> None:
+    """A tiny decode bundle so the demo's decode-residency columns are
+    live data, not zeros."""
+    import paddle_tpu as pt
+    from paddle_tpu import io as pio
+    from paddle_tpu.models import transformer as tfm
+
+    pt.core.program.reset_unique_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        tfm.transformer_lm_loss(vocab_size=32, seq_len=16, n_layers=1,
+                                d_model=8, n_heads=2, d_ff=16,
+                                max_len=64)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        pt.Executor().run(startup)
+        pio.export_decode_model(
+            d, dict(vocab_size=32, n_layers=1, d_model=8, n_heads=2,
+                    d_ff=16, max_context=64),
+            scope=scope, length_buckets=(8, 16), slots=2,
+            block_size=4, pool_blocks=32)
+
+
 def demo(replicas: int = 3) -> int:
+    import shutil
+    import tempfile
+
     import numpy as np
     from paddle_tpu.obs.metrics import render_prometheus
     from paddle_tpu.resilience import faults
@@ -95,12 +138,21 @@ def demo(replicas: int = 3) -> int:
                      for e in examples],
                     {"pad": 0.0, "device": 0.0, "scatter": 0.0})
 
+    bundle = tempfile.mkdtemp(prefix="pt_fleet_demo_")
+    _export_demo_bundle(bundle)
+
+    def loader(eng, rid):
+        eng.load_model_object("demo", Synthetic())
+        # decode plane: prefix sharing on, prompt-lookup drafter — the
+        # residency/acceptance columns below come from real traffic
+        eng.load_decode_model("gen", bundle, warmup=False,
+                              kv_share=True, drafter="ngram", spec_k=3)
+
     prior = os.environ.get("PT_FAULT_INJECT")
     os.environ["PT_FAULT_INJECT"] = "router_dispatch@17"
     faults.reset()
-    router = fleet.make_fleet(
-        lambda eng, rid: eng.load_model_object("demo", Synthetic()),
-        replicas=replicas, autoscale=False)
+    router = fleet.make_fleet(loader, replicas=replicas,
+                              autoscale=False)
     try:
         futs = [router.submit("demo", {"x": np.float32(i)},
                               priority=i % 3,
@@ -108,12 +160,22 @@ def demo(replicas: int = 3) -> int:
                 for i in range(64)]
         for f in futs:
             f.result(timeout=30)
+        # decode traffic: sessions share a prompt, so the session-affine
+        # replica aliases its blocks on every repeat; the repetitive
+        # tail keeps the n-gram drafter's acceptance nonzero. Issued
+        # one at a time: speculation packs drafts into *idle* slots, so
+        # a saturated demo would never draft
+        prompt = [5, 3, 9, 5, 3, 9, 5, 3]
+        for i in range(8):
+            router.generate("gen", prompt, max_new_tokens=24,
+                            session=f"user-{i % 4}").result(60)
         _print_status(router.status())
         _print_fleet_scrape(
             render_prometheus(router.metrics_snapshot()))
         return 0
     finally:
         router.close()
+        shutil.rmtree(bundle, ignore_errors=True)
         if prior is None:
             os.environ.pop("PT_FAULT_INJECT", None)
         else:
