@@ -10,12 +10,15 @@ shares the prefix. The index maps hashed token-prefix chains to block
 ids; admission consults it and aliases matched blocks into the new
 sequence's block table (KVBlockPool.share) instead of rewriting them.
 
-Structure: a chain of nodes, one per FULL block of cached tokens, keyed
-by (parent node, the block's token tuple) — i.e. the hash of the whole
-prefix up to and including that block, built incrementally. A lookup
-walks the chain from the root; the first miss ends the match. Two
-different prefixes can never collide onto one node because the full
-token content is the key, not a lossy digest.
+Structure: a trie of nodes, one per FULL block of cached tokens. Each
+node keys its direct children by the child block's token tuple (the
+root children live in `_root`), so the whole prefix up to and including
+a block identifies it, built incrementally. A lookup walks the chain
+from the root; the first miss ends the match. Two different prefixes
+can never collide onto one node because the full token content is the
+key, not a lossy digest — and both the full-block walk and the
+partial-tail probe only ever touch ONE parent's children, so admission
+cost scales with the prompt, not with everything indexed.
 
 Partial-block tail matches: a prompt that ends INSIDE a cached block
 (prompt tail is a proper prefix of the block's cached tokens) aliases
@@ -46,7 +49,7 @@ __all__ = ["PrefixIndex"]
 
 
 class _Node:
-    __slots__ = ("key", "parent", "block", "tokens", "children", "tick")
+    __slots__ = ("key", "parent", "block", "tokens", "kids", "tick")
 
     def __init__(self, key, parent: Optional["_Node"], block: int,
                  tokens: Tuple[int, ...]):
@@ -54,7 +57,9 @@ class _Node:
         self.parent = parent
         self.block = block
         self.tokens = tokens
-        self.children = 0
+        #: direct children keyed by their token tuple — the next-block
+        #: lookup AND the partial-tail probe scan only this dict
+        self.kids: Dict[Tuple[int, ...], "_Node"] = {}
         self.tick = 0
 
 
@@ -64,7 +69,10 @@ class PrefixIndex:
     def __init__(self, pool, block_size: Optional[int] = None):
         self.pool = pool
         self.block_size = int(block_size or pool.block_size)
+        #: flat registry (for counting, LRU-leaf scans, defrag remap);
+        #: lookups go through the per-node `kids` dicts instead
         self._nodes: Dict[tuple, _Node] = {}
+        self._root: Dict[Tuple[int, ...], _Node] = {}
         self._tick = 0
         self.hits = 0
         self.misses = 0
@@ -84,6 +92,10 @@ class PrefixIndex:
     def _key(self, parent: Optional[_Node], tokens: Tuple[int, ...]):
         return (id(parent) if parent is not None else None, tokens)
 
+    def _kids(self, parent: Optional[_Node]) -> Dict[Tuple[int, ...],
+                                                     _Node]:
+        return parent.kids if parent is not None else self._root
+
     # -- lookup --------------------------------------------------------------
     def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
         """Longest resident prefix of `tokens`: (block ids, matched token
@@ -101,7 +113,7 @@ class PrefixIndex:
         self._tick += 1
         while matched + bs <= len(toks):
             chunk = tuple(toks[matched:matched + bs])
-            node = self._nodes.get(self._key(parent, chunk))
+            node = self._kids(parent).get(chunk)
             if node is None:
                 break
             node.tick = self._tick
@@ -111,11 +123,11 @@ class PrefixIndex:
         tail = len(toks) - matched
         if 0 < tail < bs:
             # one cached child whose tokens START with the tail gives a
-            # partial alias; scan this parent's children (their keys all
-            # carry id(parent))
-            pid = id(parent) if parent is not None else None
-            for (kpid, ktoks), node in self._nodes.items():
-                if kpid == pid and ktoks[:tail] == tuple(toks[matched:]):
+            # partial alias; only this parent's DIRECT children are
+            # candidates, so the probe scans just them
+            want = tuple(toks[matched:])
+            for ktoks, node in self._kids(parent).items():
+                if ktoks[:tail] == want:
                     node.tick = self._tick
                     blocks.append(node.block)
                     matched = len(toks)
@@ -142,15 +154,14 @@ class PrefixIndex:
         added = 0
         for i in range(len(toks) // bs):
             chunk = tuple(toks[i * bs:(i + 1) * bs])
-            key = self._key(parent, chunk)
-            node = self._nodes.get(key)
+            node = self._kids(parent).get(chunk)
             if node is None:
                 block = int(blocks[i])
                 self.pool.share([block])
+                key = self._key(parent, chunk)
                 node = _Node(key, parent, block, chunk)
                 self._nodes[key] = node
-                if parent is not None:
-                    parent.children += 1
+                self._kids(parent)[chunk] = node
                 added += 1
             node.tick = self._tick
             parent = node
@@ -165,13 +176,12 @@ class PrefixIndex:
         dropped = 0
         while dropped < n:
             leaves = [node for node in self._nodes.values()
-                      if node.children == 0]
+                      if not node.kids]
             if not leaves:
                 break
             victim = min(leaves, key=lambda nd: nd.tick)
             del self._nodes[victim.key]
-            if victim.parent is not None:
-                victim.parent.children -= 1
+            del self._kids(victim.parent)[victim.tokens]
             self.pool.free([victim.block])
             self.released += 1
             dropped += 1

@@ -428,62 +428,84 @@ class DecodeScheduler:
         for seq in order:
             if len(self._running) >= self.model.slots:
                 break
-            tokens = seq.tokens_so_far
-            shared: List[int] = []
-            matched = 0
-            if self.index is not None:
-                shared, matched = self.index.match(tokens)
-            need = self.pool.blocks_for_tokens(len(tokens)) - len(shared)
-            if not self.pool.can_alloc(need) and \
-                    not self._evict_for(seq, need, allow_peers=False):
-                continue   # stays waiting; capacity frees as others end
-            self._waiting.remove(seq)
-            if seq.evictions:
-                self.metrics.on_resumed()
-                obs_trace.instant("resume", cat="decode", parent=seq.ctx,
-                                  model=self.name, sid=seq.sid)
-            if shared:
-                # alias the resident prefix: take a reference per block,
-                # write NOTHING below `matched` — those rows are, byte
-                # for byte, what this prompt's prefill would write
-                self.pool.share(shared)
-                self.metrics.on_prefix_hit(matched, len(shared))
-                obs_trace.instant("prefix_hit", cat="decode",
-                                  parent=seq.ctx, model=self.name,
-                                  sid=seq.sid, tokens=matched)
-            seq.blocks = shared + (self.pool.alloc(need) if need else [])
-            t0 = time.monotonic()
             try:
-                last_logits, kv_rows = self.model.prefill(tokens)
-                self.model.seed_sequence(seq.blocks, kv_rows,
-                                         skip_rows=matched)
-            except Exception as e:  # noqa: BLE001 — typed + delivered
+                self._admit_one(seq)
+            except Exception as e:  # noqa: BLE001 — one bad sequence
+                # must never kill the scheduler thread: fail IT typed
+                # (its blocks free in _terminate) and keep admitting
+                if seq in self._waiting:
+                    self._waiting.remove(seq)
                 self._terminate(seq, error=e if isinstance(
                     e, (Overloaded, DeadlineExceeded)) else
                     _request_failed(self.name, e))
-                continue
-            dt = time.monotonic() - t0
-            self.metrics.on_prefill(len(tokens), dt)
-            obs_trace.complete("prefill", dt, cat="decode",
-                               parent=seq.ctx, model=self.name,
-                               sid=seq.sid, tokens=len(tokens))
-            seq.cached_len = len(tokens)
-            if self.index is not None:
-                # register this sequence's full prompt blocks (decode
-                # writes land strictly past the prompt, so they stay
-                # immutable while indexed)
-                self.index.insert(tokens, seq.blocks)
-            tok = int(np.argmax(last_logits))
-            seq.generated.append(tok)
-            seq.handle._put_token(tok)
-            reason = self._finish_reason(seq, tok)
-            if reason is not None:
-                self._finish(seq, reason)
-                continue
-            free_slots = [i for i in range(self.model.slots)
-                          if all(r.slot != i for r in self._running)]
-            seq.slot = free_slots[0]
-            self._running.append(seq)
+
+    def _admit_one(self, seq: Sequence) -> None:
+        tokens = seq.tokens_so_far
+        shared: List[int] = []
+        matched = 0
+        if self.index is not None:
+            shared, matched = self.index.match(tokens)
+        if shared:
+            # alias the resident prefix: take OUR reference per block AT
+            # MATCH TIME — under pressure _evict_for drops index
+            # references (release_lru), possibly on these very blocks,
+            # and only this pin keeps them (and the `need` arithmetic
+            # below) live until admission resolves
+            self.pool.share(shared)
+            seq.blocks = list(shared)
+        need = self.pool.blocks_for_tokens(len(tokens)) - len(shared)
+        if not self.pool.can_alloc(need) and \
+                not self._evict_for(seq, need, allow_peers=False):
+            if shared:
+                self.pool.free(shared)   # unpin the aliased prefix
+                seq.blocks = []
+            return   # stays waiting; capacity frees as others end
+        self._waiting.remove(seq)
+        if seq.evictions:
+            self.metrics.on_resumed()
+            obs_trace.instant("resume", cat="decode", parent=seq.ctx,
+                              model=self.name, sid=seq.sid)
+        if shared:
+            # write NOTHING below `matched` — those rows are, byte for
+            # byte, what this prompt's prefill would write
+            self.metrics.on_prefix_hit(matched, len(shared))
+            obs_trace.instant("prefix_hit", cat="decode",
+                              parent=seq.ctx, model=self.name,
+                              sid=seq.sid, tokens=matched)
+        if need:
+            seq.blocks = seq.blocks + self.pool.alloc(need)
+        t0 = time.monotonic()
+        try:
+            last_logits, kv_rows = self.model.prefill(tokens)
+            self.model.seed_sequence(seq.blocks, kv_rows,
+                                     skip_rows=matched)
+        except Exception as e:  # noqa: BLE001 — typed + delivered
+            self._terminate(seq, error=e if isinstance(
+                e, (Overloaded, DeadlineExceeded)) else
+                _request_failed(self.name, e))
+            return
+        dt = time.monotonic() - t0
+        self.metrics.on_prefill(len(tokens), dt)
+        obs_trace.complete("prefill", dt, cat="decode",
+                           parent=seq.ctx, model=self.name,
+                           sid=seq.sid, tokens=len(tokens))
+        seq.cached_len = len(tokens)
+        if self.index is not None:
+            # register this sequence's full prompt blocks (decode
+            # writes land strictly past the prompt, so they stay
+            # immutable while indexed)
+            self.index.insert(tokens, seq.blocks)
+        tok = int(np.argmax(last_logits))
+        seq.generated.append(tok)
+        seq.handle._put_token(tok)
+        reason = self._finish_reason(seq, tok)
+        if reason is not None:
+            self._finish(seq, reason)
+            return
+        free_slots = [i for i in range(self.model.slots)
+                      if all(r.slot != i for r in self._running)]
+        seq.slot = free_slots[0]
+        self._running.append(seq)
 
     # -- copy-on-write -------------------------------------------------------
     def _cow_for_write(self, seq: Sequence) -> bool:

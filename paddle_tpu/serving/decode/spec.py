@@ -28,12 +28,17 @@ Drafters (PT_SPEC_DRAFT):
                 n-gram earlier in the context. Zero extra model, wins
                 on repetitive text (code, structured output).
     self        the target bundle's own prefill buckets re-predict the
-                next k tokens greedily (k short prefills per step).
-                Acceptance is 100% by construction — the deterministic
-                upper bound the identity tests pin.
+                next k tokens greedily. Acceptance is 100% by
+                construction — the deterministic upper bound the
+                identity tests pin. A CORRECTNESS/TESTING harness, not
+                a throughput win: each proposal runs k sequential
+                full-context prefills on the scheduler thread, each
+                costing more than the decode step being accelerated,
+                and every peer's token cadence stalls while it drafts.
     <dir>       a separate (smaller) decode bundle loaded through the
                 registry's ModelVersion machinery; its prefill side
-                drafts greedily. The classic small-drafter setup.
+                drafts greedily. The classic small-drafter setup — use
+                this (or ngram) in production.
 
 A drafter that crashes mid-step (chaos site `spec_verify`) degrades to
 plain decode for that step — never kills the session.
@@ -76,10 +81,13 @@ class NGramDrafter:
 
 class PrefillDrafter:
     """Greedy drafting through a prefill-capable model: k sequential
-    next-token predictions, each one short prefill. `model` needs
-    prefill(tokens) -> (last_logits, kv_rows) and max_prompt_len —
-    DecodeModel satisfies it, so `self` drafting reuses the target
-    bundle and a drafter DIR loads its own (smaller) bundle."""
+    next-token predictions, each one full-context prefill ON THE
+    SCHEDULER THREAD. `model` needs prefill(tokens) ->
+    (last_logits, kv_rows) and max_prompt_len — DecodeModel satisfies
+    it, so `self` drafting reuses the target bundle (the deterministic
+    100%-acceptance harness for identity tests; its drafting costs more
+    than the steps it saves, so it is NOT a production speedup) and a
+    drafter DIR loads its own smaller bundle, which is."""
 
     def __init__(self, model, name: str = "prefill"):
         self.model = model

@@ -21,6 +21,8 @@ Test planes:
     kv-economics bench row's validator refuses impossible readings.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -197,6 +199,26 @@ class TestPrefixIndex:
         assert idx.blocks_indexed == 0
         assert [pool.refcount(b) for b in blocks] == [1, 1, 1]
 
+    def test_partial_tail_probes_siblings_under_one_parent(self):
+        """Two cached chains forking after block 1: the tail probe must
+        pick the sibling whose tokens start with the prompt tail (and
+        only ever scan that parent's direct children)."""
+        pool = KVBlockPool(32, 4)
+        idx = PrefixIndex(pool)
+        a = pool.alloc(2)
+        b = pool.alloc(2)
+        idx.insert([1, 2, 3, 4, 5, 6, 7, 8], a)
+        idx.insert([1, 2, 3, 4, 9, 10, 11, 12], b)
+        # block 1 is shared between the chains; only the divergent
+        # second block of each was newly indexed
+        got, matched = idx.match([1, 2, 3, 4, 9, 10])
+        assert (got, matched) == ([a[0], b[1]], 6)
+        got, matched = idx.match([1, 2, 3, 4, 5, 6])
+        assert (got, matched) == ([a[0], a[1]], 6)
+        # a tail matching NO sibling aliases nothing past the fork
+        got, matched = idx.match([1, 2, 3, 4, 7, 7])
+        assert (got, matched) == ([a[0]], 4)
+
     def test_remap_follows_defrag(self):
         pool = KVBlockPool(16, 4)
         parked = pool.alloc(2)
@@ -340,6 +362,76 @@ def test_cow_under_pool_exhaustion_degrades_never_corrupts(
                 reference_decode(p, 10)
         snap = eng.metrics_snapshot()
         assert snap["kv_blocks_in_use"] <= 8
+    finally:
+        eng.shutdown()
+
+
+def test_admission_pins_matched_blocks_against_lru_release(
+        bundle_dir, reference_decode):
+    """Ordering regression: a big admission that matches a resident
+    prefix AND needs eviction. _evict_for releases index references
+    LRU-first — including, once everything else is drained, the very
+    blocks the admission just matched. The admission's own pool
+    references (taken at match time) must keep those blocks live;
+    taking them only after eviction used to let the pool reclaim them
+    and pool.share() then killed the scheduler thread."""
+    cap = 19                              # pool_blocks=20
+    base = _prompt(43, 2 * BLOCK)         # the donor prefix: 2 blocks
+    low = _prompt(47, 3 * BLOCK)          # the low-priority victim
+    eng = DecodeEngine(bundle_dir, name="lm", kv_share=True,
+                       pool_blocks=cap + 1)
+    try:
+        a = eng.generate(base, max_new_tokens=2)
+        v = eng.generate(low, max_new_tokens=48, priority=-1)
+        assert a.result(timeout=120)["tokens"] == \
+            reference_decode(base, 2)
+        # the victim must be RUNNING (holding blocks) before the big
+        # admission arrives
+        deadline = time.monotonic() + 60
+        while eng.metrics_snapshot()["prefills"] < 2:
+            assert time.monotonic() < deadline, "victim never admitted"
+            time.sleep(0.01)
+        # 73 tokens = 19 blocks = the whole pool: admission matches the
+        # donor's 2 blocks, must evict the victim for the other 17, and
+        # along the way release_lru drains the index — donor chain
+        # included
+        big = base + _prompt(45, 73 - 2 * BLOCK)
+        r = eng.generate(big, max_new_tokens=3).result(timeout=300)
+        assert r["tokens"] == reference_decode(big, 3)
+        # the victim was preempted, resumed, and stayed token-identical
+        assert v.result(timeout=300)["tokens"] == \
+            reference_decode(low, 48)
+        snap = eng.metrics_snapshot()
+        assert snap["evictions"] >= 1, \
+            "the scenario must actually exercise the eviction path"
+        assert snap["kv_shared_hits"] >= 1, \
+            "the pinned prefix must still be aliased after eviction"
+    finally:
+        eng.shutdown()
+
+
+def test_admission_failure_never_kills_scheduler(bundle_dir,
+                                                 reference_decode):
+    """One bad sequence fails typed; the scheduler thread survives and
+    keeps serving everyone else."""
+    from paddle_tpu.serving.admission import RequestFailed
+
+    p = _prompt(53, 6)
+    eng = DecodeEngine(bundle_dir, name="lm", kv_share=True)
+    try:
+        real, state = eng.index.match, {"armed": True}
+
+        def boom(tokens):
+            if state["armed"]:
+                state["armed"] = False
+                raise RuntimeError("index corrupted")
+            return real(tokens)
+
+        eng.index.match = boom
+        with pytest.raises(RequestFailed):
+            eng.generate(p, max_new_tokens=4).result(timeout=60)
+        r = eng.generate(p, max_new_tokens=4).result(timeout=60)
+        assert r["tokens"] == reference_decode(p, 4)
     finally:
         eng.shutdown()
 
